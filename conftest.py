@@ -1,0 +1,19 @@
+# Root conftest: force a deterministic 8-device CPU platform for the whole
+# test suite BEFORE jax is imported anywhere (SURVEY.md §5: multi-device
+# without a cluster via xla_force_host_platform_device_count).
+#
+# NOTE: this environment exports JAX_PLATFORMS=axon (one real TPU chip via a
+# loopback tunnel) and a sitecustomize.py that registers the axon PJRT plugin
+# in every interpreter.  Tests must NOT land on that single chip: we hard
+# override the platform here (setdefault is not enough), which is honored
+# because jax backends initialize lazily at first use — after this file runs.
+# Only ever run ONE jax process at a time in this container: the tunnel
+# serializes clients and concurrent processes deadlock.
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,27 @@
+"""stark_tpu — TPU-native distributed Bayesian inference (MCMC).
+
+A from-scratch JAX/XLA framework with the capabilities of the reference
+`randommm/stark` (Spark-based parallel-chain HMC/NUTS with a
+StarkModel/SamplerBackend plugin boundary — see SURVEY.md; the reference
+tree itself was unavailable, SURVEY.md §0): models declare a log-prior and a
+per-row log-likelihood; the framework runs parallel-chain NUTS/HMC/SG-HMC/
+tempered sampling with data sharded across a device mesh and likelihood
+terms + R-hat/ESS sufficient statistics allreduced over ICI.
+"""
+
+from . import bijectors, diagnostics
+from .model import Model, ParamSpec, flatten_model
+from .sampler import Posterior, SamplerConfig, sample
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Model",
+    "ParamSpec",
+    "flatten_model",
+    "sample",
+    "Posterior",
+    "SamplerConfig",
+    "bijectors",
+    "diagnostics",
+]

@@ -1,0 +1,182 @@
+"""Warmup adaptation: dual-averaging step size + diagonal mass via Welford.
+
+Windowed schedule follows the Stan three-phase layout (fast initial buffer,
+doubling slow windows for the metric, fast terminal buffer), precomputed on
+the host as flag arrays and fed to ``lax.scan`` as xs so the whole warmup is
+one compiled loop with no host round-trips (SURVEY.md §4 target stack).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+# --------------------------------------------------------------------------
+# Dual averaging (Nesterov primal-dual, Hoffman & Gelman 2014 defaults)
+# --------------------------------------------------------------------------
+
+
+class DualAveragingState(NamedTuple):
+    log_step: Array
+    log_avg_step: Array
+    h_avg: Array  # running average of (target - accept_prob)
+    mu: Array
+    count: Array
+
+
+def da_init(step_size: Array) -> DualAveragingState:
+    log_step = jnp.log(step_size)
+    return DualAveragingState(
+        log_step=log_step,
+        log_avg_step=log_step,
+        h_avg=jnp.zeros_like(log_step),
+        mu=jnp.log(10.0) + log_step,
+        count=jnp.zeros((), jnp.int32),
+    )
+
+
+def da_update(
+    state: DualAveragingState,
+    accept_prob: Array,
+    target_accept: float = 0.8,
+    t0: float = 10.0,
+    gamma: float = 0.05,
+    kappa: float = 0.75,
+) -> DualAveragingState:
+    count = state.count + 1
+    t = count.astype(accept_prob.dtype)
+    w = 1.0 / (t + t0)
+    h_avg = (1.0 - w) * state.h_avg + w * (target_accept - accept_prob)
+    log_step = state.mu - (jnp.sqrt(t) / gamma) * h_avg
+    eta = t ** (-kappa)
+    log_avg_step = eta * log_step + (1.0 - eta) * state.log_avg_step
+    return DualAveragingState(log_step, log_avg_step, h_avg, state.mu, count)
+
+
+# --------------------------------------------------------------------------
+# Welford accumulator for the diagonal metric
+# --------------------------------------------------------------------------
+
+
+class WelfordState(NamedTuple):
+    count: Array
+    mean: Array
+    m2: Array
+
+
+def welford_init(d: int, dtype=jnp.float32) -> WelfordState:
+    return WelfordState(
+        count=jnp.zeros((), jnp.int32),
+        mean=jnp.zeros((d,), dtype),
+        m2=jnp.zeros((d,), dtype),
+    )
+
+
+def welford_update(state: WelfordState, x: Array) -> WelfordState:
+    count = state.count + 1
+    delta = x - state.mean
+    mean = state.mean + delta / count.astype(x.dtype)
+    m2 = state.m2 + delta * (x - mean)
+    return WelfordState(count, mean, m2)
+
+
+def welford_variance(state: WelfordState, regularize: bool = True) -> Array:
+    n = jnp.maximum(state.count, 2).astype(state.m2.dtype)
+    var = state.m2 / (n - 1.0)
+    if regularize:
+        # Stan's shrinkage toward unit metric
+        var = (n / (n + 5.0)) * var + 1e-3 * (5.0 / (n + 5.0))
+    return var
+
+
+# --------------------------------------------------------------------------
+# Warmup schedule (host-side, static)
+# --------------------------------------------------------------------------
+
+
+class WarmupSchedule(NamedTuple):
+    """Per-step boolean flags, each shape (num_warmup,)."""
+
+    adapt_mass: np.ndarray  # accumulate Welford this step
+    window_end: np.ndarray  # last step of a slow window: refresh metric, reset DA
+
+
+def build_warmup_schedule(
+    num_warmup: int,
+    init_buffer: int = 75,
+    term_buffer: int = 50,
+    base_window: int = 25,
+) -> WarmupSchedule:
+    adapt_mass = np.zeros(num_warmup, bool)
+    window_end = np.zeros(num_warmup, bool)
+    if num_warmup < 20:
+        return WarmupSchedule(adapt_mass, window_end)
+    if num_warmup < init_buffer + term_buffer + base_window:
+        init_buffer = int(0.15 * num_warmup)
+        term_buffer = int(0.10 * num_warmup)
+        base_window = num_warmup - init_buffer - term_buffer
+    start = init_buffer
+    end_of_slow = num_warmup - term_buffer
+    w = base_window
+    while start < end_of_slow:
+        stop = start + w
+        # expand the final window to absorb the remainder
+        if stop + 2 * w > end_of_slow:
+            stop = end_of_slow
+        stop = min(stop, end_of_slow)
+        adapt_mass[start:stop] = True
+        window_end[stop - 1] = True
+        start = stop
+        w *= 2
+    return WarmupSchedule(adapt_mass, window_end)
+
+
+# --------------------------------------------------------------------------
+# Reasonable initial step size (Hoffman & Gelman Alg. 4)
+# --------------------------------------------------------------------------
+
+
+def find_reasonable_step_size(
+    potential_fn,
+    z: Array,
+    pe: Array,
+    grad: Array,
+    inv_mass_diag: Array,
+    key: Array,
+    init_step_size: float = 1.0,
+) -> Array:
+    from .kernels.base import kinetic_energy, leapfrog_step, sample_momentum
+
+    r0 = sample_momentum(key, inv_mass_diag)
+    energy0 = pe + kinetic_energy(r0, inv_mass_diag)
+
+    def accept_logprob(step_size):
+        _, r, _, pe1 = leapfrog_step(potential_fn, z, r0, grad, step_size, inv_mass_diag)
+        energy1 = pe1 + kinetic_energy(r, inv_mass_diag)
+        delta = energy0 - energy1
+        return jnp.where(jnp.isnan(delta), -jnp.inf, delta)
+
+    log2 = jnp.log(2.0)
+    lp0 = accept_logprob(jnp.asarray(init_step_size))
+    direction = jnp.where(lp0 > -log2, 1.0, -1.0)
+
+    def cond(carry):
+        step_size, count = carry
+        lp = accept_logprob(step_size)
+        keep = jnp.where(direction > 0, lp > -log2, lp <= -log2)
+        return keep & (count < 64)
+
+    def body(carry):
+        step_size, count = carry
+        return step_size * jnp.exp(direction * log2), count + 1
+
+    step_size, _ = jax.lax.while_loop(
+        cond, body, (jnp.asarray(init_step_size), jnp.zeros((), jnp.int32))
+    )
+    return step_size

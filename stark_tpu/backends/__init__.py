@@ -1,0 +1,4 @@
+from .base import SamplerBackend
+from .jax_backend import JaxBackend
+
+__all__ = ["SamplerBackend", "JaxBackend"]

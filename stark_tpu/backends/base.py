@@ -1,0 +1,34 @@
+"""`SamplerBackend` — the pluggable execution-backend boundary.
+
+Mirrors the reference's `StarkModel` / `SamplerBackend` plugin split
+(BASELINE.json:5, SURVEY.md §2 layer D): models and sampler algorithms are
+defined once; *where and how* the logp/grad + kernel loop executes is a
+backend decision.  Provided backends:
+
+* ``JaxBackend``      — jit + vmap chains on one device (TPU or CPU).
+* ``ShardedBackend``  — shard_map over a ``jax.sharding.Mesh``; data sharded
+                        over a "data" axis with psum'd likelihoods, chains
+                        over a "chains" axis (SURVEY.md §4 target stack).
+* ``CpuBackend``      — pure NumPy reference implementation; the measured
+                        baseline denominator (SURVEY.md §8 step 5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class SamplerBackend(Protocol):
+    def run(
+        self,
+        model,
+        data,
+        cfg,
+        *,
+        chains: int,
+        seed: int,
+        init_params: Optional[Dict[str, Any]] = None,
+    ):
+        """Run ``chains`` MCMC chains of ``model`` on ``data``; return Posterior."""
+        ...

@@ -1,0 +1,80 @@
+"""Single-process JAX backend: jit + vmap over chains on one device.
+
+Chain state stays resident in device memory (HBM on TPU) for the entire
+warmup+sample loop; the host sees only the finished draw block — the
+TPU-native replacement for the reference's per-step driver round-trip
+(BASELINE.json:5).
+
+The jitted runner is cached per (model, config) on the backend instance, and
+takes the data pytree as a runtime argument, so repeated ``sample()`` calls
+(multi-seed replications, benchmark sweeps) hit the XLA trace cache instead
+of recompiling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..model import Model, flatten_model
+from ..sampler import Posterior, SamplerConfig, _constrain_draws, make_chain_runner
+
+
+class JaxBackend:
+    def __init__(self, device: Optional[Any] = None):
+        self.device = device
+        self._cache: Dict[Tuple[int, SamplerConfig], Any] = {}
+
+    def _get_runner(self, model: Model, fm, cfg: SamplerConfig):
+        key = (id(model), cfg)
+        if key not in self._cache:
+            runner = make_chain_runner(fm.potential, cfg)
+            self._cache[key] = jax.jit(jax.vmap(runner, in_axes=(0, 0, None)))
+        return self._cache[key]
+
+    def run(
+        self,
+        model: Model,
+        data,
+        cfg: SamplerConfig,
+        *,
+        chains: int,
+        seed: int,
+        init_params: Optional[Dict[str, Any]] = None,
+    ) -> Posterior:
+        fm = flatten_model(model)
+        if data is not None:
+            data = jax.tree.map(jnp.asarray, data)
+
+        key = jax.random.PRNGKey(seed)
+        key_init, key_run = jax.random.split(key)
+        if init_params is not None:
+            z0 = jnp.broadcast_to(fm.unconstrain(init_params), (chains, fm.ndim))
+        else:
+            z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
+        chain_keys = jax.random.split(key_run, chains)
+
+        run = self._get_runner(model, fm, cfg)
+        if self.device is not None:
+            z0 = jax.device_put(z0, self.device)
+            chain_keys = jax.device_put(chain_keys, self.device)
+        res = run(chain_keys, z0, data)
+        res = jax.block_until_ready(res)
+
+        draws = _constrain_draws(fm, res.draws)
+        stats = {
+            "accept_prob": np.asarray(res.accept_prob),
+            "is_divergent": np.asarray(res.is_divergent),
+            "energy": np.asarray(res.energy),
+            "num_grad_evals": np.asarray(res.num_grad_evals),
+            "step_size": np.asarray(res.step_size),
+            "inv_mass_diag": np.asarray(res.inv_mass_diag),
+            "num_warmup_divergent": np.asarray(res.num_warmup_divergent),
+            "num_divergent": np.asarray(res.num_divergent),
+        }
+        return Posterior(
+            draws, stats, flat_model=fm, draws_flat=np.asarray(res.draws)
+        )

@@ -1,0 +1,192 @@
+"""Constraining bijectors (unconstrained R^k -> constrained support).
+
+Each bijector maps an unconstrained array to a constrained one and reports
+the summed forward log-det-Jacobian so samplers can run in unconstrained
+space (SURVEY.md §3, "Reparameterization" row).  All ops are elementwise /
+cumulative and fuse cleanly under XLA; shapes are static.
+
+Conventions:
+  forward(x):  unconstrained -> constrained
+  inverse(y):  constrained  -> unconstrained
+  fldj(x):     sum over the event of log|det d forward / dx|
+  unconstrained_shape(shape): event shape in unconstrained space
+
+Bijectors that change the event size (simplex, zero-sum) document it via
+``unconstrained_shape``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class Bijector:
+    def forward(self, x: Array) -> Array:
+        raise NotImplementedError
+
+    def inverse(self, y: Array) -> Array:
+        raise NotImplementedError
+
+    def fldj(self, x: Array) -> Array:
+        raise NotImplementedError
+
+    def unconstrained_shape(self, shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        return shape
+
+
+class Identity(Bijector):
+    def forward(self, x):
+        return x
+
+    def inverse(self, y):
+        return y
+
+    def fldj(self, x):
+        return jnp.zeros(())
+
+
+class Exp(Bijector):
+    """Positive reals via y = exp(x)."""
+
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def fldj(self, x):
+        return jnp.sum(x)
+
+
+class Softplus(Bijector):
+    """Positive reals via y = log1p(exp(x)); better-conditioned far tails."""
+
+    def forward(self, x):
+        return jax.nn.softplus(x)
+
+    def inverse(self, y):
+        # x = log(exp(y) - 1) = y + log1p(-exp(-y))
+        return y + jnp.log(-jnp.expm1(-y))
+
+    def fldj(self, x):
+        return jnp.sum(jax.nn.log_sigmoid(x))
+
+
+class Interval(Bijector):
+    """(a, b) via y = a + (b-a) * sigmoid(x)."""
+
+    def __init__(self, low: float, high: float):
+        self.low = float(low)
+        self.high = float(high)
+
+    def forward(self, x):
+        return self.low + (self.high - self.low) * jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        u = (y - self.low) / (self.high - self.low)
+        return jnp.log(u) - jnp.log1p(-u)
+
+    def fldj(self, x):
+        w = jnp.log(self.high - self.low)
+        return jnp.sum(w + jax.nn.log_sigmoid(x) + jax.nn.log_sigmoid(-x))
+
+
+class Ordered(Bijector):
+    """Strictly increasing vectors over the last axis.
+
+    y[0] = x[0]; y[k] = y[k-1] + exp(x[k]).  Used to break label switching in
+    mixture models (benchmark config 4, BASELINE.json:10).
+    """
+
+    def forward(self, x):
+        first = x[..., :1]
+        rest = jnp.exp(x[..., 1:])
+        return jnp.concatenate([first, rest], axis=-1).cumsum(axis=-1)
+
+    def inverse(self, y):
+        first = y[..., :1]
+        rest = jnp.log(jnp.diff(y, axis=-1))
+        return jnp.concatenate([first, rest], axis=-1)
+
+    def fldj(self, x):
+        return jnp.sum(x[..., 1:])
+
+
+class StickBreaking(Bijector):
+    """K-simplex over the last axis from K-1 unconstrained coordinates.
+
+    Stan-style stick breaking with the log(K-1-k) offset so x = 0 maps to the
+    uniform simplex point.
+    """
+
+    def forward(self, x):
+        km1 = x.shape[-1]
+        offset = jnp.log(jnp.arange(km1, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        # remainder_k = prod_{j<k} (1 - z_j), computed in log space.
+        log1mz = jnp.log1p(-z)
+        log_rem = jnp.concatenate(
+            [jnp.zeros_like(log1mz[..., :1]), jnp.cumsum(log1mz, axis=-1)], axis=-1
+        )
+        y_head = z * jnp.exp(log_rem[..., :-1])
+        y_tail = jnp.exp(log_rem[..., -1:])
+        return jnp.concatenate([y_head, y_tail], axis=-1)
+
+    def inverse(self, y):
+        km1 = y.shape[-1] - 1
+        offset = jnp.log(jnp.arange(km1, 0, -1, dtype=y.dtype))
+        rem = 1.0 - jnp.concatenate(
+            [jnp.zeros_like(y[..., :1]), jnp.cumsum(y[..., :-2], axis=-1)], axis=-1
+        )
+        z = y[..., :-1] / rem
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def fldj(self, x):
+        km1 = x.shape[-1]
+        offset = jnp.log(jnp.arange(km1, 0, -1, dtype=x.dtype))
+        xs = x - offset
+        z = jax.nn.sigmoid(xs)
+        log1mz = jnp.log1p(-z)
+        log_rem = jnp.concatenate(
+            [jnp.zeros_like(log1mz[..., :1]), jnp.cumsum(log1mz[..., :-1], axis=-1)],
+            axis=-1,
+        )
+        # triangular Jacobian: det = prod_k z_k (1-z_k) remainder_k
+        return jnp.sum(jax.nn.log_sigmoid(xs) + jax.nn.log_sigmoid(-xs) + log_rem)
+
+    def unconstrained_shape(self, shape):
+        return shape[:-1] + (shape[-1] - 1,)
+
+
+class Chain(Bijector):
+    """Compose bijectors right-to-left: forward = b_last ∘ ... ∘ b_first."""
+
+    def __init__(self, *bijectors: Bijector):
+        self.bijectors = bijectors
+
+    def forward(self, x):
+        for b in self.bijectors:
+            x = b.forward(x)
+        return x
+
+    def inverse(self, y):
+        for b in reversed(self.bijectors):
+            y = b.inverse(y)
+        return y
+
+    def fldj(self, x):
+        total = jnp.zeros(())
+        for b in self.bijectors:
+            total = total + b.fldj(x)
+            x = b.forward(x)
+        return total
+
+    def unconstrained_shape(self, shape):
+        for b in reversed(self.bijectors):
+            shape = b.unconstrained_shape(shape)
+        return shape

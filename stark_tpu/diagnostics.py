@@ -1,0 +1,129 @@
+"""Convergence diagnostics: split R-hat and ESS (SURVEY.md §3 "Diagnostics").
+
+Two forms, matching the reference capability (BASELINE.json:2,5 — "R-hat/ESS
+convergence diagnostics from sufficient statistics"):
+
+* post-hoc, from collected draws (host-side numpy, float64): ``split_rhat``
+  and ``ess`` (Geyer initial-monotone-sequence estimator via FFT), used for
+  reported results and tests;
+* streaming, from per-chain Welford sufficient statistics ``(count, mean,
+  M2)`` accumulated inside the device scan: ``rhat_from_suffstats`` — this is
+  what the adaptive runner uses to stop at R-hat < 1.01 without hauling draws
+  to the host, allreduced over the chain mesh axis on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _split_chains(x: np.ndarray) -> np.ndarray:
+    """(chains, draws, ...) -> (2*chains, draws//2, ...)."""
+    c, n = x.shape[0], x.shape[1]
+    half = n // 2
+    x = x[:, : 2 * half]
+    return x.reshape(c, 2, half, *x.shape[2:]).reshape(c * 2, half, *x.shape[2:])
+
+
+def split_rhat(x) -> np.ndarray:
+    """Split-R-hat over (chains, draws, *event). Returns (*event,)."""
+    x = np.asarray(x, np.float64)
+    x = _split_chains(x)
+    m, n = x.shape[0], x.shape[1]
+    chain_mean = x.mean(axis=1)
+    chain_var = x.var(axis=1, ddof=1)
+    between = n * chain_mean.var(axis=0, ddof=1)
+    within = chain_var.mean(axis=0)
+    var_plus = (n - 1) / n * within + between / n
+    with np.errstate(divide="ignore", invalid="ignore"):
+        rhat = np.sqrt(var_plus / within)
+    return rhat
+
+
+def _autocov_fft(x: np.ndarray) -> np.ndarray:
+    """Autocovariance along axis 1 for (chains, draws, ...)."""
+    n = x.shape[1]
+    x = x - x.mean(axis=1, keepdims=True)
+    size = 2 ** int(np.ceil(np.log2(2 * n)))
+    f = np.fft.rfft(x, size, axis=1)
+    acov = np.fft.irfft(f * np.conj(f), size, axis=1)[:, :n]
+    return acov / n
+
+
+def ess(x) -> np.ndarray:
+    """Effective sample size over (chains, draws, *event), Geyer-truncated.
+
+    Plain (mean-estimand) ESS on split chains; returns (*event,).
+    """
+    x = np.asarray(x, np.float64)
+    x = _split_chains(x)
+    m, n = x.shape[0], x.shape[1]
+    acov = _autocov_fft(x)  # (m, n, ...)
+    chain_var = acov[:, 0] * n / (n - 1.0)
+    mean_var = chain_var.mean(axis=0)
+    var_plus = mean_var * (n - 1.0) / n
+    if m > 1:
+        var_plus = var_plus + x.mean(axis=1).var(axis=0, ddof=1)
+
+    rho = 1.0 - (mean_var - acov.mean(axis=0)) / var_plus  # (n, ...)
+    rho[0] = 1.0
+    # Geyer initial positive + monotone sequence over pairs
+    # Gamma_t = rho[2t] + rho[2t+1], t = 0, 1, ...; tau = -1 + 2 * sum Gamma_t
+    max_pairs = n // 2
+    event_shape = rho.shape[1:]
+    rho_flat = rho.reshape(n, -1)
+    tau_flat = np.ones(rho_flat.shape[1])
+    for j in range(rho_flat.shape[1]):
+        pair_sums = []
+        for t in range(max_pairs):
+            s = rho_flat[2 * t, j] + rho_flat[2 * t + 1, j]
+            if s < 0:
+                break
+            pair_sums.append(s)
+        # initial monotone sequence
+        for t in range(1, len(pair_sums)):
+            pair_sums[t] = min(pair_sums[t], pair_sums[t - 1])
+        tau_flat[j] = -1.0 + 2.0 * sum(pair_sums)
+        tau_flat[j] = max(tau_flat[j], 1.0 / np.log10(m * n + 10.0))
+    tau = tau_flat.reshape(event_shape) if event_shape else tau_flat[0]
+    return m * n / tau
+
+
+def rhat_from_suffstats(count, mean, m2) -> jnp.ndarray:
+    """R-hat from per-chain Welford stats; shapes (chains, ...) -> (...).
+
+    jnp so it can run on device (inside jit / psum'd across a chain axis).
+    Uses the non-split form — chains are assumed independently initialized,
+    and the streaming path is only used for early stopping, with the final
+    reported R-hat always recomputed split from draws.
+    """
+    n = count.astype(mean.dtype)
+    if n.ndim < mean.ndim:
+        n = n.reshape(n.shape + (1,) * (mean.ndim - n.ndim))
+    chain_var = m2 / (n - 1.0)
+    within = chain_var.mean(axis=0)
+    between = n.mean(axis=0) * jnp.var(mean, axis=0, ddof=1)
+    n_mean = n.mean(axis=0)
+    var_plus = (n_mean - 1.0) / n_mean * within + between / n_mean
+    return jnp.sqrt(var_plus / within)
+
+
+def summarize(draws: Dict[str, np.ndarray]) -> Dict[str, Dict[str, np.ndarray]]:
+    """Per-parameter posterior summary: mean, sd, 5%/50%/95%, rhat, ess."""
+    out = {}
+    for name, x in draws.items():
+        x = np.asarray(x)
+        flat = x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+        out[name] = {
+            "mean": flat.mean(axis=0),
+            "sd": flat.std(axis=0, ddof=1),
+            "q5": np.quantile(flat, 0.05, axis=0),
+            "median": np.quantile(flat, 0.5, axis=0),
+            "q95": np.quantile(flat, 0.95, axis=0),
+            "rhat": split_rhat(x),
+            "ess": ess(x),
+        }
+    return out

@@ -1,0 +1,5 @@
+from .base import HMCInfo, HMCState, init_state
+from .hmc import hmc_step
+from .nuts import nuts_step
+
+__all__ = ["HMCState", "HMCInfo", "init_state", "hmc_step", "nuts_step"]

@@ -1,0 +1,83 @@
+"""Kernel state/info containers shared by HMC-family kernels.
+
+Every kernel is a pure function ``(key, state, params...) -> (state, info)``
+composable under ``jax.lax.scan`` (SURVEY.md §8 step 2).  State lives on a
+flat unconstrained vector; kinetic energy uses a diagonal inverse mass matrix
+(vector) throughout — dense mass is a documented non-goal for v1.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+PotentialFn = Callable[[Array], Array]
+
+
+class HMCState(NamedTuple):
+    z: Array  # flat unconstrained position, shape (d,)
+    potential_energy: Array  # scalar
+    grad: Array  # shape (d,)
+
+
+class HMCInfo(NamedTuple):
+    accept_prob: Array  # mean MH accept prob (dual-averaging signal)
+    is_accepted: Array
+    is_divergent: Array
+    energy: Array  # H at the accepted state
+    num_grad_evals: Array
+
+
+def init_state(potential_fn: PotentialFn, z: Array) -> HMCState:
+    pe, grad = jax.value_and_grad(potential_fn)(z)
+    return HMCState(z=z, potential_energy=pe, grad=grad)
+
+
+def kinetic_energy(r: Array, inv_mass_diag: Array) -> Array:
+    return 0.5 * jnp.sum(inv_mass_diag * r * r)
+
+
+def sample_momentum(key: Array, inv_mass_diag: Array) -> Array:
+    # r ~ N(0, M) with M = diag(1/inv_mass_diag)
+    eps = jax.random.normal(key, inv_mass_diag.shape, inv_mass_diag.dtype)
+    return eps * jax.lax.rsqrt(inv_mass_diag)
+
+
+def leapfrog_step(
+    potential_fn: PotentialFn,
+    z: Array,
+    r: Array,
+    grad: Array,
+    step_size: Array,
+    inv_mass_diag: Array,
+):
+    """One velocity-Verlet step — THE integrator, shared by every kernel."""
+    r = r - 0.5 * step_size * grad
+    z = z + step_size * (inv_mass_diag * r)
+    pe, grad = jax.value_and_grad(potential_fn)(z)
+    r = r - 0.5 * step_size * grad
+    return z, r, grad, pe
+
+
+def leapfrog(
+    potential_fn: PotentialFn,
+    z: Array,
+    r: Array,
+    grad: Array,
+    step_size: Array,
+    inv_mass_diag: Array,
+    num_steps: int,
+):
+    """Velocity-Verlet integrator, ``num_steps`` full steps under lax.scan."""
+
+    def one_step(carry, _):
+        z, r, grad, _ = carry
+        z, r, grad, pe = leapfrog_step(potential_fn, z, r, grad, step_size, inv_mass_diag)
+        return (z, r, grad, pe), None
+
+    pe0 = jnp.zeros(())  # overwritten on first step
+    (z, r, grad, pe), _ = jax.lax.scan(one_step, (z, r, grad, pe0), None, length=num_steps)
+    return z, r, grad, pe

@@ -1,0 +1,62 @@
+"""Static-trajectory HMC with Metropolis correction (SURVEY.md §3 "HMC kernel").
+
+Trajectory length is in steps (static for jit); step size and diagonal inverse
+mass are runtime values so warmup adaptation can feed them in without
+recompiling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import (
+    HMCInfo,
+    HMCState,
+    PotentialFn,
+    kinetic_energy,
+    leapfrog,
+    sample_momentum,
+)
+
+Array = jax.Array
+
+_DIVERGENCE_THRESHOLD = 1000.0
+
+
+def hmc_step(
+    key: Array,
+    state: HMCState,
+    potential_fn: PotentialFn,
+    step_size: Array,
+    inv_mass_diag: Array,
+    num_leapfrog: int,
+):
+    key_mom, key_accept = jax.random.split(key)
+    r0 = sample_momentum(key_mom, inv_mass_diag)
+    energy0 = state.potential_energy + kinetic_energy(r0, inv_mass_diag)
+
+    z1, r1, grad1, pe1 = leapfrog(
+        potential_fn, state.z, r0, state.grad, step_size, inv_mass_diag, num_leapfrog
+    )
+    energy1 = pe1 + kinetic_energy(r1, inv_mass_diag)
+
+    delta = energy1 - energy0
+    delta = jnp.where(jnp.isnan(delta), jnp.inf, delta)
+    is_divergent = delta > _DIVERGENCE_THRESHOLD
+    accept_prob = jnp.minimum(1.0, jnp.exp(-delta))
+    accept = jax.random.uniform(key_accept, ()) < accept_prob
+
+    new_state = jax.tree.map(
+        lambda a, b: jnp.where(accept, a, b),
+        HMCState(z=z1, potential_energy=pe1, grad=grad1),
+        state,
+    )
+    info = HMCInfo(
+        accept_prob=accept_prob,
+        is_accepted=accept,
+        is_divergent=is_divergent,
+        energy=jnp.where(accept, energy1, energy0),
+        num_grad_evals=jnp.asarray(num_leapfrog, jnp.int32),
+    )
+    return new_state, info

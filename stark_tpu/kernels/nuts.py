@@ -1,0 +1,312 @@
+"""Iterative multinomial NUTS (dynamic HMC), jit/scan-compatible.
+
+Recursive tree doubling is rewritten as two nested ``lax.while_loop``s with a
+fixed ``max_tree_depth`` (SURVEY.md §8 step 2: "iterative NUTS — no recursion
+— required for jit/scan").  The within-subtree U-turn bookkeeping uses the
+O(max_depth) checkpoint-stack scheme from the iterative-NUTS literature
+(PAPERS.md: NumPyro paper — pattern only, implementation is original):
+
+* leaves of a depth-``D`` subtree are generated sequentially (one leapfrog
+  step each); leaf ``i`` (0-based) is a *left edge* of pending complete binary
+  subtrees iff ``i`` is even, and closes complete subtrees iff ``i`` is odd;
+* an even leaf ``i`` stores (its momentum, cumulative momentum sum including
+  it) in checkpoint slot ``popcount(i >> 1)``;
+* an odd leaf ``i`` closes ``t = trailing_ones(i)`` subtrees whose left-edge
+  checkpoints live in slots ``popcount(i >> 1) - t + 1 .. popcount(i >> 1)``;
+  for each, the subtree momentum sum is ``S_i - S_a + r_a`` and the
+  generalized (Betancourt) U-turn criterion is evaluated between the stored
+  left-edge momentum and the current momentum.
+
+Trajectory-level proposal selection is biased progressive sampling over
+subtree weights; within-subtree selection is uniform multinomial, with
+log-weights ``H0 - H(leaf)``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import (
+    HMCInfo,
+    HMCState,
+    PotentialFn,
+    kinetic_energy,
+    leapfrog_step,
+    sample_momentum,
+)
+
+Array = jax.Array
+
+_DIVERGENCE_THRESHOLD = 1000.0
+
+
+def _is_turning(inv_mass_diag, r_left, r_right, r_sum):
+    v_left = inv_mass_diag * r_left
+    v_right = inv_mass_diag * r_right
+    rho = r_sum - 0.5 * (r_left + r_right)
+    return (jnp.dot(v_left, rho) <= 0.0) | (jnp.dot(v_right, rho) <= 0.0)
+
+
+class _Subtree(NamedTuple):
+    z_far: Array  # last leaf generated (outermost edge of the subtree)
+    r_far: Array
+    grad_far: Array
+    z_prop: Array
+    pe_prop: Array
+    grad_prop: Array
+    energy_prop: Array  # full Hamiltonian at the proposal leaf
+    r_sum: Array  # sum of leaf momenta (subtree-internal)
+    log_weight: Array  # logsumexp of (H0 - H_leaf) over leaves
+    turning: Array
+    diverging: Array
+    sum_accept: Array
+    num_leaves: Array
+
+
+def _build_subtree(
+    key,
+    depth,
+    z0,
+    r0,
+    grad0,
+    potential_fn,
+    directed_step,
+    inv_mass_diag,
+    energy0,
+    max_depth,
+):
+    """Generate up to 2**depth leaves starting one leapfrog step past the
+    (z0, r0, grad0) edge, with in-flight U-turn checkpoint checks."""
+    d = z0.shape[0]
+    dtype = z0.dtype
+    num_target = jnp.left_shift(jnp.int32(1), depth.astype(jnp.int32))
+    slots = jnp.arange(max_depth, dtype=jnp.int32)
+
+    init = _Subtree(
+        z_far=z0,
+        r_far=r0,
+        grad_far=grad0,
+        z_prop=z0,
+        pe_prop=jnp.zeros((), dtype),
+        grad_prop=grad0,
+        energy_prop=energy0,
+        r_sum=jnp.zeros((d,), dtype),
+        log_weight=jnp.full((), -jnp.inf, dtype),
+        turning=jnp.asarray(False),
+        diverging=jnp.asarray(False),
+        sum_accept=jnp.zeros((), dtype),
+        num_leaves=jnp.zeros((), jnp.int32),
+    )
+    r_ckpts = jnp.zeros((max_depth, d), dtype)
+    s_ckpts = jnp.zeros((max_depth, d), dtype)
+
+    def cond(carry):
+        st, _, _, i, _ = carry
+        return (i < num_target) & ~st.turning & ~st.diverging
+
+    def body(carry):
+        st, r_ckpts, s_ckpts, i, key = carry
+        key, key_sel = jax.random.split(key)
+        z, r, grad, pe = leapfrog_step(
+            potential_fn, st.z_far, st.r_far, st.grad_far, directed_step, inv_mass_diag
+        )
+        energy = pe + kinetic_energy(r, inv_mass_diag)
+        delta = energy - energy0
+        delta = jnp.where(jnp.isnan(delta), jnp.inf, delta)
+        diverging = delta > _DIVERGENCE_THRESHOLD
+        log_w = -delta
+        accept_leaf = jnp.minimum(1.0, jnp.exp(-delta))
+
+        new_log_weight = jnp.logaddexp(st.log_weight, log_w)
+        take = jax.random.uniform(key_sel, ()) < jnp.exp(log_w - new_log_weight)
+        z_prop = jnp.where(take, z, st.z_prop)
+        pe_prop = jnp.where(take, pe, st.pe_prop)
+        grad_prop = jnp.where(take, grad, st.grad_prop)
+        energy_prop = jnp.where(take, energy, st.energy_prop)
+
+        r_sum = st.r_sum + r
+
+        # --- checkpoint bookkeeping -------------------------------------
+        idx_max = jax.lax.population_count(jnp.right_shift(i, 1)).astype(jnp.int32)
+        trailing_ones = (
+            jax.lax.population_count(jnp.bitwise_xor(i, i + 1)).astype(jnp.int32) - 1
+        )
+        idx_min = idx_max - trailing_ones + 1
+        is_even = (i % 2) == 0
+        r_ckpts = jnp.where(
+            is_even, r_ckpts.at[idx_max].set(r), r_ckpts
+        )
+        s_ckpts = jnp.where(
+            is_even, s_ckpts.at[idx_max].set(r_sum), s_ckpts
+        )
+        # closed-subtree U-turn checks (odd leaves only), vectorized + masked
+        sub_r_sums = r_sum[None, :] - s_ckpts + r_ckpts  # (max_depth, d)
+        v_l = r_ckpts * inv_mass_diag[None, :]
+        v_r = (r * inv_mass_diag)[None, :]
+        rho = sub_r_sums - 0.5 * (r_ckpts + r[None, :])
+        turn_each = (jnp.sum(v_l * rho, axis=-1) <= 0.0) | (
+            jnp.sum(v_r * rho, axis=-1) <= 0.0
+        )
+        mask = (slots >= idx_min) & (slots <= idx_max)
+        turning = (~is_even) & jnp.any(turn_each & mask)
+
+        st = _Subtree(
+            z_far=z,
+            r_far=r,
+            grad_far=grad,
+            z_prop=z_prop,
+            pe_prop=pe_prop,
+            grad_prop=grad_prop,
+            energy_prop=energy_prop,
+            r_sum=r_sum,
+            log_weight=new_log_weight,
+            turning=turning,
+            diverging=diverging,
+            sum_accept=st.sum_accept + accept_leaf,
+            num_leaves=st.num_leaves + 1,
+        )
+        return st, r_ckpts, s_ckpts, i + 1, key
+
+    st, _, _, _, _ = jax.lax.while_loop(
+        cond, body, (init, r_ckpts, s_ckpts, jnp.zeros((), jnp.int32), key)
+    )
+    return st
+
+
+class _Traj(NamedTuple):
+    z_left: Array
+    r_left: Array
+    grad_left: Array
+    z_right: Array
+    r_right: Array
+    grad_right: Array
+    z_prop: Array
+    pe_prop: Array
+    grad_prop: Array
+    energy_prop: Array
+    r_sum: Array
+    log_weight: Array
+    turning: Array
+    diverging: Array
+    sum_accept: Array
+    num_leaves: Array
+    depth: Array
+
+
+def nuts_step(
+    key: Array,
+    state: HMCState,
+    potential_fn: PotentialFn,
+    step_size: Array,
+    inv_mass_diag: Array,
+    max_depth: int = 10,
+):
+    """One NUTS transition. Returns (new HMCState, HMCInfo)."""
+    key_mom, key_loop = jax.random.split(key)
+    r0 = sample_momentum(key_mom, inv_mass_diag)
+    energy0 = state.potential_energy + kinetic_energy(r0, inv_mass_diag)
+
+    traj = _Traj(
+        z_left=state.z,
+        r_left=r0,
+        grad_left=state.grad,
+        z_right=state.z,
+        r_right=r0,
+        grad_right=state.grad,
+        z_prop=state.z,
+        pe_prop=state.potential_energy,
+        grad_prop=state.grad,
+        energy_prop=energy0,
+        r_sum=r0,
+        log_weight=jnp.zeros((), state.z.dtype),
+        turning=jnp.asarray(False),
+        diverging=jnp.asarray(False),
+        sum_accept=jnp.zeros((), state.z.dtype),
+        num_leaves=jnp.zeros((), jnp.int32),
+        depth=jnp.zeros((), jnp.int32),
+    )
+
+    def cond(carry):
+        traj, _ = carry
+        return (traj.depth < max_depth) & ~traj.turning & ~traj.diverging
+
+    def body(carry):
+        traj, key = carry
+        key, key_dir, key_sub, key_take = jax.random.split(key, 4)
+        going_right = jax.random.bernoulli(key_dir)
+        z_edge = jnp.where(going_right, traj.z_right, traj.z_left)
+        r_edge = jnp.where(going_right, traj.r_right, traj.r_left)
+        g_edge = jnp.where(going_right, traj.grad_right, traj.grad_left)
+        directed_step = jnp.where(going_right, step_size, -step_size)
+
+        sub = _build_subtree(
+            key_sub,
+            traj.depth,
+            z_edge,
+            r_edge,
+            g_edge,
+            potential_fn,
+            directed_step,
+            inv_mass_diag,
+            energy0,
+            max_depth,
+        )
+        ok = ~sub.turning & ~sub.diverging
+
+        # biased progressive sampling between old trajectory and new subtree
+        p_take = jnp.exp(jnp.minimum(0.0, sub.log_weight - traj.log_weight))
+        take = ok & (jax.random.uniform(key_take, ()) < p_take)
+        z_prop = jnp.where(take, sub.z_prop, traj.z_prop)
+        pe_prop = jnp.where(take, sub.pe_prop, traj.pe_prop)
+        grad_prop = jnp.where(take, sub.grad_prop, traj.grad_prop)
+        energy_prop = jnp.where(take, sub.energy_prop, traj.energy_prop)
+
+        # merged edges (only meaningful when ok; loop exits otherwise)
+        z_left = jnp.where(going_right, traj.z_left, sub.z_far)
+        r_left = jnp.where(going_right, traj.r_left, sub.r_far)
+        g_left = jnp.where(going_right, traj.grad_left, sub.grad_far)
+        z_right = jnp.where(going_right, sub.z_far, traj.z_right)
+        r_right = jnp.where(going_right, sub.r_far, traj.r_right)
+        g_right = jnp.where(going_right, sub.grad_far, traj.grad_right)
+
+        r_sum = traj.r_sum + sub.r_sum
+        turning_total = _is_turning(inv_mass_diag, r_left, r_right, r_sum)
+
+        new = _Traj(
+            z_left=z_left,
+            r_left=r_left,
+            grad_left=g_left,
+            z_right=z_right,
+            r_right=r_right,
+            grad_right=g_right,
+            z_prop=z_prop,
+            pe_prop=pe_prop,
+            grad_prop=grad_prop,
+            energy_prop=energy_prop,
+            r_sum=r_sum,
+            log_weight=jnp.logaddexp(traj.log_weight, sub.log_weight),
+            turning=sub.turning | turning_total,
+            diverging=sub.diverging,
+            sum_accept=traj.sum_accept + sub.sum_accept,
+            num_leaves=traj.num_leaves + sub.num_leaves,
+            depth=traj.depth + 1,
+        )
+        return new, key
+
+    traj, _ = jax.lax.while_loop(cond, body, (traj, key_loop))
+
+    new_state = HMCState(
+        z=traj.z_prop, potential_energy=traj.pe_prop, grad=traj.grad_prop
+    )
+    num = jnp.maximum(traj.num_leaves, 1)
+    info = HMCInfo(
+        accept_prob=traj.sum_accept / num.astype(traj.sum_accept.dtype),
+        is_accepted=jnp.any(traj.z_prop != state.z),
+        is_divergent=traj.diverging,
+        energy=traj.energy_prop,
+        num_grad_evals=traj.num_leaves,
+    )
+    return new_state, info

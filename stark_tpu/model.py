@@ -1,0 +1,135 @@
+"""Model abstraction — the `StarkModel`-equivalent plugin boundary.
+
+A model declares its parameters (shapes + constraining bijectors), a log-prior
+over the constrained parameters, and a per-row log-likelihood summed over a
+batch of rows.  The framework turns this into a potential-energy function over
+a single flat unconstrained vector, optionally allreducing data-sharded
+log-likelihood terms over a mesh axis (the TPU-native replacement for the
+reference's `Sampler.mapPartitions` driver round-trip — BASELINE.json:5,
+SURVEY.md §4).
+
+The reference tree was absent at build time (SURVEY.md §0); the API here
+covers the capability surface of `StarkModel` as documented in SURVEY.md §2/§3
+(layer A: log-prior + per-row log-likelihood + parameter (un)constraining).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .bijectors import Bijector, Identity
+from .tree import make_unflatten
+
+Array = jax.Array
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamSpec:
+    """Declared shape (constrained space) + constraining bijector."""
+
+    shape: Tuple[int, ...] = ()
+    bijector: Bijector = dataclasses.field(default_factory=Identity)
+
+
+class Model:
+    """Subclass and implement param_spec / log_prior / log_lik.
+
+    ``log_lik(params, data)`` must return the *sum* of per-row log-likelihood
+    terms over whatever batch of rows it is handed; the framework decides
+    which rows those are (full data, a device shard, or a minibatch).
+    Models with no data term (pure-prior / data baked into the model) may
+    leave log_lik unimplemented and return everything from log_prior.
+    """
+
+    def param_spec(self) -> Dict[str, ParamSpec]:
+        raise NotImplementedError
+
+    def log_prior(self, params: Dict[str, Array]) -> Array:
+        raise NotImplementedError
+
+    def log_lik(self, params: Dict[str, Array], data: PyTree) -> Array:
+        raise NotImplementedError
+
+    def init_params(self, key: Array) -> Optional[Dict[str, Array]]:
+        """Optional: return constrained init values; None -> U(-2,2) in
+        unconstrained space (Stan-style random init)."""
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatModel:
+    """A model compiled down to flat-unconstrained-vector functions."""
+
+    ndim: int
+    # potential(theta_flat, data) -> scalar (data may be None)
+    potential: Callable[..., Array]
+    # constrain(theta_flat) -> params dict (constrained, named)
+    constrain: Callable[[Array], Dict[str, Array]]
+    # unconstrain(params dict) -> theta_flat
+    unconstrain: Callable[[Dict[str, Array]], Array]
+    init_flat: Callable[[Array], Array]
+
+
+def flatten_model(
+    model: Model,
+    *,
+    axis_name: Optional[str] = None,
+    prior_scale: float = 1.0,
+    lik_scale: float = 1.0,
+) -> FlatModel:
+    """Compile a Model into flat-vector potential / transforms.
+
+    axis_name: if set, ``log_lik`` is treated as a per-shard partial sum and
+      allreduced with ``lax.psum(_, axis_name)`` — the ICI collective that
+      replaces the reference's driver-side reduce (SURVEY.md §4).
+    prior_scale: prior tempering exponent (consensus Monte Carlo uses 1/S).
+    lik_scale: likelihood scale (SG-HMC minibatching uses N/batch_size).
+    """
+    spec = model.param_spec()
+    unc_shapes = {k: v.bijector.unconstrained_shape(tuple(v.shape)) for k, v in spec.items()}
+    ndim, unflatten, flatten = make_unflatten(unc_shapes)
+
+    def constrain_with_fldj(flat: Array) -> Tuple[Dict[str, Array], Array]:
+        unc = unflatten(flat)
+        params = {}
+        fldj = jnp.zeros((), dtype=flat.dtype)
+        for name, ps in spec.items():
+            params[name] = ps.bijector.forward(unc[name])
+            fldj = fldj + ps.bijector.fldj(unc[name])
+        return params, fldj
+
+    def constrain(flat: Array) -> Dict[str, Array]:
+        return constrain_with_fldj(flat)[0]
+
+    def unconstrain(params: Dict[str, Array]) -> Array:
+        unc = {k: spec[k].bijector.inverse(jnp.asarray(params[k])) for k in spec}
+        return flatten(unc)
+
+    def potential(flat: Array, data: PyTree = None) -> Array:
+        params, fldj = constrain_with_fldj(flat)
+        lp = prior_scale * model.log_prior(params) + fldj
+        if data is not None:
+            ll = model.log_lik(params, data)
+            if axis_name is not None:
+                ll = jax.lax.psum(ll, axis_name)
+            lp = lp + lik_scale * ll
+        return -lp
+
+    def init_flat(key: Array) -> Array:
+        init = model.init_params(key)
+        if init is None:
+            return jax.random.uniform(key, (ndim,), minval=-2.0, maxval=2.0)
+        return unconstrain(init)
+
+    return FlatModel(
+        ndim=ndim,
+        potential=potential,
+        constrain=constrain,
+        unconstrain=unconstrain,
+        init_flat=init_flat,
+    )

@@ -1,0 +1,3 @@
+from .eight_schools import EightSchools
+
+__all__ = ["EightSchools"]

@@ -1,0 +1,45 @@
+"""8-schools hierarchical normal — benchmark config 1 (BASELINE.json:7).
+
+Non-centered parameterization (SURVEY.md §3 "Reparameterization"): the data
+(8 rows) is baked into the model, so log_lik takes data=None-style usage via
+log_prior carrying everything.  We keep the likelihood in log_lik with the
+fixed arrays as data to exercise the standard Model protocol.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+
+from ..bijectors import Exp
+from ..model import Model, ParamSpec
+
+# classic dataset (Rubin 1981)
+Y = jnp.array([28.0, 8.0, -3.0, 7.0, -1.0, 1.0, 18.0, 12.0])
+SIGMA = jnp.array([15.0, 10.0, 16.0, 11.0, 9.0, 11.0, 10.0, 18.0])
+
+
+def eight_schools_data():
+    return {"y": Y, "sigma": SIGMA}
+
+
+class EightSchools(Model):
+    """Non-centered: theta = mu + tau * theta_raw."""
+
+    def param_spec(self):
+        return {
+            "mu": ParamSpec(()),
+            "tau": ParamSpec((), Exp()),
+            "theta_raw": ParamSpec((8,)),
+        }
+
+    def log_prior(self, p):
+        lp = jstats.norm.logpdf(p["mu"], 0.0, 5.0)
+        # half-Cauchy(0, 5) on tau (density on the positive half-line)
+        lp += jstats.cauchy.logpdf(p["tau"], 0.0, 5.0) + jnp.log(2.0)
+        lp += jnp.sum(jstats.norm.logpdf(p["theta_raw"]))
+        return lp
+
+    def log_lik(self, p, data):
+        theta = p["mu"] + p["tau"] * p["theta_raw"]
+        return jnp.sum(jstats.norm.logpdf(data["y"], theta, data["sigma"]))

@@ -1,0 +1,285 @@
+"""Sampler frontend: chain orchestration, warmup, draw collection.
+
+The `Sampler`-equivalent layer (SURVEY.md §2 layer B / §3 "Sampler frontend").
+The whole warmup-and-sample loop for a chain is ONE compiled function
+(``lax.scan`` over steps); chains are vectorized with ``vmap``.  Control
+crosses host<->device once per run (or once per draw block in the adaptive
+runner), never per gradient evaluation — the structural fix for the
+reference's per-step driver round-trip (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import diagnostics
+from .adaptation import (
+    build_warmup_schedule,
+    da_init,
+    da_update,
+    find_reasonable_step_size,
+    welford_init,
+    welford_update,
+    welford_variance,
+)
+from .kernels.base import HMCState, init_state
+from .kernels.hmc import hmc_step
+from .kernels.nuts import nuts_step
+from .model import FlatModel, Model, flatten_model
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplerConfig:
+    kernel: str = "nuts"  # "nuts" | "hmc"
+    num_warmup: int = 1000
+    num_samples: int = 1000
+    thin: int = 1
+    target_accept: float = 0.8
+    max_tree_depth: int = 10
+    num_leapfrog: int = 32  # hmc only
+    init_step_size: float = 1.0
+    adapt_step_size: bool = True
+    adapt_mass: bool = True
+
+
+def _tree_select(flag, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(flag, x, y), a, b)
+
+
+def make_kernel(cfg: SamplerConfig) -> Callable:
+    """Returns step(key, state, potential_fn=, step_size=, inv_mass_diag=)."""
+    if cfg.kernel == "nuts":
+        return partial(nuts_step, max_depth=cfg.max_tree_depth)
+    if cfg.kernel == "hmc":
+        return partial(hmc_step, num_leapfrog=cfg.num_leapfrog)
+    raise ValueError(f"unknown kernel {cfg.kernel!r}")
+
+
+class ChainResult(NamedTuple):
+    draws: Array  # (num_samples, d) flat unconstrained
+    accept_prob: Array
+    is_divergent: Array
+    energy: Array
+    num_grad_evals: Array
+    step_size: Array
+    inv_mass_diag: Array
+    num_warmup_divergent: Array
+    num_divergent: Array  # over ALL post-warmup transitions (pre-thinning)
+    final_state: HMCState
+    suff_count: Array  # streaming Welford over sample draws
+    suff_mean: Array
+    suff_m2: Array
+
+
+def make_chain_runner(potential_of_data, cfg: SamplerConfig):
+    """Build (key, z0, data) -> ChainResult; one chain, fully compiled.
+
+    ``potential_of_data(z, data)`` takes the data pytree as a runtime argument
+    so the jitted runner is reusable across datasets of the same shape (no
+    recompile per ``sample()`` call).  vmap over (key, z0) for chains with
+    data broadcast.
+    """
+    step_kernel = make_kernel(cfg)
+    schedule = build_warmup_schedule(cfg.num_warmup)
+    adapt_mass_flags = jnp.asarray(schedule.adapt_mass)
+    window_end_flags = jnp.asarray(schedule.window_end)
+
+    def warmup(key, state: HMCState, potential_fn, kernel):
+        d = state.z.shape[0]
+        dtype = state.z.dtype
+        inv_mass = jnp.ones((d,), dtype)
+        key_find, key_scan = jax.random.split(key)
+        if cfg.adapt_step_size:
+            step0 = find_reasonable_step_size(
+                potential_fn,
+                state.z,
+                state.potential_energy,
+                state.grad,
+                inv_mass,
+                key_find,
+                cfg.init_step_size,
+            )
+        else:
+            step0 = jnp.asarray(cfg.init_step_size, dtype)
+        da = da_init(step0)
+        welford = welford_init(d, dtype)
+
+        def body(carry, x):
+            state, da, welford, inv_mass = carry
+            key, adapt_mass_f, window_end_f = x
+            step_size = (
+                jnp.exp(da.log_step)
+                if cfg.adapt_step_size
+                else jnp.asarray(cfg.init_step_size, dtype)
+            )
+            state, info = kernel(key, state, step_size=step_size, inv_mass_diag=inv_mass)
+            if cfg.adapt_step_size:
+                da = da_update(da, info.accept_prob, cfg.target_accept)
+            if cfg.adapt_mass:
+                welford = _tree_select(
+                    adapt_mass_f, welford_update(welford, state.z), welford
+                )
+                new_mass = welford_variance(welford)
+                refresh = window_end_f & (welford.count > 1)
+                inv_mass = jnp.where(refresh, new_mass, inv_mass)
+                welford = _tree_select(window_end_f, welford_init(d, dtype), welford)
+                if cfg.adapt_step_size:
+                    da = _tree_select(
+                        window_end_f, da_init(jnp.exp(da.log_step)), da
+                    )
+            return (state, da, welford, inv_mass), info.is_divergent
+
+        if cfg.num_warmup > 0:
+            keys = jax.random.split(key_scan, cfg.num_warmup)
+            (state, da, _, inv_mass), divergent = jax.lax.scan(
+                body, (state, da, welford, inv_mass), (keys, adapt_mass_flags, window_end_flags)
+            )
+            n_div = jnp.sum(divergent.astype(jnp.int32))
+        else:
+            n_div = jnp.zeros((), jnp.int32)
+        step_size = (
+            jnp.exp(da.log_avg_step)
+            if cfg.adapt_step_size
+            else jnp.asarray(cfg.init_step_size, dtype)
+        )
+        return state, step_size, inv_mass, n_div
+
+    def run(key, z0, data=None):
+        def potential_fn(z):
+            return potential_of_data(z, data)
+
+        kernel = partial(step_kernel, potential_fn=potential_fn)
+        state = init_state(potential_fn, z0)
+        key_warm, key_sample = jax.random.split(key)
+        state, step_size, inv_mass, warm_div = warmup(
+            key_warm, state, potential_fn, kernel
+        )
+
+        def body(carry, key):
+            state, wf = carry
+            state, info = kernel(key, state, step_size=step_size, inv_mass_diag=inv_mass)
+            wf = welford_update(wf, state.z)
+            out = (
+                state.z,
+                info.accept_prob,
+                info.is_divergent,
+                info.energy,
+                info.num_grad_evals,
+            )
+            return (state, wf), out
+
+        total = cfg.num_samples * cfg.thin
+        keys = jax.random.split(key_sample, total)
+        wf0 = welford_init(z0.shape[0], z0.dtype)
+        (state, wf), (zs, accept, divergent, energy, ngrad) = jax.lax.scan(
+            body, (state, wf0), keys
+        )
+        # divergence count must cover ALL transitions, including thinned-out ones
+        num_divergent = jnp.sum(divergent.astype(jnp.int32))
+        if cfg.thin > 1:
+            zs = zs[cfg.thin - 1 :: cfg.thin]
+            accept = accept[cfg.thin - 1 :: cfg.thin]
+            divergent = divergent[cfg.thin - 1 :: cfg.thin]
+            energy = energy[cfg.thin - 1 :: cfg.thin]
+            ngrad = ngrad[cfg.thin - 1 :: cfg.thin]
+        return ChainResult(
+            draws=zs,
+            accept_prob=accept,
+            is_divergent=divergent,
+            energy=energy,
+            num_grad_evals=ngrad,
+            step_size=step_size,
+            inv_mass_diag=inv_mass,
+            num_warmup_divergent=warm_div,
+            num_divergent=num_divergent,
+            final_state=state,
+            suff_count=wf.count,
+            suff_mean=wf.mean,
+            suff_m2=wf.m2,
+        )
+
+    return run
+
+
+class Posterior:
+    """Posterior draws + sample stats for a finished run."""
+
+    def __init__(
+        self,
+        draws: Dict[str, np.ndarray],
+        sample_stats: Dict[str, np.ndarray],
+        flat_model: Optional[FlatModel] = None,
+        draws_flat: Optional[np.ndarray] = None,
+    ):
+        self.draws = draws
+        self.sample_stats = sample_stats
+        self.flat_model = flat_model
+        self.draws_flat = draws_flat
+
+    @property
+    def num_chains(self) -> int:
+        return next(iter(self.draws.values())).shape[0]
+
+    @property
+    def num_samples(self) -> int:
+        return next(iter(self.draws.values())).shape[1]
+
+    @property
+    def num_divergent(self) -> int:
+        # pre-thinning count when available (covers dropped transitions)
+        if "num_divergent" in self.sample_stats:
+            return int(np.sum(self.sample_stats["num_divergent"]))
+        return int(np.sum(self.sample_stats.get("is_divergent", 0)))
+
+    def rhat(self) -> Dict[str, np.ndarray]:
+        return {k: diagnostics.split_rhat(v) for k, v in self.draws.items()}
+
+    def ess(self) -> Dict[str, np.ndarray]:
+        return {k: diagnostics.ess(v) for k, v in self.draws.items()}
+
+    def summary(self):
+        return diagnostics.summarize(self.draws)
+
+    def max_rhat(self) -> float:
+        return float(max(np.max(v) for v in self.rhat().values()))
+
+    def min_ess(self) -> float:
+        return float(min(np.min(v) for v in self.ess().values()))
+
+
+def _constrain_draws(fm: FlatModel, zs) -> Dict[str, np.ndarray]:
+    constrained = jax.vmap(jax.vmap(fm.constrain))(zs)
+    return {k: np.asarray(v) for k, v in constrained.items()}
+
+
+def sample(
+    model: Model,
+    data: Any = None,
+    *,
+    chains: int = 4,
+    seed: int = 0,
+    backend: Any = None,
+    init_params: Optional[Dict[str, Array]] = None,
+    **cfg_kwargs,
+) -> Posterior:
+    """Run MCMC and return a Posterior.
+
+    The default backend is the single-process JAX backend (jit + vmap over
+    chains on the default device — TPU when present).  Pass a
+    ``backends.SamplerBackend`` instance for sharded / CPU-reference
+    execution.
+    """
+    cfg = SamplerConfig(**cfg_kwargs)
+    if backend is None:
+        from .backends.jax_backend import JaxBackend
+
+        backend = JaxBackend()
+    return backend.run(model, data, cfg, chains=chains, seed=seed, init_params=init_params)

@@ -1,0 +1,64 @@
+"""Flat-vector <-> named-parameter utilities.
+
+The samplers operate internally on a single flat unconstrained vector per
+chain (``theta_u in R^d``).  This makes diagonal mass matrices, momentum
+dot-products (NUTS u-turn checks) and Welford covariance accumulation trivial
+and keeps every kernel a dense, MXU-friendly computation.  Conversion to the
+user-facing named (and constrained) parameter structure happens once at the
+boundary, not inside the hot loop.
+
+Reference parity note: the reference framework (randommm/stark) was not
+available at build time (see SURVEY.md §0); the capability this module serves
+is the `StarkModel` parameter-handling boundary (SURVEY.md §3, row "Model
+abstraction").
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+PyTree = Any
+
+
+def sizes_from_shapes(shapes: Dict[str, Tuple[int, ...]]) -> Dict[str, int]:
+    return {k: int(math.prod(s)) if s else 1 for k, s in shapes.items()}
+
+
+def make_unflatten(
+    shapes: Dict[str, Tuple[int, ...]],
+) -> Tuple[int, Callable[[Array], Dict[str, Array]], Callable[[Dict[str, Array]], Array]]:
+    """Build (total_size, unflatten, flatten) for an ordered dict of shapes.
+
+    Ordering is the dict insertion order; it is part of the flat layout
+    contract and must be stable across calls.
+    """
+    names = list(shapes.keys())
+    sizes = sizes_from_shapes(shapes)
+    offsets = {}
+    off = 0
+    for n in names:
+        offsets[n] = off
+        off += sizes[n]
+    total = off
+
+    def unflatten(flat: Array) -> Dict[str, Array]:
+        out = {}
+        for n in names:
+            sl = jax.lax.dynamic_slice_in_dim(flat, offsets[n], sizes[n], axis=-1)
+            out[n] = sl.reshape(flat.shape[:-1] + tuple(shapes[n]))
+        return out
+
+    def flatten(params: Dict[str, Array]) -> Array:
+        parts = []
+        for n in names:
+            x = jnp.asarray(params[n])
+            batch = x.shape[: x.ndim - len(shapes[n])]
+            parts.append(x.reshape(batch + (sizes[n],)))
+        return jnp.concatenate(parts, axis=-1)
+
+    return total, unflatten, flatten

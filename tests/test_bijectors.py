@@ -1,0 +1,89 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stark_tpu import bijectors as bj
+
+
+def _check_roundtrip(b, x, atol=1e-4):
+    y = b.forward(x)
+    x2 = b.inverse(y)
+    np.testing.assert_allclose(np.asarray(x2), np.asarray(x), atol=atol, rtol=1e-3)
+
+
+def _check_fldj_autodiff(b, x, atol=1e-4):
+    """fldj must equal log|det J| of the flattened forward map."""
+    x = jnp.asarray(x)
+
+    def flat_forward(xf):
+        return b.forward(xf.reshape(x.shape)).reshape(-1)
+
+    J = jax.jacfwd(flat_forward)(x.reshape(-1))
+    if J.shape[0] == J.shape[1]:
+        expected = jnp.linalg.slogdet(J)[1]
+    else:
+        # non-square (e.g. stick-breaking): use sqrt(det(J^T J))
+        expected = 0.5 * jnp.linalg.slogdet(J.T @ J)[1]
+    got = b.fldj(x)
+    np.testing.assert_allclose(float(got), float(expected), atol=atol, rtol=1e-4)
+
+
+KEY = jax.random.PRNGKey(0)
+
+
+@pytest.mark.parametrize(
+    "b,shape",
+    [
+        (bj.Identity(), (5,)),
+        (bj.Exp(), (5,)),
+        (bj.Softplus(), (5,)),
+        (bj.Interval(-1.0, 2.5), (4,)),
+        (bj.Ordered(), (6,)),
+    ],
+)
+def test_roundtrip_and_fldj(b, shape):
+    x = jax.random.normal(KEY, shape)
+    _check_roundtrip(b, x)
+    if not isinstance(b, bj.Identity):
+        _check_fldj_autodiff(b, x)
+
+
+def test_stickbreaking():
+    b = bj.StickBreaking()
+    x = jax.random.normal(KEY, (5,))
+    y = b.forward(x)
+    assert y.shape == (6,)
+    np.testing.assert_allclose(float(jnp.sum(y)), 1.0, atol=1e-5)
+    assert np.all(np.asarray(y) > 0)
+    _check_roundtrip(b, x)
+    # x=0 maps to the uniform simplex point
+    np.testing.assert_allclose(
+        np.asarray(b.forward(jnp.zeros(5))), np.full(6, 1 / 6), atol=1e-6
+    )
+
+
+def test_stickbreaking_fldj_matches_autodiff():
+    b = bj.StickBreaking()
+    x = jax.random.normal(jax.random.PRNGKey(3), (4,))
+
+    # parameterize the K-simplex by its first K-1 coords (square Jacobian)
+    def head(xf):
+        return b.forward(xf)[:-1]
+
+    J = jax.jacfwd(head)(x)
+    expected = jnp.linalg.slogdet(J)[1]
+    np.testing.assert_allclose(float(b.fldj(x)), float(expected), atol=1e-4)
+
+
+def test_ordered_is_increasing():
+    x = jax.random.normal(KEY, (8,))
+    y = bj.Ordered().forward(x)
+    assert np.all(np.diff(np.asarray(y)) > 0)
+
+
+def test_chain():
+    b = bj.Chain(bj.Ordered(), bj.Identity())
+    x = jax.random.normal(KEY, (4,))
+    _check_roundtrip(b, x)
+    _check_fldj_autodiff(b, x)

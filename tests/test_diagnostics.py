@@ -1,0 +1,65 @@
+import numpy as np
+
+from stark_tpu.diagnostics import ess, rhat_from_suffstats, split_rhat
+
+
+def _ar1(rng, phi, shape):
+    c, n = shape
+    x = np.zeros((c, n))
+    e = rng.standard_normal((c, n))
+    x[:, 0] = e[:, 0]
+    for t in range(1, n):
+        x[:, t] = phi * x[:, t - 1] + np.sqrt(1 - phi**2) * e[:, t]
+    return x
+
+
+def test_ess_iid():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 2000))
+    e = ess(x)
+    assert 0.75 * 8000 < float(e) < 1.3 * 8000
+
+
+def test_ess_ar1():
+    # ESS/N for AR(1) with coefficient phi is (1-phi)/(1+phi)
+    rng = np.random.default_rng(1)
+    phi = 0.9
+    x = _ar1(rng, phi, (4, 5000))
+    expected = 4 * 5000 * (1 - phi) / (1 + phi)
+    got = float(ess(x))
+    assert 0.5 * expected < got < 1.7 * expected, (got, expected)
+
+
+def test_ess_antithetic_exceeds_n():
+    # negatively autocorrelated chain: ESS should exceed nominal N
+    rng = np.random.default_rng(2)
+    x = _ar1(rng, -0.5, (4, 4000))
+    assert float(ess(x)) > 4 * 4000
+
+
+def test_split_rhat_detects_nonmixing():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((4, 1000))
+    x[0] += 3.0  # one chain stuck elsewhere
+    assert float(split_rhat(x)) > 1.2
+    y = rng.standard_normal((4, 1000))
+    assert float(split_rhat(y)) < 1.01
+
+
+def test_split_rhat_detects_trend():
+    # within-chain trend (non-stationarity) is caught by the SPLIT part
+    rng = np.random.default_rng(4)
+    n = 1000
+    x = rng.standard_normal((4, n)) + np.linspace(0, 3, n)
+    assert float(split_rhat(x)) > 1.1
+
+
+def test_rhat_from_suffstats_matches_nonsplit_formula():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((4, 500, 3)).astype(np.float32)
+    count = np.full((4,), 500)
+    mean = x.mean(axis=1)
+    m2 = ((x - mean[:, None, :]) ** 2).sum(axis=1)
+    r = np.asarray(rhat_from_suffstats(count, mean, m2))
+    assert r.shape == (3,)
+    assert np.all(r < 1.02) and np.all(r > 0.98)

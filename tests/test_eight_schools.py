@@ -1,0 +1,34 @@
+"""End-to-end slice: 8-schools NUTS, 4 chains (benchmark config 1)."""
+
+import numpy as np
+
+import stark_tpu
+from stark_tpu.models.eight_schools import EightSchools, eight_schools_data
+
+
+def test_eight_schools_nuts():
+    post = stark_tpu.sample(
+        EightSchools(),
+        eight_schools_data(),
+        chains=4,
+        num_warmup=500,
+        num_samples=500,
+        seed=0,
+    )
+    assert post.num_chains == 4
+    assert post.num_samples == 500
+
+    summ = post.summary()
+    mu_mean = float(summ["mu"]["mean"])
+    tau_mean = float(summ["tau"]["mean"])
+    # published posterior (Stan reference runs): mu ~ 4.4 (sd 3.3), tau ~ 3.6
+    assert 2.0 < mu_mean < 7.0, mu_mean
+    assert 2.0 < tau_mean < 6.0, tau_mean
+
+    rhat = post.rhat()
+    assert max(np.max(v) for v in rhat.values()) < 1.05
+    ess = post.ess()
+    assert min(np.min(v) for v in ess.values()) > 100
+
+    # divergences should be rare in the non-centered parameterization
+    assert post.num_divergent < 0.02 * 4 * 500

@@ -1,0 +1,53 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stark_tpu.kernels.base import init_state
+from stark_tpu.kernels.nuts import nuts_step
+
+
+def test_nuts_std_normal_moments():
+    d = 10
+    potential = lambda z: 0.5 * jnp.sum(z * z)
+    inv_mass = jnp.ones(d)
+    state = init_state(potential, jnp.zeros(d))
+
+    def step(st, key):
+        st, info = nuts_step(key, st, potential, jnp.asarray(0.3), inv_mass, 8)
+        return st, (st.z, info.num_grad_evals)
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 3000)
+    _, (zs, ngrad) = jax.lax.scan(jax.jit(step), state, keys)
+    zs = np.asarray(zs)[500:]
+    assert np.all(np.abs(zs.mean(0)) < 0.15)
+    assert np.all(np.abs(zs.var(0) - 1.0) < 0.25)
+    # trajectories should actually expand (more than 1 leaf on average)
+    assert float(np.asarray(ngrad).mean()) > 3
+
+
+def test_nuts_correlated_gaussian():
+    # anisotropic target exercises the u-turn criterion harder
+    scales = jnp.array([0.2, 1.0, 5.0])
+    potential = lambda z: 0.5 * jnp.sum((z / scales) ** 2)
+    inv_mass = jnp.ones(3)
+    state = init_state(potential, jnp.zeros(3))
+
+    def step(st, key):
+        st, info = nuts_step(key, st, potential, jnp.asarray(0.1), inv_mass, 10)
+        return st, st.z
+
+    keys = jax.random.split(jax.random.PRNGKey(1), 6000)
+    _, zs = jax.lax.scan(jax.jit(step), state, keys)
+    zs = np.asarray(zs)[1000:]
+    np.testing.assert_allclose(zs.std(0), np.asarray(scales), rtol=0.25)
+    assert np.all(np.abs(zs.mean(0)) < 0.3 * np.asarray(scales))
+
+
+def test_nuts_divergence_flag():
+    # absurdly large step size on a narrow target must flag divergence
+    potential = lambda z: 0.5 * jnp.sum((z / 0.01) ** 2)
+    state = init_state(potential, jnp.full((2,), 0.02))
+    _, info = jax.jit(
+        lambda k, s: nuts_step(k, s, potential, jnp.asarray(10.0), jnp.ones(2), 5)
+    )(jax.random.PRNGKey(2), state)
+    assert bool(info.is_divergent)
