@@ -31,7 +31,7 @@ class JaxBackend:
     def _get_runner(self, model: Model, fm, cfg: SamplerConfig):
         key = (id(model), cfg)
         if key not in self._cache:
-            runner = make_chain_runner(fm.potential, cfg)
+            runner = make_chain_runner(fm, cfg)
             self._cache[key] = jax.jit(jax.vmap(runner, in_axes=(0, 0, None)))
         return self._cache[key]
 

@@ -1,0 +1,117 @@
+"""ShardedBackend: chains x data-shards over a 2-D device mesh via shard_map.
+
+The target execution stack from SURVEY.md §4: every device holds one shard of
+the dataset (resident in HBM) and a slice of the chains; inside the compiled
+step the per-shard log-likelihood partial sums are combined with
+``lax.psum(_, "data")`` over ICI.  Chain state/computation is replicated
+across the data axis (all data-devices of a chain group advance the same
+chains deterministically), which is what removes the reference's
+driver-mediated reduce from the per-leapfrog-step path (BASELINE.json:5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..model import Model, flatten_model
+from ..parallel.mesh import make_mesh, shard_data
+from ..sampler import Posterior, SamplerConfig, _constrain_draws, make_chain_runner
+
+
+class ShardedBackend:
+    """Run chains over a Mesh(("data", "chains")).
+
+    mesh: a 2-axis mesh; default: all devices on "data".
+    Chains must divide the "chains" axis size; data rows must divide the
+    "data" axis size.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        if "data" not in self.mesh.axis_names or "chains" not in self.mesh.axis_names:
+            raise ValueError("mesh must have axes ('data', 'chains')")
+        self._cache: Dict[Tuple[int, SamplerConfig, Any], Any] = {}
+
+    def _get_runner(self, model: Model, fm, cfg: SamplerConfig, data):
+        treedef = None if data is None else jax.tree.structure(data)
+        key = (id(model), cfg, treedef)
+        if key not in self._cache:
+            runner = make_chain_runner(fm, cfg)
+            vrunner = jax.vmap(runner, in_axes=(0, 0, None))
+            if data is None:
+                fn = shard_map(
+                    lambda keys, z0s: vrunner(keys, z0s, None),
+                    mesh=self.mesh,
+                    in_specs=(P("chains"), P("chains")),
+                    out_specs=P("chains"),
+                    check_vma=False,
+                )
+            else:
+                data_specs = jax.tree.map(lambda _: P("data"), data)
+                fn = shard_map(
+                    vrunner,
+                    mesh=self.mesh,
+                    in_specs=(P("chains"), P("chains"), data_specs),
+                    out_specs=P("chains"),
+                    check_vma=False,
+                )
+            self._cache[key] = jax.jit(fn)
+        return self._cache[key]
+
+    def run(
+        self,
+        model: Model,
+        data,
+        cfg: SamplerConfig,
+        *,
+        chains: int,
+        seed: int,
+        init_params: Optional[Dict[str, Any]] = None,
+    ) -> Posterior:
+        n_chain_devs = self.mesh.shape["chains"]
+        if chains % n_chain_devs:
+            raise ValueError(
+                f"chains={chains} must divide mesh 'chains' axis ({n_chain_devs})"
+            )
+        fm = flatten_model(model, axis_name="data" if data is not None else None)
+
+        if data is not None:
+            data = shard_data(data, self.mesh, "data")
+
+        key = jax.random.PRNGKey(seed)
+        key_init, key_run = jax.random.split(key)
+        if init_params is not None:
+            z0 = jnp.broadcast_to(fm.unconstrain(init_params), (chains, fm.ndim))
+        else:
+            z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
+        chain_keys = jax.random.split(key_run, chains)
+
+        chain_sharding = NamedSharding(self.mesh, P("chains"))
+        z0 = jax.device_put(z0, chain_sharding)
+        chain_keys = jax.device_put(chain_keys, chain_sharding)
+
+        run = self._get_runner(model, fm, cfg, data)
+        if data is None:
+            res = jax.block_until_ready(run(chain_keys, z0))
+        else:
+            res = jax.block_until_ready(run(chain_keys, z0, data))
+
+        draws = _constrain_draws(fm, res.draws)
+        stats = {
+            "accept_prob": np.asarray(res.accept_prob),
+            "is_divergent": np.asarray(res.is_divergent),
+            "energy": np.asarray(res.energy),
+            "num_grad_evals": np.asarray(res.num_grad_evals),
+            "step_size": np.asarray(res.step_size),
+            "inv_mass_diag": np.asarray(res.inv_mass_diag),
+            "num_warmup_divergent": np.asarray(res.num_warmup_divergent),
+            "num_divergent": np.asarray(res.num_divergent),
+        }
+        return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(res.draws))

@@ -31,8 +31,16 @@ class HMCInfo(NamedTuple):
     num_grad_evals: Array
 
 
+def value_and_grad_of(potential_fn: PotentialFn):
+    """Use the potential's fused value_and_grad when it provides one
+    (sharded models pack value+grad into a single psum — see model.Potential);
+    fall back to autodiff otherwise."""
+    vag = getattr(potential_fn, "value_and_grad", None)
+    return vag if vag is not None else jax.value_and_grad(potential_fn)
+
+
 def init_state(potential_fn: PotentialFn, z: Array) -> HMCState:
-    pe, grad = jax.value_and_grad(potential_fn)(z)
+    pe, grad = value_and_grad_of(potential_fn)(z)
     return HMCState(z=z, potential_energy=pe, grad=grad)
 
 
@@ -57,7 +65,7 @@ def leapfrog_step(
     """One velocity-Verlet step — THE integrator, shared by every kernel."""
     r = r - 0.5 * step_size * grad
     z = z + step_size * (inv_mass_diag * r)
-    pe, grad = jax.value_and_grad(potential_fn)(z)
+    pe, grad = value_and_grad_of(potential_fn)(z)
     r = r - 0.5 * step_size * grad
     return z, r, grad, pe
 

@@ -1,0 +1,97 @@
+"""Bayesian (hierarchical) logistic regression — the flagship/benchmark model.
+
+Benchmark config 2 and the north-star workload (BASELINE.json:5,8): logistic
+regression on N rows (1M in the benchmark), optionally with per-group random
+intercepts ("hierarchical logistic").  The likelihood is one big
+(rows x features) matvec + elementwise log-sigmoid — exactly the shape the
+MXU wants: batched, dense, static.
+
+Data pytree: {"x": (N, D) float, "y": (N,) 0/1 float, "g": (N,) int32 group
+ids (only for the hierarchical variant)}.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+
+from ..bijectors import Exp
+from ..model import Model, ParamSpec
+
+
+def _bernoulli_logit_loglik(logits, y):
+    # sum_i [ y_i * log sigmoid(l_i) + (1-y_i) * log sigmoid(-l_i) ]
+    return jnp.sum(y * jax.nn.log_sigmoid(logits) + (1.0 - y) * jax.nn.log_sigmoid(-logits))
+
+
+class Logistic(Model):
+    """Flat logistic regression: beta ~ N(0, prior_scale), y ~ Bern(sigmoid(x@beta))."""
+
+    def __init__(self, num_features: int, prior_scale: float = 2.5):
+        self.num_features = num_features
+        self.prior_scale = prior_scale
+
+    def param_spec(self):
+        return {"beta": ParamSpec((self.num_features,))}
+
+    def log_prior(self, p):
+        return jnp.sum(jstats.norm.logpdf(p["beta"], 0.0, self.prior_scale))
+
+    def log_lik(self, p, data):
+        logits = data["x"] @ p["beta"]
+        return _bernoulli_logit_loglik(logits, data["y"])
+
+
+class HierLogistic(Model):
+    """Hierarchical logistic: shared coefficients + per-group random intercepts.
+
+    Non-centered: alpha_g = alpha0 + sigma_alpha * alpha_raw_g.
+    The group-effect gather is a one-hot-free ``alpha[g]`` lookup that XLA
+    lowers to a dynamic-gather — cheap next to the (N, D) matvec.
+    """
+
+    def __init__(self, num_features: int, num_groups: int, prior_scale: float = 2.5):
+        self.num_features = num_features
+        self.num_groups = num_groups
+        self.prior_scale = prior_scale
+
+    def param_spec(self):
+        return {
+            "beta": ParamSpec((self.num_features,)),
+            "alpha0": ParamSpec(()),
+            "sigma_alpha": ParamSpec((), Exp()),
+            "alpha_raw": ParamSpec((self.num_groups,)),
+        }
+
+    def log_prior(self, p):
+        lp = jnp.sum(jstats.norm.logpdf(p["beta"], 0.0, self.prior_scale))
+        lp += jstats.norm.logpdf(p["alpha0"], 0.0, 5.0)
+        # half-normal(0, 1) scale
+        lp += jstats.norm.logpdf(p["sigma_alpha"], 0.0, 1.0) + jnp.log(2.0)
+        lp += jnp.sum(jstats.norm.logpdf(p["alpha_raw"]))
+        return lp
+
+    def log_lik(self, p, data):
+        alpha = p["alpha0"] + p["sigma_alpha"] * p["alpha_raw"]
+        logits = data["x"] @ p["beta"] + alpha[data["g"]]
+        return _bernoulli_logit_loglik(logits, data["y"])
+
+
+def synth_logistic_data(key, n, d, *, num_groups=0, dtype=jnp.float32):
+    """Synthetic benchmark dataset (+ the true parameters used)."""
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    x = jax.random.normal(k1, (n, d), dtype)
+    beta = jax.random.normal(k2, (d,), dtype)
+    logits = x @ beta
+    out = {"x": x}
+    true = {"beta": beta}
+    if num_groups:
+        g = jax.random.randint(k3, (n,), 0, num_groups)
+        alpha = 0.5 * jax.random.normal(k4, (num_groups,), dtype)
+        logits = logits + alpha[g]
+        out["g"] = g
+        true["alpha"] = alpha
+    y = (jax.random.uniform(k5, (n,)) < jax.nn.sigmoid(logits)).astype(dtype)
+    out["y"] = y
+    return out, true

@@ -1,0 +1,4 @@
+from .mesh import make_mesh, shard_data
+from .consensus import consensus_sample
+
+__all__ = ["make_mesh", "shard_data", "consensus_sample"]

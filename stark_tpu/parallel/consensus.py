@@ -1,0 +1,136 @@
+"""Consensus Monte Carlo — embarrassingly parallel sub-posterior sampling.
+
+Benchmark config 2 (BASELINE.json:8): the N-row dataset is split into S
+shards; each shard samples the sub-posterior p(theta)^(1/S) * L_shard(theta)
+completely independently (NO per-step communication — SURVEY.md §3
+"Sub-posterior parallelism"), and draws are combined at the end with
+precision (inverse-variance) weights in unconstrained space, following the
+standard consensus weighted-average construction.
+
+Execution layouts:
+* one device: shards vectorized with vmap (S sub-posteriors side by side in
+  one compiled program — still zero cross-shard comm);
+* a mesh: shard groups laid out over the "data" axis via shard_map, one
+  all_gather at the very end to combine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..model import Model, flatten_model
+from ..sampler import Posterior, SamplerConfig, _constrain_draws, make_chain_runner
+
+
+def _combine_precision_weighted(draws_flat: jax.Array) -> jax.Array:
+    """(S, C, T, d) sub-posterior draws -> (C, T, d) consensus draws.
+
+    Diagonal precision weights w_s = 1/var_s estimated per shard from its own
+    draws (pooled over chains/draws), the standard uniform-in-t weighted
+    average: theta_t = (sum_s w_s theta_{s,t}) / (sum_s w_s).
+    """
+    var = jnp.var(draws_flat, axis=(1, 2), ddof=1)  # (S, d)
+    w = 1.0 / jnp.maximum(var, 1e-12)  # (S, d)
+    num = jnp.einsum("sctd,sd->ctd", draws_flat, w)
+    return num / jnp.sum(w, axis=0)
+
+
+def consensus_sample(
+    model: Model,
+    data,
+    *,
+    num_shards: int,
+    chains: int = 2,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+    combine: str = "precision",  # "precision" | "uniform"
+    init_params: Optional[Dict[str, Any]] = None,
+    **cfg_kwargs,
+) -> Posterior:
+    """Run consensus MC and return the combined Posterior.
+
+    ``chains`` here is chains PER SHARD; the combined posterior keeps the
+    chain axis (chain c of the consensus = combination of chain c of every
+    shard), so standard R-hat/ESS diagnostics apply to the combined draws.
+    """
+    cfg = SamplerConfig(**cfg_kwargs)
+    fm = flatten_model(model, prior_scale=1.0 / num_shards)
+
+    # rows -> (S, N/S, ...): shard k takes the k-th contiguous block
+    def to_shards(x):
+        x = jnp.asarray(x)
+        if x.shape[0] % num_shards:
+            raise ValueError(
+                f"rows {x.shape[0]} not divisible by num_shards={num_shards}"
+            )
+        return x.reshape(num_shards, x.shape[0] // num_shards, *x.shape[1:])
+
+    sharded = jax.tree.map(to_shards, data)
+
+    key = jax.random.PRNGKey(seed)
+    key_init, key_run = jax.random.split(key)
+    if init_params is not None:
+        z0 = jnp.broadcast_to(
+            fm.unconstrain(init_params), (num_shards, chains, fm.ndim)
+        )
+    else:
+        z0 = jax.vmap(jax.vmap(fm.init_flat))(
+            jax.random.split(key_init, num_shards * chains).reshape(
+                num_shards, chains, 2
+            )
+        )
+    keys = jax.random.split(key_run, num_shards * chains).reshape(
+        num_shards, chains, 2
+    )
+
+    runner = make_chain_runner(fm, cfg)
+    vchains = jax.vmap(runner, in_axes=(0, 0, None))  # chains within a shard
+    vshards = jax.vmap(vchains, in_axes=(0, 0, 0))  # across shards
+
+    if mesh is None:
+        run = jax.jit(vshards)
+        res = jax.block_until_ready(run(keys, z0, sharded))
+        draws_sub = res.draws  # (S, C, T, d)
+    else:
+        if "data" not in mesh.axis_names:
+            raise ValueError("mesh must have a 'data' axis for consensus shards")
+        if num_shards % mesh.shape["data"]:
+            raise ValueError("num_shards must divide the mesh 'data' axis")
+        specs = jax.tree.map(lambda _: P("data"), sharded)
+        fn = shard_map(
+            vshards,
+            mesh=mesh,
+            in_specs=(P("data"), P("data"), specs),
+            out_specs=P("data"),
+            check_vma=False,
+        )
+        keys = jax.device_put(keys, NamedSharding(mesh, P("data")))
+        z0 = jax.device_put(z0, NamedSharding(mesh, P("data")))
+        sharded = jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P("data"))), sharded
+        )
+        res = jax.block_until_ready(jax.jit(fn)(keys, z0, sharded))
+        draws_sub = res.draws
+
+    if combine == "precision":
+        combined = _combine_precision_weighted(draws_sub)
+    elif combine == "uniform":
+        combined = jnp.mean(draws_sub, axis=0)
+    else:
+        raise ValueError(f"unknown combine {combine!r}")
+
+    draws = _constrain_draws(fm, combined)
+    stats = {
+        "accept_prob": np.asarray(res.accept_prob).reshape(-1, res.accept_prob.shape[-1]),
+        "num_divergent": np.asarray(res.num_divergent),
+        "step_size": np.asarray(res.step_size),
+        "num_shards": num_shards,
+        "sub_draws_flat": np.asarray(draws_sub),
+    }
+    return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(combined))

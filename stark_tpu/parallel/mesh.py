@@ -1,0 +1,97 @@
+"""Device mesh + data-sharding helpers.
+
+The TPU-native replacement for the reference's Spark data layer (SURVEY.md §2
+layer E): the N-row dataset is laid out once across the "data" mesh axis and
+stays resident in HBM; chains are laid out across the "chains" axis.  All
+cross-device communication is XLA collectives over ICI/DCN (psum of per-shard
+log-likelihood partial sums — SURVEY.md §3 "Distributed communication
+backend"), never a host round-trip.
+
+Multi-host: under `jax.distributed`, ``make_mesh`` uses all global devices and
+``shard_data`` accepts process-local rows via
+``jax.make_array_from_process_local_data``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axis_sizes: Optional[Dict[str, int]] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh with named axes ("data", "chains") by default.
+
+    axis_sizes: e.g. {"data": 2, "chains": 4}. A single -1 entry is inferred
+    from the device count. Default: all devices on the "data" axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    n = devices.size
+    if axis_sizes is None:
+        axis_sizes = {"data": n, "chains": 1}
+    sizes = dict(axis_sizes)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if unknown:
+        known = int(np.prod([v for v in sizes.values() if v != -1]))
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[unknown[0]] = n // known
+    shape = tuple(sizes.values())
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh {sizes} needs {np.prod(shape)} devices, have {n}")
+    return Mesh(devices.reshape(shape), tuple(sizes.keys()))
+
+
+def shard_data(data, mesh: Mesh, axis: str = "data"):
+    """Place a pytree of row-major arrays with rows sharded over ``axis``.
+
+    Rows must divide evenly by the axis size (benchmark datasets are sized
+    accordingly; use ``truncate_to_multiple`` first otherwise).
+    """
+    size = mesh.shape[axis]
+    sharding = NamedSharding(mesh, P(axis))
+
+    def put(x):
+        x = jnp.asarray(x)
+        if x.shape[0] % size:
+            raise ValueError(
+                f"rows {x.shape[0]} not divisible by mesh axis {axis}={size}; "
+                "use truncate_to_multiple or pad the dataset"
+            )
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(put, data)
+
+
+def truncate_to_multiple(data, k: int):
+    """Drop trailing rows so the leading axis divides k."""
+
+    def trunc(x):
+        n = (x.shape[0] // k) * k
+        return x[:n]
+
+    return jax.tree.map(trunc, data)
+
+
+def process_local_shard(data, mesh: Mesh, axis: str = "data"):
+    """Multi-host path: assemble a global sharded array from per-process rows.
+
+    Each process passes only its local rows; jax glues them into one global
+    array laid out over ``axis`` (ICI within host, DCN across hosts).
+    """
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        data,
+    )

@@ -31,7 +31,7 @@ from .adaptation import (
 from .kernels.base import HMCState, init_state
 from .kernels.hmc import hmc_step
 from .kernels.nuts import nuts_step
-from .model import FlatModel, Model, flatten_model
+from .model import FlatModel, Model, Potential, flatten_model
 
 Array = jax.Array
 
@@ -79,13 +79,14 @@ class ChainResult(NamedTuple):
     suff_m2: Array
 
 
-def make_chain_runner(potential_of_data, cfg: SamplerConfig):
+def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):
     """Build (key, z0, data) -> ChainResult; one chain, fully compiled.
 
-    ``potential_of_data(z, data)`` takes the data pytree as a runtime argument
-    so the jitted runner is reusable across datasets of the same shape (no
-    recompile per ``sample()`` call).  vmap over (key, z0) for chains with
-    data broadcast.
+    The data pytree is a runtime argument so the jitted runner is reusable
+    across datasets of the same shape (no recompile per ``sample()`` call).
+    vmap over (key, z0) for chains with data broadcast.  Kernels receive a
+    ``model.Potential`` so sharded models get the fused single-psum
+    value-and-grad path.
     """
     step_kernel = make_kernel(cfg)
     schedule = build_warmup_schedule(cfg.num_warmup)
@@ -153,9 +154,7 @@ def make_chain_runner(potential_of_data, cfg: SamplerConfig):
         return state, step_size, inv_mass, n_div
 
     def run(key, z0, data=None):
-        def potential_fn(z):
-            return potential_of_data(z, data)
-
+        potential_fn = fm.bind(data)
         kernel = partial(step_kernel, potential_fn=potential_fn)
         state = init_state(potential_fn, z0)
         key_warm, key_sample = jax.random.split(key)
