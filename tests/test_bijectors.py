@@ -59,7 +59,7 @@ def test_stickbreaking():
     _check_roundtrip(b, x)
     # x=0 maps to the uniform simplex point
     np.testing.assert_allclose(
-        np.asarray(b.forward(jnp.zeros(5))), np.full(6, 1 / 6), atol=1e-6
+        np.asarray(b.forward(jnp.zeros(5))), np.full(6, 1 / 6), atol=1e-4
     )
 
 
@@ -73,7 +73,7 @@ def test_stickbreaking_fldj_matches_autodiff():
 
     J = jax.jacfwd(head)(x)
     expected = jnp.linalg.slogdet(J)[1]
-    np.testing.assert_allclose(float(b.fldj(x)), float(expected), atol=1e-4)
+    np.testing.assert_allclose(float(b.fldj(x)), float(expected), atol=1e-3)
 
 
 def test_ordered_is_increasing():
