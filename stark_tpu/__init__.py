@@ -11,7 +11,9 @@ terms + R-hat/ESS sufficient statistics allreduced over ICI.
 
 from . import bijectors, diagnostics
 from .model import Model, ParamSpec, flatten_model
+from .runner import sample_until_converged
 from .sampler import Posterior, SamplerConfig, sample
+from .sghmc import sghmc_sample
 
 __version__ = "0.1.0"
 
@@ -20,6 +22,8 @@ __all__ = [
     "ParamSpec",
     "flatten_model",
     "sample",
+    "sample_until_converged",
+    "sghmc_sample",
     "Posterior",
     "SamplerConfig",
     "bijectors",

@@ -1,4 +1,6 @@
 from .base import SamplerBackend
+from .cpu_backend import CpuBackend
 from .jax_backend import JaxBackend
+from .sharded import ShardedBackend
 
-__all__ = ["SamplerBackend", "JaxBackend"]
+__all__ = ["SamplerBackend", "CpuBackend", "JaxBackend", "ShardedBackend"]

@@ -1,0 +1,324 @@
+"""CpuBackend — host-driven reference sampler; the baseline denominator.
+
+This backend reproduces the reference's *execution architecture* (SURVEY.md
+§4: the Spark driver advances every chain step-by-step in host Python, with
+each log-posterior/gradient evaluation crossing the host boundary), so it is
+the honest denominator for the ≥20× effective-samples/sec north star
+(BASELINE.json:5) — the numerator being the fully-compiled TPU backends.
+
+Concretely: the MCMC loop is plain Python (one host round-trip per gradient
+evaluation, un-jitted op-by-op dispatch), NUTS is the textbook *recursive*
+tree-doubling formulation, and all accumulators are NumPy.  Because this
+implementation shares no control-flow code with `kernels/nuts.py` (iterative
+checkpoint-stack under `lax.while_loop`), it doubles as an independent
+correctness oracle for the compiled path (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..adaptation import build_warmup_schedule
+from ..model import Model, flatten_model
+from ..sampler import Posterior, SamplerConfig, _constrain_draws
+
+_DIVERGENCE_THRESHOLD = 1000.0
+
+
+class _HostPotential:
+    """Un-jitted value-and-grad crossing the host boundary every call."""
+
+    def __init__(self, fm, data):
+        self._vag = jax.value_and_grad(fm.potential)
+        self._data = data
+        self.num_evals = 0
+
+    def __call__(self, z: np.ndarray):
+        self.num_evals += 1
+        pe, grad = self._vag(z, self._data)
+        return float(pe), np.asarray(grad, np.float64)
+
+
+def _kinetic(r, inv_mass):
+    return 0.5 * float(np.sum(inv_mass * r * r))
+
+
+def _leapfrog(pot, z, r, grad, eps, inv_mass):
+    r = r - 0.5 * eps * grad
+    z = z + eps * inv_mass * r
+    pe, grad = pot(z)
+    r = r - 0.5 * eps * grad
+    return z, r, grad, pe
+
+
+class _DualAveraging:
+    def __init__(self, step0, target=0.8, t0=10.0, gamma=0.05, kappa=0.75):
+        self.mu = math.log(10.0 * step0)
+        self.log_step = math.log(step0)
+        self.log_avg = math.log(step0)
+        self.h = 0.0
+        self.t = 0
+        self.target, self.t0, self.gamma, self.kappa = target, t0, gamma, kappa
+
+    def update(self, accept):
+        self.t += 1
+        w = 1.0 / (self.t + self.t0)
+        self.h = (1 - w) * self.h + w * (self.target - accept)
+        self.log_step = self.mu - math.sqrt(self.t) / self.gamma * self.h
+        eta = self.t ** (-self.kappa)
+        self.log_avg = eta * self.log_step + (1 - eta) * self.log_avg
+
+
+def _find_reasonable_step(pot, z, pe, grad, inv_mass, rng, init=1.0):
+    r0 = rng.standard_normal(z.shape) / np.sqrt(inv_mass)
+    e0 = pe + _kinetic(r0, inv_mass)
+
+    def logp(eps):
+        _, r, _, pe1 = _leapfrog(pot, z, r0, grad, eps, inv_mass)
+        d = e0 - (pe1 + _kinetic(r, inv_mass))
+        return -np.inf if not np.isfinite(d) else d
+
+    eps = init
+    direction = 1.0 if logp(eps) > -math.log(2.0) else -1.0
+    for _ in range(64):
+        ok = logp(eps) > -math.log(2.0)
+        if (direction > 0 and not ok) or (direction < 0 and ok):
+            break
+        eps *= 2.0**direction
+    return eps
+
+
+class _RecursiveNuts:
+    """Textbook recursive multinomial NUTS (Betancourt-style U-turn)."""
+
+    def __init__(self, pot, inv_mass, max_depth):
+        self.pot = pot
+        self.inv_mass = inv_mass
+        self.max_depth = max_depth
+
+    def _turning(self, r_left, r_right, r_sum):
+        v_l = self.inv_mass * r_left
+        v_r = self.inv_mass * r_right
+        rho = r_sum - 0.5 * (r_left + r_right)
+        return (v_l @ rho <= 0.0) or (v_r @ rho <= 0.0)
+
+    def _build(self, rng, z, r, grad, direction, depth, eps, e0):
+        if depth == 0:
+            z1, r1, g1, pe1 = _leapfrog(self.pot, z, r, grad, direction * eps, self.inv_mass)
+            e1 = pe1 + _kinetic(r1, self.inv_mass)
+            delta = e1 - e0
+            delta = np.inf if not np.isfinite(delta) else delta
+            return {
+                "z_minus": z1, "r_minus": r1, "g_minus": g1,
+                "z_plus": z1, "r_plus": r1, "g_plus": g1,
+                "z_prop": z1, "pe_prop": pe1, "g_prop": g1,
+                "log_w": -delta, "r_sum": r1.copy(),
+                "diverging": delta > _DIVERGENCE_THRESHOLD,
+                "turning": False,
+                "sum_accept": math.exp(-delta) if delta > 0.0 else 1.0,
+                "n_leaves": 1,
+            }
+        first = self._build(rng, z, r, grad, direction, depth - 1, eps, e0)
+        if first["diverging"] or first["turning"]:
+            return first
+        if direction > 0:
+            second = self._build(
+                rng, first["z_plus"], first["r_plus"], first["g_plus"],
+                direction, depth - 1, eps, e0,
+            )
+        else:
+            second = self._build(
+                rng, first["z_minus"], first["r_minus"], first["g_minus"],
+                direction, depth - 1, eps, e0,
+            )
+        log_w = np.logaddexp(first["log_w"], second["log_w"])
+        take_second = rng.uniform() < math.exp(
+            min(0.0, second["log_w"] - log_w)
+        )
+        prop = second if take_second else first
+        left, right = (first, second) if direction > 0 else (second, first)
+        r_sum = first["r_sum"] + second["r_sum"]
+        return {
+            "z_minus": left["z_minus"], "r_minus": left["r_minus"],
+            "g_minus": left["g_minus"],
+            "z_plus": right["z_plus"], "r_plus": right["r_plus"],
+            "g_plus": right["g_plus"],
+            "z_prop": prop["z_prop"], "pe_prop": prop["pe_prop"],
+            "g_prop": prop["g_prop"],
+            "log_w": log_w,
+            "r_sum": r_sum,
+            "diverging": second["diverging"],
+            "turning": second["turning"]
+            or self._turning(left["r_minus"], right["r_plus"], r_sum),
+            "sum_accept": first["sum_accept"] + second["sum_accept"],
+            "n_leaves": first["n_leaves"] + second["n_leaves"],
+        }
+
+    def step(self, rng, z, pe, grad, eps):
+        r0 = rng.standard_normal(z.shape) / np.sqrt(self.inv_mass)
+        e0 = pe + _kinetic(r0, self.inv_mass)
+        tree = {
+            "z_minus": z, "r_minus": r0, "g_minus": grad,
+            "z_plus": z, "r_plus": r0, "g_plus": grad,
+            "z_prop": z, "pe_prop": pe, "g_prop": grad,
+            "log_w": 0.0, "r_sum": r0.copy(),
+            "diverging": False, "turning": False,
+            "sum_accept": 0.0, "n_leaves": 0,
+        }
+        for depth in range(self.max_depth):
+            direction = 1.0 if rng.uniform() < 0.5 else -1.0
+            if direction > 0:
+                sub = self._build(
+                    rng, tree["z_plus"], tree["r_plus"], tree["g_plus"],
+                    direction, depth, eps, e0,
+                )
+            else:
+                sub = self._build(
+                    rng, tree["z_minus"], tree["r_minus"], tree["g_minus"],
+                    direction, depth, eps, e0,
+                )
+            tree["sum_accept"] += sub["sum_accept"]
+            tree["n_leaves"] += sub["n_leaves"]
+            if sub["diverging"] or sub["turning"]:
+                tree["diverging"] = tree["diverging"] or sub["diverging"]
+                break
+            # biased progressive sampling toward the new subtree
+            if rng.uniform() < math.exp(min(0.0, sub["log_w"] - tree["log_w"])):
+                tree["z_prop"] = sub["z_prop"]
+                tree["pe_prop"] = sub["pe_prop"]
+                tree["g_prop"] = sub["g_prop"]
+            tree["log_w"] = np.logaddexp(tree["log_w"], sub["log_w"])
+            if direction > 0:
+                tree["z_plus"], tree["r_plus"], tree["g_plus"] = (
+                    sub["z_plus"], sub["r_plus"], sub["g_plus"]
+                )
+            else:
+                tree["z_minus"], tree["r_minus"], tree["g_minus"] = (
+                    sub["z_minus"], sub["r_minus"], sub["g_minus"]
+                )
+            tree["r_sum"] = tree["r_sum"] + sub["r_sum"]
+            if self._turning(tree["r_minus"], tree["r_plus"], tree["r_sum"]):
+                break
+        accept_prob = tree["sum_accept"] / max(tree["n_leaves"], 1)
+        return (
+            tree["z_prop"], tree["pe_prop"], tree["g_prop"],
+            accept_prob, tree["diverging"],
+        )
+
+
+class CpuBackend:
+    """Host-Python reference backend (SamplerBackend protocol)."""
+
+    def run(
+        self,
+        model: Model,
+        data,
+        cfg: SamplerConfig,
+        *,
+        chains: int,
+        seed: int,
+        init_params: Optional[Dict[str, Any]] = None,
+    ) -> Posterior:
+        fm = flatten_model(model)
+        pot = _HostPotential(fm, data)
+        schedule = build_warmup_schedule(cfg.num_warmup)
+
+        all_draws = []
+        all_accept = []
+        all_div = []
+        total_evals = 0
+        for c in range(chains):
+            rng = np.random.default_rng(seed * 1000003 + c)
+            if init_params is not None:
+                z = np.asarray(fm.unconstrain(init_params), np.float64)
+            else:
+                z = rng.uniform(-2.0, 2.0, fm.ndim)
+            pe, grad = pot(z)
+            inv_mass = np.ones(fm.ndim)
+
+            step = (
+                _find_reasonable_step(pot, z, pe, grad, inv_mass, rng, cfg.init_step_size)
+                if cfg.adapt_step_size
+                else cfg.init_step_size
+            )
+            da = _DualAveraging(step, cfg.target_accept)
+            welford_n, welford_mean, welford_m2 = 0, np.zeros(fm.ndim), np.zeros(fm.ndim)
+
+            kernel = _RecursiveNuts(pot, inv_mass, cfg.max_tree_depth)
+            for i in range(cfg.num_warmup):
+                eps = math.exp(da.log_step) if cfg.adapt_step_size else cfg.init_step_size
+                if cfg.kernel == "nuts":
+                    z, pe, grad, acc, _ = kernel.step(rng, z, pe, grad, eps)
+                else:
+                    z, pe, grad, acc = _hmc_transition(
+                        pot, rng, z, pe, grad, eps, inv_mass, cfg.num_leapfrog
+                    )
+                if cfg.adapt_step_size:
+                    da.update(acc)
+                if cfg.adapt_mass and schedule.adapt_mass[i]:
+                    welford_n += 1
+                    delta = z - welford_mean
+                    welford_mean = welford_mean + delta / welford_n
+                    welford_m2 = welford_m2 + delta * (z - welford_mean)
+                if cfg.adapt_mass and schedule.window_end[i] and welford_n > 1:
+                    var = welford_m2 / (welford_n - 1)
+                    var = (welford_n / (welford_n + 5.0)) * var + 1e-3 * (
+                        5.0 / (welford_n + 5.0)
+                    )
+                    inv_mass = var
+                    kernel.inv_mass = inv_mass
+                    welford_n, welford_mean, welford_m2 = (
+                        0, np.zeros(fm.ndim), np.zeros(fm.ndim)
+                    )
+                    if cfg.adapt_step_size:
+                        da = _DualAveraging(math.exp(da.log_step), cfg.target_accept)
+
+            eps = math.exp(da.log_avg) if cfg.adapt_step_size else cfg.init_step_size
+            draws = np.empty((cfg.num_samples, fm.ndim))
+            accepts = np.empty(cfg.num_samples)
+            n_div = 0  # counts ALL transitions, thinned-out included
+            for t in range(cfg.num_samples * cfg.thin):
+                if cfg.kernel == "nuts":
+                    z, pe, grad, acc, div = kernel.step(rng, z, pe, grad, eps)
+                else:
+                    z, pe, grad, acc = _hmc_transition(
+                        pot, rng, z, pe, grad, eps, inv_mass, cfg.num_leapfrog
+                    )
+                    div = False
+                n_div += int(div)
+                if (t + 1) % cfg.thin == 0:
+                    j = (t + 1) // cfg.thin - 1
+                    draws[j] = z
+                    accepts[j] = acc
+            all_draws.append(draws)
+            all_accept.append(accepts)
+            all_div.append(n_div)
+        total_evals = pot.num_evals
+
+        zs = np.stack(all_draws).astype(np.float32)  # (chains, T, d)
+        draws = _constrain_draws(fm, zs)
+        stats = {
+            "accept_prob": np.stack(all_accept),
+            "num_divergent": np.asarray(all_div),
+            "num_grad_evals_total": np.asarray(total_evals),
+        }
+        return Posterior(draws, stats, flat_model=fm, draws_flat=zs)
+
+
+def _hmc_transition(pot, rng, z, pe, grad, eps, inv_mass, num_leapfrog):
+    r0 = rng.standard_normal(z.shape) / np.sqrt(inv_mass)
+    e0 = pe + _kinetic(r0, inv_mass)
+    z1, r1, g1, pe1 = z, r0, grad, pe
+    for _ in range(num_leapfrog):
+        z1, r1, g1, pe1 = _leapfrog(pot, z1, r1, g1, eps, inv_mass)
+    e1 = pe1 + _kinetic(r1, inv_mass)
+    delta = e1 - e0
+    delta = np.inf if not np.isfinite(delta) else delta
+    acc = math.exp(-delta) if delta > 0.0 else 1.0
+    if rng.uniform() < acc:
+        return z1, pe1, g1, acc
+    return z, pe, grad, acc

@@ -1,0 +1,51 @@
+"""Checkpoint/resume — chain state as one flat-array bundle (SURVEY.md §6).
+
+The full sampler state (positions, potential/grad caches, step sizes, mass
+matrix, PRNG key, draw-accumulator metadata) is a dict of arrays; the JSON
+metadata rides inside the same .npz (as a uint8 array) so a checkpoint is
+ONE file and one atomic rename — a preempted write can never pair new
+arrays with stale metadata (the failure-detection story for v1: restart
+from the last good checkpoint; elastic re-sharding is a documented
+non-goal, SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+_META_KEY = "__stark_meta_json__"
+
+
+def save_checkpoint(path: str, arrays: Dict[str, np.ndarray], meta: Dict[str, Any]):
+    """Atomically write arrays + meta as one .npz (write temp, rename)."""
+    if _META_KEY in arrays:
+        raise ValueError(f"array name {_META_KEY!r} is reserved")
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(directory, exist_ok=True)
+    payload = {k: np.asarray(v) for k, v in arrays.items()}
+    payload[_META_KEY] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), dtype=np.uint8
+    )
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_checkpoint(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+        meta: Dict[str, Any] = {}
+        if _META_KEY in z.files:
+            meta = json.loads(bytes(z[_META_KEY]).decode("utf-8"))
+    return arrays, meta

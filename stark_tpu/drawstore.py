@@ -1,0 +1,134 @@
+"""Python binding for the native C++ DrawStore (ctypes, no pybind11).
+
+The .so is compiled on first use with the system g++ (cached next to the
+source; rebuilt when the source is newer).  See native/drawstore.cpp for the
+format and the async-writer design.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "drawstore.cpp")
+_SO = os.path.join(_NATIVE_DIR, "_drawstore.so")
+_HEADER_BYTES = 4 + 4 + 8 + 8  # magic, version, chains, dim
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        rebuild = (not os.path.exists(_SO)) or (
+            os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        )
+        if rebuild:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                 _SRC, "-o", _SO],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_SO)
+        lib.ds_open.restype = ctypes.c_void_p
+        lib.ds_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+        lib.ds_append.restype = ctypes.c_int
+        lib.ds_append.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_uint64,
+        ]
+        lib.ds_flush.restype = ctypes.c_int
+        lib.ds_flush.argtypes = [ctypes.c_void_p]
+        lib.ds_count.restype = ctypes.c_uint64
+        lib.ds_count.argtypes = [ctypes.c_void_p]
+        lib.ds_close.restype = ctypes.c_int
+        lib.ds_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class DrawStore:
+    """Append-only draw sink; ``append`` is non-blocking (async writer)."""
+
+    def __init__(self, path: str, chains: int, dim: int):
+        self._lib = _load()
+        self._handle = self._lib.ds_open(
+            path.encode(), ctypes.c_uint64(chains), ctypes.c_uint64(dim)
+        )
+        if not self._handle:
+            raise OSError(f"DrawStore: cannot open {path!r}")
+        self.path = path
+        self.chains = chains
+        self.dim = dim
+
+    def append(self, block: np.ndarray) -> None:
+        """block: strictly (chains, n_draws, dim) float32 — the layout the
+        samplers produce.  Stored draw-major (transposed here, host copy) so
+        on-disk reads concatenate along the draw axis."""
+        if block.ndim != 3 or block.shape[0] != self.chains or block.shape[2] != self.dim:
+            raise ValueError(
+                f"expected (chains={self.chains}, n, dim={self.dim}),"
+                f" got {block.shape}"
+            )
+        block = np.transpose(block, (1, 0, 2))
+        block = np.ascontiguousarray(block, np.float32)
+        rc = self._lib.ds_append(
+            self._handle,
+            block.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_uint64(block.shape[0]),
+        )
+        if rc != 0:
+            raise OSError(f"DrawStore.append failed: rc={rc}")
+
+    def flush(self) -> None:
+        rc = self._lib.ds_flush(self._handle)
+        if rc != 0:
+            raise OSError(f"DrawStore.flush failed: rc={rc}")
+
+    def __len__(self) -> int:
+        return int(self._lib.ds_count(self._handle))
+
+    def close(self) -> None:
+        if self._handle:
+            rc = self._lib.ds_close(self._handle)
+            self._handle = None
+            if rc != 0:
+                raise OSError(f"DrawStore.close failed: rc={rc}")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_draws(path: str, mmap: bool = True) -> Tuple[np.ndarray, int, int]:
+    """-> (draws (n, chains, dim), chains, dim); zero-copy memmap by default."""
+    with open(path, "rb") as f:
+        header = f.read(_HEADER_BYTES)
+    if header[:4] != b"STKD":
+        raise ValueError(f"{path!r} is not a DrawStore file")
+    chains = int.from_bytes(header[8:16], "little")
+    dim = int.from_bytes(header[16:24], "little")
+    size = os.path.getsize(path) - _HEADER_BYTES
+    n = size // (4 * chains * dim)
+    if mmap:
+        arr = np.memmap(
+            path, np.float32, mode="r", offset=_HEADER_BYTES,
+            shape=(n, chains, dim),
+        )
+    else:
+        arr = np.fromfile(path, np.float32, offset=_HEADER_BYTES).reshape(
+            n, chains, dim
+        )
+    return arr, chains, dim

@@ -1,5 +1,15 @@
 from .base import HMCInfo, HMCState, init_state
 from .hmc import hmc_step
 from .nuts import nuts_step
+from .sghmc import SGHMCState, sghmc_init, sghmc_step
 
-__all__ = ["HMCState", "HMCInfo", "init_state", "hmc_step", "nuts_step"]
+__all__ = [
+    "HMCState",
+    "HMCInfo",
+    "init_state",
+    "hmc_step",
+    "nuts_step",
+    "SGHMCState",
+    "sghmc_init",
+    "sghmc_step",
+]

@@ -1,0 +1,117 @@
+"""SG-HMC — stochastic-gradient HMC with friction (benchmark config 5).
+
+Minibatch-gradient HMC following the friction-corrected underdamped-Langevin
+construction (Chen, Fox & Guestrin 2014; PAPERS.md — pattern only): with
+mass M = diag(1/inv_mass_diag), friction C and step ``eps`` the transition is
+
+    r <- r - eps * grad_est(z) - eps * C * M^{-1} r + N(0, 2 C eps I)
+    z <- z + eps * M^{-1} r
+
+There is no Metropolis correction (the stochastic gradient makes exact MH
+intractable); the friction term dissipates the gradient-noise injection.
+Momentum is PERSISTENT across steps and optionally refreshed every
+``resample_every`` steps to restore ergodicity on multimodal targets.
+
+The gradient estimator draws a with-replacement minibatch of static size
+inside the compiled step (`jax.random.randint` + gather — static shapes, so
+the whole chain is one `lax.scan`), with the likelihood term pre-scaled by
+N/batch via ``flatten_model(lik_scale=...)``.
+
+Reference parity: the capability is `BASELINE.json:11` ("Bayesian neural net
+(2-layer MLP), SG-HMC minibatch gradients"); the reference tree itself was
+absent (SURVEY.md §0), so the kernel design is original.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import sample_momentum
+
+Array = jax.Array
+# grad_fn(key, z) -> (d,) stochastic estimate of grad U(z)
+StochasticGradFn = Callable[[Array, Array], Array]
+
+
+class SGHMCState(NamedTuple):
+    z: Array  # flat unconstrained position (d,)
+    r: Array  # persistent momentum (d,)
+
+
+class SGHMCInfo(NamedTuple):
+    kinetic_energy: Array
+    grad_norm: Array
+    is_divergent: Array  # non-finite position after the update
+
+
+def sghmc_init(key: Array, z: Array, inv_mass_diag: Array) -> SGHMCState:
+    return SGHMCState(z=z, r=sample_momentum(key, inv_mass_diag))
+
+
+def sghmc_step(
+    key: Array,
+    state: SGHMCState,
+    grad_fn: StochasticGradFn,
+    step_size: Array,
+    friction: Array,
+    inv_mass_diag: Array,
+    resample_momentum: Array | bool = False,
+):
+    """One SG-HMC transition; pure, `lax.scan`-composable.
+
+    resample_momentum: traced bool — refresh r ~ N(0, M) before the update
+    (fed from a host-precomputed flag array, like the warmup schedule).
+    """
+    key_grad, key_noise, key_mom = jax.random.split(key, 3)
+    r = jnp.where(
+        jnp.asarray(resample_momentum),
+        sample_momentum(key_mom, inv_mass_diag),
+        state.r,
+    )
+    grad = grad_fn(key_grad, state.z)
+    noise = jnp.sqrt(2.0 * friction * step_size) * jax.random.normal(
+        key_noise, r.shape, r.dtype
+    )
+    r = (
+        r
+        - step_size * grad
+        - step_size * friction * (inv_mass_diag * r)
+        + noise
+    )
+    z = state.z + step_size * (inv_mass_diag * r)
+
+    bad = ~jnp.all(jnp.isfinite(z))
+    # freeze the chain instead of propagating NaNs through the scan
+    z = jnp.where(bad, state.z, z)
+    r = jnp.where(bad, jnp.zeros_like(r), r)
+
+    info = SGHMCInfo(
+        kinetic_energy=0.5 * jnp.sum(inv_mass_diag * r * r),
+        grad_norm=jnp.sqrt(jnp.sum(grad * grad)),
+        is_divergent=bad,
+    )
+    return SGHMCState(z=z, r=r), info
+
+
+def make_minibatch_grad(
+    potential_with_data: Callable[[Array, object], Array],
+    data,
+    batch_size: int,
+) -> StochasticGradFn:
+    """Static-shape minibatch grad estimator over a leading row axis.
+
+    ``potential_with_data(z, batch)`` must already include the N/batch
+    likelihood scale (``flatten_model(lik_scale=N/batch)``).  Sampling is
+    with replacement (`randint`) so the batch shape is static under jit.
+    """
+    n = jax.tree.leaves(data)[0].shape[0]
+
+    def grad_fn(key, z):
+        idx = jax.random.randint(key, (batch_size,), 0, n)
+        batch = jax.tree.map(lambda x: jnp.take(x, idx, axis=0), data)
+        return jax.grad(potential_with_data)(z, batch)
+
+    return grad_fn

@@ -98,9 +98,14 @@ class FlatModel:
     # unconstrain(params dict) -> theta_flat
     unconstrain: Callable[[Dict[str, Array]], Array]
     init_flat: Callable[[Array], Array]
+    # optional: data -> Potential, replacing the default autodiff assembly
+    # (used by fused Pallas paths, e.g. ops.logistic_fused)
+    potential_factory: Optional[Callable[..., Potential]] = None
 
     def bind(self, data=None) -> Potential:
         """Close over a dataset -> a Potential for the kernels."""
+        if self.potential_factory is not None:
+            return self.potential_factory(data)
         return Potential(
             lambda z: self.potential(z, data),
             lambda z: self.potential_and_grad(z, data),

@@ -1,3 +1,19 @@
-from .eight_schools import EightSchools
+from .bnn import BayesianMLP, synth_bnn_data
+from .eight_schools import EightSchools, eight_schools_data
+from .gmm import GaussianMixture, synth_gmm_data
+from .lmm import LinearMixedModel, synth_lmm_data
+from .logistic import HierLogistic, Logistic, synth_logistic_data
 
-__all__ = ["EightSchools"]
+__all__ = [
+    "BayesianMLP",
+    "EightSchools",
+    "GaussianMixture",
+    "HierLogistic",
+    "LinearMixedModel",
+    "Logistic",
+    "eight_schools_data",
+    "synth_bnn_data",
+    "synth_gmm_data",
+    "synth_lmm_data",
+    "synth_logistic_data",
+]

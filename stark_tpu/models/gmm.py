@@ -1,0 +1,74 @@
+"""Gaussian mixture model — benchmark config 4 (BASELINE.json:10).
+
+K-component mixture with reparameterized sampling: simplex weights via
+stick-breaking, ordered component means (1-D) to break label switching, and
+log-scale component sds — all handled by the bijector layer so kernels see
+one unconstrained vector (SURVEY.md §3 "Reparameterization").  The per-row
+likelihood is a (N, K) logsumexp — batched and static, MXU/VPU friendly.
+
+Multimodality is what parallel tempering (`parallel.tempering`) is for;
+this model is the intended pairing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+from jax.scipy.special import logsumexp
+
+from ..bijectors import Exp, Ordered, StickBreaking
+from ..model import Model, ParamSpec
+
+
+class GaussianMixture(Model):
+    """1-D K-component GMM with ordered means.
+
+    params: weights (K-simplex), mu (K, ordered ascending), sigma (K, >0).
+    data: {"x": (N,)}.
+    """
+
+    def __init__(
+        self,
+        num_components: int,
+        mu_scale: float = 10.0,
+        dirichlet_alpha: float = 1.0,
+    ):
+        self.num_components = num_components
+        self.mu_scale = mu_scale
+        self.dirichlet_alpha = dirichlet_alpha
+
+    def param_spec(self):
+        k = self.num_components
+        return {
+            "weights": ParamSpec((k,), StickBreaking()),
+            "mu": ParamSpec((k,), Ordered()),
+            "sigma": ParamSpec((k,), Exp()),
+        }
+
+    def log_prior(self, p):
+        a = self.dirichlet_alpha
+        # Dirichlet(a, ..., a) up to the (constant) normalizer
+        lp = jnp.sum((a - 1.0) * jnp.log(jnp.maximum(p["weights"], 1e-30)))
+        lp += jnp.sum(jstats.norm.logpdf(p["mu"], 0.0, self.mu_scale))
+        # half-normal(0, 2) on component sds
+        lp += jnp.sum(jstats.norm.logpdf(p["sigma"], 0.0, 2.0) + jnp.log(2.0))
+        return lp
+
+    def log_lik(self, p, data):
+        x = data["x"][:, None]  # (N, 1)
+        comp = jstats.norm.logpdf(x, p["mu"][None, :], p["sigma"][None, :])
+        log_w = jnp.log(jnp.maximum(p["weights"], 1e-30))[None, :]
+        return jnp.sum(logsumexp(comp + log_w, axis=1))
+
+
+def synth_gmm_data(key, n, num_components, *, spread=6.0, dtype=jnp.float32):
+    """Well-separated synthetic mixture + the generating parameters."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    mu = spread * jnp.arange(num_components, dtype=dtype)
+    mu = mu - mu.mean()
+    sigma = 0.5 + 0.5 * jax.random.uniform(k1, (num_components,), dtype)
+    w = jax.random.dirichlet(k2, 5.0 * jnp.ones(num_components))
+    comp = jax.random.choice(k3, num_components, (n,), p=w)
+    x = mu[comp] + sigma[comp] * jax.random.normal(key, (n,), dtype)
+    return {"x": x}, {"weights": w, "mu": mu, "sigma": sigma}

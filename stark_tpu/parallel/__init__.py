@@ -1,4 +1,11 @@
-from .mesh import make_mesh, shard_data
 from .consensus import consensus_sample
+from .mesh import make_mesh, shard_data
+from .tempering import geometric_ladder, tempered_sample
 
-__all__ = ["make_mesh", "shard_data", "consensus_sample"]
+__all__ = [
+    "consensus_sample",
+    "geometric_ladder",
+    "make_mesh",
+    "shard_data",
+    "tempered_sample",
+]

@@ -84,6 +84,29 @@ def truncate_to_multiple(data, k: int):
     return jax.tree.map(trunc, data)
 
 
+def run_over_chains(mesh: Mesh, vrun, *args):
+    """shard_map a vmapped chain runner over the mesh "chains" axis and run.
+
+    Every arg must have chains as its leading axis; outputs likewise (the
+    P("chains") out_spec is applied as a pytree prefix).  Shared dispatch
+    for the samplers that parallelize only over chains (SG-HMC, tempering).
+    """
+    from jax import shard_map
+
+    if "chains" not in mesh.axis_names:
+        raise ValueError("mesh must have a 'chains' axis")
+    fn = shard_map(
+        vrun,
+        mesh=mesh,
+        in_specs=tuple(P("chains") for _ in args),
+        out_specs=P("chains"),
+        check_vma=False,
+    )
+    sharding = NamedSharding(mesh, P("chains"))
+    args = tuple(jax.device_put(a, sharding) for a in args)
+    return jax.block_until_ready(jax.jit(fn)(*args))
+
+
 def process_local_shard(data, mesh: Mesh, axis: str = "data"):
     """Multi-host path: assemble a global sharded array from per-process rows.
 

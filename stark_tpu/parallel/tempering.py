@@ -1,0 +1,230 @@
+"""Parallel tempering (replica exchange) — benchmark config 4 capability.
+
+K likelihood-tempered replicas per chain, target_k(z) ∝ prior(z)·lik(z)^β_k
+with β_0 = 1 > β_1 > ... > β_{K-1}; each replica advances with HMC/NUTS and
+adjacent replicas propose state swaps every ``swap_every`` steps with the
+standard exchange acceptance  log A = (β_k − β_j)(ll_j − ll_k).
+
+TPU-native layout (SURVEY.md §3 "Temperature parallelism"): the K replicas
+of a chain are a vmapped axis *within* the device program — a swap is a
+K-length gather, not communication — and chains shard over the mesh "chains"
+axis like every other sampler here.  This is the mesh-axis folding the
+survey prescribes; there is no per-swap host round-trip and no cross-device
+traffic for swaps at all.
+
+Replica state caches (ll, ll_grad, prior_pe, prior_grad) at the current
+position so both the swap acceptance and the post-swap kernel state
+(pe = prior_pe − β·ll, grad likewise) are recomputation-free; caches are
+refreshed once per transition (≪ the leapfrog cost of the transition).
+
+Reference parity: capability from BASELINE.json:10 ("Gaussian mixture K=16
+with reparameterized HMC + parallel tempering"); reference tree absent
+(SURVEY.md §0), design original.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..adaptation import da_init, da_update
+from ..kernels.base import HMCState
+from ..kernels.hmc import hmc_step
+from ..kernels.nuts import nuts_step
+from ..model import Model, flatten_model
+from ..sampler import Posterior, _constrain_draws
+
+Array = jax.Array
+
+
+class ReplicaState(NamedTuple):
+    """Stacked over the K-temperature axis (leading dim K)."""
+
+    z: Array  # (K, d)
+    prior_pe: Array  # (K,)  -(log_prior + fldj)
+    prior_grad: Array  # (K, d)
+    ll: Array  # (K,) log-likelihood at z
+    ll_grad: Array  # (K, d)
+
+
+def geometric_ladder(num_temps: int, beta_min: float = 0.05) -> jnp.ndarray:
+    """β_0=1 ... β_{K-1}=beta_min, geometrically spaced."""
+    if num_temps == 1:
+        return jnp.ones((1,))
+    return jnp.asarray(
+        np.geomspace(1.0, beta_min, num_temps), jnp.float32
+    )
+
+
+def tempered_sample(
+    model: Model,
+    data,
+    *,
+    chains: int = 2,
+    num_temps: int = 8,
+    betas: Optional[jnp.ndarray] = None,
+    kernel: str = "hmc",
+    num_leapfrog: int = 16,
+    max_tree_depth: int = 6,
+    num_warmup: int = 500,
+    num_samples: int = 1000,
+    swap_every: int = 5,
+    target_accept: float = 0.8,
+    init_step_size: float = 0.1,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+    init_params: Optional[Dict[str, Any]] = None,
+) -> Posterior:
+    """Run parallel-tempered MCMC; returns the β=1 replica's Posterior.
+
+    Step sizes adapt per temperature with dual averaging during warmup
+    (hot replicas want larger steps).  ``sample_stats["swap_accept_rate"]``
+    reports the realized adjacent-swap acceptance per chain.
+    """
+    if data is None:
+        raise ValueError("tempering requires a data likelihood to temper")
+    data = jax.tree.map(jnp.asarray, data)
+    fm = flatten_model(model)
+    betas = geometric_ladder(num_temps) if betas is None else jnp.asarray(betas)
+    num_temps = betas.shape[0]
+
+    def prior_pot(z):
+        return fm.potential(z, None)
+
+    def loglik(z):
+        return model.log_lik(fm.constrain(z), data)
+
+    vag_prior = jax.value_and_grad(prior_pot)
+    vag_ll = jax.value_and_grad(loglik)
+
+    def refresh(z):
+        ppe, pgr = vag_prior(z)
+        ll, llg = vag_ll(z)
+        return ppe, pgr, ll, llg
+
+    if kernel == "nuts":
+        kstep = partial(nuts_step, max_depth=max_tree_depth)
+    elif kernel == "hmc":
+        kstep = partial(hmc_step, num_leapfrog=num_leapfrog)
+    else:
+        raise ValueError(f"unknown kernel {kernel!r}")
+
+    def one_replica_step(key, z, ppe, pgr, ll, llg, beta, step_size):
+        pot = lambda zz: prior_pot(zz) - beta * loglik(zz)
+        st = HMCState(z=z, potential_energy=ppe - beta * ll, grad=pgr - beta * llg)
+        st, info = kstep(key, st, potential_fn=pot, step_size=step_size,
+                         inv_mass_diag=jnp.ones_like(z))
+        ppe, pgr, ll, llg = refresh(st.z)
+        return (st.z, ppe, pgr, ll, llg), info
+
+    v_step = jax.vmap(one_replica_step, in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
+
+    temps_idx = jnp.arange(num_temps)
+
+    def swap(key, rs: ReplicaState, parity):
+        """Even-odd adjacent exchange; returns (new state, n_accept, n_pairs)."""
+        k = temps_idx
+        partner = jnp.where((k - parity) % 2 == 0, k + 1, k - 1)
+        valid = (partner >= 0) & (partner < num_temps)
+        partner = jnp.clip(partner, 0, num_temps - 1)
+        delta = (betas - betas[partner]) * (rs.ll[partner] - rs.ll)
+        u = jax.random.uniform(key, (num_temps,))
+        u_pair = u[jnp.minimum(k, partner)]  # one draw per pair
+        accept = valid & (jnp.log(u_pair) < delta)
+        perm = jnp.where(accept, partner, k)
+        new = ReplicaState(*[x[perm] for x in rs])
+        is_lower = k < partner
+        n_acc = jnp.sum((accept & is_lower).astype(jnp.int32))
+        n_pairs = jnp.sum((valid & is_lower).astype(jnp.int32))
+        return new, n_acc, n_pairs
+
+    swap_flags = np.zeros(num_warmup + num_samples, bool)
+    if swap_every > 0:
+        swap_flags[swap_every - 1 :: swap_every] = True
+    parities = np.cumsum(swap_flags) % 2  # alternate parity across swap rounds
+    is_warm = np.arange(num_warmup + num_samples) < num_warmup
+
+    def run_chain(key, z0):
+        ppe, pgr, ll, llg = jax.vmap(refresh)(z0)
+        rs = ReplicaState(z0, ppe, pgr, ll, llg)
+        da = jax.vmap(da_init)(jnp.full((num_temps,), init_step_size))
+
+        def body(carry, x):
+            rs, da = carry
+            key, do_swap, parity, warm = x
+            key_step, key_swap = jax.random.split(key)
+            step_size = jnp.where(warm, jnp.exp(da.log_step), jnp.exp(da.log_avg_step))
+            keys = jax.random.split(key_step, num_temps)
+            (z, ppe, pgr, ll, llg), info = v_step(
+                keys, rs.z, rs.prior_pe, rs.prior_grad, rs.ll, rs.ll_grad,
+                betas, step_size,
+            )
+            rs = ReplicaState(z, ppe, pgr, ll, llg)
+            da_new = jax.vmap(lambda d, a: da_update(d, a, target_accept))(
+                da, info.accept_prob
+            )
+            da = jax.tree.map(lambda a, b: jnp.where(warm, a, b), da_new, da)
+            swapped, n_acc, n_pairs = swap(key_swap, rs, parity)
+            rs = jax.tree.map(
+                lambda a, b: jnp.where(do_swap, a, b), swapped, rs
+            )
+            out = (
+                rs.z[0],
+                info.is_divergent[0],
+                jnp.where(do_swap, n_acc, 0),
+                jnp.where(do_swap, n_pairs, 0),
+            )
+            return (rs, da), out
+
+        total = num_warmup + num_samples
+        keys = jax.random.split(key, total)
+        xs = (
+            keys,
+            jnp.asarray(swap_flags),
+            jnp.asarray(parities, jnp.int32),
+            jnp.asarray(is_warm),
+        )
+        (rs, da), (z_cold, div, n_acc, n_pairs) = jax.lax.scan(
+            body, (rs, da), xs
+        )
+        zs = z_cold[num_warmup:]
+        n_div = jnp.sum(div[num_warmup:].astype(jnp.int32))
+        swap_rate = jnp.sum(n_acc) / jnp.maximum(jnp.sum(n_pairs), 1)
+        return zs, n_div, swap_rate, jnp.exp(da.log_avg_step)
+
+    key = jax.random.PRNGKey(seed)
+    key_init, key_run = jax.random.split(key)
+    if init_params is not None:
+        z0 = jnp.broadcast_to(
+            fm.unconstrain(init_params), (chains, num_temps, fm.ndim)
+        )
+    else:
+        z0 = jax.vmap(jax.vmap(fm.init_flat))(
+            jax.random.split(key_init, chains * num_temps).reshape(
+                chains, num_temps, 2
+            )
+        )
+    chain_keys = jax.random.split(key_run, chains)
+
+    vrun = jax.vmap(run_chain)
+    if mesh is None:
+        out = jax.block_until_ready(jax.jit(vrun)(chain_keys, z0))
+    else:
+        from .mesh import run_over_chains
+
+        out = run_over_chains(mesh, vrun, chain_keys, z0)
+
+    zs, n_div, swap_rate, step_sizes = out
+    draws = _constrain_draws(fm, zs)
+    stats = {
+        "num_divergent": np.asarray(n_div),
+        "swap_accept_rate": np.asarray(swap_rate),
+        "step_size_per_temp": np.asarray(step_sizes),
+        "betas": np.asarray(betas),
+    }
+    return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(zs))

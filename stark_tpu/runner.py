@@ -1,0 +1,233 @@
+"""Adaptive runner: sample in blocks until R-hat < target (SURVEY.md §4).
+
+The primary judged metric is *wall-clock to R-hat < 1.01* (BASELINE.json:2),
+so this is the measurement driver: warmup once (compiled), then draw blocks
+of ``block_size`` transitions per host round-trip; after each block the host
+checks split-R-hat/ESS on the accumulated draws, appends a JSONL metrics
+record, and optionally checkpoints the full chain state.  Stop when
+converged (or budget exhausted) — the convergence-based stopping the
+reference exposes via its R-hat/ESS diagnostics (SURVEY.md §2 layer C).
+
+Auxiliary subsystems wired here (SURVEY.md §6):
+  * metrics JSONL   — one line per block (max_rhat, min_ess, wall, divs)
+  * checkpoint      — `checkpoint.save_checkpoint` every block; resume via
+                      ``resume_from=`` (restarts mid-run after preemption)
+  * profiler hooks  — ``profile_dir=`` wraps the first post-warmup block in
+                      a `jax.profiler.trace` for TPU timeline inspection
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import diagnostics
+from .kernels.base import HMCState
+from .model import Model, flatten_model
+from .sampler import Posterior, SamplerConfig, _constrain_draws, make_block_runners
+
+
+class AdaptiveResult(Posterior):
+    """Posterior + convergence trajectory."""
+
+    def __init__(self, *args, history=None, converged=False, wall_s=0.0, **kw):
+        super().__init__(*args, **kw)
+        self.history = history or []
+        self.converged = converged
+        self.wall_s = wall_s
+
+
+def sample_until_converged(
+    model: Model,
+    data: Any = None,
+    *,
+    chains: int = 4,
+    block_size: int = 100,
+    max_blocks: int = 50,
+    min_blocks: int = 2,
+    rhat_target: float = 1.01,
+    ess_target: float = 400.0,
+    seed: int = 0,
+    checkpoint_path: Optional[str] = None,
+    resume_from: Optional[str] = None,
+    metrics_path: Optional[str] = None,
+    profile_dir: Optional[str] = None,
+    draw_store_path: Optional[str] = None,
+    init_params: Optional[Dict[str, Any]] = None,
+    **cfg_kwargs,
+) -> AdaptiveResult:
+    """Run chains until split-R-hat < rhat_target AND min-ESS > ess_target.
+
+    Draw blocks are compiled once and reused; the host-side work per block is
+    O(draws so far) diagnostics on numpy arrays.
+    """
+    cfg = SamplerConfig(**cfg_kwargs)
+    fm = flatten_model(model)
+    if data is not None:
+        data = jax.tree.map(jnp.asarray, data)
+
+    warmup_run, block_run = make_block_runners(fm, cfg, block_size)
+    v_warm = jax.jit(jax.vmap(warmup_run, in_axes=(0, 0, None)))
+    v_block = jax.jit(jax.vmap(block_run, in_axes=(0, 0, 0, 0, None)))
+
+    t_start = time.perf_counter()
+    metrics_f = open(metrics_path, "a") if metrics_path else None
+
+    def emit(rec):
+        if metrics_f:
+            metrics_f.write(json.dumps(rec) + "\n")
+            metrics_f.flush()
+
+    blocks_done = 0
+    total_div = 0
+    history = []
+    draw_blocks = []
+    if resume_from:
+        from .checkpoint import load_checkpoint
+
+        arrays, meta = load_checkpoint(resume_from)
+        state = HMCState(
+            z=jnp.asarray(arrays["z"]),
+            potential_energy=jnp.asarray(arrays["pe"]),
+            grad=jnp.asarray(arrays["grad"]),
+        )
+        step_size = jnp.asarray(arrays["step_size"])
+        inv_mass = jnp.asarray(arrays["inv_mass"])
+        key = jnp.asarray(arrays["key"])
+        blocks_done = int(meta.get("blocks_done", 0))
+        total_div = int(meta.get("num_divergent", 0))
+        history = list(meta.get("history", []))
+        chains = state.z.shape[0]
+        if "draws" in arrays:
+            draw_blocks = [arrays["draws"]]
+        elif draw_store_path and os.path.exists(draw_store_path):
+            from .drawstore import read_draws
+
+            stored, _, _ = read_draws(draw_store_path, mmap=False)
+            if stored.shape[0]:
+                # (n, chains, d) on disk -> (chains, n, d) in memory
+                draw_blocks = [np.ascontiguousarray(stored.transpose(1, 0, 2))]
+    else:
+        key = jax.random.PRNGKey(seed)
+        key, key_init, key_warm = jax.random.split(key, 3)
+        if init_params is not None:
+            z0 = jnp.broadcast_to(fm.unconstrain(init_params), (chains, fm.ndim))
+        else:
+            z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
+        warm_keys = jax.random.split(key_warm, chains)
+        state, step_size, inv_mass, n_div = jax.block_until_ready(
+            v_warm(warm_keys, z0, data)
+        )
+        emit(
+            {
+                "event": "warmup_done",
+                "wall_s": time.perf_counter() - t_start,
+                "num_divergent": int(np.sum(np.asarray(n_div))),
+                "step_size": np.asarray(step_size).tolist(),
+            }
+        )
+
+    draw_store = None
+    converged = False
+    try:
+        if draw_store_path:
+            from .drawstore import DrawStore
+
+            draw_store = DrawStore(draw_store_path, chains, fm.ndim)
+
+        while blocks_done < max_blocks:
+            key, key_block = jax.random.split(key)
+            block_keys = jax.random.split(key_block, chains)
+            if profile_dir and blocks_done == 0:
+                with jax.profiler.trace(profile_dir):
+                    out = jax.block_until_ready(
+                        v_block(block_keys, state, step_size, inv_mass, data)
+                    )
+            else:
+                out = jax.block_until_ready(
+                    v_block(block_keys, state, step_size, inv_mass, data)
+                )
+            state, zs, accept, divergent, energy, ngrad = out
+            blocks_done += 1
+            draw_blocks.append(np.asarray(zs))  # (chains, block, d)
+            if draw_store is not None:
+                draw_store.append(draw_blocks[-1])  # async; doesn't stall the loop
+            total_div += int(np.sum(np.asarray(divergent)))
+
+            all_draws = np.concatenate(draw_blocks, axis=1)
+            rhat = diagnostics.split_rhat(all_draws)
+            max_rhat = float(np.max(rhat))
+            min_ess = float(np.min(diagnostics.ess(all_draws)))
+            wall = time.perf_counter() - t_start
+            rec = {
+                "event": "block",
+                "block": blocks_done,
+                "draws_per_chain": int(all_draws.shape[1]),
+                "max_rhat": max_rhat,
+                "min_ess": min_ess,
+                "num_divergent": total_div,
+                "wall_s": wall,
+            }
+            history.append(rec)
+            emit(rec)
+
+            if checkpoint_path:
+                from .checkpoint import save_checkpoint
+
+                arrays = {
+                    "z": np.asarray(state.z),
+                    "pe": np.asarray(state.potential_energy),
+                    "grad": np.asarray(state.grad),
+                    "step_size": np.asarray(step_size),
+                    "inv_mass": np.asarray(inv_mass),
+                    "key": np.asarray(key),
+                }
+                if draw_store is None:
+                    # no draw store -> draws ride in the checkpoint; with a
+                    # store the draws are already persisted incrementally
+                    # (avoids O(blocks^2) checkpoint I/O)
+                    arrays["draws"] = all_draws
+                else:
+                    draw_store.flush()  # store on disk before state advances
+                save_checkpoint(
+                    checkpoint_path,
+                    arrays,
+                    {
+                        "blocks_done": blocks_done,
+                        "num_divergent": total_div,
+                        "history": history,
+                        "model": type(model).__name__,
+                    },
+                )
+
+            if (
+                blocks_done >= min_blocks
+                and max_rhat < rhat_target
+                and min_ess > ess_target
+            ):
+                converged = True
+                break
+    finally:
+        if metrics_f:
+            metrics_f.close()
+        if draw_store is not None:
+            draw_store.close()
+
+    all_draws = np.concatenate(draw_blocks, axis=1)
+    draws = _constrain_draws(fm, all_draws)
+    stats = {"num_divergent": np.asarray(total_div)}
+    return AdaptiveResult(
+        draws,
+        stats,
+        flat_model=fm,
+        draws_flat=all_draws,
+        history=history,
+        converged=converged,
+        wall_s=time.perf_counter() - t_start,
+    )

@@ -79,16 +79,10 @@ class ChainResult(NamedTuple):
     suff_m2: Array
 
 
-def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):
-    """Build (key, z0, data) -> ChainResult; one chain, fully compiled.
-
-    The data pytree is a runtime argument so the jitted runner is reusable
-    across datasets of the same shape (no recompile per ``sample()`` call).
-    vmap over (key, z0) for chains with data broadcast.  Kernels receive a
-    ``model.Potential`` so sharded models get the fused single-psum
-    value-and-grad path.
-    """
-    step_kernel = make_kernel(cfg)
+def make_warmup_fn(fm: FlatModel, cfg: SamplerConfig):
+    """Build warmup(key, state, potential_fn, kernel) ->
+    (state, step_size, inv_mass, n_divergent) — the windowed Stan-style
+    adaptation loop as one `lax.scan`."""
     schedule = build_warmup_schedule(cfg.num_warmup)
     adapt_mass_flags = jnp.asarray(schedule.adapt_mass)
     window_end_flags = jnp.asarray(schedule.window_end)
@@ -153,6 +147,21 @@ def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):
         )
         return state, step_size, inv_mass, n_div
 
+    return warmup
+
+
+def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):
+    """Build (key, z0, data) -> ChainResult; one chain, fully compiled.
+
+    The data pytree is a runtime argument so the jitted runner is reusable
+    across datasets of the same shape (no recompile per ``sample()`` call).
+    vmap over (key, z0) for chains with data broadcast.  Kernels receive a
+    ``model.Potential`` so sharded models get the fused single-psum
+    value-and-grad path.
+    """
+    step_kernel = make_kernel(cfg)
+    warmup = make_warmup_fn(fm, cfg)
+
     def run(key, z0, data=None):
         potential_fn = fm.bind(data)
         kernel = partial(step_kernel, potential_fn=potential_fn)
@@ -206,6 +215,57 @@ def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):
         )
 
     return run
+
+
+def make_block_runners(fm: FlatModel, cfg: SamplerConfig, block_size: int):
+    """Split-phase runners for the adaptive (run-until-converged) driver.
+
+    Returns (warmup_run, block_run), each jit/vmap-able per chain:
+      warmup_run(key, z0, data) -> (HMCState, step_size, inv_mass, n_div)
+      block_run(key, state, step_size, inv_mass, data)
+        -> (HMCState, zs, accept, divergent, energy, ngrad)
+
+    Control crosses host<->device once per BLOCK (SURVEY.md §4: "periodic
+    async draw fetch + convergence check"), which is how wall-clock-to-
+    R-hat<1.01 — the primary metric — is measured without paying a host
+    round-trip per transition.
+    """
+    step_kernel = make_kernel(cfg)
+    warmup = make_warmup_fn(fm, cfg)
+
+    def warmup_run(key, z0, data=None):
+        potential_fn = fm.bind(data)
+        kernel = partial(step_kernel, potential_fn=potential_fn)
+        state = init_state(potential_fn, z0)
+        return warmup(key, state, potential_fn, kernel)
+
+    def block_run(key, state, step_size, inv_mass, data=None):
+        potential_fn = fm.bind(data)
+        kernel = partial(step_kernel, potential_fn=potential_fn)
+        # state was checkpointed/carried as raw arrays; rebuild gradient
+        # lazily only if absent is not possible under jit, so the carried
+        # state must include pe/grad (it does — HMCState is the carry).
+
+        def body(state, key):
+            state, info = kernel(
+                key, state, step_size=step_size, inv_mass_diag=inv_mass
+            )
+            out = (
+                state.z,
+                info.accept_prob,
+                info.is_divergent,
+                info.energy,
+                info.num_grad_evals,
+            )
+            return state, out
+
+        keys = jax.random.split(key, block_size)
+        state, (zs, accept, divergent, energy, ngrad) = jax.lax.scan(
+            body, state, keys
+        )
+        return state, zs, accept, divergent, energy, ngrad
+
+    return warmup_run, block_run
 
 
 class Posterior:

@@ -1,0 +1,111 @@
+"""SG-HMC sampler frontend (benchmark config 5, BASELINE.json:11).
+
+Runs vectorized parallel chains of the friction SG-HMC kernel
+(`kernels.sghmc`) with a static-shape minibatch gradient estimator.  The
+whole warmup+sample run is one compiled program per chain (`lax.scan`),
+chains vectorized with `vmap` and optionally spread over a mesh "chains"
+axis with `shard_map` — no host round-trips inside the loop, matching the
+target stack in SURVEY.md §4.
+
+SG-HMC has no accept statistic, so there is no dual-averaging warmup; the
+"warmup" here is a discarded burn-in run at the same step size.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .kernels.sghmc import SGHMCState, make_minibatch_grad, sghmc_init, sghmc_step
+from .model import Model, flatten_model
+from .sampler import Posterior, _constrain_draws
+
+
+def sghmc_sample(
+    model: Model,
+    data,
+    *,
+    batch_size: int,
+    chains: int = 4,
+    num_warmup: int = 500,
+    num_samples: int = 1000,
+    thin: int = 1,
+    step_size: float = 1e-3,
+    friction: float = 1.0,
+    resample_every: int = 50,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+    init_params: Optional[Dict[str, Any]] = None,
+) -> Posterior:
+    """Run parallel-chain SG-HMC and return a Posterior.
+
+    data must have a leading row axis on every leaf; the likelihood term is
+    scaled by N/batch_size so the stochastic gradient is unbiased for the
+    full-data potential.
+    """
+    data = jax.tree.map(jnp.asarray, data)
+    n = jax.tree.leaves(data)[0].shape[0]
+    if batch_size > n:
+        raise ValueError(f"batch_size={batch_size} > rows={n}")
+    fm = flatten_model(model, lik_scale=n / batch_size)
+    grad_fn = make_minibatch_grad(fm.potential, data, batch_size)
+
+    total = num_warmup + num_samples * thin
+    # host-precomputed momentum-refresh schedule, fed to the scan as xs
+    steps = np.arange(total)
+    resample_flags = jnp.asarray(
+        (steps % max(resample_every, 1) == 0) if resample_every else np.zeros(total, bool)
+    )
+
+    def run_chain(key, z0):
+        key_init, key_scan = jax.random.split(key)
+        inv_mass = jnp.ones_like(z0)
+        state = sghmc_init(key_init, z0, inv_mass)
+
+        def body(state, x):
+            key, refresh = x
+            state, info = sghmc_step(
+                key,
+                state,
+                grad_fn,
+                jnp.asarray(step_size, z0.dtype),
+                jnp.asarray(friction, z0.dtype),
+                inv_mass,
+                resample_momentum=refresh,
+            )
+            return state, (state.z, info.kinetic_energy, info.is_divergent)
+
+        keys = jax.random.split(key_scan, total)
+        state, (zs, ke, div) = jax.lax.scan(body, state, (keys, resample_flags))
+        zs = zs[num_warmup:][thin - 1 :: thin]
+        ke = ke[num_warmup:][thin - 1 :: thin]
+        n_div = jnp.sum(div.astype(jnp.int32))
+        return zs, ke, n_div
+
+    key = jax.random.PRNGKey(seed)
+    key_init, key_run = jax.random.split(key)
+    if init_params is not None:
+        z0 = jnp.broadcast_to(fm.unconstrain(init_params), (chains, fm.ndim))
+    else:
+        z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
+    chain_keys = jax.random.split(key_run, chains)
+
+    vrun = jax.vmap(run_chain)
+    if mesh is None:
+        zs, ke, n_div = jax.block_until_ready(jax.jit(vrun)(chain_keys, z0))
+    else:
+        from .parallel.mesh import run_over_chains
+
+        zs, ke, n_div = run_over_chains(mesh, vrun, chain_keys, z0)
+
+    draws = _constrain_draws(fm, zs)
+    stats = {
+        "kinetic_energy": np.asarray(ke),
+        "num_divergent": np.asarray(n_div),
+        "step_size": np.full((chains,), step_size),
+    }
+    return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(zs))

@@ -1,0 +1,78 @@
+"""CpuBackend tests: the host-Python recursive-NUTS reference.
+
+It is an independent implementation (recursive tree, NumPy accumulators) so
+agreement with the compiled iterative NUTS on a known posterior is a strong
+cross-check of both (SURVEY.md §5 "correctness oracles").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import stark_tpu
+from stark_tpu.backends import CpuBackend
+from stark_tpu.model import Model, ParamSpec
+
+
+class ConjugateNormal(Model):
+    """y_i ~ N(mu, 1), mu ~ N(0, 10) — posterior is N(sum y/(1/100+n), ...)."""
+
+    def param_spec(self):
+        return {"mu": ParamSpec(())}
+
+    def log_prior(self, p):
+        return jax.scipy.stats.norm.logpdf(p["mu"], 0.0, 10.0)
+
+    def log_lik(self, p, data):
+        return jnp.sum(jax.scipy.stats.norm.logpdf(data["y"], p["mu"], 1.0))
+
+
+def _true_posterior(y):
+    prec = 1.0 / 100.0 + y.shape[0]
+    return y.sum() / prec, 1.0 / prec
+
+
+def test_cpu_backend_matches_analytic_posterior():
+    y = np.asarray(2.0 + np.random.default_rng(0).standard_normal(32), np.float32)
+    data = {"y": jnp.asarray(y)}
+    post = stark_tpu.sample(
+        ConjugateNormal(), data, backend=CpuBackend(), chains=2,
+        kernel="nuts", max_tree_depth=6, num_warmup=200, num_samples=300,
+        seed=0,
+    )
+    mu_true, var_true = _true_posterior(y)
+    draws = post.draws["mu"]
+    assert abs(draws.mean() - mu_true) < 4 * np.sqrt(var_true / draws.size)
+    assert 0.6 * var_true < draws.var() < 1.6 * var_true
+    assert post.max_rhat() < 1.05
+
+
+def test_cpu_and_jax_backends_agree():
+    """Same posterior, two independent NUTS implementations."""
+    y = np.asarray(1.0 + 0.5 * np.random.default_rng(1).standard_normal(24), np.float32)
+    data = {"y": jnp.asarray(y)}
+    kwargs = dict(
+        chains=2, kernel="nuts", max_tree_depth=6,
+        num_warmup=300, num_samples=500,
+    )
+    post_cpu = stark_tpu.sample(
+        ConjugateNormal(), data, backend=CpuBackend(), seed=0, **kwargs
+    )
+    post_jax = stark_tpu.sample(ConjugateNormal(), data, seed=0, **kwargs)
+    m_cpu, m_jax = post_cpu.draws["mu"].mean(), post_jax.draws["mu"].mean()
+    s_cpu, s_jax = post_cpu.draws["mu"].std(), post_jax.draws["mu"].std()
+    mu_true, var_true = _true_posterior(y)
+    se = np.sqrt(var_true / 500)
+    assert abs(m_cpu - mu_true) < 5 * se
+    assert abs(m_jax - mu_true) < 5 * se
+    assert abs(s_cpu - s_jax) < 0.3 * np.sqrt(var_true)
+
+
+def test_cpu_backend_hmc_kernel():
+    y = np.asarray(np.random.default_rng(2).standard_normal(16), np.float32)
+    post = stark_tpu.sample(
+        ConjugateNormal(), {"y": jnp.asarray(y)}, backend=CpuBackend(),
+        chains=1, kernel="hmc", num_leapfrog=8, num_warmup=100,
+        num_samples=200, seed=3,
+    )
+    assert np.all(np.isfinite(post.draws["mu"]))

@@ -1,0 +1,97 @@
+"""Native C++ DrawStore tests: build, roundtrip, async semantics, runner hook."""
+
+import numpy as np
+import pytest
+
+from stark_tpu.drawstore import DrawStore, read_draws
+
+
+def test_roundtrip(tmp_path):
+    path = str(tmp_path / "draws.stkd")
+    rng = np.random.default_rng(0)
+    b1 = rng.standard_normal((4, 10, 3)).astype(np.float32)  # (chains, n, d)
+    b2 = rng.standard_normal((4, 7, 3)).astype(np.float32)
+    with DrawStore(path, chains=4, dim=3) as ds:
+        ds.append(b1)
+        ds.append(b2)
+        ds.flush()
+        assert len(ds) == 17
+    draws, chains, dim = read_draws(path)
+    assert (chains, dim) == (4, 3)
+    assert draws.shape == (17, 4, 3)
+    # draw-major on disk == transpose of the (chains, n, d) blocks
+    np.testing.assert_array_equal(draws[:10], np.transpose(b1, (1, 0, 2)))
+    np.testing.assert_array_equal(draws[10:], np.transpose(b2, (1, 0, 2)))
+
+
+def test_many_async_appends(tmp_path):
+    path = str(tmp_path / "many.stkd")
+    blocks = [
+        np.full((2, 5, 2), i, np.float32) for i in range(50)
+    ]
+    with DrawStore(path, chains=2, dim=2) as ds:
+        for b in blocks:
+            ds.append(b)  # returns immediately; writer thread drains
+    draws, _, _ = read_draws(path)
+    assert draws.shape == (250, 2, 2)
+    for i in range(50):
+        np.testing.assert_array_equal(
+            draws[5 * i : 5 * (i + 1)], np.full((5, 2, 2), i, np.float32)
+        )
+
+
+def test_reopen_appends_instead_of_truncating(tmp_path):
+    path = str(tmp_path / "resume.stkd")
+    b1 = np.ones((2, 5, 3), np.float32)
+    with DrawStore(path, chains=2, dim=3) as ds:
+        ds.append(b1)
+    # reopening with a matching header must preserve + append
+    with DrawStore(path, chains=2, dim=3) as ds:
+        assert len(ds) == 5
+        ds.append(2.0 * b1)
+    draws, _, _ = read_draws(path)
+    assert draws.shape == (10, 2, 3)
+    np.testing.assert_array_equal(draws[:5], np.ones((5, 2, 3), np.float32))
+    np.testing.assert_array_equal(draws[5:], 2 * np.ones((5, 2, 3), np.float32))
+    # mismatched header is an error, not a truncation
+    import pytest as _pytest
+
+    with _pytest.raises(OSError):
+        DrawStore(path, chains=4, dim=3)
+    draws2, _, _ = read_draws(path)
+    assert draws2.shape == (10, 2, 3)
+
+
+def test_shape_validation(tmp_path):
+    with DrawStore(str(tmp_path / "v.stkd"), chains=2, dim=3) as ds:
+        with pytest.raises(ValueError):
+            ds.append(np.zeros((5, 4), np.float32))
+        with pytest.raises(ValueError):
+            ds.append(np.zeros((7, 7, 7), np.float32))
+
+
+def test_runner_writes_draw_store(tmp_path):
+    import jax.numpy as jnp
+
+    import stark_tpu
+    from stark_tpu.model import Model, ParamSpec
+
+    class StdNormal(Model):
+        def param_spec(self):
+            return {"x": ParamSpec((2,))}
+
+        def log_prior(self, p):
+            return -0.5 * jnp.sum(p["x"] ** 2)
+
+    path = str(tmp_path / "run.stkd")
+    post = stark_tpu.sample_until_converged(
+        StdNormal(), chains=2, block_size=25, max_blocks=2, min_blocks=2,
+        rhat_target=0.5, num_warmup=50, kernel="hmc", num_leapfrog=8,
+        seed=0, draw_store_path=path,
+    )
+    draws, chains, dim = read_draws(path)
+    assert (chains, dim) == (2, 2)
+    assert draws.shape[0] == post.num_samples
+    np.testing.assert_allclose(
+        np.transpose(draws, (1, 0, 2)), post.draws_flat, rtol=1e-6
+    )

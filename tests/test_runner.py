@@ -1,0 +1,83 @@
+"""Adaptive runner tests: run-until-R-hat, metrics JSONL, checkpoint/resume."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import stark_tpu
+from stark_tpu.checkpoint import load_checkpoint, save_checkpoint
+from stark_tpu.model import Model, ParamSpec
+
+
+class StdNormal2(Model):
+    def param_spec(self):
+        return {"x": ParamSpec((2,))}
+
+    def log_prior(self, p):
+        return -0.5 * jnp.sum(p["x"] ** 2)
+
+    def log_lik(self, p, data):
+        return jnp.zeros(())
+
+
+def test_sample_until_converged(tmp_path):
+    metrics = str(tmp_path / "metrics.jsonl")
+    ckpt = str(tmp_path / "state.npz")
+    post = stark_tpu.sample_until_converged(
+        StdNormal2(),
+        chains=4,
+        block_size=100,
+        max_blocks=20,
+        rhat_target=1.02,
+        ess_target=200.0,
+        num_warmup=150,
+        kernel="nuts",
+        max_tree_depth=6,
+        seed=0,
+        metrics_path=metrics,
+        checkpoint_path=ckpt,
+    )
+    assert post.converged, post.history
+    assert post.max_rhat() < 1.02
+    assert post.min_ess() > 200.0
+    # metrics JSONL: warmup event + one line per block
+    lines = [json.loads(l) for l in open(metrics)]
+    assert lines[0]["event"] == "warmup_done"
+    assert sum(1 for l in lines if l["event"] == "block") == len(post.history)
+    # checkpoint written and loadable
+    arrays, meta = load_checkpoint(ckpt)
+    assert arrays["z"].shape == (4, 2)
+    assert meta["blocks_done"] == len(post.history)
+
+
+def test_resume_from_checkpoint(tmp_path):
+    ckpt = str(tmp_path / "state.npz")
+    post1 = stark_tpu.sample_until_converged(
+        StdNormal2(), chains=2, block_size=50, max_blocks=2, min_blocks=2,
+        rhat_target=0.5,  # unreachable -> runs exactly max_blocks
+        num_warmup=100, kernel="hmc", num_leapfrog=8, seed=1,
+        checkpoint_path=ckpt,
+    )
+    assert not post1.converged
+    assert post1.num_samples == 100
+    post2 = stark_tpu.sample_until_converged(
+        StdNormal2(), block_size=50, max_blocks=4, min_blocks=2,
+        rhat_target=0.5, num_warmup=100, kernel="hmc", num_leapfrog=8,
+        resume_from=ckpt,
+    )
+    # resumed run continues from 2 blocks of saved draws to 4 blocks total
+    assert post2.num_samples == 200
+    assert post2.num_chains == 2
+
+
+def test_checkpoint_atomic_roundtrip(tmp_path):
+    path = str(tmp_path / "c.npz")
+    arrays = {"a": np.arange(6).reshape(2, 3), "b": np.ones(4, np.float32)}
+    save_checkpoint(path, arrays, {"k": 1})
+    out, meta = load_checkpoint(path)
+    np.testing.assert_array_equal(out["a"], arrays["a"])
+    np.testing.assert_array_equal(out["b"], arrays["b"])
+    assert meta == {"k": 1}

@@ -1,0 +1,116 @@
+"""SG-HMC kernel + runner tests (benchmark config 5 capability).
+
+Correctness oracle: conjugate normal-mean posterior (known mean/variance);
+SG-HMC is asymptotically biased at finite step size so tolerances are loose
+but tight enough to catch sign/scale errors in the friction update.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import stark_tpu
+from stark_tpu.kernels.sghmc import make_minibatch_grad, sghmc_init, sghmc_step
+from stark_tpu.model import Model, ParamSpec, flatten_model
+from stark_tpu.sghmc import sghmc_sample
+
+
+class NormalMean(Model):
+    """y_i ~ N(mu, 1), mu ~ N(0, prior_sd): conjugate, posterior known."""
+
+    def __init__(self, prior_sd=10.0):
+        self.prior_sd = prior_sd
+
+    def param_spec(self):
+        return {"mu": ParamSpec(())}
+
+    def log_prior(self, p):
+        return jax.scipy.stats.norm.logpdf(p["mu"], 0.0, self.prior_sd)
+
+    def log_lik(self, p, data):
+        return jnp.sum(jax.scipy.stats.norm.logpdf(data["y"], p["mu"], 1.0))
+
+
+def _posterior_mean_var(y, prior_sd):
+    n = y.shape[0]
+    prec = 1.0 / prior_sd**2 + n
+    return float(y.sum() / prec), float(1.0 / prec)
+
+
+def test_minibatch_grad_unbiased():
+    """E[minibatch grad] == full-data grad (averaged over many keys)."""
+    key = jax.random.PRNGKey(0)
+    y = 1.5 + jax.random.normal(key, (64,))
+    data = {"y": y}
+    model = NormalMean()
+    fm_full = flatten_model(model)
+    fm_mb = flatten_model(model, lik_scale=64 / 8)
+    grad_fn = make_minibatch_grad(fm_mb.potential, data, batch_size=8)
+    z = jnp.asarray([0.3])
+    full = jax.grad(fm_full.potential)(z, data)
+    keys = jax.random.split(jax.random.PRNGKey(1), 4000)
+    est = jax.vmap(lambda k: grad_fn(k, z))(keys).mean(0)
+    np.testing.assert_allclose(np.asarray(est), np.asarray(full), rtol=0.05)
+
+
+def test_sghmc_step_finite_and_freezes_on_nan():
+    inv_mass = jnp.ones(2)
+    state = sghmc_init(jax.random.PRNGKey(0), jnp.zeros(2), inv_mass)
+
+    def bad_grad(key, z):
+        return jnp.full_like(z, jnp.nan)
+
+    new, info = sghmc_step(
+        jax.random.PRNGKey(1), state, bad_grad, jnp.asarray(0.01),
+        jnp.asarray(1.0), inv_mass,
+    )
+    assert bool(info.is_divergent)
+    np.testing.assert_array_equal(np.asarray(new.z), np.asarray(state.z))
+
+
+def test_sghmc_conjugate_normal_posterior():
+    key = jax.random.PRNGKey(42)
+    n = 512
+    y = 2.0 + jax.random.normal(key, (n,))
+    data = {"y": y}
+    model = NormalMean()
+    post = sghmc_sample(
+        model,
+        data,
+        batch_size=64,
+        chains=4,
+        num_warmup=500,
+        num_samples=2000,
+        step_size=2e-3,
+        friction=5.0,
+        resample_every=50,
+        seed=3,
+    )
+    mu_true, var_true = _posterior_mean_var(np.asarray(y), 10.0)
+    draws = post.draws["mu"]
+    assert post.num_divergent == 0
+    assert abs(draws.mean() - mu_true) < 0.05
+    # variance within 2x — SGHMC's stationary variance is step-size biased
+    assert 0.5 * var_true < draws.var() < 2.0 * var_true
+
+
+def test_sghmc_on_mesh_chains_axis():
+    from stark_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"data": 2, "chains": 4})
+    key = jax.random.PRNGKey(7)
+    y = 1.0 + jax.random.normal(key, (128,))
+    post = sghmc_sample(
+        NormalMean(),
+        {"y": y},
+        batch_size=32,
+        chains=4,
+        num_warmup=100,
+        num_samples=200,
+        step_size=2e-3,
+        friction=5.0,
+        seed=5,
+        mesh=mesh,
+    )
+    assert post.draws["mu"].shape == (4, 200)
+    assert np.all(np.isfinite(post.draws["mu"]))
