@@ -59,21 +59,41 @@ def main():
         kernel="nuts", max_tree_depth=depth, num_warmup=num_warmup,
         num_samples=num_samples,
     )
-    # compile pass (cached runner), then the timed run
-    stark_tpu.sample(model, data, backend=backend, chains=chains, seed=0, **kwargs)
-    t0 = time.perf_counter()
-    post = stark_tpu.sample(
-        model, data, backend=backend, chains=chains, seed=1, **kwargs
-    )
-    wall = time.perf_counter() - t0
+
+    def timed_run(m, tag):
+        # compile pass (cached runner), then the timed run
+        stark_tpu.sample(m, data, backend=backend, chains=chains, seed=0, **kwargs)
+        t0 = time.perf_counter()
+        post = stark_tpu.sample(
+            m, data, backend=backend, chains=chains, seed=1, **kwargs
+        )
+        wall = time.perf_counter() - t0
+        eps = post.min_ess() / wall
+        print(
+            f"[bench] {tag}: wall={wall:.1f}s min_ess={post.min_ess():.0f} "
+            f"ess/s={eps:.2f} max_rhat={post.max_rhat():.3f} "
+            f"divergent={post.num_divergent}",
+            file=sys.stderr,
+        )
+        return post, eps
+
+    post, ess_per_sec = timed_run(model, "autodiff")
+    try_fused = os.environ.get("BENCH_FUSED", "auto")
+    # "auto": only on accelerators — the CPU interpret path is orders of
+    # magnitude slower and would dominate bench wall-clock for nothing
+    if try_fused == "1" or (try_fused == "auto" and platform != "cpu"):
+        # one-pass Pallas likelihood kernel; fall back silently if Mosaic
+        # rejects it on this chip so the bench always records a result
+        try:
+            from stark_tpu.models import FusedHierLogistic
+
+            fused = FusedHierLogistic(num_features=d, num_groups=groups)
+            _, eps_fused = timed_run(fused, "pallas-fused")
+            if eps_fused > ess_per_sec:
+                ess_per_sec = eps_fused
+        except Exception as e:  # noqa: BLE001 — any compile/runtime failure
+            print(f"[bench] fused path unavailable: {e!r}", file=sys.stderr)
     min_ess = post.min_ess()
-    ess_per_sec = min_ess / wall
-    print(
-        f"[bench] tpu: wall={wall:.1f}s min_ess={min_ess:.0f} "
-        f"ess/s={ess_per_sec:.2f} max_rhat={post.max_rhat():.3f} "
-        f"divergent={post.num_divergent}",
-        file=sys.stderr,
-    )
 
     # ---- CPU reference denominator (host-driven loop, reference-style) ----
     baseline_file = os.path.join(

@@ -2,11 +2,19 @@ from .bnn import BayesianMLP, synth_bnn_data
 from .eight_schools import EightSchools, eight_schools_data
 from .gmm import GaussianMixture, synth_gmm_data
 from .lmm import LinearMixedModel, synth_lmm_data
-from .logistic import HierLogistic, Logistic, synth_logistic_data
+from .logistic import (
+    FusedHierLogistic,
+    FusedLogistic,
+    HierLogistic,
+    Logistic,
+    synth_logistic_data,
+)
 
 __all__ = [
     "BayesianMLP",
     "EightSchools",
+    "FusedHierLogistic",
+    "FusedLogistic",
     "GaussianMixture",
     "HierLogistic",
     "LinearMixedModel",

@@ -1,0 +1,6 @@
+from .logistic_fused import (
+    fused_logistic_flat_model,
+    logistic_loglik_value_and_grad,
+)
+
+__all__ = ["fused_logistic_flat_model", "logistic_loglik_value_and_grad"]
