@@ -77,7 +77,7 @@ def main():
         )
         return post, eps
 
-    post, ess_per_sec = timed_run(model, "autodiff")
+    _, ess_per_sec = timed_run(model, "autodiff")
     try_fused = os.environ.get("BENCH_FUSED", "auto")
     # "auto": only on accelerators — the CPU interpret path is orders of
     # magnitude slower and would dominate bench wall-clock for nothing
@@ -93,7 +93,6 @@ def main():
                 ess_per_sec = eps_fused
         except Exception as e:  # noqa: BLE001 — any compile/runtime failure
             print(f"[bench] fused path unavailable: {e!r}", file=sys.stderr)
-    min_ess = post.min_ess()
 
     # ---- CPU reference denominator (host-driven loop, reference-style) ----
     baseline_file = os.path.join(

@@ -17,3 +17,13 @@ if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize imports jax at interpreter startup — BEFORE this
+# file runs — so jax's config has already captured JAX_PLATFORMS=axon from
+# the environment and the os.environ write above is too late for it.
+# jax.config.update works any time before the backend actually initializes
+# (first jax.devices()/dispatch), which is still in the future here.
+# XLA_FLAGS is read at CPU-backend init, so the env write above does work.
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -86,12 +86,9 @@ class FusedLogistic(Logistic):
     """
 
     def log_lik(self, p, data):
-        from ..ops.logistic_fused import logistic_offset_loglik
+        from ..ops.logistic_fused import logistic_loglik
 
-        x = data["x"]
-        return logistic_offset_loglik(
-            p["beta"], jnp.zeros((x.shape[0],), x.dtype), x, data["y"]
-        )
+        return logistic_loglik(p["beta"], data["x"], data["y"])
 
 
 class FusedHierLogistic(HierLogistic):

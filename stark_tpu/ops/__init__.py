@@ -1,6 +1,11 @@
 from .logistic_fused import (
-    fused_logistic_flat_model,
+    logistic_loglik,
     logistic_loglik_value_and_grad,
+    logistic_offset_loglik,
 )
 
-__all__ = ["fused_logistic_flat_model", "logistic_loglik_value_and_grad"]
+__all__ = [
+    "logistic_loglik",
+    "logistic_loglik_value_and_grad",
+    "logistic_offset_loglik",
+]

@@ -20,7 +20,6 @@ float32 tolerance (see tests/test_ops_fused.py).
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 from typing import Optional
 
@@ -28,13 +27,20 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ..model import FlatModel, Potential
-
 _ROW_TILE = 1024
 _LANE = 128
 
 
-def _kernel(x_ref, y_ref, w_ref, beta_ref, val_ref, grad_ref):
+def _kernel_body(x_ref, y_ref, w_ref, beta_ref, val_ref, grad_ref,
+                 off_ref=None, resid_ref=None):
+    """Shared tile body for both entry points.
+
+    With ``off_ref``/``resid_ref`` (the offset variant) logits get a per-row
+    offset and the per-row residual is written out so the caller's VJP can
+    chain through whatever produced the offsets (gather → segment-sum, in
+    XLA outside the kernel).
+    """
+
     @pl.when(pl.program_id(0) == 0)
     def _init():
         val_ref[...] = jnp.zeros_like(val_ref)
@@ -48,9 +54,13 @@ def _kernel(x_ref, y_ref, w_ref, beta_ref, val_ref, grad_ref):
         x, beta, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # (TILE, 1)
+    if off_ref is not None:
+        logits = logits + off_ref[...]
     ll = y * jax.nn.log_sigmoid(logits) + (1.0 - y) * jax.nn.log_sigmoid(-logits)
     val_ref[0, 0] += jnp.sum(ll * w)
     resid = (y - jax.nn.sigmoid(logits)) * w  # (TILE, 1)
+    if resid_ref is not None:
+        resid_ref[...] = resid
     grad_ref[...] += jax.lax.dot_general(
         resid, x, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -67,6 +77,67 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, widths)
 
 
+def _fused_call(beta, x, y, offsets, *, row_tile, interpret):
+    """Pad to tile multiples, build specs, and invoke the shared kernel body.
+
+    -> (ll scalar, dll/dbeta (D,)) without offsets, plus the (N,) per-row
+    residual when ``offsets`` is given.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"  # non-CPU (tpu/axon): real Mosaic lowering
+    n, d = x.shape
+    xp = _pad_to(_pad_to(x, 0, row_tile), 1, _LANE)
+    dp = xp.shape[1]
+    np_rows = xp.shape[0]
+    grid = np_rows // row_tile
+
+    def row_spec(width=1):
+        return pl.BlockSpec((row_tile, width), lambda i: (i, 0))
+
+    args = [
+        xp,
+        _pad_to(y.astype(jnp.float32)[:, None], 0, row_tile),
+        _pad_to(jnp.ones((n, 1), jnp.float32), 0, row_tile),
+    ]
+    in_specs = [row_spec(dp), row_spec(), row_spec()]
+    if offsets is not None:
+        args.append(_pad_to(offsets.astype(jnp.float32)[:, None], 0, row_tile))
+        in_specs.append(row_spec())
+    args.append(_pad_to(beta.astype(jnp.float32)[None, :], 1, _LANE))
+    in_specs.append(pl.BlockSpec((1, dp), lambda i: (0, 0)))
+
+    out_specs = [
+        pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        pl.BlockSpec((1, dp), lambda i: (0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        jax.ShapeDtypeStruct((1, dp), jnp.float32),
+    ]
+    if offsets is not None:
+        out_specs.append(row_spec())
+        out_shape.append(jax.ShapeDtypeStruct((np_rows, 1), jnp.float32))
+        def kernel(x_ref, y_ref, w_ref, off_ref, beta_ref,
+                   val_ref, grad_ref, resid_ref):
+            _kernel_body(x_ref, y_ref, w_ref, beta_ref, val_ref, grad_ref,
+                         off_ref=off_ref, resid_ref=resid_ref)
+    else:
+        kernel = _kernel_body
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*args)
+    val, grad = out[0][0, 0], out[1][0, :d]
+    if offsets is not None:
+        return val, grad, out[2][:n, 0]
+    return val, grad
+
+
 @functools.partial(jax.jit, static_argnames=("row_tile", "interpret"))
 def logistic_loglik_value_and_grad(
     beta: jax.Array,
@@ -80,103 +151,12 @@ def logistic_loglik_value_and_grad(
 
     beta: (D,), x: (N, D) float32, y: (N,) in {0, 1}.
     """
-    if interpret is None:
-        interpret = jax.default_backend() == "cpu"  # non-CPU (tpu/axon): real Mosaic lowering
-    n, d = x.shape
-    xp = _pad_to(_pad_to(x, 0, row_tile), 1, _LANE)
-    dp = xp.shape[1]
-    yp = _pad_to(y.astype(jnp.float32)[:, None], 0, row_tile)
-    w = _pad_to(jnp.ones((n, 1), jnp.float32), 0, row_tile)
-    betap = _pad_to(beta.astype(jnp.float32)[None, :], 1, _LANE)
-    grid = xp.shape[0] // row_tile
-
-    val, grad = pl.pallas_call(
-        _kernel,
-        grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((row_tile, dp), lambda i: (i, 0)),
-            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((1, dp), lambda i: (0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1), lambda i: (0, 0)),
-            pl.BlockSpec((1, dp), lambda i: (0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((1, 1), jnp.float32),
-            jax.ShapeDtypeStruct((1, dp), jnp.float32),
-        ],
-        interpret=interpret,
-    )(xp, yp, w, betap)
-    return val[0, 0], grad[0, :d]
-
-
-def _kernel_offset(x_ref, y_ref, w_ref, off_ref, beta_ref, val_ref, grad_ref, resid_ref):
-    """Like _kernel but logits get a per-row offset (e.g. group intercepts),
-    and the per-row residual (y - sigmoid) is written out so the caller can
-    backprop through the offset path (segment-sum outside, in XLA)."""
-
-    @pl.when(pl.program_id(0) == 0)
-    def _init():
-        val_ref[...] = jnp.zeros_like(val_ref)
-        grad_ref[...] = jnp.zeros_like(grad_ref)
-
-    x = x_ref[...]
-    y = y_ref[...]
-    w = w_ref[...]
-    beta = beta_ref[...]
-    logits = jax.lax.dot_general(
-        x, beta, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) + off_ref[...]
-    ll = y * jax.nn.log_sigmoid(logits) + (1.0 - y) * jax.nn.log_sigmoid(-logits)
-    val_ref[0, 0] += jnp.sum(ll * w)
-    resid = (y - jax.nn.sigmoid(logits)) * w
-    resid_ref[...] = resid
-    grad_ref[...] += jax.lax.dot_general(
-        resid, x, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+    return _fused_call(beta, x, y, None, row_tile=row_tile, interpret=interpret)
 
 
 @functools.partial(jax.jit, static_argnames=("row_tile", "interpret"))
 def _offset_fused(beta, offsets, x, y, *, row_tile=_ROW_TILE, interpret=None):
-    if interpret is None:
-        interpret = jax.default_backend() == "cpu"  # non-CPU (tpu/axon): real Mosaic lowering
-    n, d = x.shape
-    xp = _pad_to(_pad_to(x, 0, row_tile), 1, _LANE)
-    dp = xp.shape[1]
-    np_rows = xp.shape[0]
-    yp = _pad_to(y.astype(jnp.float32)[:, None], 0, row_tile)
-    offp = _pad_to(offsets.astype(jnp.float32)[:, None], 0, row_tile)
-    w = _pad_to(jnp.ones((n, 1), jnp.float32), 0, row_tile)
-    betap = _pad_to(beta.astype(jnp.float32)[None, :], 1, _LANE)
-    grid = np_rows // row_tile
-
-    val, grad, resid = pl.pallas_call(
-        _kernel_offset,
-        grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((row_tile, dp), lambda i: (i, 0)),
-            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((1, dp), lambda i: (0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1), lambda i: (0, 0)),
-            pl.BlockSpec((1, dp), lambda i: (0, 0)),
-            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((1, 1), jnp.float32),
-            jax.ShapeDtypeStruct((1, dp), jnp.float32),
-            jax.ShapeDtypeStruct((np_rows, 1), jnp.float32),
-        ],
-        interpret=interpret,
-    )(xp, yp, w, offp, betap)
-    return val[0, 0], grad[0, :d], resid[:n, 0]
+    return _fused_call(beta, x, y, offsets, row_tile=row_tile, interpret=interpret)
 
 
 @jax.custom_vjp
@@ -205,30 +185,26 @@ def _off_bwd(res, ct):
 logistic_offset_loglik.defvjp(_off_fwd, _off_bwd)
 
 
-def fused_logistic_flat_model(fm: FlatModel, model) -> FlatModel:
-    """Swap the flat Logistic model's potential for the fused-kernel path.
+@jax.custom_vjp
+def logistic_loglik(beta, x, y):
+    """Differentiable fused op: Bernoulli-logit log-lik of Xβ (no offset).
 
-    ``model`` must be ``models.logistic.Logistic`` (flat coefficients,
-    identity bijectors — the flat vector IS beta).  Returns a FlatModel
-    whose ``bind(data)`` yields a Potential computing the likelihood term
-    with the one-pass Pallas kernel and the (cheap, data-free) prior term
-    with autodiff.
+    One Pallas pass yields both the value and ∂/∂β, so the VJP never
+    re-reads X and — unlike routing through ``logistic_offset_loglik``
+    with a zeros offset — no (N,) offset input is streamed in and no (N,)
+    residual output is written back per evaluation.
     """
-    vag_prior = jax.value_and_grad(lambda z: fm.potential(z, None))
+    val, _ = logistic_loglik_value_and_grad(beta, x, y)
+    return val
 
-    def factory(data) -> Potential:
-        if data is None:
-            return Potential(
-                lambda z: fm.potential(z, None),
-                lambda z: vag_prior(z),
-            )
-        x, y = data["x"], data["y"]
 
-        def value_and_grad(z):
-            pv, pg = vag_prior(z)
-            ll, llg = logistic_loglik_value_and_grad(z, x, y)
-            return pv - ll, pg - llg
+def _noff_fwd(beta, x, y):
+    val, gbeta = logistic_loglik_value_and_grad(beta, x, y)
+    return val, gbeta
 
-        return Potential(lambda z: value_and_grad(z)[0], value_and_grad)
 
-    return dataclasses.replace(fm, potential_factory=factory)
+def _noff_bwd(gbeta, ct):
+    return ct * gbeta, None, None
+
+
+logistic_loglik.defvjp(_noff_fwd, _noff_bwd)

@@ -7,10 +7,7 @@ import numpy as np
 import stark_tpu
 from stark_tpu.model import flatten_model
 from stark_tpu.models import Logistic, synth_logistic_data
-from stark_tpu.ops import (
-    fused_logistic_flat_model,
-    logistic_loglik_value_and_grad,
-)
+from stark_tpu.ops import logistic_loglik_value_and_grad
 
 
 def _autodiff_oracle(beta, x, y):
@@ -67,10 +64,12 @@ def test_fused_hier_sampling_vmapped():
 
 def test_fused_flat_model_sampling():
     """NUTS through the fused potential reproduces the autodiff posterior."""
+    from stark_tpu.models import FusedLogistic
+
     model = Logistic(num_features=4)
     data, true = synth_logistic_data(jax.random.PRNGKey(1), 2048, 4)
     fm = flatten_model(model)
-    fm_fused = fused_logistic_flat_model(fm, model)
+    fm_fused = flatten_model(FusedLogistic(num_features=4))
 
     pot_a = fm.bind(jax.tree.map(jnp.asarray, data))
     pot_f = fm_fused.bind(jax.tree.map(jnp.asarray, data))
