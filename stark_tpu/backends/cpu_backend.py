@@ -224,6 +224,8 @@ class CpuBackend:
         init_params: Optional[Dict[str, Any]] = None,
     ) -> Posterior:
         fm = flatten_model(model)
+        if data is not None:
+            data = model.prepare_data(data)  # host backend: keep numpy leaves
         pot = _HostPotential(fm, data)
         schedule = build_warmup_schedule(cfg.num_warmup)
 

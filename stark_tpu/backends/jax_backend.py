@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..model import Model, flatten_model
+from ..model import Model, flatten_model, prepare_model_data
 from ..sampler import Posterior, SamplerConfig, _constrain_draws, make_chain_runner
 
 
@@ -46,8 +46,7 @@ class JaxBackend:
         init_params: Optional[Dict[str, Any]] = None,
     ) -> Posterior:
         fm = flatten_model(model)
-        if data is not None:
-            data = jax.tree.map(jnp.asarray, data)
+        data = prepare_model_data(model, data)
 
         key = jax.random.PRNGKey(seed)
         key_init, key_run = jax.random.split(key)

@@ -20,8 +20,8 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..model import Model, flatten_model
-from ..parallel.mesh import make_mesh, shard_data
+from ..model import Model, flatten_model, prepare_model_data
+from ..parallel.mesh import make_mesh, row_partition_specs, shard_data
 from ..sampler import Posterior, SamplerConfig, _constrain_draws, make_chain_runner
 
 
@@ -39,7 +39,7 @@ class ShardedBackend:
             raise ValueError("mesh must have axes ('data', 'chains')")
         self._cache: Dict[Tuple[int, SamplerConfig, Any], Any] = {}
 
-    def _get_runner(self, model: Model, fm, cfg: SamplerConfig, data):
+    def _get_runner(self, model: Model, fm, cfg: SamplerConfig, data, row_axes):
         treedef = None if data is None else jax.tree.structure(data)
         key = (id(model), cfg, treedef)
         if key not in self._cache:
@@ -54,7 +54,7 @@ class ShardedBackend:
                     check_vma=False,
                 )
             else:
-                data_specs = jax.tree.map(lambda _: P("data"), data)
+                data_specs = row_partition_specs(data, "data", row_axes)
                 fn = shard_map(
                     vrunner,
                     mesh=self.mesh,
@@ -82,8 +82,11 @@ class ShardedBackend:
             )
         fm = flatten_model(model, axis_name="data" if data is not None else None)
 
+        row_axes = None
         if data is not None:
-            data = shard_data(data, self.mesh, "data")
+            data = prepare_model_data(model, data)
+            row_axes = model.data_row_axes(data)
+            data = shard_data(data, self.mesh, "data", row_axes=row_axes)
 
         key = jax.random.PRNGKey(seed)
         key_init, key_run = jax.random.split(key)
@@ -97,7 +100,7 @@ class ShardedBackend:
         z0 = jax.device_put(z0, chain_sharding)
         chain_keys = jax.device_put(chain_keys, chain_sharding)
 
-        run = self._get_runner(model, fm, cfg, data)
+        run = self._get_runner(model, fm, cfg, data, row_axes)
         if data is None:
             res = jax.block_until_ready(run(chain_keys, z0))
         else:

@@ -100,18 +100,28 @@ def make_minibatch_grad(
     potential_with_data: Callable[[Array, object], Array],
     data,
     batch_size: int,
+    row_axes=None,
 ) -> StochasticGradFn:
-    """Static-shape minibatch grad estimator over a leading row axis.
+    """Static-shape minibatch grad estimator over the data-row axis.
 
     ``potential_with_data(z, batch)`` must already include the N/batch
     likelihood scale (``flatten_model(lik_scale=N/batch)``).  Sampling is
     with replacement (`randint`) so the batch shape is static under jit.
+    row_axes: per-leaf row-axis pytree (``Model.data_row_axes``); default
+    axis 0 everywhere.  Leaves with transformed layouts (e.g. ``xT`` with
+    rows on axis 1) are gathered along their own axis so every leaf of the
+    batch holds the SAME rows.
     """
-    n = jax.tree.leaves(data)[0].shape[0]
+    if row_axes is None:
+        row_axes = jax.tree.map(lambda _: 0, data)
+    leaves, axes = jax.tree.leaves(data), jax.tree.leaves(row_axes)
+    n = leaves[0].shape[axes[0]]
 
     def grad_fn(key, z):
         idx = jax.random.randint(key, (batch_size,), 0, n)
-        batch = jax.tree.map(lambda x: jnp.take(x, idx, axis=0), data)
+        batch = jax.tree.map(
+            lambda x, ax: jnp.take(x, idx, axis=ax), data, row_axes
+        )
         return jax.grad(potential_with_data)(z, batch)
 
     return grad_fn

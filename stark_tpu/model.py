@@ -60,6 +60,43 @@ class Model:
         unconstrained space (Stan-style random init)."""
         return None
 
+    def prepare_data(self, data: PyTree) -> PyTree:
+        """Optional one-time, host-side data transform applied by backends
+        BEFORE the compiled sample loop closes over the data.
+
+        Use for layout changes the hot path should not pay per evaluation —
+        e.g. the fused logistic models store the row matrix transposed
+        ((D, N), features on the TPU sublane axis, rows on the 128-wide
+        lane axis) so the Pallas kernel streams full-width tiles.
+
+        Every entry point must route data through ``prepare_model_data``
+        (below) so this hook is applied exactly once; models that move the
+        row axis off axis 0 must override ``data_row_axes`` to match.
+        """
+        return data
+
+    def data_row_axes(self, data: PyTree) -> PyTree:
+        """Which axis of each ``prepare_data``-output leaf indexes data rows.
+
+        Default: axis 0 everywhere.  Entry points that shard or minibatch
+        rows (mesh sharding, SG-HMC minibatches, consensus shards) consult
+        this so layout-transformed leaves (e.g. a transposed ``xT`` with
+        rows on axis 1) are split along the correct axis.
+        """
+        return jax.tree.map(lambda _: 0, data)
+
+
+def prepare_model_data(model: Model, data: PyTree) -> PyTree:
+    """The single data choke point for every entry point: apply the model's
+    one-time host-side layout hook, then move leaves to device arrays.
+
+    Entry points must NOT call ``jax.tree.map(jnp.asarray, data)`` directly —
+    that skips ``Model.prepare_data`` and breaks models with custom layouts
+    (the fused Pallas models crash on a missing ``xT``)."""
+    if data is None:
+        return None
+    return jax.tree.map(jnp.asarray, model.prepare_data(data))
+
 
 class Potential:
     """Potential-energy callable with a fused value-and-grad path.

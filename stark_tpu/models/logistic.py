@@ -78,17 +78,39 @@ class HierLogistic(Model):
         return _bernoulli_logit_loglik(logits, data["y"])
 
 
+def _transpose_x(data):
+    """One-time host-side layout prep for the fused kernels: replace the
+    (N, D) row matrix with its (D, N) transpose so the kernel streams the
+    row axis on full-width TPU lanes (see ops/logistic_fused.py)."""
+    if "xT" in data:
+        return data
+    out = {k: v for k, v in data.items() if k != "x"}
+    out["xT"] = jnp.asarray(data["x"]).T
+    return out
+
+
+def _row_axes_xt(data):
+    # rows ride axis 1 of the transposed matrix, axis 0 everywhere else
+    return {k: (1 if k == "xT" else 0) for k in data}
+
+
 class FusedLogistic(Logistic):
     """Logistic with the one-pass Pallas likelihood kernel.
 
-    Identical posterior; the per-evaluation HBM traffic over the (N, D) row
+    Identical posterior; the per-evaluation HBM traffic over the row
     matrix is halved vs autodiff (see ops/logistic_fused.py).
     """
+
+    def prepare_data(self, data):
+        return _transpose_x(data)
+
+    def data_row_axes(self, data):
+        return _row_axes_xt(data)
 
     def log_lik(self, p, data):
         from ..ops.logistic_fused import logistic_loglik
 
-        return logistic_loglik(p["beta"], data["x"], data["y"])
+        return logistic_loglik(p["beta"], data["xT"], data["y"])
 
 
 class FusedHierLogistic(HierLogistic):
@@ -96,12 +118,18 @@ class FusedHierLogistic(HierLogistic):
     group-intercept gather and its segment-sum VJP stay in XLA via the
     custom_vjp residual output."""
 
+    def prepare_data(self, data):
+        return _transpose_x(data)
+
+    def data_row_axes(self, data):
+        return _row_axes_xt(data)
+
     def log_lik(self, p, data):
         from ..ops.logistic_fused import logistic_offset_loglik
 
         alpha = p["alpha0"] + p["sigma_alpha"] * p["alpha_raw"]
         return logistic_offset_loglik(
-            p["beta"], alpha[data["g"]], data["x"], data["y"]
+            p["beta"], alpha[data["g"]], data["xT"], data["y"]
         )
 
 

@@ -5,13 +5,32 @@ The hierarchical-logistic hot loop evaluates, per leapfrog step,
 ``∇_β ll = Xᵀ(y − σ(Xβ))``.  Under autodiff that is a forward pass plus a
 backward pass — the (N, D) row matrix is read from HBM twice.  At benchmark
 scale (N=1M) the op is HBM-bandwidth-bound, so this kernel computes value
-and gradient in ONE pass over X: rows stream through VMEM in row tiles, the
-(TILE, D)·(D, 1) product rides the MXU, and a scalar + (1, D) accumulator
-live in the sequential-grid output block (TPU grid steps run in order, so
-accumulating into the same output block is race-free).
+and gradient in ONE pass over X.
 
-Rows and features are padded to tile multiples with a weight-mask column so
-padding contributes exactly zero to both outputs.
+Layout: the kernel takes X TRANSPOSED — ``xT`` of shape (D, N) — so the
+million-row axis rides the 128-wide TPU *lane* dimension in full native
+(8, 128) tiles and features ride the sublane axis.  Row-major (N, D)
+blocks at small D (the benchmark has D=32) fill only D of 128 lanes, which
+measured ~4x slower than XLA's own matvec; transposing recovers full-width
+streaming.  Models produce ``xT`` once per run via ``Model.prepare_data``
+(a host-side transpose outside the compiled loop), so the hot path never
+pays a layout change.
+
+Each grid step handles one (D, LANE_TILE) slab and writes its OWN
+partial-sum rows (no cross-step accumulation: Mosaic rejects
+read-modify-write on revisited output blocks in kernels that also have a
+per-tile output — "only constant accumulators supported" — and scalar
+stores to VMEM).  The (grid,)-length partials are reduced outside, in XLA:
+a (grid, D) sum is sub-microsecond next to the (D, N) stream.  The ragged
+last tile is masked in-kernel from the static row count with
+``jnp.where`` selects (NOT multiplies — 0·NaN = NaN; out-of-bounds lanes
+read unspecified values).
+
+The matvec runs on the VPU (multiply + sublane/lane reductions), not the
+MXU: matrix-vector work is bandwidth-bound so the MXU buys nothing, and
+Mosaic additionally pattern-matches dot_general+add into a
+matmul-with-accumulator it cannot compile for a non-constant accumulator
+(the per-row offset).
 
 CPU fallback: ``interpret=True`` (Pallas interpreter) keeps tests and the
 virtual-device mesh runnable without a TPU; the numerics match autodiff to
@@ -27,153 +46,140 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-_ROW_TILE = 1024
-_LANE = 128
+# Default lane-tile cap; the actual tile shrinks with D so the (D, LT) f32
+# slab stays within a fixed VMEM budget (see _default_lane_tile).
+_LANE_TILE = 8192
+# ~2MB per input slab leaves room for double buffering + the small
+# y/offset/resid streams in ~16MB of VMEM at any feature count.
+_SLAB_BUDGET_ELEMS = (2 * 1024 * 1024) // 4
 
 
-def _kernel_body(x_ref, y_ref, w_ref, beta_ref, val_ref, grad_ref,
-                 off_ref=None, resid_ref=None):
-    """Shared tile body for both entry points.
-
-    With ``off_ref``/``resid_ref`` (the offset variant) logits get a per-row
-    offset and the per-row residual is written out so the caller's VJP can
-    chain through whatever produced the offsets (gather → segment-sum, in
-    XLA outside the kernel).
-    """
-
-    @pl.when(pl.program_id(0) == 0)
-    def _init():
-        val_ref[...] = jnp.zeros_like(val_ref)
-        grad_ref[...] = jnp.zeros_like(grad_ref)
-
-    x = x_ref[...]  # (TILE, Dp)
-    y = y_ref[...]  # (TILE, 1)
-    w = w_ref[...]  # (TILE, 1)
-    beta = beta_ref[...]  # (1, Dp)
-    logits = jax.lax.dot_general(
-        x, beta, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # (TILE, 1)
-    if off_ref is not None:
-        logits = logits + off_ref[...]
-    ll = y * jax.nn.log_sigmoid(logits) + (1.0 - y) * jax.nn.log_sigmoid(-logits)
-    val_ref[0, 0] += jnp.sum(ll * w)
-    resid = (y - jax.nn.sigmoid(logits)) * w  # (TILE, 1)
-    if resid_ref is not None:
-        resid_ref[...] = resid
-    grad_ref[...] += jax.lax.dot_general(
-        resid, x, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # (1, Dp)
+def _default_lane_tile(d: int) -> int:
+    """Largest 128-multiple lane tile whose (d, tile) slab fits the budget."""
+    return max(128, min(_LANE_TILE, (_SLAB_BUDGET_ELEMS // max(d, 1)) // 128 * 128))
 
 
-def _pad_to(x, axis, multiple):
-    n = x.shape[axis]
-    pad = (-n) % multiple
-    if pad == 0:
-        return x
-    widths = [(0, 0)] * x.ndim
-    widths[axis] = (0, pad)
-    return jnp.pad(x, widths)
+def _make_kernel(n, lane_tile, with_offset):
+    """Tile kernel for a dataset of ``n`` rows (static)."""
+
+    def kernel(*refs):
+        if with_offset:
+            xt_ref, y_ref, off_ref, beta_ref, val_ref, grad_ref, resid_ref = refs
+        else:
+            xt_ref, y_ref, beta_ref, val_ref, grad_ref = refs
+            off_ref = resid_ref = None
+        lane0 = pl.program_id(0) * lane_tile
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, lane_tile), 1)
+        mask = lane0 + iota < n  # (1, TILE) — False on ragged-tile overhang
+        xt = jnp.where(mask, xt_ref[...], 0.0)  # (D, TILE)
+        y = jnp.where(mask, y_ref[...], 0.0)  # (1, TILE)
+        beta = beta_ref[...]  # (D, 1)
+        logits = jnp.sum(xt * beta, axis=0, keepdims=True)  # (1, TILE)
+        if off_ref is not None:
+            logits = logits + jnp.where(mask, off_ref[...], 0.0)
+        ll = y * jax.nn.log_sigmoid(logits) + (1.0 - y) * jax.nn.log_sigmoid(-logits)
+        # partial-sum rows shaped (1, 1, ·)/(1, D, 1) to satisfy TPU tiling
+        # (block last-two dims must equal the array's when not (8, 128)-aligned)
+        val_ref[...] = jnp.sum(jnp.where(mask, ll, 0.0)).reshape(1, 1, 1)
+        resid = jnp.where(mask, y - jax.nn.sigmoid(logits), 0.0)  # (1, TILE)
+        if resid_ref is not None:
+            resid_ref[...] = resid
+        grad_ref[...] = jnp.sum(xt * resid, axis=1, keepdims=True)[None]  # (1, D, 1)
+
+    return kernel
 
 
-def _fused_call(beta, x, y, offsets, *, row_tile, interpret):
-    """Pad to tile multiples, build specs, and invoke the shared kernel body.
+def _fused_call(beta, xt, y, offsets, *, lane_tile, interpret):
+    """Build specs and invoke the tile kernel.
 
-    -> (ll scalar, dll/dbeta (D,)) without offsets, plus the (N,) per-row
-    residual when ``offsets`` is given.
+    -> (ll scalar, dll/dbeta (D,)), plus the (N,) per-row residual when
+    ``offsets`` is given.
     """
     if interpret is None:
         interpret = jax.default_backend() == "cpu"  # non-CPU (tpu/axon): real Mosaic lowering
-    n, d = x.shape
-    xp = _pad_to(_pad_to(x, 0, row_tile), 1, _LANE)
-    dp = xp.shape[1]
-    np_rows = xp.shape[0]
-    grid = np_rows // row_tile
+    d, n = xt.shape
+    if lane_tile is None:
+        lane_tile = _default_lane_tile(d)
+    grid = -(-n // lane_tile)  # cdiv: ragged last tile masked in-kernel
 
-    def row_spec(width=1):
-        return pl.BlockSpec((row_tile, width), lambda i: (i, 0))
+    def lane_spec(height=1):
+        return pl.BlockSpec((height, lane_tile), lambda i: (0, i))
 
-    args = [
-        xp,
-        _pad_to(y.astype(jnp.float32)[:, None], 0, row_tile),
-        _pad_to(jnp.ones((n, 1), jnp.float32), 0, row_tile),
-    ]
-    in_specs = [row_spec(dp), row_spec(), row_spec()]
+    args = [xt.astype(jnp.float32), y.astype(jnp.float32)[None, :]]
+    in_specs = [lane_spec(d), lane_spec()]
     if offsets is not None:
-        args.append(_pad_to(offsets.astype(jnp.float32)[:, None], 0, row_tile))
-        in_specs.append(row_spec())
-    args.append(_pad_to(beta.astype(jnp.float32)[None, :], 1, _LANE))
-    in_specs.append(pl.BlockSpec((1, dp), lambda i: (0, 0)))
+        args.append(offsets.astype(jnp.float32)[None, :])
+        in_specs.append(lane_spec())
+    args.append(beta.astype(jnp.float32)[:, None])
+    in_specs.append(pl.BlockSpec((d, 1), lambda i: (0, 0)))
 
+    # one partial-sum row per grid step; reduced in XLA below
     out_specs = [
-        pl.BlockSpec((1, 1), lambda i: (0, 0)),
-        pl.BlockSpec((1, dp), lambda i: (0, 0)),
+        pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, d, 1), lambda i: (i, 0, 0)),
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((1, 1), jnp.float32),
-        jax.ShapeDtypeStruct((1, dp), jnp.float32),
+        jax.ShapeDtypeStruct((grid, 1, 1), jnp.float32),
+        jax.ShapeDtypeStruct((grid, d, 1), jnp.float32),
     ]
     if offsets is not None:
-        out_specs.append(row_spec())
-        out_shape.append(jax.ShapeDtypeStruct((np_rows, 1), jnp.float32))
-        def kernel(x_ref, y_ref, w_ref, off_ref, beta_ref,
-                   val_ref, grad_ref, resid_ref):
-            _kernel_body(x_ref, y_ref, w_ref, beta_ref, val_ref, grad_ref,
-                         off_ref=off_ref, resid_ref=resid_ref)
-    else:
-        kernel = _kernel_body
+        # allocated at the padded lane count so the ragged tile's store stays
+        # in-bounds; sliced back to n below (an output buffer, not a copy of
+        # any input)
+        out_specs.append(lane_spec())
+        out_shape.append(jax.ShapeDtypeStruct((1, grid * lane_tile), jnp.float32))
 
     out = pl.pallas_call(
-        kernel,
+        _make_kernel(n, lane_tile, offsets is not None),
         grid=(grid,),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,
     )(*args)
-    val, grad = out[0][0, 0], out[1][0, :d]
+    val, grad = jnp.sum(out[0]), jnp.sum(out[1], axis=0)[:, 0]
     if offsets is not None:
-        return val, grad, out[2][:n, 0]
+        return val, grad, out[2][0, :n]
     return val, grad
 
 
-@functools.partial(jax.jit, static_argnames=("row_tile", "interpret"))
+@functools.partial(jax.jit, static_argnames=("lane_tile", "interpret"))
 def logistic_loglik_value_and_grad(
     beta: jax.Array,
-    x: jax.Array,
+    xt: jax.Array,
     y: jax.Array,
     *,
-    row_tile: int = _ROW_TILE,
+    lane_tile: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
-    """-> (ll scalar, dll/dbeta (D,)) in one pass over x.
+    """-> (ll scalar, dll/dbeta (D,)) in one pass over xt.
 
-    beta: (D,), x: (N, D) float32, y: (N,) in {0, 1}.
+    beta: (D,), xt: (D, N) float32 — X TRANSPOSED — y: (N,) in {0, 1}.
     """
-    return _fused_call(beta, x, y, None, row_tile=row_tile, interpret=interpret)
+    return _fused_call(beta, xt, y, None, lane_tile=lane_tile, interpret=interpret)
 
 
-@functools.partial(jax.jit, static_argnames=("row_tile", "interpret"))
-def _offset_fused(beta, offsets, x, y, *, row_tile=_ROW_TILE, interpret=None):
-    return _fused_call(beta, x, y, offsets, row_tile=row_tile, interpret=interpret)
+@functools.partial(jax.jit, static_argnames=("lane_tile", "interpret"))
+def _offset_fused(beta, offsets, xt, y, *, lane_tile=None, interpret=None):
+    return _fused_call(beta, xt, y, offsets, lane_tile=lane_tile, interpret=interpret)
 
 
 @jax.custom_vjp
-def logistic_offset_loglik(beta, offsets, x, y):
+def logistic_offset_loglik(beta, offsets, xt, y):
     """Differentiable fused op: Bernoulli-logit log-lik of Xβ + offsets.
 
-    One Pallas pass computes the value, ∂/∂β, and the per-row residual; the
-    VJP is therefore free of any further pass over X.  ∂/∂offsets is the
-    residual vector, which XLA chains through whatever produced the offsets
-    (e.g. an `alpha[g]` gather → segment-sum, handled by autodiff outside).
+    ``xt`` is X transposed, (D, N).  One Pallas pass computes the value,
+    ∂/∂β, and the per-row residual; the VJP is therefore free of any
+    further pass over X.  ∂/∂offsets is the residual vector, which XLA
+    chains through whatever produced the offsets (e.g. an ``alpha[g]``
+    gather → segment-sum, handled by autodiff outside).
     """
-    val, _, _ = _offset_fused(beta, offsets, x, y)
+    val, _, _ = _offset_fused(beta, offsets, xt, y)
     return val
 
 
-def _off_fwd(beta, offsets, x, y):
-    val, gbeta, resid = _offset_fused(beta, offsets, x, y)
+def _off_fwd(beta, offsets, xt, y):
+    val, gbeta, resid = _offset_fused(beta, offsets, xt, y)
     return val, (gbeta, resid)
 
 
@@ -186,20 +192,21 @@ logistic_offset_loglik.defvjp(_off_fwd, _off_bwd)
 
 
 @jax.custom_vjp
-def logistic_loglik(beta, x, y):
+def logistic_loglik(beta, xt, y):
     """Differentiable fused op: Bernoulli-logit log-lik of Xβ (no offset).
 
-    One Pallas pass yields both the value and ∂/∂β, so the VJP never
-    re-reads X and — unlike routing through ``logistic_offset_loglik``
-    with a zeros offset — no (N,) offset input is streamed in and no (N,)
-    residual output is written back per evaluation.
+    ``xt`` is X transposed, (D, N).  One Pallas pass yields both the value
+    and ∂/∂β, so the VJP never re-reads X and — unlike routing through
+    ``logistic_offset_loglik`` with a zeros offset — no (N,) offset input
+    is streamed in and no (N,) residual output is written back per
+    evaluation.
     """
-    val, _ = logistic_loglik_value_and_grad(beta, x, y)
+    val, _ = logistic_loglik_value_and_grad(beta, xt, y)
     return val
 
 
-def _noff_fwd(beta, x, y):
-    val, gbeta = logistic_loglik_value_and_grad(beta, x, y)
+def _noff_fwd(beta, xt, y):
+    val, gbeta = logistic_loglik_value_and_grad(beta, xt, y)
     return val, gbeta
 
 

@@ -24,7 +24,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..model import Model, flatten_model
+from ..model import Model, flatten_model, prepare_model_data
 from ..sampler import Posterior, SamplerConfig, _constrain_draws, make_chain_runner
 
 
@@ -61,17 +61,25 @@ def consensus_sample(
     """
     cfg = SamplerConfig(**cfg_kwargs)
     fm = flatten_model(model, prior_scale=1.0 / num_shards)
+    data = prepare_model_data(model, data)
+    row_axes = model.data_row_axes(data)
 
-    # rows -> (S, N/S, ...): shard k takes the k-th contiguous block
-    def to_shards(x):
+    # split each leaf's row axis into contiguous blocks and move the new
+    # shard axis to the FRONT (vmap axis), preserving the model's per-shard
+    # layout: (..., N, ...) -> (S, ..., N/S, ...); shard k = k-th row block
+    def to_shards(x, ax):
         x = jnp.asarray(x)
-        if x.shape[0] % num_shards:
+        n = x.shape[ax]
+        if n % num_shards:
             raise ValueError(
-                f"rows {x.shape[0]} not divisible by num_shards={num_shards}"
+                f"rows {n} not divisible by num_shards={num_shards}"
             )
-        return x.reshape(num_shards, x.shape[0] // num_shards, *x.shape[1:])
+        split = x.reshape(
+            x.shape[:ax] + (num_shards, n // num_shards) + x.shape[ax + 1 :]
+        )
+        return jnp.moveaxis(split, ax, 0)
 
-    sharded = jax.tree.map(to_shards, data)
+    sharded = jax.tree.map(to_shards, data, row_axes)
 
     key = jax.random.PRNGKey(seed)
     key_init, key_run = jax.random.split(key)

@@ -53,25 +53,42 @@ def make_mesh(
     return Mesh(devices.reshape(shape), tuple(sizes.keys()))
 
 
-def shard_data(data, mesh: Mesh, axis: str = "data"):
-    """Place a pytree of row-major arrays with rows sharded over ``axis``.
+def row_partition_specs(data, axis: str = "data", row_axes=None):
+    """PartitionSpec pytree putting ``axis`` on each leaf's data-row axis.
+
+    row_axes: per-leaf row-axis pytree (``Model.data_row_axes``); default
+    axis 0 everywhere.  A leaf with rows on axis 1 (e.g. a transposed
+    ``xT``) gets P(None, axis) so the mesh splits rows, not features.
+    """
+    if row_axes is None:
+        row_axes = jax.tree.map(lambda _: 0, data)
+    return jax.tree.map(
+        lambda _, ax: P(*([None] * ax + [axis])), data, row_axes
+    )
+
+
+def shard_data(data, mesh: Mesh, axis: str = "data", row_axes=None):
+    """Place a pytree of arrays with data rows sharded over ``axis``.
 
     Rows must divide evenly by the axis size (benchmark datasets are sized
     accordingly; use ``truncate_to_multiple`` first otherwise).
+    row_axes: see ``row_partition_specs``.
     """
     size = mesh.shape[axis]
-    sharding = NamedSharding(mesh, P(axis))
+    if row_axes is None:
+        row_axes = jax.tree.map(lambda _: 0, data)
+    specs = row_partition_specs(data, axis, row_axes)
 
-    def put(x):
+    def put(x, ax, spec):
         x = jnp.asarray(x)
-        if x.shape[0] % size:
+        if x.shape[ax] % size:
             raise ValueError(
-                f"rows {x.shape[0]} not divisible by mesh axis {axis}={size}; "
+                f"rows {x.shape[ax]} not divisible by mesh axis {axis}={size}; "
                 "use truncate_to_multiple or pad the dataset"
             )
-        return jax.device_put(x, sharding)
+        return jax.device_put(x, NamedSharding(mesh, spec))
 
-    return jax.tree.map(put, data)
+    return jax.tree.map(put, data, row_axes, specs)
 
 
 def truncate_to_multiple(data, k: int):

@@ -36,7 +36,7 @@ from ..adaptation import da_init, da_update
 from ..kernels.base import HMCState
 from ..kernels.hmc import hmc_step
 from ..kernels.nuts import nuts_step
-from ..model import Model, flatten_model
+from ..model import Model, flatten_model, prepare_model_data
 from ..sampler import Posterior, _constrain_draws
 
 Array = jax.Array
@@ -88,7 +88,7 @@ def tempered_sample(
     """
     if data is None:
         raise ValueError("tempering requires a data likelihood to temper")
-    data = jax.tree.map(jnp.asarray, data)
+    data = prepare_model_data(model, data)
     fm = flatten_model(model)
     betas = geometric_ladder(num_temps) if betas is None else jnp.asarray(betas)
     num_temps = betas.shape[0]

@@ -29,7 +29,7 @@ import numpy as np
 
 from . import diagnostics
 from .kernels.base import HMCState
-from .model import Model, flatten_model
+from .model import Model, flatten_model, prepare_model_data
 from .sampler import Posterior, SamplerConfig, _constrain_draws, make_block_runners
 
 
@@ -69,8 +69,7 @@ def sample_until_converged(
     """
     cfg = SamplerConfig(**cfg_kwargs)
     fm = flatten_model(model)
-    if data is not None:
-        data = jax.tree.map(jnp.asarray, data)
+    data = prepare_model_data(model, data)
 
     warmup_run, block_run = make_block_runners(fm, cfg, block_size)
     v_warm = jax.jit(jax.vmap(warmup_run, in_axes=(0, 0, None)))

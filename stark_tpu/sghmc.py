@@ -21,7 +21,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .kernels.sghmc import SGHMCState, make_minibatch_grad, sghmc_init, sghmc_step
-from .model import Model, flatten_model
+from .model import Model, flatten_model, prepare_model_data
 from .sampler import Posterior, _constrain_draws
 
 
@@ -43,16 +43,17 @@ def sghmc_sample(
 ) -> Posterior:
     """Run parallel-chain SG-HMC and return a Posterior.
 
-    data must have a leading row axis on every leaf; the likelihood term is
-    scaled by N/batch_size so the stochastic gradient is unbiased for the
-    full-data potential.
+    Rows may live on any per-leaf axis declared by ``model.data_row_axes``
+    (axis 0 by default); the likelihood term is scaled by N/batch_size so
+    the stochastic gradient is unbiased for the full-data potential.
     """
-    data = jax.tree.map(jnp.asarray, data)
-    n = jax.tree.leaves(data)[0].shape[0]
+    data = prepare_model_data(model, data)
+    row_axes = model.data_row_axes(data)
+    n = jax.tree.leaves(data)[0].shape[jax.tree.leaves(row_axes)[0]]
     if batch_size > n:
         raise ValueError(f"batch_size={batch_size} > rows={n}")
     fm = flatten_model(model, lik_scale=n / batch_size)
-    grad_fn = make_minibatch_grad(fm.potential, data, batch_size)
+    grad_fn = make_minibatch_grad(fm.potential, data, batch_size, row_axes=row_axes)
 
     total = num_warmup + num_samples * thin
     # host-precomputed momentum-refresh schedule, fed to the scan as xs

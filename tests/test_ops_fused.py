@@ -26,7 +26,7 @@ def test_fused_matches_autodiff():
         data, _ = synth_logistic_data(jax.random.PRNGKey(n), n, d)
         beta = 0.5 * jax.random.normal(key, (d,))
         v1, g1 = logistic_loglik_value_and_grad(
-            beta, data["x"], data["y"], row_tile=256
+            beta, data["x"].T, data["y"], lane_tile=256
         )
         v2, g2 = _autodiff_oracle(beta, data["x"], data["y"])
         np.testing.assert_allclose(float(v1), float(v2), rtol=2e-5)
@@ -39,11 +39,12 @@ def test_offset_op_grads_match_autodiff():
 
     data, _ = synth_logistic_data(jax.random.PRNGKey(4), 600, 5, num_groups=12)
     data = jax.tree.map(jnp.asarray, data)
-    ref_fm = flatten_model(HierLogistic(5, 12))
-    fus_fm = flatten_model(FusedHierLogistic(5, 12))
+    ref_model, fus_model = HierLogistic(5, 12), FusedHierLogistic(5, 12)
+    ref_fm = flatten_model(ref_model)
+    fus_fm = flatten_model(fus_model)
     z = 0.3 * jax.random.normal(jax.random.PRNGKey(5), (ref_fm.ndim,))
     va, ga = ref_fm.potential_and_grad(z, data)
-    vf, gf = fus_fm.potential_and_grad(z, data)
+    vf, gf = fus_fm.potential_and_grad(z, fus_model.prepare_data(data))
     np.testing.assert_allclose(float(va), float(vf), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(ga), np.asarray(gf), rtol=1e-3, atol=1e-4)
 
@@ -67,12 +68,15 @@ def test_fused_flat_model_sampling():
     from stark_tpu.models import FusedLogistic
 
     model = Logistic(num_features=4)
+    fused_model = FusedLogistic(num_features=4)
     data, true = synth_logistic_data(jax.random.PRNGKey(1), 2048, 4)
+    data = jax.tree.map(jnp.asarray, data)
+    data_t = fused_model.prepare_data(data)
     fm = flatten_model(model)
-    fm_fused = flatten_model(FusedLogistic(num_features=4))
+    fm_fused = flatten_model(fused_model)
 
-    pot_a = fm.bind(jax.tree.map(jnp.asarray, data))
-    pot_f = fm_fused.bind(jax.tree.map(jnp.asarray, data))
+    pot_a = fm.bind(data)
+    pot_f = fm_fused.bind(data_t)
     z = jnp.asarray([0.1, -0.2, 0.3, 0.0])
     va, ga = pot_a.value_and_grad(z)
     vf, gf = pot_f.value_and_grad(z)
@@ -85,9 +89,49 @@ def test_fused_flat_model_sampling():
     runner = jax.jit(jax.vmap(make_chain_runner(fm_fused, cfg), in_axes=(0, 0, None)))
     keys = jax.random.split(jax.random.PRNGKey(2), 2)
     z0 = 0.1 * jax.random.normal(jax.random.PRNGKey(3), (2, 4))
-    res = runner(keys, z0, jax.tree.map(jnp.asarray, data))
+    res = runner(keys, z0, data_t)
     draws = np.asarray(res.draws)  # (2, 200, 4)
     assert np.all(np.isfinite(draws))
     np.testing.assert_allclose(
         draws.mean(axis=(0, 1)), np.asarray(true["beta"]), atol=0.3
+    )
+
+
+def test_fused_model_all_entry_points():
+    """Every row-splitting entry point honors prepare_data + data_row_axes.
+
+    Regression: consensus/SG-HMC/sharded once bypassed Model.prepare_data
+    (KeyError 'xT'), and a naive fix would have split the transposed xT
+    along features instead of rows."""
+    from stark_tpu.backends.sharded import ShardedBackend
+    from stark_tpu.models import FusedLogistic
+    from stark_tpu.parallel.consensus import consensus_sample
+    from stark_tpu.parallel.mesh import make_mesh
+    from stark_tpu.sghmc import sghmc_sample
+
+    data, true = synth_logistic_data(jax.random.PRNGKey(0), 2048, 4)
+    beta_true = np.asarray(true["beta"])
+
+    post = consensus_sample(
+        FusedLogistic(4), data, num_shards=2, chains=2, kernel="nuts",
+        max_tree_depth=5, num_warmup=100, num_samples=100, seed=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(post.draws["beta"]).mean((0, 1)), beta_true, atol=0.35
+    )
+
+    post = sghmc_sample(
+        FusedLogistic(4), data, batch_size=256, chains=2, num_warmup=100,
+        num_samples=200, step_size=5e-4, seed=0,
+    )
+    assert np.all(np.isfinite(np.asarray(post.draws["beta"])))
+
+    mesh = make_mesh({"data": 4, "chains": 2})
+    post = stark_tpu.sample(
+        FusedLogistic(4), data, backend=ShardedBackend(mesh), chains=2,
+        kernel="nuts", max_tree_depth=5, num_warmup=100, num_samples=100,
+        seed=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(post.draws["beta"]).mean((0, 1)), beta_true, atol=0.35
     )
