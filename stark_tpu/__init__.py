@@ -10,7 +10,7 @@ terms + R-hat/ESS sufficient statistics allreduced over ICI.
 """
 
 from . import bijectors, diagnostics
-from .model import Model, ParamSpec, flatten_model
+from .model import Model, ParamSpec, flatten_model, prepare_model_data
 from .runner import sample_until_converged
 from .sampler import Posterior, SamplerConfig, sample
 from .sghmc import sghmc_sample
@@ -21,6 +21,7 @@ __all__ = [
     "Model",
     "ParamSpec",
     "flatten_model",
+    "prepare_model_data",
     "sample",
     "sample_until_converged",
     "sghmc_sample",
@@ -28,4 +29,5 @@ __all__ = [
     "SamplerConfig",
     "bijectors",
     "diagnostics",
+    # lazily importable (heavier deps): .config, .validate, .benchmarks
 ]

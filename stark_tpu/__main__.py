@@ -1,0 +1,77 @@
+"""CLI: run declarative sampling configs and list benchmark entries.
+
+    python -m stark_tpu run configs/eight_schools.yaml   # one config
+    python -m stark_tpu bench eight_schools              # named benchmark
+    python -m stark_tpu list                             # what exists
+
+``run`` prints one JSON summary line (wall, R-hat, min-ESS, ESS/s) so runs
+are scriptable; draws/metrics go wherever the config's ``outputs`` section
+points.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_run(args) -> int:
+    from .config import run_config_file
+
+    summary = run_config_file(args.config)
+    print(json.dumps(summary))
+    return 0
+
+
+def _cmd_bench(args) -> int:
+    from .benchmarks import ALL_BENCHMARKS
+
+    if args.name not in ALL_BENCHMARKS:
+        print(f"unknown benchmark {args.name!r}; have {sorted(ALL_BENCHMARKS)}",
+              file=sys.stderr)
+        return 2
+    res = ALL_BENCHMARKS[args.name]()
+    print(res.row(), file=sys.stderr)
+    print(json.dumps({
+        "name": res.name,
+        "wall_s": round(res.wall_s, 3),
+        "min_ess": round(res.min_ess, 1),
+        "ess_per_sec": round(res.ess_per_sec, 3),
+        "max_rhat": round(res.max_rhat, 5),
+        **res.extra,
+    }))
+    return 0
+
+
+def _cmd_list(args) -> int:
+    from .benchmarks import ALL_BENCHMARKS
+    from .config import _model_registry, _synth_registry
+
+    print("benchmarks:", ", ".join(sorted(ALL_BENCHMARKS)))
+    print("models:", ", ".join(sorted(_model_registry())))
+    print("synth datasets:", ", ".join(sorted(_synth_registry())))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="stark_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="run a YAML config")
+    p_run.add_argument("config")
+    p_run.set_defaults(fn=_cmd_run)
+
+    p_bench = sub.add_parser("bench", help="run a named benchmark at smoke scale")
+    p_bench.add_argument("name")
+    p_bench.set_defaults(fn=_cmd_bench)
+
+    p_list = sub.add_parser("list", help="list benchmarks/models/datasets")
+    p_list.set_defaults(fn=_cmd_list)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

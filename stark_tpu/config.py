@@ -1,0 +1,211 @@
+"""Config system: plain dataclasses, YAML-loadable (SURVEY.md §6).
+
+A run is one document with four sections — model, data, sampler, execution —
+each a name plus plain kwargs.  ``load_config`` parses YAML into the
+``RunConfig`` dataclass; ``run_config`` builds the pieces from the
+registries below and dispatches to the matching entry point
+(sample / sample_until_converged / consensus / tempered / SG-HMC).
+
+The five judged benchmark configs (BASELINE.json:6-12) live in
+``configs/*.yaml`` at the repo root, one per benchmark, runnable as
+``python -m stark_tpu run configs/<name>.yaml``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """One sampling run, fully declarative."""
+
+    name: str
+    model: Dict[str, Any]  # {"type": <registry name>, ...kwargs}
+    sampler: Dict[str, Any]  # {"entry": sample|until_converged|consensus|tempered|sghmc, ...kwargs}
+    data: Optional[Dict[str, Any]] = None  # {"synth": <name>, ...kwargs} | None
+    execution: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # execution: {"backend": jax|cpu|sharded, "mesh": {axis: size}, "chains": N, "seed": S}
+    outputs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # outputs: {"metrics_path": ..., "checkpoint_path": ..., "draw_store_path": ...}
+
+
+def _model_registry() -> Dict[str, Callable]:
+    from . import models
+
+    return {
+        "EightSchools": models.EightSchools,
+        "Logistic": models.Logistic,
+        "HierLogistic": models.HierLogistic,
+        "FusedLogistic": models.FusedLogistic,
+        "FusedHierLogistic": models.FusedHierLogistic,
+        "LinearMixedModel": models.LinearMixedModel,
+        "GaussianMixture": models.GaussianMixture,
+        "BayesianMLP": models.BayesianMLP,
+    }
+
+
+def _synth_registry() -> Dict[str, Callable]:
+    import jax
+
+    from . import models
+
+    def seeded(fn):
+        def wrapper(*, seed=0, **kw):
+            out = fn(jax.random.PRNGKey(seed), **kw)
+            return out[0] if isinstance(out, tuple) else out
+
+        return wrapper
+
+    return {
+        "eight_schools": lambda **kw: models.eight_schools_data(),
+        "logistic": seeded(models.synth_logistic_data),
+        "lmm": seeded(models.synth_lmm_data),
+        "gmm": seeded(models.synth_gmm_data),
+        "bnn": seeded(models.synth_bnn_data),
+    }
+
+
+def load_config(path: str) -> RunConfig:
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"config {path} must be a YAML mapping, got {type(doc).__name__}")
+    fields = {f.name for f in dataclasses.fields(RunConfig)}
+    unknown = set(doc) - fields
+    if unknown:
+        raise ValueError(f"unknown config keys {sorted(unknown)} in {path}")
+    return RunConfig(**doc)
+
+
+def build_model(cfg: RunConfig):
+    spec = dict(cfg.model)
+    typ = spec.pop("type")
+    registry = _model_registry()
+    if typ not in registry:
+        raise ValueError(f"unknown model type {typ!r}; have {sorted(registry)}")
+    return registry[typ](**spec)
+
+
+def build_data(cfg: RunConfig):
+    if cfg.data is None:
+        return None
+    spec = dict(cfg.data)
+    if "synth" in spec:
+        name = spec.pop("synth")
+        registry = _synth_registry()
+        if name not in registry:
+            raise ValueError(f"unknown synth dataset {name!r}; have {sorted(registry)}")
+        return registry[name](**spec)
+    if "npz" in spec:
+        with np.load(spec["npz"]) as z:
+            return {k: z[k] for k in z.files}
+    raise ValueError("data section needs 'synth' or 'npz'")
+
+
+def build_backend(cfg: RunConfig):
+    from .backends import CpuBackend, JaxBackend, ShardedBackend
+    from .parallel.mesh import make_mesh
+
+    name = cfg.execution.get("backend", "jax")
+    if name == "jax":
+        return JaxBackend()
+    if name == "cpu":
+        return CpuBackend()
+    if name == "sharded":
+        mesh_spec = cfg.execution.get("mesh")
+        mesh = make_mesh(dict(mesh_spec)) if mesh_spec else None
+        return ShardedBackend(mesh)
+    raise ValueError(f"unknown backend {name!r}")
+
+
+def run_config(cfg: RunConfig):
+    """Execute a RunConfig -> (Posterior, summary dict)."""
+    import stark_tpu
+    from .parallel.consensus import consensus_sample
+    from .parallel.mesh import make_mesh
+    from .parallel.tempering import tempered_sample
+    from .sghmc import sghmc_sample
+
+    model = build_model(cfg)
+    data = build_data(cfg)
+    sampler = dict(cfg.sampler)
+    entry = sampler.pop("entry", "sample")
+    chains = cfg.execution.get("chains", 4)
+    seed = cfg.execution.get("seed", 0)
+    mesh_spec = cfg.execution.get("mesh")
+    mesh = make_mesh(dict(mesh_spec)) if mesh_spec else None
+
+    # every execution key must be consumed by the chosen entry — silently
+    # dropping e.g. backend:sharded would report unsharded results as sharded
+    supported = {"chains", "seed"}
+    supported |= {"backend", "mesh"} if entry == "sample" else set()
+    supported |= {"mesh"} if entry in ("consensus", "tempered", "sghmc") else set()
+    unused = set(cfg.execution) - supported
+    if unused:
+        raise ValueError(
+            f"execution keys {sorted(unused)} are not supported by "
+            f"sampler entry {entry!r}"
+        )
+
+    t0 = time.perf_counter()
+    if entry == "sample":
+        post = stark_tpu.sample(
+            model, data, backend=build_backend(cfg), chains=chains, seed=seed,
+            **sampler,
+        )
+    elif entry == "until_converged":
+        post = stark_tpu.sample_until_converged(
+            model, data, chains=chains, seed=seed,
+            metrics_path=cfg.outputs.get("metrics_path"),
+            checkpoint_path=cfg.outputs.get("checkpoint_path"),
+            draw_store_path=cfg.outputs.get("draw_store_path"),
+            profile_dir=cfg.outputs.get("profile_dir"),
+            **sampler,
+        )
+    elif entry == "consensus":
+        post = consensus_sample(
+            model, data, chains=chains, seed=seed, mesh=mesh, **sampler
+        )
+    elif entry == "tempered":
+        post = tempered_sample(
+            model, data, chains=chains, seed=seed, mesh=mesh, **sampler
+        )
+    elif entry == "sghmc":
+        post = sghmc_sample(
+            model, data, chains=chains, seed=seed, mesh=mesh, **sampler
+        )
+    else:
+        raise ValueError(f"unknown sampler entry {entry!r}")
+    wall = time.perf_counter() - t0
+
+    min_ess = post.min_ess()
+    summary = {
+        "name": cfg.name,
+        "entry": entry,
+        "wall_s": round(wall, 3),
+        "max_rhat": round(post.max_rhat(), 5),
+        "min_ess": round(min_ess, 1),
+        "ess_per_sec": round(min_ess / wall, 3),
+        "num_divergent": int(post.num_divergent),
+    }
+    return post, summary
+
+
+def run_config_file(path: str) -> Dict[str, Any]:
+    cfg = load_config(path)
+    _, summary = run_config(cfg)
+    return summary
+
+
+if __name__ == "__main__":  # pragma: no cover - convenience
+    import sys
+
+    print(json.dumps(run_config_file(sys.argv[1])))

@@ -1,0 +1,107 @@
+"""Config system + CLI: YAML -> RunConfig -> posterior, entry dispatch."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+from stark_tpu.config import RunConfig, load_config, run_config
+
+
+def test_run_config_sample_entry(tmp_path):
+    cfg_yaml = tmp_path / "cfg.yaml"
+    cfg_yaml.write_text(
+        """
+name: smoke_eight_schools
+model:
+  type: EightSchools
+data:
+  synth: eight_schools
+sampler:
+  entry: sample
+  kernel: nuts
+  max_tree_depth: 8
+  num_warmup: 300
+  num_samples: 300
+execution:
+  backend: jax
+  chains: 2
+  seed: 0
+"""
+    )
+    cfg = load_config(str(cfg_yaml))
+    assert cfg.name == "smoke_eight_schools"
+    post, summary = run_config(cfg)
+    assert summary["max_rhat"] < 1.2
+    assert np.isfinite(summary["ess_per_sec"])
+    assert post.draws["mu"].shape[:2] == (2, 300)
+
+
+def test_run_config_all_entries_dispatch():
+    """Every sampler entry builds and runs at tiny scale."""
+    entries = [
+        (
+            {"type": "Logistic", "num_features": 3},
+            {"synth": "logistic", "n": 512, "d": 3, "seed": 1},
+            {"entry": "consensus", "num_shards": 2, "kernel": "nuts",
+             "max_tree_depth": 5, "num_warmup": 50, "num_samples": 50},
+        ),
+        (
+            {"type": "GaussianMixture", "num_components": 2},
+            {"synth": "gmm", "n": 512, "num_components": 2, "seed": 1},
+            {"entry": "tempered", "num_temps": 2, "kernel": "hmc",
+             "num_leapfrog": 4, "num_warmup": 50, "num_samples": 50},
+        ),
+        (
+            {"type": "BayesianMLP", "num_features": 4, "hidden": 4},
+            {"synth": "bnn", "n": 512, "num_features": 4, "seed": 1},
+            {"entry": "sghmc", "batch_size": 64, "num_warmup": 20,
+             "num_samples": 50, "step_size": 1e-3},
+        ),
+    ]
+    for model, data, sampler in entries:
+        cfg = RunConfig(
+            name=f"smoke_{sampler['entry']}",
+            model=model,
+            data=data,
+            sampler=sampler,
+            execution={"chains": 2, "seed": 0},
+        )
+        _, summary = run_config(cfg)
+        assert np.isfinite(summary["wall_s"]), summary
+
+
+def test_load_config_rejects_unknown_keys(tmp_path):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("name: x\nmodel: {type: EightSchools}\nsampler: {}\ntypo: 1\n")
+    try:
+        load_config(str(bad))
+    except ValueError as e:
+        assert "typo" in str(e)
+    else:
+        raise AssertionError("expected ValueError for unknown key")
+
+
+def test_cli_list():
+    out = subprocess.run(
+        [sys.executable, "-m", "stark_tpu", "list"],
+        capture_output=True, text=True, check=True,
+    )
+    assert "benchmarks:" in out.stdout
+    assert "eight_schools" in out.stdout
+
+
+def test_repo_configs_parse():
+    """Every checked-in configs/*.yaml must at least load and build."""
+    import glob
+    import os
+
+    from stark_tpu.config import build_model
+
+    root = os.path.join(os.path.dirname(__file__), "..", "configs")
+    paths = sorted(glob.glob(os.path.join(root, "*.yaml")))
+    assert len(paths) >= 5, "expected the five judged benchmark configs"
+    for p in paths:
+        cfg = load_config(p)
+        build_model(cfg)  # constructor kwargs must match
