@@ -1,0 +1,49 @@
+"""Shared build-on-first-use loader for the C++ components in native/.
+
+pybind11 is not available in this image; the native pieces use a plain C ABI
+loaded via ctypes.  The .so is compiled with the system g++ on first use and
+cached next to the source (rebuilt when the source is newer)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_lock = threading.Lock()
+_cache: Dict[str, ctypes.CDLL] = {}
+
+
+def load_native(
+    source: str,
+    api: Dict[str, Tuple[Optional[type], Sequence[type]]],
+) -> ctypes.CDLL:
+    """Compile native/<source> if stale, load it, declare the C API.
+
+    api: {function_name: (restype, [argtypes...])}.
+    """
+    with _lock:
+        if source in _cache:
+            return _cache[source]
+        src = os.path.join(_NATIVE_DIR, source)
+        so = os.path.join(_NATIVE_DIR, "_" + os.path.splitext(source)[0] + ".so")
+        rebuild = (not os.path.exists(so)) or (
+            os.path.getmtime(src) > os.path.getmtime(so)
+        )
+        if rebuild:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                 src, "-o", so],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(so)
+        for name, (restype, argtypes) in api.items():
+            fn = getattr(lib, name)
+            fn.restype = restype
+            fn.argtypes = list(argtypes)
+        _cache[source] = lib
+        return lib

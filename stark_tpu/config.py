@@ -107,7 +107,12 @@ def build_data(cfg: RunConfig):
     if "npz" in spec:
         with np.load(spec["npz"]) as z:
             return {k: z[k] for k in z.files}
-    raise ValueError("data section needs 'synth' or 'npz'")
+    if "path" in spec:
+        # native ingest: parallel CSV parse or STKR row file (dataio.py)
+        from .dataio import load_dataset
+
+        return load_dataset(spec.pop("path"), **spec)
+    raise ValueError("data section needs 'synth', 'npz', or 'path'")
 
 
 def build_backend(cfg: RunConfig):

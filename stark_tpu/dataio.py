@@ -1,0 +1,170 @@
+"""Data ingest — Python face of the native RowLoader (native/rowloader.cpp).
+
+The framework-owned data layer standing in for the reference's Spark ingest
+(SURVEY.md §2 layer E; reference tree absent, SURVEY.md §0):
+
+* ``load_csv``    — parallel native CSV -> float32 matrix (mmap + one parser
+                    thread per core; no Python-object row path).
+* ``write_rows`` / ``RowReader`` — STKR binary row format with random-access
+  row-range reads, so each host of a multi-host run can stream exactly its
+  shard from shared storage into
+  ``parallel.mesh.process_local_shard`` without materializing the rest.
+* ``load_dataset`` — dict-of-columns convenience over either format,
+  producing the ``{"x": (N, D), "y": (N,)}``-style pytrees the models take.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import weakref
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ._native_build import load_native
+
+_F32P = ctypes.POINTER(ctypes.c_float)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+
+_API = {
+    "rl_csv_shape": (ctypes.c_int, [ctypes.c_char_p, _I64P, _I64P]),
+    "rl_csv_parse": (
+        ctypes.c_int64,
+        [ctypes.c_char_p, _F32P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int],
+    ),
+    "rl_bin_write": (
+        ctypes.c_int,
+        [ctypes.c_char_p, _F32P, ctypes.c_uint64, ctypes.c_uint64],
+    ),
+    "rl_bin_open": (ctypes.c_void_p, [ctypes.c_char_p, _U64P, _U64P]),
+    "rl_bin_read": (
+        ctypes.c_int64,
+        [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, _F32P],
+    ),
+    "rl_bin_close": (ctypes.c_int, [ctypes.c_void_p]),
+}
+
+
+def _lib():
+    return load_native("rowloader.cpp", _API)
+
+
+def csv_shape(path: str) -> tuple[int, int]:
+    rows, cols = ctypes.c_int64(), ctypes.c_int64()
+    rc = _lib().rl_csv_shape(os.fspath(path).encode(), ctypes.byref(rows),
+                             ctypes.byref(cols))
+    if rc != 0:
+        raise OSError(f"cannot probe CSV {path!r} (rc={rc})")
+    return rows.value, cols.value
+
+
+def load_csv(path: str, *, threads: int = 0) -> np.ndarray:
+    """Parse a numeric CSV (no header) into a float32 (rows, cols) array."""
+    rows, cols = csv_shape(path)
+    out = np.empty((rows, cols), np.float32)
+    n = _lib().rl_csv_parse(
+        os.fspath(path).encode(), out.ctypes.data_as(_F32P), rows, cols, threads
+    )
+    if n != rows:
+        raise ValueError(f"malformed CSV {path!r} (rc={n})")
+    return out
+
+
+def write_rows(path: str, data: np.ndarray) -> None:
+    """Write a float32 (rows, cols) matrix in the STKR binary row format."""
+    data = np.ascontiguousarray(data, np.float32)
+    if data.ndim != 2:
+        raise ValueError(f"expected a 2-D matrix, got shape {data.shape}")
+    rc = _lib().rl_bin_write(
+        os.fspath(path).encode(), data.ctypes.data_as(_F32P),
+        data.shape[0], data.shape[1],
+    )
+    if rc != 0:
+        raise OSError(f"cannot write {path!r} (rc={rc})")
+
+
+class RowReader:
+    """Random-access row-range reads over an STKR file.
+
+    ``reader[row0:row1]`` returns a freshly-read float32 (n, cols) block —
+    the unit a host uses to pull its own shard of a shared dataset.
+    """
+
+    def __init__(self, path: str):
+        rows, cols = ctypes.c_uint64(), ctypes.c_uint64()
+        self._handle = _lib().rl_bin_open(
+            os.fspath(path).encode(), ctypes.byref(rows), ctypes.byref(cols)
+        )
+        if not self._handle:
+            raise OSError(f"cannot open {path!r} as STKR")
+        self.rows, self.cols = rows.value, cols.value
+        self.path = path
+        # safety net: close the native handle (FILE* + heap reader) even if
+        # the caller drops the object without close()/context manager
+        self._finalizer = weakref.finalize(
+            self, _lib().rl_bin_close, self._handle
+        )
+
+    def read(self, row0: int, n: int) -> np.ndarray:
+        out = np.empty((n, self.cols), np.float32)
+        got = _lib().rl_bin_read(self._handle, row0, n, out.ctypes.data_as(_F32P))
+        if got != n:
+            raise OSError(f"short read [{row0}, {row0 + n}) from {self.path!r}")
+        return out
+
+    def __getitem__(self, s: slice) -> np.ndarray:
+        row0, row1, step = s.indices(self.rows)
+        if step != 1:
+            raise ValueError("only contiguous row ranges are supported")
+        return self.read(row0, row1 - row0)
+
+    def __len__(self) -> int:
+        return self.rows
+
+    def close(self) -> None:
+        if self._handle:
+            self._finalizer.detach()
+            rc = _lib().rl_bin_close(self._handle)
+            self._handle = None
+            if rc != 0:
+                raise OSError(f"closing {self.path!r} failed (rc={rc})")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def load_dataset(
+    path: str,
+    *,
+    y_col: Optional[int] = None,
+    group_col: Optional[int] = None,
+    columns: Optional[Sequence[int]] = None,
+) -> Dict[str, np.ndarray]:
+    """File -> model data pytree: {"x", ["y"], ["g"]}.
+
+    CSV (.csv) or STKR (anything else).  ``y_col``/``group_col`` pull those
+    columns out of the matrix; ``columns`` optionally restricts the feature
+    columns (default: all remaining).
+    """
+    if os.fspath(path).endswith(".csv"):
+        mat = load_csv(path)
+    else:
+        with RowReader(path) as r:
+            mat = r.read(0, r.rows)
+    out: Dict[str, np.ndarray] = {}
+    taken = set()
+    if y_col is not None:
+        out["y"] = mat[:, y_col].copy()
+        taken.add(y_col % mat.shape[1])
+    if group_col is not None:
+        out["g"] = mat[:, group_col].astype(np.int32)
+        taken.add(group_col % mat.shape[1])
+    if columns is None:
+        columns = [c for c in range(mat.shape[1]) if c not in taken]
+    out["x"] = np.ascontiguousarray(mat[:, list(columns)])
+    return out

@@ -9,52 +9,28 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
-_SRC = os.path.join(_NATIVE_DIR, "drawstore.cpp")
-_SO = os.path.join(_NATIVE_DIR, "_drawstore.so")
+from ._native_build import load_native
+
 _HEADER_BYTES = 4 + 4 + 8 + 8  # magic, version, chains, dim
-_build_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
+
+_API = {
+    "ds_open": (ctypes.c_void_p, [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]),
+    "ds_append": (
+        ctypes.c_int,
+        [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_uint64],
+    ),
+    "ds_flush": (ctypes.c_int, [ctypes.c_void_p]),
+    "ds_count": (ctypes.c_uint64, [ctypes.c_void_p]),
+    "ds_close": (ctypes.c_int, [ctypes.c_void_p]),
+}
 
 
 def _load() -> ctypes.CDLL:
-    global _lib
-    with _build_lock:
-        if _lib is not None:
-            return _lib
-        rebuild = (not os.path.exists(_SO)) or (
-            os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-        )
-        if rebuild:
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-                 _SRC, "-o", _SO],
-                check=True,
-                capture_output=True,
-            )
-        lib = ctypes.CDLL(_SO)
-        lib.ds_open.restype = ctypes.c_void_p
-        lib.ds_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
-        lib.ds_append.restype = ctypes.c_int
-        lib.ds_append.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.c_uint64,
-        ]
-        lib.ds_flush.restype = ctypes.c_int
-        lib.ds_flush.argtypes = [ctypes.c_void_p]
-        lib.ds_count.restype = ctypes.c_uint64
-        lib.ds_count.argtypes = [ctypes.c_void_p]
-        lib.ds_close.restype = ctypes.c_int
-        lib.ds_close.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return lib
+    return load_native("drawstore.cpp", _API)
 
 
 class DrawStore:
