@@ -44,6 +44,47 @@ def _cmd_bench(args) -> int:
     return 0
 
 
+def _cmd_bench_all(args) -> int:
+    """Run every benchmark config and append a measured table to BASELINE.md."""
+    import datetime
+
+    import jax
+
+    from .benchmarks import ALL_BENCHMARKS
+
+    platform = jax.devices()[0].platform
+    rows = []
+    for name in sorted(ALL_BENCHMARKS):
+        try:
+            res = ALL_BENCHMARKS[name]()
+            print(res.row(), file=sys.stderr)
+            rows.append(
+                f"| {res.name} | {res.ess_per_sec:.2f} | {res.min_ess:.0f} | "
+                f"{res.wall_s:.1f} | {res.max_rhat:.3f} | {platform} |"
+            )
+        except Exception as e:  # noqa: BLE001 — record partial results
+            print(f"{name}: FAILED {e!r}", file=sys.stderr)
+            rows.append(f"| {name} | — | — | — | — | FAILED |")
+    stamp = datetime.date.today().isoformat()
+    table = "\n".join(
+        [
+            "",
+            f"## Measured (smoke scale, {stamp}, platform={platform})",
+            "",
+            "| benchmark | ESS/s | min ESS | wall (s) | max R-hat | platform |",
+            "|---|---|---|---|---|---|",
+            *rows,
+            "",
+        ]
+    )
+    if args.update_baseline:
+        with open(args.update_baseline, "a") as f:
+            f.write(table)
+        print(f"appended to {args.update_baseline}", file=sys.stderr)
+    print(table)
+    return 0
+
+
 def _cmd_list(args) -> int:
     from .benchmarks import ALL_BENCHMARKS
     from .config import _model_registry, _synth_registry
@@ -65,6 +106,12 @@ def main(argv=None) -> int:
     p_bench = sub.add_parser("bench", help="run a named benchmark at smoke scale")
     p_bench.add_argument("name")
     p_bench.set_defaults(fn=_cmd_bench)
+
+    p_all = sub.add_parser(
+        "bench-all", help="run every benchmark; optionally append to BASELINE.md"
+    )
+    p_all.add_argument("--update-baseline", metavar="PATH", default=None)
+    p_all.set_defaults(fn=_cmd_bench_all)
 
     p_list = sub.add_parser("list", help="list benchmarks/models/datasets")
     p_list.set_defaults(fn=_cmd_list)

@@ -21,7 +21,12 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..model import Model, flatten_model, prepare_model_data
-from ..parallel.mesh import make_mesh, row_partition_specs, shard_data
+from ..parallel.mesh import (
+    make_mesh,
+    process_local_shard,
+    row_partition_specs,
+    shard_data,
+)
 from ..sampler import Posterior, SamplerConfig, _constrain_draws, make_chain_runner
 
 
@@ -81,12 +86,18 @@ class ShardedBackend:
                 f"chains={chains} must divide mesh 'chains' axis ({n_chain_devs})"
             )
         fm = flatten_model(model, axis_name="data" if data is not None else None)
+        multiproc = jax.process_count() > 1
 
         row_axes = None
         if data is not None:
             data = prepare_model_data(model, data)
             row_axes = model.data_row_axes(data)
-            data = shard_data(data, self.mesh, "data", row_axes=row_axes)
+            if multiproc:
+                # each process passed only ITS rows (distributed.local_row_range);
+                # glue them into one global row-sharded array over ICI/DCN
+                data = process_local_shard(data, self.mesh, "data", row_axes=row_axes)
+            else:
+                data = shard_data(data, self.mesh, "data", row_axes=row_axes)
 
         key = jax.random.PRNGKey(seed)
         key_init, key_run = jax.random.split(key)
@@ -97,14 +108,33 @@ class ShardedBackend:
         chain_keys = jax.random.split(key_run, chains)
 
         chain_sharding = NamedSharding(self.mesh, P("chains"))
-        z0 = jax.device_put(z0, chain_sharding)
-        chain_keys = jax.device_put(chain_keys, chain_sharding)
+        if multiproc:
+            # every process computed the full (identical, same-seed) z0/keys;
+            # each contributes just its addressable shards
+            def to_global(x):
+                x = np.asarray(x)
+                return jax.make_array_from_callback(
+                    x.shape, chain_sharding, lambda idx: x[idx]
+                )
+
+            z0 = to_global(z0)
+            chain_keys = to_global(chain_keys)
+        else:
+            z0 = jax.device_put(z0, chain_sharding)
+            chain_keys = jax.device_put(chain_keys, chain_sharding)
 
         run = self._get_runner(model, fm, cfg, data, row_axes)
         if data is None:
             res = jax.block_until_ready(run(chain_keys, z0))
         else:
             res = jax.block_until_ready(run(chain_keys, z0, data))
+
+        if multiproc:
+            # multi-host draw collection: allgather the chain-sharded results
+            # so every host returns the same full Posterior (no driver funnel)
+            from ..distributed import gather_draws
+
+            res = gather_draws(res)
 
         draws = _constrain_draws(fm, res.draws)
         stats = {

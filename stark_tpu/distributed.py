@@ -1,0 +1,102 @@
+"""Multi-host execution — the distributed communication backend (SURVEY.md
+§2/§3: XLA collectives over ICI within a slice, DCN across hosts, replacing
+the reference's Spark driver/shuffle transport).
+
+Usage on each host (one process per host; same program everywhere):
+
+    import stark_tpu.distributed as dist
+    dist.initialize()                      # env-driven, or pass explicitly
+    mesh = make_mesh({"data": -1, "chains": 2})   # GLOBAL devices
+    post = stark_tpu.sample(model, local_rows, backend=ShardedBackend(mesh),
+                            chains=8)
+
+With ``jax.distributed`` initialized, ``jax.devices()`` is the global device
+set, ``ShardedBackend`` assembles each host's local rows into one global
+row-sharded array (``jax.make_array_from_process_local_data``) and the
+per-step ``psum("data")`` rides ICI/DCN inside the compiled program — no
+host round-trips.  Draws come back through ``gather_draws`` (an allgather of
+the chain-sharded result) so every host returns the same full Posterior.
+
+On CPU (tests, the virtual mesh), cross-process collectives use the Gloo
+backend: set ``JAX_CPU_COLLECTIVES_IMPLEMENTATION=gloo`` before importing
+jax (see tests/test_distributed.py for a complete 2-process example).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> None:
+    """Idempotent ``jax.distributed.initialize``.
+
+    With no arguments, resolution falls to jax's env/cluster detection
+    (JAX_COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID, or the TPU pod
+    metadata on real multi-host slices).  Single-process runs may simply
+    never call this — every helper below degrades to the local case.
+    """
+    if is_initialized():
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+
+
+def is_initialized() -> bool:
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:  # pragma: no cover - defensive on jax internals
+        return False
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def local_row_range(total_rows: int) -> tuple[int, int]:
+    """[start, end) of this host's contiguous shard of a ``total_rows``
+    dataset (row-block layout matching ``parallel.mesh.process_local_shard``).
+    Pair with ``dataio.RowReader`` to stream exactly this host's rows."""
+    n, p, k = total_rows, process_count(), process_index()
+    if n % p:
+        raise ValueError(f"rows {n} not divisible by process count {p}")
+    per = n // p
+    return k * per, (k + 1) * per
+
+
+def gather_draws(tree):
+    """Materialize a (possibly non-addressable, sharded) result pytree on
+    EVERY host as plain numpy arrays — the multi-host draw collection step.
+
+    Single-process: a plain device->host copy.  Multi-process: an
+    allgather over DCN (jax.experimental.multihost_utils), after which all
+    hosts hold identical full draws — the equivalent of the reference's
+    driver-side collect, without funnelling through one node.
+    ``ShardedBackend.run`` routes its results through here.
+    """
+    if process_count() == 1:
+        return jax.tree.map(np.asarray, tree)
+    from jax.experimental import multihost_utils
+
+    return jax.tree.map(
+        lambda x: np.asarray(multihost_utils.process_allgather(x, tiled=True)),
+        tree,
+    )

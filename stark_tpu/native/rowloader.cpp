@@ -22,6 +22,7 @@
 #include <atomic>
 #include <cctype>
 #include <cerrno>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -98,7 +99,10 @@ int ParseLine(const char* p, const char* line_end, int64_t cols, float* dst) {
     float v = strtof(p, &cell_end);
     // strtof skips leading whitespace INCLUDING '\n': a conversion that
     // wandered past line_end consumed the next line — malformed input.
-    if (cell_end == p || cell_end > line_end || errno == ERANGE || c >= cols)
+    // ERANGE counts only on OVERFLOW: underflow (e.g. the float32
+    // subnormal 1e-42) also sets ERANGE but yields a usable denormal/0.
+    bool overflow = errno == ERANGE && (v >= HUGE_VALF || v <= -HUGE_VALF);
+    if (cell_end == p || cell_end > line_end || overflow || c >= cols)
       return -1;
     dst[c++] = v;
     p = cell_end;

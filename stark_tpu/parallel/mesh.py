@@ -124,14 +124,19 @@ def run_over_chains(mesh: Mesh, vrun, *args):
     return jax.block_until_ready(jax.jit(fn)(*args))
 
 
-def process_local_shard(data, mesh: Mesh, axis: str = "data"):
+def process_local_shard(data, mesh: Mesh, axis: str = "data", row_axes=None):
     """Multi-host path: assemble a global sharded array from per-process rows.
 
     Each process passes only its local rows; jax glues them into one global
     array laid out over ``axis`` (ICI within host, DCN across hosts).
+    row_axes: see ``row_partition_specs`` — transformed layouts (e.g. a
+    transposed ``xT``) shard their row axis, wherever it lives.
     """
-    sharding = NamedSharding(mesh, P(axis))
+    specs = row_partition_specs(data, axis, row_axes)
     return jax.tree.map(
-        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        lambda x, spec: jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), np.asarray(x)
+        ),
         data,
+        specs,
     )

@@ -119,3 +119,15 @@ def test_rowreader_close_raises_and_finalizes(tmp_path, matrix):
     fin = r2._finalizer
     del r2
     assert not fin.alive
+
+
+def test_csv_subnormal_and_large_values(tmp_path):
+    """Regression: strtof underflow (ERANGE on 1e-42) must not reject the
+    file; genuine float32-range values round-trip."""
+    path = tmp_path / "sub.csv"
+    path.write_text("1e-42,3e38\n-1e-40,1.0\n")
+    out = load_csv(str(path))
+    assert out.shape == (2, 2)
+    assert out[0, 0] != 0.0 or out[0, 0] == 0.0  # parsed, not rejected
+    np.testing.assert_allclose(out[1, 1], 1.0)
+    assert np.isfinite(out).all()
