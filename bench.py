@@ -53,7 +53,12 @@ def main():
 
     model = HierLogistic(num_features=d, num_groups=groups)
     data, _ = synth_logistic_data(jax.random.PRNGKey(0), n, d, num_groups=groups)
-    backend = JaxBackend()
+    # bounded dispatches on accelerators: the axon tunnel faults device
+    # programs running past ~1 min.  An explicit BENCH_DISPATCH=0 forces the
+    # monolithic single dispatch (JaxBackend treats 0 as "no segmentation"
+    # without falling through to the STARK_DISPATCH_STEPS env default).
+    dispatch = _env_int("BENCH_DISPATCH", 0 if platform == "cpu" else 50)
+    backend = JaxBackend(dispatch_steps=dispatch)
 
     kwargs = dict(
         kernel="nuts", max_tree_depth=depth, num_warmup=num_warmup,

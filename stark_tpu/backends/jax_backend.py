@@ -13,19 +13,44 @@ of recompiling.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..adaptation import build_warmup_schedule
 from ..model import Model, flatten_model, prepare_model_data
-from ..sampler import Posterior, SamplerConfig, _constrain_draws, make_chain_runner
+from ..sampler import (
+    Posterior,
+    SamplerConfig,
+    _constrain_draws,
+    make_block_runners,
+    make_chain_runner,
+    make_warmup_parts,
+)
 
 
 class JaxBackend:
-    def __init__(self, device: Optional[Any] = None):
+    """Single-process backend.
+
+    dispatch_steps: when set (or via the STARK_DISPATCH_STEPS env var), the
+    run executes as a sequence of device programs of at most that many
+    transitions each instead of one monolithic dispatch — required where
+    the runtime bounds device-program wall-clock (the axon TPU tunnel
+    faults executions past roughly a minute) and what keeps any single
+    fault re-startable.  Results are statistically equivalent; the RNG
+    stream differs from the monolithic path.
+    """
+
+    def __init__(self, device: Optional[Any] = None,
+                 dispatch_steps: Optional[int] = None):
         self.device = device
+        if dispatch_steps is None:
+            env = os.environ.get("STARK_DISPATCH_STEPS")
+            dispatch_steps = int(env) if env else None
+        self.dispatch_steps = dispatch_steps
         self._cache: Dict[Tuple[int, SamplerConfig], Any] = {}
 
     def _get_runner(self, model: Model, fm, cfg: SamplerConfig):
@@ -56,10 +81,14 @@ class JaxBackend:
             z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
         chain_keys = jax.random.split(key_run, chains)
 
-        run = self._get_runner(model, fm, cfg)
         if self.device is not None:
             z0 = jax.device_put(z0, self.device)
             chain_keys = jax.device_put(chain_keys, self.device)
+
+        if self.dispatch_steps:
+            return self._run_segmented(model, fm, cfg, data, chain_keys, z0)
+
+        run = self._get_runner(model, fm, cfg)
         res = run(chain_keys, z0, data)
         res = jax.block_until_ready(res)
 
@@ -77,3 +106,102 @@ class JaxBackend:
         return Posterior(
             draws, stats, flat_model=fm, draws_flat=np.asarray(res.draws)
         )
+
+    def _run_segmented(self, model, fm, cfg, data, chain_keys, z0):
+        """Warmup + sampling as bounded-length dispatches (see class doc).
+
+        At most two compiled variants per phase (the full segment and one
+        remainder length); all compiled functions are cached per
+        (model, cfg, segment length) on the backend.
+        """
+        seg = int(self.dispatch_steps)
+        chains = z0.shape[0]
+
+        def cached(tag, builder):
+            key = (id(model), cfg, tag)
+            if key not in self._cache:
+                self._cache[key] = builder()
+            return self._cache[key]
+
+        init_carry, segment, finalize = make_warmup_parts(fm, cfg)
+        v_init = cached("warm_init", lambda: jax.jit(
+            jax.vmap(init_carry, in_axes=(0, 0, None))))
+
+        keys = jax.vmap(lambda k: jax.random.split(k, 2))(chain_keys)
+        warm_keys, sample_keys = keys[:, 0], keys[:, 1]
+        kinit = jax.vmap(lambda k: jax.random.split(k, 2))(warm_keys)
+        state, da, welford, inv_mass = jax.block_until_ready(
+            v_init(kinit[:, 0], z0, data)
+        )
+
+        schedule = build_warmup_schedule(cfg.num_warmup)
+        aflags = np.asarray(schedule.adapt_mass)
+        wflags = np.asarray(schedule.window_end)
+        # (num_warmup, chains, 2) step keys, sliced per segment on the host
+        wkeys = np.asarray(
+            jax.vmap(lambda k: jax.random.split(k, max(cfg.num_warmup, 1)))(
+                kinit[:, 1]
+            )
+        ).transpose(1, 0, 2)
+        warm_div = np.zeros((chains,), np.int64)
+        for s in range(0, cfg.num_warmup, seg):
+            e = min(s + seg, cfg.num_warmup)
+            fn = cached(
+                ("warm_seg", e - s), lambda: jax.jit(
+                    jax.vmap(segment, in_axes=(1, None, None, 0, 0, 0, 0, None))))
+            state, da, welford, inv_mass, ndiv = jax.block_until_ready(
+                fn(jnp.asarray(wkeys[s:e]), jnp.asarray(aflags[s:e]),
+                   jnp.asarray(wflags[s:e]), state, da, welford, inv_mass, data)
+            )
+            warm_div += np.asarray(ndiv)
+        step_size = finalize(da)
+
+        total = cfg.num_samples * cfg.thin
+        skeys = np.asarray(
+            jax.vmap(lambda k: jax.random.split(k, max(total, 1)))(sample_keys)
+        )  # (chains, >=1, 2)
+        # empty seeds keep the num_samples=0 (warmup-only) case concatenable
+        zs_blocks = [np.zeros((chains, 0, z0.shape[1]), np.asarray(z0).dtype)]
+        acc_blocks = [np.zeros((chains, 0), np.float32)]
+        div_blocks = [np.zeros((chains, 0), bool)]
+        en_blocks = [np.zeros((chains, 0), np.float32)]
+        ng_blocks = [np.zeros((chains, 0), np.int32)]
+        for s in range(0, total, seg):
+            e = min(s + seg, total)
+            v_block = cached(("block", e - s), lambda: jax.jit(jax.vmap(
+                make_block_runners(fm, cfg, e - s)[1],
+                in_axes=(0, 0, 0, 0, None))))
+            # block_run splits its own per-step keys from one key per chain
+            bkeys = jnp.asarray(skeys[:, s, :])
+            state, zs, accept, divergent, energy, ngrad = jax.block_until_ready(
+                v_block(bkeys, state, step_size, inv_mass, data)
+            )
+            zs_blocks.append(np.asarray(zs))
+            acc_blocks.append(np.asarray(accept))
+            div_blocks.append(np.asarray(divergent))
+            en_blocks.append(np.asarray(energy))
+            ng_blocks.append(np.asarray(ngrad))
+
+        zs = np.concatenate(zs_blocks, axis=1)  # (chains, total, d)
+        accept = np.concatenate(acc_blocks, axis=1)
+        divergent = np.concatenate(div_blocks, axis=1)
+        energy = np.concatenate(en_blocks, axis=1)
+        ngrad = np.concatenate(ng_blocks, axis=1)
+        num_divergent = divergent.astype(np.int64).sum(axis=1)
+        if cfg.thin > 1:
+            sl = slice(cfg.thin - 1, None, cfg.thin)
+            zs, accept = zs[:, sl], accept[:, sl]
+            divergent, energy, ngrad = divergent[:, sl], energy[:, sl], ngrad[:, sl]
+
+        draws = _constrain_draws(fm, jnp.asarray(zs))
+        stats = {
+            "accept_prob": accept,
+            "is_divergent": divergent,
+            "energy": energy,
+            "num_grad_evals": ngrad,
+            "step_size": np.asarray(step_size),
+            "inv_mass_diag": np.asarray(inv_mass),
+            "num_warmup_divergent": warm_div,
+            "num_divergent": num_divergent,
+        }
+        return Posterior(draws, stats, flat_model=fm, draws_flat=zs)

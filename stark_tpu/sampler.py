@@ -79,6 +79,60 @@ class ChainResult(NamedTuple):
     suff_m2: Array
 
 
+def _make_warmup_body(cfg: SamplerConfig, kernel):
+    """The per-transition warmup update shared by the one-dispatch warmup
+    and the dispatch-bounded segment runner — one implementation so the two
+    paths cannot drift."""
+
+    def body(carry, x):
+        state, da, welford, inv_mass = carry
+        d = state.z.shape[0]
+        dtype = state.z.dtype
+        key, adapt_mass_f, window_end_f = x
+        step_size = (
+            jnp.exp(da.log_step)
+            if cfg.adapt_step_size
+            else jnp.asarray(cfg.init_step_size, dtype)
+        )
+        state, info = kernel(key, state, step_size=step_size, inv_mass_diag=inv_mass)
+        if cfg.adapt_step_size:
+            da = da_update(da, info.accept_prob, cfg.target_accept)
+        if cfg.adapt_mass:
+            welford = _tree_select(
+                adapt_mass_f, welford_update(welford, state.z), welford
+            )
+            new_mass = welford_variance(welford)
+            refresh = window_end_f & (welford.count > 1)
+            inv_mass = jnp.where(refresh, new_mass, inv_mass)
+            welford = _tree_select(window_end_f, welford_init(d, dtype), welford)
+            if cfg.adapt_step_size:
+                da = _tree_select(
+                    window_end_f, da_init(jnp.exp(da.log_step)), da
+                )
+        return (state, da, welford, inv_mass), info.is_divergent
+
+    return body
+
+
+def _warmup_carry_init(cfg: SamplerConfig, potential_fn, key, state: HMCState):
+    d = state.z.shape[0]
+    dtype = state.z.dtype
+    inv_mass = jnp.ones((d,), dtype)
+    if cfg.adapt_step_size:
+        step0 = find_reasonable_step_size(
+            potential_fn,
+            state.z,
+            state.potential_energy,
+            state.grad,
+            inv_mass,
+            key,
+            cfg.init_step_size,
+        )
+    else:
+        step0 = jnp.asarray(cfg.init_step_size, dtype)
+    return state, da_init(step0), welford_init(d, dtype), inv_mass
+
+
 def make_warmup_fn(fm: FlatModel, cfg: SamplerConfig):
     """Build warmup(key, state, potential_fn, kernel) ->
     (state, step_size, inv_mass, n_divergent) — the windowed Stan-style
@@ -88,58 +142,20 @@ def make_warmup_fn(fm: FlatModel, cfg: SamplerConfig):
     window_end_flags = jnp.asarray(schedule.window_end)
 
     def warmup(key, state: HMCState, potential_fn, kernel):
-        d = state.z.shape[0]
         dtype = state.z.dtype
-        inv_mass = jnp.ones((d,), dtype)
         key_find, key_scan = jax.random.split(key)
-        if cfg.adapt_step_size:
-            step0 = find_reasonable_step_size(
-                potential_fn,
-                state.z,
-                state.potential_energy,
-                state.grad,
-                inv_mass,
-                key_find,
-                cfg.init_step_size,
-            )
-        else:
-            step0 = jnp.asarray(cfg.init_step_size, dtype)
-        da = da_init(step0)
-        welford = welford_init(d, dtype)
-
-        def body(carry, x):
-            state, da, welford, inv_mass = carry
-            key, adapt_mass_f, window_end_f = x
-            step_size = (
-                jnp.exp(da.log_step)
-                if cfg.adapt_step_size
-                else jnp.asarray(cfg.init_step_size, dtype)
-            )
-            state, info = kernel(key, state, step_size=step_size, inv_mass_diag=inv_mass)
-            if cfg.adapt_step_size:
-                da = da_update(da, info.accept_prob, cfg.target_accept)
-            if cfg.adapt_mass:
-                welford = _tree_select(
-                    adapt_mass_f, welford_update(welford, state.z), welford
-                )
-                new_mass = welford_variance(welford)
-                refresh = window_end_f & (welford.count > 1)
-                inv_mass = jnp.where(refresh, new_mass, inv_mass)
-                welford = _tree_select(window_end_f, welford_init(d, dtype), welford)
-                if cfg.adapt_step_size:
-                    da = _tree_select(
-                        window_end_f, da_init(jnp.exp(da.log_step)), da
-                    )
-            return (state, da, welford, inv_mass), info.is_divergent
-
+        carry = _warmup_carry_init(cfg, potential_fn, key_find, state)
         if cfg.num_warmup > 0:
             keys = jax.random.split(key_scan, cfg.num_warmup)
-            (state, da, _, inv_mass), divergent = jax.lax.scan(
-                body, (state, da, welford, inv_mass), (keys, adapt_mass_flags, window_end_flags)
+            carry, divergent = jax.lax.scan(
+                _make_warmup_body(cfg, kernel),
+                carry,
+                (keys, adapt_mass_flags, window_end_flags),
             )
             n_div = jnp.sum(divergent.astype(jnp.int32))
         else:
             n_div = jnp.zeros((), jnp.int32)
+        state, da, _, inv_mass = carry
         step_size = (
             jnp.exp(da.log_avg_step)
             if cfg.adapt_step_size
@@ -148,6 +164,48 @@ def make_warmup_fn(fm: FlatModel, cfg: SamplerConfig):
         return state, step_size, inv_mass, n_div
 
     return warmup
+
+
+def make_warmup_parts(fm: FlatModel, cfg: SamplerConfig):
+    """Dispatch-bounded warmup: (init_carry, segment, finalize).
+
+    Identical math to ``make_warmup_fn`` (same shared body), but the host
+    drives the schedule in bounded slices, carrying the full adaptation
+    state (chain state, dual-averaging, Welford, mass) between dispatches.
+    Needed where the runtime kills long device programs (the axon tunnel
+    faults executions past ~1 min) and for checkpointable warmup.
+
+      init_carry(key, z0, data) -> (state, da, welford, inv_mass)
+      segment(keys, adapt_flags, wend_flags, state, da, welford, inv_mass,
+              data) -> (state, da, welford, inv_mass, n_div)
+      finalize(da) -> step_size            (host-side, cheap)
+
+    Slice ``build_warmup_schedule(cfg.num_warmup)`` flags to feed segments.
+    """
+    step_kernel = make_kernel(cfg)
+
+    def init_carry(key, z0, data=None):
+        potential_fn = fm.bind(data)
+        state = init_state(potential_fn, z0)
+        return _warmup_carry_init(cfg, potential_fn, key, state)
+
+    def segment(keys, adapt_flags, wend_flags, state, da, welford, inv_mass,
+                data=None):
+        potential_fn = fm.bind(data)
+        kernel = partial(step_kernel, potential_fn=potential_fn)
+        (state, da, welford, inv_mass), divergent = jax.lax.scan(
+            _make_warmup_body(cfg, kernel),
+            (state, da, welford, inv_mass),
+            (keys, adapt_flags, wend_flags),
+        )
+        return state, da, welford, inv_mass, jnp.sum(divergent.astype(jnp.int32))
+
+    def finalize(da):
+        if cfg.adapt_step_size:
+            return jnp.exp(da.log_avg_step)
+        return jnp.full_like(jnp.asarray(da.log_avg_step), cfg.init_step_size)
+
+    return init_carry, segment, finalize
 
 
 def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):

@@ -128,6 +128,8 @@ def test_csv_subnormal_and_large_values(tmp_path):
     path.write_text("1e-42,3e38\n-1e-40,1.0\n")
     out = load_csv(str(path))
     assert out.shape == (2, 2)
-    assert out[0, 0] != 0.0 or out[0, 0] == 0.0  # parsed, not rejected
+    assert 0.0 <= out[0, 0] <= 1e-41  # underflow parsed as denormal/0
+    assert -1e-39 <= out[1, 0] <= 0.0
+    np.testing.assert_allclose(out[0, 1], 3e38, rtol=1e-6)
     np.testing.assert_allclose(out[1, 1], 1.0)
     assert np.isfinite(out).all()
