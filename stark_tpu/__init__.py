@@ -9,6 +9,25 @@ tempered sampling with data sharded across a device mesh and likelihood
 terms + R-hat/ESS sufficient statistics allreduced over ICI.
 """
 
+import os as _os
+
+import jax as _jax
+
+# MCMC correctness depends on gradient/energy accuracy: on TPU the default
+# matmul precision can drop inputs to bfloat16 (and XLA may rewrite gather
+# VJP scatters into MXU one-hot matmuls), which is catastrophic for
+# Hamiltonian energy conservation.  The framework's hot paths are
+# bandwidth-bound matrix-vector work, so full-f32 MXU passes cost little.
+# Applied ONLY when the host application has not configured a precision
+# itself (None = jax's never-set default), so importing stark_tpu never
+# clobbers an explicit choice.  Opt out / override with
+# STARK_MATMUL_PRECISION=default|high|highest.
+if _jax.config.jax_default_matmul_precision is None or "STARK_MATMUL_PRECISION" in _os.environ:
+    _jax.config.update(
+        "jax_default_matmul_precision",
+        _os.environ.get("STARK_MATMUL_PRECISION", "highest"),
+    )
+
 from . import bijectors, diagnostics
 from .model import Model, ParamSpec, flatten_model, prepare_model_data
 from .runner import sample_until_converged

@@ -50,11 +50,15 @@ class JaxBackend:
         if dispatch_steps is None:
             env = os.environ.get("STARK_DISPATCH_STEPS")
             dispatch_steps = int(env) if env else None
+        if dispatch_steps is not None and dispatch_steps < 0:
+            raise ValueError(f"dispatch_steps must be >= 0, got {dispatch_steps}")
         self.dispatch_steps = dispatch_steps
-        self._cache: Dict[Tuple[int, SamplerConfig], Any] = {}
+        # keyed on the model OBJECT (kept alive by the key): an id() key can
+        # be silently reused for a different model after garbage collection
+        self._cache: Dict[Tuple[Any, ...], Any] = {}
 
     def _get_runner(self, model: Model, fm, cfg: SamplerConfig):
-        key = (id(model), cfg)
+        key = (model, cfg)
         if key not in self._cache:
             runner = make_chain_runner(fm, cfg)
             self._cache[key] = jax.jit(jax.vmap(runner, in_axes=(0, 0, None)))
@@ -118,7 +122,7 @@ class JaxBackend:
         chains = z0.shape[0]
 
         def cached(tag, builder):
-            key = (id(model), cfg, tag)
+            key = (model, cfg, tag)
             if key not in self._cache:
                 self._cache[key] = builder()
             return self._cache[key]
@@ -126,6 +130,10 @@ class JaxBackend:
         init_carry, segment, finalize = make_warmup_parts(fm, cfg)
         v_init = cached("warm_init", lambda: jax.jit(
             jax.vmap(init_carry, in_axes=(0, 0, None))))
+        # one jitted wrapper serves every segment length: the length lives
+        # in the input shapes, which jit already caches traces per
+        v_warm_seg = cached("warm_seg", lambda: jax.jit(
+            jax.vmap(segment, in_axes=(1, None, None, 0, 0, 0, 0, None))))
 
         keys = jax.vmap(lambda k: jax.random.split(k, 2))(chain_keys)
         warm_keys, sample_keys = keys[:, 0], keys[:, 1]
@@ -146,12 +154,10 @@ class JaxBackend:
         warm_div = np.zeros((chains,), np.int64)
         for s in range(0, cfg.num_warmup, seg):
             e = min(s + seg, cfg.num_warmup)
-            fn = cached(
-                ("warm_seg", e - s), lambda: jax.jit(
-                    jax.vmap(segment, in_axes=(1, None, None, 0, 0, 0, 0, None))))
             state, da, welford, inv_mass, ndiv = jax.block_until_ready(
-                fn(jnp.asarray(wkeys[s:e]), jnp.asarray(aflags[s:e]),
-                   jnp.asarray(wflags[s:e]), state, da, welford, inv_mass, data)
+                v_warm_seg(jnp.asarray(wkeys[s:e]), jnp.asarray(aflags[s:e]),
+                           jnp.asarray(wflags[s:e]), state, da, welford,
+                           inv_mass, data)
             )
             warm_div += np.asarray(ndiv)
         step_size = finalize(da)
@@ -160,12 +166,14 @@ class JaxBackend:
         skeys = np.asarray(
             jax.vmap(lambda k: jax.random.split(k, max(total, 1)))(sample_keys)
         )  # (chains, >=1, 2)
-        # empty seeds keep the num_samples=0 (warmup-only) case concatenable
+        # empty seeds keep the num_samples=0 (warmup-only) case concatenable;
+        # thinning happens PER BLOCK so host memory holds only kept draws
         zs_blocks = [np.zeros((chains, 0, z0.shape[1]), np.asarray(z0).dtype)]
         acc_blocks = [np.zeros((chains, 0), np.float32)]
         div_blocks = [np.zeros((chains, 0), bool)]
         en_blocks = [np.zeros((chains, 0), np.float32)]
         ng_blocks = [np.zeros((chains, 0), np.int32)]
+        num_divergent = np.zeros((chains,), np.int64)
         for s in range(0, total, seg):
             e = min(s + seg, total)
             v_block = cached(("block", e - s), lambda: jax.jit(jax.vmap(
@@ -176,22 +184,22 @@ class JaxBackend:
             state, zs, accept, divergent, energy, ngrad = jax.block_until_ready(
                 v_block(bkeys, state, step_size, inv_mass, data)
             )
-            zs_blocks.append(np.asarray(zs))
-            acc_blocks.append(np.asarray(accept))
-            div_blocks.append(np.asarray(divergent))
-            en_blocks.append(np.asarray(energy))
-            ng_blocks.append(np.asarray(ngrad))
+            divergent = np.asarray(divergent)
+            num_divergent += divergent.astype(np.int64).sum(axis=1)
+            # global transition i is kept when (i+1) % thin == 0
+            keep = np.arange(s, e)
+            keep = (keep[(keep + 1) % cfg.thin == 0] - s) if cfg.thin > 1 else slice(None)
+            zs_blocks.append(np.asarray(zs)[:, keep])
+            acc_blocks.append(np.asarray(accept)[:, keep])
+            div_blocks.append(divergent[:, keep])
+            en_blocks.append(np.asarray(energy)[:, keep])
+            ng_blocks.append(np.asarray(ngrad)[:, keep])
 
-        zs = np.concatenate(zs_blocks, axis=1)  # (chains, total, d)
+        zs = np.concatenate(zs_blocks, axis=1)  # (chains, num_samples, d)
         accept = np.concatenate(acc_blocks, axis=1)
         divergent = np.concatenate(div_blocks, axis=1)
         energy = np.concatenate(en_blocks, axis=1)
         ngrad = np.concatenate(ng_blocks, axis=1)
-        num_divergent = divergent.astype(np.int64).sum(axis=1)
-        if cfg.thin > 1:
-            sl = slice(cfg.thin - 1, None, cfg.thin)
-            zs, accept = zs[:, sl], accept[:, sl]
-            divergent, energy, ngrad = divergent[:, sl], energy[:, sl], ngrad[:, sl]
 
         draws = _constrain_draws(fm, jnp.asarray(zs))
         stats = {

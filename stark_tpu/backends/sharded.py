@@ -46,7 +46,8 @@ class ShardedBackend:
 
     def _get_runner(self, model: Model, fm, cfg: SamplerConfig, data, row_axes):
         treedef = None if data is None else jax.tree.structure(data)
-        key = (id(model), cfg, treedef)
+        # model OBJECT in the key (not id(): freed ids get reused after GC)
+        key = (model, cfg, treedef)
         if key not in self._cache:
             runner = make_chain_runner(fm, cfg)
             vrunner = jax.vmap(runner, in_axes=(0, 0, None))
