@@ -22,11 +22,17 @@ import jax as _jax
 # itself (None = jax's never-set default), so importing stark_tpu never
 # clobbers an explicit choice.  Opt out / override with
 # STARK_MATMUL_PRECISION=default|high|highest.
-if _jax.config.jax_default_matmul_precision is None or "STARK_MATMUL_PRECISION" in _os.environ:
-    _jax.config.update(
-        "jax_default_matmul_precision",
-        _os.environ.get("STARK_MATMUL_PRECISION", "highest"),
-    )
+_prec = _os.environ.get("STARK_MATMUL_PRECISION")
+if _prec == "" or (_prec or "").lower() == "none":
+    _prec = None  # explicit "leave jax's precision untouched"
+    _explicit_skip = True
+else:
+    _explicit_skip = False
+if not _explicit_skip and (
+    _prec is not None or _jax.config.jax_default_matmul_precision is None
+):
+    _jax.config.update("jax_default_matmul_precision", _prec or "highest")
+del _prec, _explicit_skip
 
 from . import bijectors, diagnostics
 from .model import Model, ParamSpec, flatten_model, prepare_model_data

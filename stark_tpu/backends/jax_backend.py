@@ -20,15 +20,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..adaptation import build_warmup_schedule
 from ..model import Model, flatten_model, prepare_model_data
 from ..sampler import (
     Posterior,
     SamplerConfig,
     _constrain_draws,
-    make_block_runners,
+    make_block_runner,
     make_chain_runner,
-    make_warmup_parts,
+    make_segmented_warmup,
 )
 
 
@@ -127,40 +126,13 @@ class JaxBackend:
                 self._cache[key] = builder()
             return self._cache[key]
 
-        init_carry, segment, finalize = make_warmup_parts(fm, cfg)
-        v_init = cached("warm_init", lambda: jax.jit(
-            jax.vmap(init_carry, in_axes=(0, 0, None))))
-        # one jitted wrapper serves every segment length: the length lives
-        # in the input shapes, which jit already caches traces per
-        v_warm_seg = cached("warm_seg", lambda: jax.jit(
-            jax.vmap(segment, in_axes=(1, None, None, 0, 0, 0, 0, None))))
+        seg_warmup = cached("seg_warmup", lambda: make_segmented_warmup(fm, cfg))
 
         keys = jax.vmap(lambda k: jax.random.split(k, 2))(chain_keys)
         warm_keys, sample_keys = keys[:, 0], keys[:, 1]
-        kinit = jax.vmap(lambda k: jax.random.split(k, 2))(warm_keys)
-        state, da, welford, inv_mass = jax.block_until_ready(
-            v_init(kinit[:, 0], z0, data)
+        state, step_size, inv_mass, warm_div = seg_warmup(
+            warm_keys, z0, data, seg
         )
-
-        schedule = build_warmup_schedule(cfg.num_warmup)
-        aflags = np.asarray(schedule.adapt_mass)
-        wflags = np.asarray(schedule.window_end)
-        # (num_warmup, chains, 2) step keys, sliced per segment on the host
-        wkeys = np.asarray(
-            jax.vmap(lambda k: jax.random.split(k, max(cfg.num_warmup, 1)))(
-                kinit[:, 1]
-            )
-        ).transpose(1, 0, 2)
-        warm_div = np.zeros((chains,), np.int64)
-        for s in range(0, cfg.num_warmup, seg):
-            e = min(s + seg, cfg.num_warmup)
-            state, da, welford, inv_mass, ndiv = jax.block_until_ready(
-                v_warm_seg(jnp.asarray(wkeys[s:e]), jnp.asarray(aflags[s:e]),
-                           jnp.asarray(wflags[s:e]), state, da, welford,
-                           inv_mass, data)
-            )
-            warm_div += np.asarray(ndiv)
-        step_size = finalize(da)
 
         total = cfg.num_samples * cfg.thin
         skeys = np.asarray(
@@ -177,7 +149,7 @@ class JaxBackend:
         for s in range(0, total, seg):
             e = min(s + seg, total)
             v_block = cached(("block", e - s), lambda: jax.jit(jax.vmap(
-                make_block_runners(fm, cfg, e - s)[1],
+                make_block_runner(fm, cfg, e - s),
                 in_axes=(0, 0, 0, 0, None))))
             # block_run splits its own per-step keys from one key per chain
             bkeys = jnp.asarray(skeys[:, s, :])

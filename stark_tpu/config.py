@@ -45,6 +45,8 @@ def _model_registry() -> Dict[str, Callable]:
         "FusedLogistic": models.FusedLogistic,
         "FusedHierLogistic": models.FusedHierLogistic,
         "LinearMixedModel": models.LinearMixedModel,
+        "LinearRegression": models.LinearRegression,
+        "PoissonRegression": models.PoissonRegression,
         "GaussianMixture": models.GaussianMixture,
         "BayesianMLP": models.BayesianMLP,
     }
@@ -65,7 +67,9 @@ def _synth_registry() -> Dict[str, Callable]:
     return {
         "eight_schools": lambda **kw: models.eight_schools_data(),
         "logistic": seeded(models.synth_logistic_data),
+        "linreg": seeded(models.synth_linreg_data),
         "lmm": seeded(models.synth_lmm_data),
+        "poisson": seeded(models.synth_poisson_data),
         "gmm": seeded(models.synth_gmm_data),
         "bnn": seeded(models.synth_bnn_data),
     }

@@ -1,5 +1,11 @@
 from .bnn import BayesianMLP, synth_bnn_data
 from .eight_schools import EightSchools, eight_schools_data
+from .glm import (
+    LinearRegression,
+    PoissonRegression,
+    synth_linreg_data,
+    synth_poisson_data,
+)
 from .gmm import GaussianMixture, synth_gmm_data
 from .lmm import LinearMixedModel, synth_lmm_data
 from .logistic import (
@@ -18,10 +24,14 @@ __all__ = [
     "GaussianMixture",
     "HierLogistic",
     "LinearMixedModel",
+    "LinearRegression",
+    "PoissonRegression",
     "Logistic",
     "eight_schools_data",
     "synth_bnn_data",
     "synth_gmm_data",
+    "synth_linreg_data",
     "synth_lmm_data",
+    "synth_poisson_data",
     "synth_logistic_data",
 ]

@@ -1,0 +1,80 @@
+"""Standard GLM families: Bayesian linear and Poisson regression.
+
+Rounding out the model zoo beyond the judged benchmark configs
+(SURVEY.md §2 layer A — the reference tree was absent, SURVEY.md §0, so
+the family list follows what any Stan/PyMC-class framework ships).  Both
+are MXU-shaped like the logistic family: one (N, D) matvec per potential
+evaluation, elementwise link + reduction fused by XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+
+from ..bijectors import Exp
+from ..model import Model, ParamSpec
+
+
+class LinearRegression(Model):
+    """y ~ N(x @ beta, sigma); beta ~ N(0, prior_scale), sigma ~ HalfNormal(1)."""
+
+    def __init__(self, num_features: int, prior_scale: float = 2.5):
+        self.num_features = num_features
+        self.prior_scale = prior_scale
+
+    def param_spec(self):
+        return {
+            "beta": ParamSpec((self.num_features,)),
+            "sigma": ParamSpec((), Exp()),
+        }
+
+    def log_prior(self, p):
+        lp = jnp.sum(jstats.norm.logpdf(p["beta"], 0.0, self.prior_scale))
+        lp += jstats.norm.logpdf(p["sigma"], 0.0, 1.0) + jnp.log(2.0)
+        return lp
+
+    def log_lik(self, p, data):
+        mu = data["x"] @ p["beta"]
+        return jnp.sum(jstats.norm.logpdf(data["y"], mu, p["sigma"]))
+
+
+class PoissonRegression(Model):
+    """y ~ Poisson(exp(x @ beta)); beta ~ N(0, prior_scale).
+
+    The log-link rate is clipped in log space before exponentiation so a
+    warmup excursion cannot overflow float32 (inf rate -> NaN potential ->
+    frozen chain)."""
+
+    def __init__(self, num_features: int, prior_scale: float = 2.5):
+        self.num_features = num_features
+        self.prior_scale = prior_scale
+
+    def param_spec(self):
+        return {"beta": ParamSpec((self.num_features,))}
+
+    def log_prior(self, p):
+        return jnp.sum(jstats.norm.logpdf(p["beta"], 0.0, self.prior_scale))
+
+    def log_lik(self, p, data):
+        log_rate = jnp.clip(data["x"] @ p["beta"], -30.0, 30.0)
+        y = data["y"]
+        return jnp.sum(y * log_rate - jnp.exp(log_rate) - jax.lax.lgamma(y + 1.0))
+
+
+def synth_linreg_data(key, n, d, *, noise=0.5, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = jax.random.normal(k1, (n, d), dtype)
+    beta = jax.random.normal(k2, (d,), dtype)
+    y = x @ beta + noise * jax.random.normal(k3, (n,), dtype)
+    return {"x": x, "y": y}, {"beta": beta, "sigma": noise}
+
+
+def synth_poisson_data(key, n, d, *, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = jax.random.normal(k1, (n, d), dtype)
+    beta = 0.3 * jax.random.normal(k2, (d,), dtype)
+    rate = jnp.exp(x @ beta)
+    y = jax.random.poisson(k3, rate).astype(dtype)
+    return {"x": x, "y": y}, {"beta": beta}

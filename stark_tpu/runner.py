@@ -28,15 +28,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import diagnostics
-from .adaptation import build_warmup_schedule
 from .kernels.base import HMCState
 from .model import Model, flatten_model, prepare_model_data
 from .sampler import (
     Posterior,
     SamplerConfig,
     _constrain_draws,
-    make_block_runners,
-    make_warmup_parts,
+    make_block_runner,
+    make_segmented_warmup,
 )
 
 
@@ -78,43 +77,13 @@ def sample_until_converged(
     fm = flatten_model(model)
     data = prepare_model_data(model, data)
 
-    _, block_run = make_block_runners(fm, cfg, block_size)
+    block_run = make_block_runner(fm, cfg, block_size)
     v_block = jax.jit(jax.vmap(block_run, in_axes=(0, 0, 0, 0, None)))
 
     # warmup runs as block_size-bounded dispatches too (same device-program
     # length cap as the draw blocks; the monolithic warmup faulted the axon
-    # tunnel at benchmark scale).  One jitted wrapper serves every segment
-    # length — the length lives in the input shapes, which jit traces per.
-    init_carry, warm_segment, warm_finalize = make_warmup_parts(fm, cfg)
-    v_warm_init = jax.jit(jax.vmap(init_carry, in_axes=(0, 0, None)))
-    v_warm_seg = jax.jit(
-        jax.vmap(warm_segment, in_axes=(1, None, None, 0, 0, 0, 0, None))
-    )
-
-    def run_warmup(warm_keys, z0):
-        kinit = jax.vmap(lambda k: jax.random.split(k, 2))(warm_keys)
-        carry = jax.block_until_ready(v_warm_init(kinit[:, 0], z0, data))
-        state, da, welford, inv_mass = carry
-        schedule = build_warmup_schedule(cfg.num_warmup)
-        aflags = np.asarray(schedule.adapt_mass)
-        wflags = np.asarray(schedule.window_end)
-        wkeys = np.asarray(
-            jax.vmap(lambda k: jax.random.split(k, max(cfg.num_warmup, 1)))(
-                kinit[:, 1]
-            )
-        ).transpose(1, 0, 2)
-        n_div = np.zeros((z0.shape[0],), np.int64)
-        for s in range(0, cfg.num_warmup, block_size):
-            e = min(s + block_size, cfg.num_warmup)
-            state, da, welford, inv_mass, ndiv = jax.block_until_ready(
-                v_warm_seg(
-                    jnp.asarray(wkeys[s:e]), jnp.asarray(aflags[s:e]),
-                    jnp.asarray(wflags[s:e]), state, da, welford, inv_mass,
-                    data,
-                )
-            )
-            n_div += np.asarray(ndiv)
-        return state, warm_finalize(da), inv_mass, n_div
+    # tunnel at benchmark scale) — shared driver with the segmented backend
+    seg_warmup = make_segmented_warmup(fm, cfg)
 
     t_start = time.perf_counter()
     metrics_f = open(metrics_path, "a") if metrics_path else None
@@ -161,7 +130,9 @@ def sample_until_converged(
         else:
             z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
         warm_keys = jax.random.split(key_warm, chains)
-        state, step_size, inv_mass, n_div = run_warmup(warm_keys, z0)
+        state, step_size, inv_mass, n_div = seg_warmup(
+            warm_keys, z0, data, block_size
+        )
         emit(
             {
                 "event": "warmup_done",

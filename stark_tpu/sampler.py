@@ -208,6 +208,52 @@ def make_warmup_parts(fm: FlatModel, cfg: SamplerConfig):
     return init_carry, segment, finalize
 
 
+def make_segmented_warmup(fm: FlatModel, cfg: SamplerConfig):
+    """The shared host-side driver over ``make_warmup_parts`` — built once
+    (callers cache the returned runner; the jitted init/segment functions
+    are closed over, and one wrapper serves every segment length since the
+    length lives in the input shapes).
+
+      run(warm_keys, z0, data, seg) -> (state, step_size, inv_mass,
+                                        warm_div numpy (chains,))
+
+    Used by both JaxBackend._run_segmented and the adaptive runner so the
+    key layout / schedule slicing cannot drift between them.
+    """
+    init_carry, segment, finalize = make_warmup_parts(fm, cfg)
+    v_init = jax.jit(jax.vmap(init_carry, in_axes=(0, 0, None)))
+    v_seg = jax.jit(
+        jax.vmap(segment, in_axes=(1, None, None, 0, 0, 0, 0, None))
+    )
+
+    def run(warm_keys, z0, data, seg):
+        kinit = jax.vmap(lambda k: jax.random.split(k, 2))(warm_keys)
+        state, da, welford, inv_mass = jax.block_until_ready(
+            v_init(kinit[:, 0], z0, data)
+        )
+        schedule = build_warmup_schedule(cfg.num_warmup)
+        aflags = np.asarray(schedule.adapt_mass)
+        wflags = np.asarray(schedule.window_end)
+        # (num_warmup, chains, 2) step keys, sliced per segment on the host
+        wkeys = np.asarray(
+            jax.vmap(lambda k: jax.random.split(k, max(cfg.num_warmup, 1)))(
+                kinit[:, 1]
+            )
+        ).transpose(1, 0, 2)
+        warm_div = np.zeros((z0.shape[0],), np.int64)
+        for s in range(0, cfg.num_warmup, seg):
+            e = min(s + seg, cfg.num_warmup)
+            state, da, welford, inv_mass, ndiv = jax.block_until_ready(
+                v_seg(jnp.asarray(wkeys[s:e]), jnp.asarray(aflags[s:e]),
+                      jnp.asarray(wflags[s:e]), state, da, welford, inv_mass,
+                      data)
+            )
+            warm_div += np.asarray(ndiv)
+        return state, finalize(da), inv_mass, warm_div
+
+    return run
+
+
 def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):
     """Build (key, z0, data) -> ChainResult; one chain, fully compiled.
 
@@ -275,27 +321,19 @@ def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):
     return run
 
 
-def make_block_runners(fm: FlatModel, cfg: SamplerConfig, block_size: int):
-    """Split-phase runners for the adaptive (run-until-converged) driver.
-
-    Returns (warmup_run, block_run), each jit/vmap-able per chain:
-      warmup_run(key, z0, data) -> (HMCState, step_size, inv_mass, n_div)
+def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int):
+    """One draw block for the segmented/adaptive drivers, jit/vmap-able
+    per chain:
       block_run(key, state, step_size, inv_mass, data)
         -> (HMCState, zs, accept, divergent, energy, ngrad)
 
     Control crosses host<->device once per BLOCK (SURVEY.md §4: "periodic
     async draw fetch + convergence check"), which is how wall-clock-to-
     R-hat<1.01 — the primary metric — is measured without paying a host
-    round-trip per transition.
+    round-trip per transition.  Warmup has its own dispatch-bounded API
+    (``make_warmup_parts`` + ``run_segmented_warmup``).
     """
     step_kernel = make_kernel(cfg)
-    warmup = make_warmup_fn(fm, cfg)
-
-    def warmup_run(key, z0, data=None):
-        potential_fn = fm.bind(data)
-        kernel = partial(step_kernel, potential_fn=potential_fn)
-        state = init_state(potential_fn, z0)
-        return warmup(key, state, potential_fn, kernel)
 
     def block_run(key, state, step_size, inv_mass, data=None):
         potential_fn = fm.bind(data)
@@ -323,7 +361,7 @@ def make_block_runners(fm: FlatModel, cfg: SamplerConfig, block_size: int):
         )
         return state, zs, accept, divergent, energy, ngrad
 
-    return warmup_run, block_run
+    return block_run
 
 
 class Posterior:
@@ -385,6 +423,7 @@ def sample(
     seed: int = 0,
     backend: Any = None,
     init_params: Optional[Dict[str, Array]] = None,
+    debug_nans: bool = False,
     **cfg_kwargs,
 ) -> Posterior:
     """Run MCMC and return a Posterior.
@@ -393,10 +432,22 @@ def sample(
     chains on the default device — TPU when present).  Pass a
     ``backends.SamplerBackend`` instance for sharded / CPU-reference
     execution.
+
+    debug_nans: run under ``jax_debug_nans`` so the FIRST non-finite value
+    in the potential/gradient raises with a traceback into the model code,
+    instead of surfacing later as a silently frozen chain — the sanitizer
+    mode of SURVEY.md §6 (pure-functional JAX has no data races to detect;
+    numerics are the failure class that remains).
     """
     cfg = SamplerConfig(**cfg_kwargs)
     if backend is None:
         from .backends.jax_backend import JaxBackend
 
         backend = JaxBackend()
+    if debug_nans:
+        with jax.debug_nans(True):
+            return backend.run(
+                model, data, cfg, chains=chains, seed=seed,
+                init_params=init_params,
+            )
     return backend.run(model, data, cfg, chains=chains, seed=seed, init_params=init_params)
