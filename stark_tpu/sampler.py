@@ -10,6 +10,7 @@ reference's per-step driver round-trip (SURVEY.md §4).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from functools import partial
 from typing import Any, Callable, Dict, NamedTuple, Optional
@@ -331,7 +332,7 @@ def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int):
     async draw fetch + convergence check"), which is how wall-clock-to-
     R-hat<1.01 — the primary metric — is measured without paying a host
     round-trip per transition.  Warmup has its own dispatch-bounded API
-    (``make_warmup_parts`` + ``run_segmented_warmup``).
+    (``make_segmented_warmup``).
     """
     step_kernel = make_kernel(cfg)
 
@@ -444,10 +445,8 @@ def sample(
         from .backends.jax_backend import JaxBackend
 
         backend = JaxBackend()
-    if debug_nans:
-        with jax.debug_nans(True):
-            return backend.run(
-                model, data, cfg, chains=chains, seed=seed,
-                init_params=init_params,
-            )
-    return backend.run(model, data, cfg, chains=chains, seed=seed, init_params=init_params)
+    ctx = jax.debug_nans(True) if debug_nans else contextlib.nullcontext()
+    with ctx:
+        return backend.run(
+            model, data, cfg, chains=chains, seed=seed, init_params=init_params
+        )
