@@ -39,6 +39,7 @@ from .model import Model, ParamSpec, flatten_model, prepare_model_data
 from .runner import sample_until_converged
 from .sampler import Posterior, SamplerConfig, sample
 from .sghmc import sghmc_sample
+from .supervise import ChainHealthError, supervised_sample
 
 __version__ = "0.1.0"
 
@@ -50,6 +51,8 @@ __all__ = [
     "sample",
     "sample_until_converged",
     "sghmc_sample",
+    "supervised_sample",
+    "ChainHealthError",
     "Posterior",
     "SamplerConfig",
     "bijectors",

@@ -14,6 +14,9 @@ Auxiliary subsystems wired here (SURVEY.md §6):
                       ``resume_from=`` (restarts mid-run after preemption)
   * profiler hooks  — ``profile_dir=`` wraps the first post-warmup block in
                       a `jax.profiler.trace` for TPU timeline inspection
+  * failure detect  — ``health_check=True`` raises ChainHealthError on
+                      non-finite state BEFORE it is checkpointed; see
+                      `supervise.supervised_sample` for auto-restart
 """
 
 from __future__ import annotations
@@ -66,6 +69,8 @@ def sample_until_converged(
     profile_dir: Optional[str] = None,
     draw_store_path: Optional[str] = None,
     init_params: Optional[Dict[str, Any]] = None,
+    health_check: bool = False,
+    reseed: Optional[int] = None,
     **cfg_kwargs,
 ) -> AdaptiveResult:
     """Run chains until split-R-hat < rhat_target AND min-ESS > ess_target.
@@ -109,6 +114,11 @@ def sample_until_converged(
         step_size = jnp.asarray(arrays["step_size"])
         inv_mass = jnp.asarray(arrays["inv_mass"])
         key = jnp.asarray(arrays["key"])
+        if reseed is not None:
+            # a deterministic numerical failure would otherwise replay
+            # identically from the checkpointed key on every retry — the
+            # supervisor passes the attempt number to branch the stream
+            key = jax.random.fold_in(key, reseed)
         blocks_done = int(meta.get("blocks_done", 0))
         total_div = int(meta.get("num_divergent", 0))
         history = list(meta.get("history", []))
@@ -163,6 +173,20 @@ def sample_until_converged(
                     v_block(block_keys, state, step_size, inv_mass, data)
                 )
             state, zs, accept, divergent, energy, ngrad = out
+            if health_check:
+                # poisoned state must never reach the checkpoint; the
+                # supervisor (supervise.supervised_sample) restarts from
+                # the last healthy one
+                from .supervise import check_finite_state
+
+                check_finite_state(
+                    {
+                        "z": np.asarray(state.z),
+                        "pe": np.asarray(state.potential_energy),
+                        "step_size": np.asarray(step_size),
+                        "inv_mass": np.asarray(inv_mass),
+                    }
+                )
             blocks_done += 1
             draw_blocks.append(np.asarray(zs))  # (chains, block, d)
             if draw_store is not None:
@@ -181,6 +205,7 @@ def sample_until_converged(
                 "max_rhat": max_rhat,
                 "min_ess": min_ess,
                 "num_divergent": total_div,
+                "mean_accept": float(np.mean(np.asarray(accept))),
                 "wall_s": wall,
             }
             history.append(rec)

@@ -1,0 +1,149 @@
+"""Failure detection + supervised auto-restart (SURVEY.md §6).
+
+The reference's failure story is Spark task retry (SURVEY.md §6, INFERRED);
+the TPU-native equivalent is checkpoint-based restart: the adaptive runner
+checkpoints the full chain state every draw block (one atomic .npz), and
+this module supervises a run — detecting failures and restarting from the
+last *healthy* checkpoint, or from scratch when no healthy checkpoint
+exists.
+
+Failure classes handled:
+
+  * process/device faults — any exception out of the run (XLA error, TPU
+    tunnel fault, preemption surfacing as a crash on the next attempt's
+    ``resume_from``) → restart from the latest valid checkpoint.
+  * numerical divergence of the sampler state — non-finite positions or
+    step sizes detected by the runner's per-block health check BEFORE the
+    state is checkpointed (a poisoned state never lands on disk) →
+    ``ChainHealthError`` → restart with a fresh seed.
+  * checkpoint corruption — a checkpoint that fails to load or contains
+    non-finite state is discarded and the run cold-starts.
+
+Elastic re-sharding (changing the device mesh mid-run) is a documented
+non-goal for v1 — restart-from-checkpoint onto the new topology covers the
+preemption story without it (DESIGN.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .checkpoint import load_checkpoint
+from .model import Model
+
+__all__ = [
+    "ChainHealthError",
+    "check_finite_state",
+    "checkpoint_is_healthy",
+    "supervised_sample",
+]
+
+
+class ChainHealthError(RuntimeError):
+    """Sampler state went non-finite (detected before checkpointing)."""
+
+
+_HEALTH_KEYS = ("z", "pe", "step_size", "inv_mass")
+
+
+def check_finite_state(arrays: Dict[str, Any]) -> None:
+    """Raise ChainHealthError if any monitored state array is non-finite.
+
+    ``grad`` is deliberately not monitored: a transient inf gradient at a
+    rejected proposal is legal; the carried position/energy/step-size are
+    what must stay finite for the run to be recoverable.
+    """
+    for name in _HEALTH_KEYS:
+        if name not in arrays:
+            continue
+        a = np.asarray(arrays[name])
+        if not np.all(np.isfinite(a)):
+            bad = int(a.size - np.sum(np.isfinite(a)))
+            raise ChainHealthError(
+                f"non-finite sampler state: {bad}/{a.size} entries of {name!r}"
+            )
+
+
+def checkpoint_is_healthy(path: str) -> bool:
+    """True iff the checkpoint loads and its state arrays are finite."""
+    try:
+        arrays, _ = load_checkpoint(path)
+        check_finite_state(arrays)
+        return True
+    except Exception:
+        return False
+
+
+def supervised_sample(
+    model: Model,
+    data: Any = None,
+    *,
+    workdir: str,
+    max_restarts: int = 3,
+    seed: int = 0,
+    reseed_on_restart: bool = True,
+    **kwargs,
+):
+    """Run ``sample_until_converged`` under supervision.
+
+    Checkpoints, draw store, and metrics all live under ``workdir``; on any
+    failure the run restarts from the last healthy checkpoint (or from
+    scratch if none), up to ``max_restarts`` times.  Each restart is logged
+    as a ``{"event": "restart", ...}`` line in the metrics JSONL — the
+    observable failure-detection record.
+
+    Returns the AdaptiveResult of the first successful attempt.
+    """
+    from .runner import sample_until_converged
+
+    os.makedirs(workdir, exist_ok=True)
+    ckpt_path = os.path.join(workdir, "chain.ckpt.npz")
+    metrics_path = kwargs.pop(
+        "metrics_path", os.path.join(workdir, "metrics.jsonl")
+    )
+    kwargs.setdefault("draw_store_path", os.path.join(workdir, "draws.stkr"))
+    kwargs.setdefault("health_check", True)
+
+    store_path = kwargs.get("draw_store_path")
+    attempt = 0
+    while True:
+        resume: Optional[str] = None
+        if os.path.exists(ckpt_path):
+            if checkpoint_is_healthy(ckpt_path):
+                resume = ckpt_path
+            else:
+                # corrupt/poisoned checkpoint: quarantine it and cold-start
+                os.replace(ckpt_path, ckpt_path + ".bad")
+        if resume is None and store_path and os.path.exists(store_path):
+            # cold start: draws persisted by a discarded run must not mix
+            # into this run's store (a later resume reads the whole store)
+            os.replace(store_path, store_path + ".bad")
+        try:
+            return sample_until_converged(
+                model,
+                data,
+                seed=seed + attempt if reseed_on_restart else seed,
+                checkpoint_path=ckpt_path,
+                resume_from=resume,
+                metrics_path=metrics_path,
+                reseed=attempt if (attempt and reseed_on_restart) else None,
+                **kwargs,
+            )
+        except Exception as e:  # noqa: BLE001 — supervision boundary
+            attempt += 1
+            rec = {
+                "event": "restart",
+                "attempt": attempt,
+                "error": f"{type(e).__name__}: {e}",
+                "resumed_from_checkpoint": resume is not None,
+                "ts": time.time(),
+            }
+            with open(metrics_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            if attempt > max_restarts:
+                raise
