@@ -19,6 +19,7 @@ import jax
 import numpy as np
 
 import stark_tpu
+from . import diagnostics
 from .backends import JaxBackend
 from .models import (
     BayesianMLP,
@@ -141,7 +142,9 @@ def bench_lmm(
     """Config 3: hierarchical LMM, random slopes, 10k groups."""
     model = LinearMixedModel(num_features=d, num_groups=groups, num_random=2)
     data, _ = synth_lmm_data(jax.random.PRNGKey(seed), n, d, groups)
-    backend = JaxBackend()
+    # d ~ 2*groups+... is large here; bound each device program so a single
+    # dispatch can't trip device-side execution limits at benchmark scale
+    backend = JaxBackend(dispatch_steps=50)
     post, wall = _timed(
         lambda: stark_tpu.sample(
             model, data, backend=backend, chains=chains, kernel="nuts",
@@ -159,12 +162,22 @@ def bench_gmm_tempered(
     """Config 4: GMM K=16, reparameterized HMC + parallel tempering."""
     model = GaussianMixture(num_components=k)
     data, _ = synth_gmm_data(jax.random.PRNGKey(seed), n, k, spread=4.0)
+    # with N=50k rows the posterior is too peaked for a prior-draw init to
+    # find the mode reliably: init the ordered means at data quantiles
+    # (the standard identified-mixture initialization); tempering then has
+    # to hold the chains together, not find the basin from scratch
+    qs = np.quantile(np.asarray(data["x"]), (np.arange(k) + 0.5) / k)
+    init = {
+        "weights": np.full((k,), 1.0 / k, np.float32),
+        "mu": qs.astype(np.float32),
+        "sigma": np.full((k,), 1.0, np.float32),
+    }
 
     def run():
         return tempered_sample(
             model, data, chains=chains, num_temps=num_temps, kernel="hmc",
             num_leapfrog=16, num_warmup=num_warmup, num_samples=num_samples,
-            swap_every=5, seed=seed,
+            swap_every=5, seed=seed, init_params=init,
         )
 
     post, wall = _timed(run)
@@ -173,9 +186,13 @@ def bench_gmm_tempered(
 
 def bench_bnn_sghmc(
     *, n=100_000, d=64, hidden=64, batch_size=1024, chains=4,
-    num_warmup=500, num_samples=2000, seed=0,
+    num_warmup=2000, num_samples=4000, cycles=8, step_size=3e-3, seed=0,
 ):
-    """Config 5: Bayesian 2-layer MLP, SG-HMC minibatch gradients."""
+    """Config 5: Bayesian 2-layer MLP, SG-HMC minibatch gradients.
+
+    Preconditioned cyclical SG-HMC: the grad**2-EMA mass equilibrates the
+    fan-in prior scales and the warm-restart cycles hop posterior modes.
+    """
     model = BayesianMLP(num_features=d, hidden=hidden)
     data, _ = synth_bnn_data(jax.random.PRNGKey(seed), n, d)
 
@@ -183,11 +200,31 @@ def bench_bnn_sghmc(
         return sghmc_sample(
             model, data, batch_size=batch_size, chains=chains,
             num_warmup=num_warmup, num_samples=num_samples,
-            step_size=1e-3, friction=5.0, seed=seed,
+            step_size=step_size, friction=5.0, cycles=cycles, seed=seed,
         )
 
     post, wall = _timed(run)
-    return _result("bnn_sghmc", post, wall, batch_size=batch_size)
+    # BNN weights are non-identifiable (hidden-unit permutation/sign
+    # symmetry), so weight-space R-hat/ESS is meaningless by construction.
+    # Diagnose in predictive space: logits at fixed probe inputs.
+    x_probe = np.asarray(data["x"][:256])
+    y_probe = np.asarray(data["y"][:256])
+    logits = post.functional(lambda p: model.forward(p, x_probe))
+    min_ess = float(np.min(diagnostics.ess(logits)))
+    probs = 1.0 / (1.0 + np.exp(-np.asarray(logits, np.float64)))
+    acc = float(np.mean((probs.mean(axis=(0, 1)) > 0.5) == (y_probe > 0.5)))
+    return BenchResult(
+        name="bnn_sghmc",
+        wall_s=wall,
+        min_ess=min_ess,
+        ess_per_sec=min_ess / wall,
+        max_rhat=float(np.max(diagnostics.split_rhat(logits))),
+        extra={
+            "batch_size": batch_size,
+            "diag_space": "predictive_logits",
+            "predictive_accuracy": acc,
+        },
+    )
 
 
 ALL_BENCHMARKS = {

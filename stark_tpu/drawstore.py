@@ -88,6 +88,26 @@ class DrawStore:
         self.close()
 
 
+def truncate_draws(path: str, n_draws: int) -> None:
+    """Truncate the store to its first ``n_draws`` rows.
+
+    Resume reconciliation: the async writer can land a block in the store
+    in the window before the matching checkpoint rename completes, so on
+    resume the store may hold more rows than the checkpoint accounts for —
+    those orphans must be dropped or they double-count after the block is
+    re-run.
+    """
+    with open(path, "rb") as f:
+        header = f.read(_HEADER_BYTES)
+    if header[:4] != b"STKD":
+        raise ValueError(f"{path!r} is not a DrawStore file")
+    chains = int.from_bytes(header[8:16], "little")
+    dim = int.from_bytes(header[16:24], "little")
+    target = _HEADER_BYTES + 4 * chains * dim * n_draws
+    if os.path.getsize(path) > target:  # shrink only — never zero-extend
+        os.truncate(path, target)
+
+
 def read_draws(path: str, mmap: bool = True) -> Tuple[np.ndarray, int, int]:
     """-> (draws (n, chains, dim), chains, dim); zero-copy memmap by default."""
     with open(path, "rb") as f:

@@ -2,10 +2,16 @@
 
 Minibatch-gradient HMC following the friction-corrected underdamped-Langevin
 construction (Chen, Fox & Guestrin 2014; PAPERS.md — pattern only): with
-mass M = diag(1/inv_mass_diag), friction C and step ``eps`` the transition is
+mass M = diag(1/inv_mass_diag), friction rate c and step ``eps`` the
+friction matrix is taken PROPORTIONAL TO THE MASS, C = c*M, so the damping
+rate is uniform across coordinates whatever the preconditioner:
 
-    r <- r - eps * grad_est(z) - eps * C * M^{-1} r + N(0, 2 C eps I)
+    r <- r - eps * grad_est(z) - eps * c * r + N(0, 2 c eps M)
     z <- z + eps * M^{-1} r
+
+(dr = -∇U dt - C M^{-1} r dt + N(0, 2C dt) with C = c*M leaves
+exp(-U(z) - r^T M^{-1} r / 2) invariant for any fixed diagonal M, and
+reduces to the classical scalar-friction kernel at M = I.)
 
 There is no Metropolis correction (the stochastic gradient makes exact MH
 intractable); the friction term dissipates the gradient-noise injection.
@@ -64,6 +70,11 @@ def sghmc_step(
 
     resample_momentum: traced bool — refresh r ~ N(0, M) before the update
     (fed from a host-precomputed flag array, like the warmup schedule).
+
+    Returns (state, info, grad): the raw stochastic gradient is exposed so
+    a driver can adapt a preconditioner from it (grad**2 EMA) without a
+    second gradient evaluation; scan bodies that don't carry it just drop
+    it (lax.scan only stacks what the body returns).
     """
     key_grad, key_noise, key_mom = jax.random.split(key, 3)
     r = jnp.where(
@@ -72,15 +83,11 @@ def sghmc_step(
         state.r,
     )
     grad = grad_fn(key_grad, state.z)
-    noise = jnp.sqrt(2.0 * friction * step_size) * jax.random.normal(
-        key_noise, r.shape, r.dtype
-    )
-    r = (
-        r
-        - step_size * grad
-        - step_size * friction * (inv_mass_diag * r)
-        + noise
-    )
+    # noise cov 2*C*eps with C = friction * M = friction / inv_mass_diag
+    noise = jnp.sqrt(
+        2.0 * friction * step_size / inv_mass_diag
+    ) * jax.random.normal(key_noise, r.shape, r.dtype)
+    r = r - step_size * grad - step_size * friction * r + noise
     z = state.z + step_size * (inv_mass_diag * r)
 
     bad = ~jnp.all(jnp.isfinite(z))
@@ -93,7 +100,7 @@ def sghmc_step(
         grad_norm=jnp.sqrt(jnp.sum(grad * grad)),
         is_divergent=bad,
     )
-    return SGHMCState(z=z, r=r), info
+    return SGHMCState(z=z, r=r), info, grad
 
 
 def make_minibatch_grad(

@@ -126,8 +126,12 @@ def sample_until_converged(
         if "draws" in arrays:
             draw_blocks = [arrays["draws"]]
         elif draw_store_path and os.path.exists(draw_store_path):
-            from .drawstore import read_draws
+            from .drawstore import read_draws, truncate_draws
 
+            # the async writer can land a block after the last completed
+            # checkpoint: drop rows the checkpoint doesn't account for, or
+            # the re-run block double-counts
+            truncate_draws(draw_store_path, blocks_done * block_size)
             stored, _, _ = read_draws(draw_store_path, mmap=False)
             if stored.shape[0]:
                 # (n, chains, d) on disk -> (chains, n, d) in memory
@@ -183,6 +187,7 @@ def sample_until_converged(
                     {
                         "z": np.asarray(state.z),
                         "pe": np.asarray(state.potential_energy),
+                        "grad": np.asarray(state.grad),
                         "step_size": np.asarray(step_size),
                         "inv_mass": np.asarray(inv_mass),
                     }

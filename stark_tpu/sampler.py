@@ -410,6 +410,20 @@ class Posterior:
     def min_ess(self) -> float:
         return float(min(np.min(v) for v in self.ess().values()))
 
+    def functional(self, fn: Callable[[Dict[str, Any]], Any]) -> np.ndarray:
+        """Apply ``fn(params) -> array`` to every draw; (chains, draws, ...).
+
+        The honest diagnostic space for models whose raw parameters are
+        non-identifiable (neural nets under permutation/sign symmetry,
+        mixtures under label switching): compute R-hat/ESS on a posterior
+        *functional* — e.g. predictions at probe inputs — instead of on
+        weights.
+        """
+        out = jax.vmap(jax.vmap(fn))(
+            {k: jnp.asarray(v) for k, v in self.draws.items()}
+        )
+        return np.asarray(out)
+
 
 def _constrain_draws(fm: FlatModel, zs) -> Dict[str, np.ndarray]:
     constrained = jax.vmap(jax.vmap(fm.constrain))(zs)

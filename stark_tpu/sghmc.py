@@ -8,7 +8,14 @@ axis with `shard_map` — no host round-trips inside the loop, matching the
 target stack in SURVEY.md §4.
 
 SG-HMC has no accept statistic, so there is no dual-averaging warmup; the
-"warmup" here is a discarded burn-in run at the same step size.
+"warmup" here is a discarded burn-in run at the same step size.  During
+burn-in a diagonal RMSprop-style preconditioner is adapted from the
+stochastic gradients (grad**2 EMA — the scale-adapted SG-HMC pattern,
+Springenberg et al. 2016; PAPERS.md — pattern only) and then FROZEN for
+the sampling phase, so the sampled dynamics leave the target invariant
+with a fixed mass matrix.  Neural-net posteriors mix orders of magnitude
+faster under this equilibration (per-parameter curvature in a BNN spans
+the 1/sqrt(fan_in) prior scales).
 """
 
 from __future__ import annotations
@@ -37,6 +44,12 @@ def sghmc_sample(
     step_size: float = 1e-3,
     friction: float = 1.0,
     resample_every: int = 50,
+    precondition: bool = True,
+    precond_beta: float = 0.99,
+    precond_damping: float = 1e-8,
+    precond_clip: float = 100.0,
+    cycles: int = 0,
+    cycle_collect_frac: float = 0.3,
     seed: int = 0,
     mesh: Optional[Mesh] = None,
     init_params: Optional[Dict[str, Any]] = None,
@@ -46,6 +59,26 @@ def sghmc_sample(
     Rows may live on any per-leaf axis declared by ``model.data_row_axes``
     (axis 0 by default); the likelihood term is scaled by N/batch_size so
     the stochastic gradient is unbiased for the full-data potential.
+
+    precondition: adapt a diagonal mass matrix from the grad**2 EMA ``v``
+    during burn-in, frozen for sampling (per-chain).  Both the curvature
+    signal and the minibatch-noise variance of the stochastic gradient
+    scale per-coordinate as 1/posterior_sd**2, so the *ratios* of ``v``
+    track inverse posterior variances; the absolute scale of ``v`` is in
+    gradient units and is discarded by median-normalizing:
+    ``M^{-1} = median(v)/v`` — the median coordinate keeps exactly the
+    unit-mass dynamics (so ``step_size`` keeps its meaning, and d=1
+    models are untouched) while badly-scaled coordinates equilibrate.
+
+    cycles: when > 0, run cyclical SG-MCMC over the sampling phase (Zhang
+    et al. 2020 pattern — PAPERS.md, pattern only): the step size follows
+    ``step_size * (cos(pi * t_cyc / T) + 1)`` warm-restart cycles with a
+    fresh momentum draw at each cycle start; high-step phases hop between
+    posterior modes (the multimodality of e.g. BNN posteriors that a
+    constant-step chain cannot cross), and draws are collected only in the
+    final ``cycle_collect_frac`` of each cycle where the step is small.
+    The returned Posterior holds the collected draws (num_samples*thin
+    steps are run; roughly cycle_collect_frac of them are kept).
     """
     data = prepare_model_data(model, data)
     row_axes = model.data_row_axes(data)
@@ -55,36 +88,101 @@ def sghmc_sample(
     fm = flatten_model(model, lik_scale=n / batch_size)
     grad_fn = make_minibatch_grad(fm.potential, data, batch_size, row_axes=row_axes)
 
-    total = num_warmup + num_samples * thin
-    # host-precomputed momentum-refresh schedule, fed to the scan as xs
-    steps = np.arange(total)
-    resample_flags = jnp.asarray(
-        (steps % max(resample_every, 1) == 0) if resample_every else np.zeros(total, bool)
+    total_sample = num_samples * thin
+    # host-precomputed momentum-refresh schedule, fed to the scans as xs
+    steps = np.arange(num_warmup + total_sample)
+    flags = (
+        (steps % max(resample_every, 1) == 0)
+        if resample_every
+        else np.zeros(num_warmup + total_sample, bool)
     )
+    warm_flags = jnp.asarray(flags[:num_warmup])
+    sample_flags = np.asarray(flags[num_warmup:])
+    if cycles > 0:
+        # cosine warm-restart schedule over the sampling phase; fresh
+        # momentum at each cycle start; collect in the low-step tail
+        t_period = max(total_sample // cycles, 1)
+        phase = (np.arange(total_sample) % t_period) / t_period
+        eps_mult = np.cos(np.pi * phase) + 1.0
+        collect_mask = phase >= 1.0 - cycle_collect_frac
+        if not collect_mask.any():
+            raise ValueError(
+                f"cycles={cycles} over {total_sample} sampling steps gives "
+                f"{t_period}-step cycles whose last {cycle_collect_frac:.0%} "
+                "contains no step — nothing would be collected; use fewer "
+                "cycles or more samples"
+            )
+        sample_flags = sample_flags | (phase == 0.0)
+    else:
+        eps_mult = np.ones(total_sample)
+        collect_mask = np.ones(total_sample, bool)
+    eps_mult = jnp.asarray(eps_mult, jnp.float32)
+    sample_flags = jnp.asarray(sample_flags)
+
+    def inv_mass_from(v):
+        # ratios of v ~ inverse posterior variances; median-normalize so
+        # the typical coordinate keeps unit-mass dynamics.  The clip bounds
+        # how far any coordinate's dynamics may be rescaled: an extreme
+        # inv_mass inflates the per-step gradient-noise injection by the
+        # same factor and outruns the friction (the SG-HMC stability
+        # condition), so equilibration is deliberately conservative.
+        v_hat = v / jnp.maximum(jnp.median(v), precond_damping)
+        return jnp.clip(
+            1.0 / jnp.maximum(v_hat, precond_damping),
+            1.0 / precond_clip,
+            precond_clip,
+        )
 
     def run_chain(key, z0):
-        key_init, key_scan = jax.random.split(key)
-        inv_mass = jnp.ones_like(z0)
-        state = sghmc_init(key_init, z0, inv_mass)
+        key_init, key_warm, key_mom, key_scan = jax.random.split(key, 4)
+        eps = jnp.asarray(step_size, z0.dtype)
+        fric = jnp.asarray(friction, z0.dtype)
+        unit_mass = jnp.ones_like(z0)
+        state = sghmc_init(key_init, z0, unit_mass)
 
-        def body(state, x):
+        # --- burn-in: adapt the preconditioner from the gradient stream ---
+        def warm_body(carry, x):
+            state, v = carry
             key, refresh = x
-            state, info = sghmc_step(
-                key,
-                state,
-                grad_fn,
-                jnp.asarray(step_size, z0.dtype),
-                jnp.asarray(friction, z0.dtype),
-                inv_mass,
+            inv_mass = inv_mass_from(v) if precondition else unit_mass
+            state, info, grad = sghmc_step(
+                key, state, grad_fn, eps, fric, inv_mass,
+                resample_momentum=refresh,
+            )
+            v = jnp.where(
+                jnp.isfinite(grad).all(),
+                precond_beta * v + (1.0 - precond_beta) * grad * grad,
+                v,
+            )
+            return (state, v), info.is_divergent
+
+        v0 = jnp.ones_like(z0)
+        (state, v), warm_div = jax.lax.scan(
+            warm_body,
+            (state, v0),
+            (jax.random.split(key_warm, num_warmup), warm_flags),
+        )
+        inv_mass = inv_mass_from(v) if precondition else unit_mass
+        # momentum was carried under the moving mass; re-draw it under the
+        # frozen one so the sampling dynamics start in equilibrium
+        state = sghmc_init(key_mom, state.z, inv_mass)
+
+        # --- sampling: fixed preconditioner, target left invariant ---
+        def body(state, x):
+            key, refresh, mult = x
+            state, info, _ = sghmc_step(
+                key, state, grad_fn, eps * mult, fric, inv_mass,
                 resample_momentum=refresh,
             )
             return state, (state.z, info.kinetic_energy, info.is_divergent)
 
-        keys = jax.random.split(key_scan, total)
-        state, (zs, ke, div) = jax.lax.scan(body, state, (keys, resample_flags))
-        zs = zs[num_warmup:][thin - 1 :: thin]
-        ke = ke[num_warmup:][thin - 1 :: thin]
-        n_div = jnp.sum(div.astype(jnp.int32))
+        keys = jax.random.split(key_scan, total_sample)
+        state, (zs, ke, div) = jax.lax.scan(
+            body, state, (keys, sample_flags, eps_mult)
+        )
+        n_div = jnp.sum(div.astype(jnp.int32)) + jnp.sum(
+            warm_div.astype(jnp.int32)
+        )
         return zs, ke, n_div
 
     key = jax.random.PRNGKey(seed)
@@ -103,6 +201,10 @@ def sghmc_sample(
 
         zs, ke, n_div = run_over_chains(mesh, vrun, chain_keys, z0)
 
+    # draw selection is host-side: collect-phase steps (cyclic mode), thinned
+    keep = np.flatnonzero(collect_mask)[thin - 1 :: thin]
+    zs = np.asarray(zs)[:, keep]
+    ke = np.asarray(ke)[:, keep]
     draws = _constrain_draws(fm, zs)
     stats = {
         "kinetic_energy": np.asarray(ke),

@@ -48,15 +48,16 @@ class ChainHealthError(RuntimeError):
     """Sampler state went non-finite (detected before checkpointing)."""
 
 
-_HEALTH_KEYS = ("z", "pe", "step_size", "inv_mass")
+_HEALTH_KEYS = ("z", "pe", "grad", "step_size", "inv_mass")
 
 
 def check_finite_state(arrays: Dict[str, Any]) -> None:
     """Raise ChainHealthError if any monitored state array is non-finite.
 
-    ``grad`` is deliberately not monitored: a transient inf gradient at a
-    rejected proposal is legal; the carried position/energy/step-size are
-    what must stay finite for the run to be recoverable.
+    ``grad`` here is the CARRIED gradient of the accepted state — it seeds
+    the next transition's first leapfrog half-step, so a non-finite value
+    poisons every resume from this state (unlike a transient inf at a
+    rejected proposal, which is legal and never carried).
     """
     for name in _HEALTH_KEYS:
         if name not in arrays:
@@ -143,7 +144,8 @@ def supervised_sample(
                 "resumed_from_checkpoint": resume is not None,
                 "ts": time.time(),
             }
-            with open(metrics_path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+            if metrics_path:  # caller may disable metrics with None
+                with open(metrics_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
             if attempt > max_restarts:
                 raise

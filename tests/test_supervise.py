@@ -48,8 +48,9 @@ def test_check_finite_state():
     bad = dict(good, step_size=np.array([0.1, np.nan]))
     with pytest.raises(ChainHealthError, match="step_size"):
         check_finite_state(bad)
-    # grad is exempt: transient infs at rejected proposals are legal
-    check_finite_state(dict(good, grad=np.array([np.inf])))
+    # the CARRIED grad seeds the next leapfrog half-step: must be finite
+    with pytest.raises(ChainHealthError, match="grad"):
+        check_finite_state(dict(good, grad=np.array([np.inf])))
 
 
 def test_checkpoint_health(tmp_path):
@@ -179,6 +180,43 @@ def test_cold_start_quarantines_stale_draw_store(tmp_path):
     # store contains exactly this run's draws (no 7-draw stale block)
     assert stored.shape[0] == post.draws_flat.shape[1]
     assert not np.any(stored == 99.0)
+
+
+def test_resume_truncates_orphaned_store_rows(tmp_path):
+    """Rows the async writer landed after the last completed checkpoint
+    must be dropped on resume, or the re-run block double-counts."""
+    from stark_tpu.drawstore import DrawStore, read_draws
+
+    ckpt = str(tmp_path / "state.npz")
+    store = str(tmp_path / "draws.stkr")
+    post1 = stark_tpu.sample_until_converged(
+        StdNormal2(), chains=2, block_size=50, max_blocks=2, min_blocks=2,
+        rhat_target=0.5, num_warmup=100, kernel="nuts", max_tree_depth=5,
+        seed=0, checkpoint_path=ckpt, draw_store_path=store,
+    )
+    # simulate the crash window: one extra block in the store, no checkpoint
+    with DrawStore(store, 2, 2) as ds:
+        ds.append(np.full((2, 50, 2), 7.7, np.float32))
+    post2 = stark_tpu.sample_until_converged(
+        StdNormal2(), chains=2, block_size=50, max_blocks=3, min_blocks=3,
+        rhat_target=0.5, num_warmup=100, kernel="nuts", max_tree_depth=5,
+        resume_from=ckpt, draw_store_path=store,
+    )
+    assert post2.draws_flat.shape[1] == 150  # 2 resumed + 1 new block
+    assert not np.any(post2.draws_flat == 7.7)
+    stored, _, _ = read_draws(store, mmap=False)
+    assert not np.any(stored == 7.7)
+
+
+def test_cyclic_empty_collect_raises():
+    from stark_tpu.sghmc import sghmc_sample
+
+    data = {"y": jnp.ones((64,))}
+    with pytest.raises(ValueError, match="nothing would be collected"):
+        sghmc_sample(
+            StdNormal2(), data, batch_size=16, chains=1,
+            num_warmup=10, num_samples=100, cycles=50, seed=0,
+        )
 
 
 def test_supervised_gives_up_after_max_restarts(tmp_path, monkeypatch):
