@@ -49,6 +49,11 @@ def _model_registry() -> Dict[str, Callable]:
         "PoissonRegression": models.PoissonRegression,
         "GaussianMixture": models.GaussianMixture,
         "BayesianMLP": models.BayesianMLP,
+        "StudentTRegression": models.StudentTRegression,
+        "NegBinomialRegression": models.NegBinomialRegression,
+        "HorseshoeRegression": models.HorseshoeRegression,
+        "OrderedLogistic": models.OrderedLogistic,
+        "StochasticVolatility": models.StochasticVolatility,
     }
 
 
@@ -72,6 +77,11 @@ def _synth_registry() -> Dict[str, Callable]:
         "poisson": seeded(models.synth_poisson_data),
         "gmm": seeded(models.synth_gmm_data),
         "bnn": seeded(models.synth_bnn_data),
+        "studentt": seeded(models.synth_studentt_data),
+        "negbinom": seeded(models.synth_negbinom_data),
+        "horseshoe": seeded(models.synth_horseshoe_data),
+        "ordinal": seeded(models.synth_ordinal_data),
+        "sv": seeded(models.synth_sv_data),
     }
 
 

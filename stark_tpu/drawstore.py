@@ -88,6 +88,17 @@ class DrawStore:
         self.close()
 
 
+def _read_header(path: str) -> Tuple[int, int]:
+    """Validate the STKD header; -> (chains, dim)."""
+    with open(path, "rb") as f:
+        header = f.read(_HEADER_BYTES)
+    if header[:4] != b"STKD":
+        raise ValueError(f"{path!r} is not a DrawStore file")
+    chains = int.from_bytes(header[8:16], "little")
+    dim = int.from_bytes(header[16:24], "little")
+    return chains, dim
+
+
 def truncate_draws(path: str, n_draws: int) -> None:
     """Truncate the store to its first ``n_draws`` rows.
 
@@ -97,12 +108,7 @@ def truncate_draws(path: str, n_draws: int) -> None:
     those orphans must be dropped or they double-count after the block is
     re-run.
     """
-    with open(path, "rb") as f:
-        header = f.read(_HEADER_BYTES)
-    if header[:4] != b"STKD":
-        raise ValueError(f"{path!r} is not a DrawStore file")
-    chains = int.from_bytes(header[8:16], "little")
-    dim = int.from_bytes(header[16:24], "little")
+    chains, dim = _read_header(path)
     target = _HEADER_BYTES + 4 * chains * dim * n_draws
     if os.path.getsize(path) > target:  # shrink only — never zero-extend
         os.truncate(path, target)
@@ -110,12 +116,7 @@ def truncate_draws(path: str, n_draws: int) -> None:
 
 def read_draws(path: str, mmap: bool = True) -> Tuple[np.ndarray, int, int]:
     """-> (draws (n, chains, dim), chains, dim); zero-copy memmap by default."""
-    with open(path, "rb") as f:
-        header = f.read(_HEADER_BYTES)
-    if header[:4] != b"STKD":
-        raise ValueError(f"{path!r} is not a DrawStore file")
-    chains = int.from_bytes(header[8:16], "little")
-    dim = int.from_bytes(header[16:24], "little")
+    chains, dim = _read_header(path)
     size = os.path.getsize(path) - _HEADER_BYTES
     n = size // (4 * chains * dim)
     if mmap:

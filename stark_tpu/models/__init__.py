@@ -15,6 +15,16 @@ from .logistic import (
     Logistic,
     synth_logistic_data,
 )
+from .ordinal import OrderedLogistic, synth_ordinal_data
+from .robust import (
+    HorseshoeRegression,
+    NegBinomialRegression,
+    StudentTRegression,
+    synth_horseshoe_data,
+    synth_negbinom_data,
+    synth_studentt_data,
+)
+from .timeseries import StochasticVolatility, synth_sv_data
 
 __all__ = [
     "BayesianMLP",
@@ -23,15 +33,25 @@ __all__ = [
     "FusedLogistic",
     "GaussianMixture",
     "HierLogistic",
+    "HorseshoeRegression",
     "LinearMixedModel",
     "LinearRegression",
+    "NegBinomialRegression",
+    "OrderedLogistic",
     "PoissonRegression",
     "Logistic",
+    "StochasticVolatility",
+    "StudentTRegression",
     "eight_schools_data",
     "synth_bnn_data",
     "synth_gmm_data",
+    "synth_horseshoe_data",
     "synth_linreg_data",
     "synth_lmm_data",
+    "synth_negbinom_data",
+    "synth_ordinal_data",
     "synth_poisson_data",
     "synth_logistic_data",
+    "synth_studentt_data",
+    "synth_sv_data",
 ]

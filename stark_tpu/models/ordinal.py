@@ -1,0 +1,73 @@
+"""Ordered logistic regression — ordinal outcomes with ordered cutpoints.
+
+The cutpoint vector rides the `Ordered` bijector (strictly increasing by
+construction), so kernels see an unconstrained vector and the category
+probabilities are always well-defined.  Likelihood shape: one (N, D)
+matvec, a 2-gather over padded cutpoints, elementwise links — fused by XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+
+from ..bijectors import Ordered
+from ..model import Model, ParamSpec
+
+
+class OrderedLogistic(Model):
+    """y in {0..K-1} ~ OrderedLogistic(x @ beta, cutpoints).
+
+    P(y = k) = sigmoid(c_{k+1} - eta) - sigmoid(c_k - eta) with
+    c_0 = -inf, c_K = +inf; cutpoints (K-1,) strictly increasing.
+    """
+
+    def __init__(self, num_features: int, num_categories: int,
+                 prior_scale: float = 2.5, cut_scale: float = 5.0):
+        if num_categories < 2:
+            raise ValueError("need at least 2 categories")
+        self.num_features = num_features
+        self.num_categories = num_categories
+        self.prior_scale = prior_scale
+        self.cut_scale = cut_scale
+
+    def param_spec(self):
+        return {
+            "beta": ParamSpec((self.num_features,)),
+            "cutpoints": ParamSpec((self.num_categories - 1,), Ordered()),
+        }
+
+    def log_prior(self, p):
+        lp = jnp.sum(jstats.norm.logpdf(p["beta"], 0.0, self.prior_scale))
+        lp += jnp.sum(jstats.norm.logpdf(p["cutpoints"], 0.0, self.cut_scale))
+        return lp
+
+    def log_lik(self, p, data):
+        eta = data["x"] @ p["beta"]  # (N,)
+        big = jnp.asarray(1e9, eta.dtype)
+        cpad = jnp.concatenate([-big[None], p["cutpoints"], big[None]])
+        y = data["y"].astype(jnp.int32)
+        upper = cpad[y + 1] - eta
+        lower = cpad[y] - eta
+        # sigmoid(u) - sigmoid(l) = sigmoid(u) * sigmoid(-l) * (1 - e^{l-u}):
+        # all-log-space, stable for cutpoint gaps down to float32 eps
+        log_p = (
+            jax.nn.log_sigmoid(upper)
+            + jax.nn.log_sigmoid(-lower)
+            + jnp.log1p(-jnp.exp(jnp.minimum(lower - upper, -1e-6)))
+        )
+        return jnp.sum(log_p)
+
+
+def synth_ordinal_data(key, n, d, *, num_categories=5, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = jax.random.normal(k1, (n, d), dtype)
+    beta = jax.random.normal(k2, (d,), dtype)
+    eta = x @ beta
+    cuts = jnp.quantile(
+        eta, jnp.linspace(0.0, 1.0, num_categories + 1)[1:-1]
+    ).astype(dtype)
+    noise = jax.random.logistic(k3, (n,), dtype)
+    y = jnp.sum((eta + noise)[:, None] > cuts[None, :], axis=1).astype(dtype)
+    return {"x": x, "y": y}, {"beta": beta, "cutpoints": cuts}

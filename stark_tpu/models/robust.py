@@ -1,0 +1,161 @@
+"""Robust / overdispersed / sparse regression families.
+
+Rounding out the model zoo (SURVEY.md §2 layer A; the reference tree was
+absent — SURVEY.md §0 — so the family list follows what any Stan/PyMC-class
+framework ships): Student-t robust regression, negative-binomial counts,
+and horseshoe sparse regression.  All three keep the MXU-friendly shape of
+the other GLMs — one (N, D) matvec per potential evaluation, elementwise
+link + reduction fused by XLA — and the horseshoe uses the non-centered
+parameterization so HMC survives its funnel geometry.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+
+from ..bijectors import Exp
+from ..model import Model, ParamSpec
+
+
+def _half_cauchy_logpdf(x, scale):
+    # x > 0; density 2/(pi*scale*(1+(x/scale)^2))
+    return (
+        jnp.log(2.0 / jnp.pi)
+        - jnp.log(scale)
+        - jnp.log1p((x / scale) ** 2)
+    )
+
+
+class StudentTRegression(Model):
+    """y ~ StudentT(nu, x @ beta, sigma) — robust linear regression.
+
+    beta ~ N(0, prior_scale); sigma ~ HalfNormal(1); nu ~ Gamma(2, 0.1)
+    (mean 20: weakly informative over the near-normal-to-heavy-tail range).
+    """
+
+    def __init__(self, num_features: int, prior_scale: float = 2.5):
+        self.num_features = num_features
+        self.prior_scale = prior_scale
+
+    def param_spec(self):
+        return {
+            "beta": ParamSpec((self.num_features,)),
+            "sigma": ParamSpec((), Exp()),
+            "nu": ParamSpec((), Exp()),
+        }
+
+    def log_prior(self, p):
+        lp = jnp.sum(jstats.norm.logpdf(p["beta"], 0.0, self.prior_scale))
+        lp += jstats.norm.logpdf(p["sigma"], 0.0, 1.0) + jnp.log(2.0)
+        # Gamma(a=2, rate=0.1) up to a constant
+        lp += jstats.gamma.logpdf(p["nu"], 2.0, scale=10.0)
+        return lp
+
+    def log_lik(self, p, data):
+        mu = data["x"] @ p["beta"]
+        return jnp.sum(jstats.t.logpdf(data["y"], p["nu"], mu, p["sigma"]))
+
+
+class NegBinomialRegression(Model):
+    """y ~ NegBinomial(mean=exp(x @ beta), concentration=phi).
+
+    Overdispersed counts: Var = mu + mu^2/phi.  beta ~ N(0, prior_scale);
+    phi ~ HalfNormal(5).  The log-link is clipped like PoissonRegression so
+    warmup excursions cannot overflow float32.
+    """
+
+    def __init__(self, num_features: int, prior_scale: float = 2.5):
+        self.num_features = num_features
+        self.prior_scale = prior_scale
+
+    def param_spec(self):
+        return {
+            "beta": ParamSpec((self.num_features,)),
+            "phi": ParamSpec((), Exp()),
+        }
+
+    def log_prior(self, p):
+        lp = jnp.sum(jstats.norm.logpdf(p["beta"], 0.0, self.prior_scale))
+        lp += jstats.norm.logpdf(p["phi"], 0.0, 5.0) + jnp.log(2.0)
+        return lp
+
+    def log_lik(self, p, data):
+        log_mu = jnp.clip(data["x"] @ p["beta"], -30.0, 30.0)
+        mu, phi, y = jnp.exp(log_mu), p["phi"], data["y"]
+        return jnp.sum(
+            jax.lax.lgamma(y + phi)
+            - jax.lax.lgamma(phi)
+            - jax.lax.lgamma(y + 1.0)
+            + phi * (jnp.log(phi) - jnp.log(phi + mu))
+            + y * (log_mu - jnp.log(phi + mu))
+        )
+
+
+class HorseshoeRegression(Model):
+    """Sparse linear regression with the horseshoe prior, non-centered.
+
+    beta_j = z_j * lambda_j * tau with z ~ N(0,1), lambda_j ~ HalfCauchy(1),
+    tau ~ HalfCauchy(tau0); y ~ N(x @ beta, sigma).  The non-centered
+    (z, lambda, tau) parameterization decorrelates the funnel so HMC can
+    adapt a diagonal mass matrix to it.
+    """
+
+    def __init__(self, num_features: int, tau0: float = 0.1):
+        self.num_features = num_features
+        self.tau0 = tau0
+
+    def param_spec(self):
+        d = self.num_features
+        return {
+            "z": ParamSpec((d,)),
+            "lam": ParamSpec((d,), Exp()),
+            "tau": ParamSpec((), Exp()),
+            "sigma": ParamSpec((), Exp()),
+        }
+
+    def beta(self, p):
+        return p["z"] * p["lam"] * p["tau"]
+
+    def log_prior(self, p):
+        lp = jnp.sum(jstats.norm.logpdf(p["z"]))
+        lp += jnp.sum(_half_cauchy_logpdf(p["lam"], 1.0))
+        lp += _half_cauchy_logpdf(p["tau"], self.tau0)
+        lp += jstats.norm.logpdf(p["sigma"], 0.0, 1.0) + jnp.log(2.0)
+        return lp
+
+    def log_lik(self, p, data):
+        mu = data["x"] @ self.beta(p)
+        return jnp.sum(jstats.norm.logpdf(data["y"], mu, p["sigma"]))
+
+
+def synth_studentt_data(key, n, d, *, nu=4.0, noise=0.5, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = jax.random.normal(k1, (n, d), dtype)
+    beta = jax.random.normal(k2, (d,), dtype)
+    y = x @ beta + noise * jax.random.t(k3, nu, (n,), dtype)
+    return {"x": x, "y": y}, {"beta": beta, "nu": nu}
+
+
+def synth_negbinom_data(key, n, d, *, phi=2.0, dtype=jnp.float32):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    x = 0.3 * jax.random.normal(k1, (n, d), dtype)
+    beta = jax.random.normal(k2, (d,), dtype)
+    mu = jnp.exp(jnp.clip(x @ beta, -10.0, 10.0))
+    # NB as Gamma-Poisson mixture
+    rate = mu * jax.random.gamma(k3, phi, (n,), dtype) / phi
+    y = jax.random.poisson(k4, rate).astype(dtype)
+    return {"x": x, "y": y}, {"beta": beta, "phi": phi}
+
+
+def synth_horseshoe_data(
+    key, n, d, *, num_nonzero=5, noise=0.5, dtype=jnp.float32
+):
+    """Sparse truth: num_nonzero coefficients at +-2, the rest exactly 0."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = jax.random.normal(k1, (n, d), dtype)
+    signs = jnp.where(jax.random.bernoulli(k2, 0.5, (num_nonzero,)), 2.0, -2.0)
+    beta = jnp.zeros((d,), dtype).at[:num_nonzero].set(signs)
+    y = x @ beta + noise * jax.random.normal(k3, (n,), dtype)
+    return {"x": x, "y": y}, {"beta": beta}

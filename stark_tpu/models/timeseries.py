@@ -1,0 +1,84 @@
+"""Latent time-series models — stochastic volatility.
+
+The classic HMC stress test (a T-dimensional correlated latent field).
+TPU-first construction: the AR(1) latent log-volatility path is built from
+non-centered innovations with `jax.lax.associative_scan` — a log-depth
+parallel prefix that XLA maps onto the VPU, instead of a sequential
+T-step `scan` (the latent recurrence is the hot loop here, not a matmul).
+
+NOTE: the likelihood depends on the whole latent path, so this model does
+NOT shard over a data axis and must not be minibatched (`data_row_axes`
+intentionally left at the default; use single-shard backends).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+
+from ..bijectors import Exp, Interval
+from ..model import Model, ParamSpec
+
+
+def _ar1_path(phi, eps):
+    """h'_t = phi * h'_{t-1} + eps_t via parallel prefix over (a, b):
+    composition (a2, b2) . (a1, b1) = (a1*a2, b1*a2 + b2)."""
+
+    def combine(x, y):
+        a1, b1 = x
+        a2, b2 = y
+        return a1 * a2, b1 * a2 + b2
+
+    a = jnp.full_like(eps, phi)
+    av, bv = jax.lax.associative_scan(combine, (a, eps))
+    return bv
+
+
+class StochasticVolatility(Model):
+    """y_t ~ N(0, exp(h_t / 2)); h_t = mu + phi (h_{t-1} - mu) + sigma_h e_t.
+
+    Non-centered: params are the innovations e (T,), plus mu, phi, sigma_h.
+    phi rides an Interval(-1, 1) bijector (stationarity by construction);
+    the first state is drawn from the stationary distribution.
+    """
+
+    def __init__(self, num_steps: int):
+        self.num_steps = num_steps
+
+    def param_spec(self):
+        return {
+            "eps": ParamSpec((self.num_steps,)),
+            "mu": ParamSpec(()),
+            "phi": ParamSpec((), Interval(-1.0, 1.0)),
+            "sigma_h": ParamSpec((), Exp()),
+        }
+
+    def log_prior(self, p):
+        lp = jnp.sum(jstats.norm.logpdf(p["eps"]))
+        lp += jstats.norm.logpdf(p["mu"], 0.0, 5.0)
+        # phi ~ 2*Beta(20, 1.5) - 1 (Stan manual's SV prior), up to a const
+        lp += 19.0 * jnp.log1p(p["phi"]) + 0.5 * jnp.log1p(-p["phi"])
+        lp += jstats.norm.logpdf(p["sigma_h"], 0.0, 1.0) + jnp.log(2.0)
+        return lp
+
+    def latent_h(self, p):
+        phi, sig = p["phi"], p["sigma_h"]
+        # stationary start: scale the first innovation to sd 1/sqrt(1-phi^2)
+        boost = 1.0 / jnp.sqrt(jnp.maximum(1.0 - phi**2, 1e-6))
+        scaled = p["eps"].at[0].multiply(boost)
+        return p["mu"] + sig * _ar1_path(phi, scaled)
+
+    def log_lik(self, p, data):
+        h = self.latent_h(p)
+        return jnp.sum(jstats.norm.logpdf(data["y"], 0.0, jnp.exp(h / 2.0)))
+
+
+def synth_sv_data(key, num_steps, *, mu=-1.0, phi=0.95, sigma_h=0.25,
+                  dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    eps = jax.random.normal(k1, (num_steps,), dtype)
+    eps = eps.at[0].multiply(1.0 / jnp.sqrt(1.0 - phi**2))
+    h = mu + sigma_h * _ar1_path(jnp.asarray(phi, dtype), eps)
+    y = jnp.exp(h / 2.0) * jax.random.normal(k2, (num_steps,), dtype)
+    return {"y": y}, {"mu": mu, "phi": phi, "sigma_h": sigma_h, "h": h}

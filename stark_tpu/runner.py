@@ -130,8 +130,11 @@ def sample_until_converged(
 
             # the async writer can land a block after the last completed
             # checkpoint: drop rows the checkpoint doesn't account for, or
-            # the re-run block double-counts
-            truncate_draws(draw_store_path, blocks_done * block_size)
+            # the re-run block double-counts.  The accounted count rides in
+            # the meta (the original run's block size, not this call's —
+            # they may differ legally).
+            accounted = meta.get("draw_rows", blocks_done * block_size)
+            truncate_draws(draw_store_path, accounted)
             stored, _, _ = read_draws(draw_store_path, mmap=False)
             if stored.shape[0]:
                 # (n, chains, d) on disk -> (chains, n, d) in memory
@@ -239,6 +242,7 @@ def sample_until_converged(
                     arrays,
                     {
                         "blocks_done": blocks_done,
+                        "draw_rows": int(all_draws.shape[1]),
                         "num_divergent": total_div,
                         "history": history,
                         "model": type(model).__name__,

@@ -118,6 +118,7 @@ def sghmc_sample(
         collect_mask = np.ones(total_sample, bool)
     eps_mult = jnp.asarray(eps_mult, jnp.float32)
     sample_flags = jnp.asarray(sample_flags)
+    keep = jnp.asarray(np.flatnonzero(collect_mask)[thin - 1 :: thin])
 
     def inv_mass_from(v):
         # ratios of v ~ inverse posterior variances; median-normalize so
@@ -180,6 +181,10 @@ def sghmc_sample(
         state, (zs, ke, div) = jax.lax.scan(
             body, state, (keys, sample_flags, eps_mult)
         )
+        # keep is host-static: select collect-phase (cyclic), thinned draws
+        # inside the jit so only kept draws cross device->host
+        zs = jnp.take(zs, keep, axis=0)
+        ke = jnp.take(ke, keep, axis=0)
         n_div = jnp.sum(div.astype(jnp.int32)) + jnp.sum(
             warm_div.astype(jnp.int32)
         )
@@ -201,10 +206,8 @@ def sghmc_sample(
 
         zs, ke, n_div = run_over_chains(mesh, vrun, chain_keys, z0)
 
-    # draw selection is host-side: collect-phase steps (cyclic mode), thinned
-    keep = np.flatnonzero(collect_mask)[thin - 1 :: thin]
-    zs = np.asarray(zs)[:, keep]
-    ke = np.asarray(ke)[:, keep]
+    zs = np.asarray(zs)
+    ke = np.asarray(ke)
     draws = _constrain_draws(fm, zs)
     stats = {
         "kinetic_energy": np.asarray(ke),

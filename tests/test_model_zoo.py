@@ -1,0 +1,125 @@
+"""New model families: Student-t / NegBinomial / Horseshoe / Ordered /
+Stochastic Volatility — parameter recovery at small scale."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import stark_tpu
+from stark_tpu.models import (
+    HorseshoeRegression,
+    NegBinomialRegression,
+    OrderedLogistic,
+    StochasticVolatility,
+    StudentTRegression,
+    synth_horseshoe_data,
+    synth_negbinom_data,
+    synth_ordinal_data,
+    synth_studentt_data,
+    synth_sv_data,
+)
+
+
+def test_studentt_recovers_truth():
+    data, true = synth_studentt_data(jax.random.PRNGKey(0), 2048, 4, nu=4.0)
+    post = stark_tpu.sample(
+        StudentTRegression(num_features=4), data, chains=2, kernel="nuts",
+        max_tree_depth=6, num_warmup=300, num_samples=300, seed=0,
+    )
+    assert post.max_rhat() < 1.05
+    np.testing.assert_allclose(
+        np.asarray(post.draws["beta"]).mean((0, 1)),
+        np.asarray(true["beta"]), atol=0.1,
+    )
+    # nu is weakly identified; just require heavy-tail territory
+    assert float(np.median(post.draws["nu"])) < 15.0
+
+
+def test_negbinom_recovers_truth():
+    data, true = synth_negbinom_data(jax.random.PRNGKey(1), 4096, 3, phi=2.0)
+    post = stark_tpu.sample(
+        NegBinomialRegression(num_features=3), data, chains=2, kernel="nuts",
+        max_tree_depth=6, num_warmup=300, num_samples=300, seed=0,
+    )
+    assert post.max_rhat() < 1.05
+    np.testing.assert_allclose(
+        np.asarray(post.draws["beta"]).mean((0, 1)),
+        np.asarray(true["beta"]), atol=0.15,
+    )
+    assert 1.0 < float(np.asarray(post.draws["phi"]).mean()) < 4.0
+
+
+def test_horseshoe_shrinks_nulls_keeps_signals():
+    data, true = synth_horseshoe_data(
+        jax.random.PRNGKey(2), 1024, 32, num_nonzero=4, noise=0.5
+    )
+    model = HorseshoeRegression(num_features=32)
+    post = stark_tpu.sample(
+        model, data, chains=2, kernel="nuts", max_tree_depth=8,
+        num_warmup=500, num_samples=500, seed=0,
+    )
+    beta_draws = (
+        np.asarray(post.draws["z"])
+        * np.asarray(post.draws["lam"])
+        * np.asarray(post.draws["tau"])[..., None]
+    )
+    beta_hat = beta_draws.mean((0, 1))
+    true_beta = np.asarray(true["beta"])
+    # signals recovered...
+    np.testing.assert_allclose(beta_hat[:4], true_beta[:4], atol=0.25)
+    # ...nulls shrunk hard (the whole point of the horseshoe)
+    assert np.max(np.abs(beta_hat[4:])) < 0.1
+
+
+def test_ordered_logistic_recovers_truth():
+    data, true = synth_ordinal_data(
+        jax.random.PRNGKey(3), 4096, 3, num_categories=5
+    )
+    post = stark_tpu.sample(
+        OrderedLogistic(num_features=3, num_categories=5), data, chains=2,
+        kernel="nuts", max_tree_depth=6, num_warmup=300, num_samples=300,
+        seed=0,
+    )
+    assert post.max_rhat() < 1.05
+    np.testing.assert_allclose(
+        np.asarray(post.draws["beta"]).mean((0, 1)),
+        np.asarray(true["beta"]), atol=0.2,
+    )
+    cuts = np.asarray(post.draws["cutpoints"]).mean((0, 1))
+    assert np.all(np.diff(cuts) > 0)
+    np.testing.assert_allclose(cuts, np.asarray(true["cutpoints"]), atol=0.3)
+
+
+def test_stochastic_volatility_runs_and_recovers_scale():
+    data, true = synth_sv_data(
+        jax.random.PRNGKey(4), 512, mu=-1.0, phi=0.95, sigma_h=0.25
+    )
+    post = stark_tpu.sample(
+        StochasticVolatility(num_steps=512), data, chains=2, kernel="nuts",
+        max_tree_depth=8, num_warmup=500, num_samples=500, seed=0,
+    )
+    # T+3 dims, strong correlation: loose convergence bar at this budget
+    assert post.max_rhat() < 1.2
+    assert abs(float(np.asarray(post.draws["mu"]).mean()) - (-1.0)) < 0.8
+    assert float(np.asarray(post.draws["phi"]).mean()) > 0.7
+    # latent path tracks the realized volatility profile
+    model = StochasticVolatility(num_steps=512)
+    h_hat = post.functional(model.latent_h).mean((0, 1))
+    corr = np.corrcoef(h_hat, np.asarray(true["h"]))[0, 1]
+    assert corr > 0.5, corr
+
+
+def test_ar1_path_matches_sequential():
+    from stark_tpu.models.timeseries import _ar1_path
+
+    phi = 0.9
+    eps = np.random.default_rng(0).normal(size=64).astype(np.float32)
+    h = np.zeros(64, np.float32)
+    acc = 0.0
+    for i, e in enumerate(eps):
+        acc = phi * acc + e
+        h[i] = acc
+    np.testing.assert_allclose(
+        np.asarray(_ar1_path(jnp.asarray(phi), jnp.asarray(eps))), h,
+        rtol=2e-5, atol=2e-5,
+    )
