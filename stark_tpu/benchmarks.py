@@ -90,8 +90,8 @@ def bench_eight_schools(*, chains=4, num_warmup=500, num_samples=1000, seed=0):
 
 
 def bench_hier_logistic(
-    *, n=200_000, d=32, groups=1000, chains=8, num_warmup=200,
-    num_samples=200, max_tree_depth=6, seed=0, backend=None,
+    *, n=200_000, d=32, groups=1000, chains=8, num_warmup=300,
+    num_samples=300, max_tree_depth=6, seed=0, backend=None,
 ):
     """Config 2 / north-star numerator: hierarchical logistic, NUTS."""
     model = HierLogistic(num_features=d, num_groups=groups)
@@ -136,20 +136,28 @@ def bench_consensus_logistic(
 
 
 def bench_lmm(
-    *, n=100_000, d=8, groups=10_000, chains=4, num_warmup=300,
-    num_samples=300, seed=0,
+    *, n=100_000, d=8, groups=10_000, chains=4, num_warmup=700,
+    num_samples=500, max_tree_depth=8, seed=0,
 ):
-    """Config 3: hierarchical LMM, random slopes, 10k groups."""
+    """Config 3: hierarchical LMM, random slopes, 10k groups.
+
+    A ~20k-dim posterior needs Stan-class settings: deep trees (the
+    trajectory must traverse the group-effect block) and a long enough
+    warmup for 20k Welford variances to stabilize — depth 6 / warmup 300
+    measured R-hat > 100 (frozen chains), depth 9 / warmup 600+ converges.
+    """
     model = LinearMixedModel(num_features=d, num_groups=groups, num_random=2)
     data, _ = synth_lmm_data(jax.random.PRNGKey(seed), n, d, groups)
     # d ~ 2*groups+... is large here; bound each device program so a single
     # dispatch can't trip device-side execution limits at benchmark scale
-    backend = JaxBackend(dispatch_steps=50)
+    # (budget ~3k grad evals per dispatch: 12 transitions x 2^8-grad trees;
+    # 50 x depth-8 trees measured a device fault)
+    backend = JaxBackend(dispatch_steps=12)
     post, wall = _timed(
         lambda: stark_tpu.sample(
             model, data, backend=backend, chains=chains, kernel="nuts",
-            max_tree_depth=6, num_warmup=num_warmup, num_samples=num_samples,
-            seed=seed,
+            max_tree_depth=max_tree_depth, num_warmup=num_warmup,
+            num_samples=num_samples, seed=seed,
         )
     )
     return _result("lmm_random_slopes", post, wall, groups=groups)
@@ -157,27 +165,28 @@ def bench_lmm(
 
 def bench_gmm_tempered(
     *, n=50_000, k=16, chains=2, num_temps=8, num_warmup=500,
-    num_samples=500, seed=0,
+    num_samples=500, max_tree_depth=7, seed=0,
 ):
     """Config 4: GMM K=16, reparameterized HMC + parallel tempering."""
+    from .models.gmm import gmm_init_1d
+
     model = GaussianMixture(num_components=k)
     data, _ = synth_gmm_data(jax.random.PRNGKey(seed), n, k, spread=4.0)
     # with N=50k rows the posterior is too peaked for a prior-draw init to
-    # find the mode reliably: init the ordered means at data quantiles
-    # (the standard identified-mixture initialization); tempering then has
-    # to hold the chains together, not find the basin from scratch
-    qs = np.quantile(np.asarray(data["x"]), (np.arange(k) + 0.5) / k)
-    init = {
-        "weights": np.full((k,), 1.0 / k, np.float32),
-        "mu": qs.astype(np.float32),
-        "sigma": np.full((k,), 1.0, np.float32),
-    }
+    # find the mode reliably: k-means init (see gmm_init_1d) fixes the
+    # component allocation; tempering then has to hold the chains
+    # together, not find the basin from scratch
+    init = gmm_init_1d(np.asarray(data["x"]), k)
 
     def run():
+        # NUTS replicas: adaptive trajectories mix the 3K-1-dim mixture
+        # posterior far better than fixed-length leapfrog (measured ~5x
+        # min-ESS at equal draws)
         return tempered_sample(
-            model, data, chains=chains, num_temps=num_temps, kernel="hmc",
-            num_leapfrog=16, num_warmup=num_warmup, num_samples=num_samples,
-            swap_every=5, seed=seed, init_params=init,
+            model, data, chains=chains, num_temps=num_temps, kernel="nuts",
+            max_tree_depth=max_tree_depth, num_warmup=num_warmup,
+            num_samples=num_samples, swap_every=5, seed=seed,
+            init_params=init,
         )
 
     post, wall = _timed(run)

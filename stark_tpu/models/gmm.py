@@ -62,6 +62,78 @@ class GaussianMixture(Model):
         return jnp.sum(logsumexp(comp + log_w, axis=1))
 
 
+def gmm_init_1d(
+    x, num_components, *, restarts=8, iters=60, subsample=5000, seed=0
+):
+    """Data-driven constrained init for 1-D mixtures: best-of-restarts EM.
+
+    Equal-mass quantile inits lose light components when the true weights
+    are uneven (two seeds land in one heavy component, none in a light
+    one), and which component gets lost varies per chain — R-hat then
+    diverges on a mis-allocation mode, not on sampling error.  A handful
+    of short EM runs from jittered quantile seeds (best log-likelihood
+    wins) resolves the allocation before the kernel ever runs; the
+    centers are sorted so the `Ordered` bijector accepts them.  Host-side
+    numpy on a subsample — one-time init cost, not a hot path.
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    x = np.asarray(x, np.float64).ravel()
+    if x.size > subsample:
+        x = rng.choice(x, subsample, replace=False)
+    n, k = x.size, num_components
+    span = x.max() - x.min() + 1e-9
+    base_mu = np.quantile(x, (np.arange(k) + 0.5) / k)
+
+    def kmeanspp_seeds():
+        # distance^2-weighted seeding reaches light components that
+        # equal-mass quantile seeds skip
+        seeds = [rng.choice(x)]
+        for _ in range(k - 1):
+            d2 = np.min(
+                (x[:, None] - np.asarray(seeds)[None, :]) ** 2, axis=1
+            )
+            seeds.append(rng.choice(x, p=d2 / d2.sum()))
+        return np.sort(np.asarray(seeds))
+
+    best = None
+    for r in range(restarts):
+        mu = base_mu if r == 0 else kmeanspp_seeds()
+        w = np.full(k, 1.0 / k)
+        var = np.full(k, (span / (4 * k)) ** 2)
+        ll = -np.inf
+        for _ in range(iters):
+            # E-step in log space; guard tiny variances
+            var = np.maximum(var, 1e-8)
+            logp = (
+                np.log(w)[None, :]
+                - 0.5 * np.log(2 * np.pi * var)[None, :]
+                - 0.5 * (x[:, None] - mu[None, :]) ** 2 / var[None, :]
+            )
+            m = logp.max(axis=1, keepdims=True)
+            p = np.exp(logp - m)
+            tot = p.sum(axis=1, keepdims=True)
+            ll = float((m.ravel() + np.log(tot.ravel())).sum())
+            resp = p / tot  # (n, k)
+            nk = np.maximum(resp.sum(axis=0), 1e-6)
+            w = nk / n
+            mu = (resp * x[:, None]).sum(axis=0) / nk
+            var = (resp * (x[:, None] - mu[None, :]) ** 2).sum(axis=0) / nk
+        if best is None or ll > best[0]:
+            best = (ll, w, mu, np.sqrt(var))
+
+    _, w, mu, sigma = best
+    order = np.argsort(mu)
+    eps = 1e-3 * span / k
+    mu = np.maximum.accumulate(mu[order] + eps * np.arange(k))
+    return {
+        "weights": (w[order] / w.sum()).astype(np.float32),
+        "mu": mu.astype(np.float32),
+        "sigma": np.clip(sigma[order], 0.05, None).astype(np.float32),
+    }
+
+
 def synth_gmm_data(key, n, num_components, *, spread=6.0, dtype=jnp.float32):
     """Well-separated synthetic mixture + the generating parameters."""
     k1, k2, k3 = jax.random.split(key, 3)

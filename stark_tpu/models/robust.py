@@ -20,12 +20,8 @@ from ..model import Model, ParamSpec
 
 
 def _half_cauchy_logpdf(x, scale):
-    # x > 0; density 2/(pi*scale*(1+(x/scale)^2))
-    return (
-        jnp.log(2.0 / jnp.pi)
-        - jnp.log(scale)
-        - jnp.log1p((x / scale) ** 2)
-    )
+    # x > 0; same idiom as eight_schools.py's tau prior
+    return jstats.cauchy.logpdf(x, 0.0, scale) + jnp.log(2.0)
 
 
 class StudentTRegression(Model):

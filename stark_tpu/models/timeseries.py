@@ -7,8 +7,10 @@ parallel prefix that XLA maps onto the VPU, instead of a sequential
 T-step `scan` (the latent recurrence is the hot loop here, not a matmul).
 
 NOTE: the likelihood depends on the whole latent path, so this model does
-NOT shard over a data axis and must not be minibatched (`data_row_axes`
-intentionally left at the default; use single-shard backends).
+NOT shard over a data axis and must not be minibatched — `data_row_axes`
+raises so the sharded/consensus/SG-HMC entry points fail fast instead of
+slicing `y` out from under `latent_h` inside jit.  Use single-shard
+backends (JaxBackend / CpuBackend); chains still parallelize.
 """
 
 from __future__ import annotations
@@ -53,6 +55,14 @@ class StochasticVolatility(Model):
             "phi": ParamSpec((), Interval(-1.0, 1.0)),
             "sigma_h": ParamSpec((), Exp()),
         }
+
+    def data_row_axes(self, data):
+        raise NotImplementedError(
+            "StochasticVolatility's likelihood couples every y_t through "
+            "the latent AR(1) path: rows cannot be sharded or minibatched. "
+            "Use a single-shard backend (JaxBackend/CpuBackend); chain "
+            "parallelism still applies."
+        )
 
     def log_prior(self, p):
         lp = jnp.sum(jstats.norm.logpdf(p["eps"]))

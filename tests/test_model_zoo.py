@@ -109,6 +109,19 @@ def test_stochastic_volatility_runs_and_recovers_scale():
     assert corr > 0.5, corr
 
 
+def test_sv_rejects_row_sharding_entry_points():
+    import pytest
+
+    from stark_tpu.sghmc import sghmc_sample
+
+    data, _ = synth_sv_data(jax.random.PRNGKey(0), 128)
+    with pytest.raises(NotImplementedError, match="cannot be sharded"):
+        sghmc_sample(
+            StochasticVolatility(num_steps=128), data, batch_size=32,
+            chains=1, num_warmup=10, num_samples=10, seed=0,
+        )
+
+
 def test_ar1_path_matches_sequential():
     from stark_tpu.models.timeseries import _ar1_path
 
