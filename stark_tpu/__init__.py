@@ -36,6 +36,7 @@ del _prec, _explicit_skip
 
 from . import bijectors, diagnostics
 from .model import Model, ParamSpec, flatten_model, prepare_model_data
+from .chees import chees_sample
 from .runner import sample_until_converged
 from .sampler import Posterior, SamplerConfig, sample
 from .sghmc import sghmc_sample
@@ -51,6 +52,7 @@ __all__ = [
     "sample",
     "sample_until_converged",
     "sghmc_sample",
+    "chees_sample",
     "supervised_sample",
     "ChainHealthError",
     "Posterior",

@@ -1,0 +1,258 @@
+"""ChEES-HMC driver — cross-chain adaptive HMC without NUTS trees.
+
+Why this exists (the TPU argument): vmapped iterative NUTS executes the
+full 2^max_depth gradient budget for every chain at every transition —
+masked lanes still run — so the per-draw cost is the worst case, always.
+ChEES-HMC learns ONE trajectory length for the whole chain ensemble by
+gradient ascent on the ChEES criterion (kernels/chees.py), runs plain
+jittered fixed-length trajectories (static per-step cost, no tree control
+flow), and uses the vectorized chains themselves as the adaptation signal
+— the more chains the device runs, the better the adaptation, which is
+exactly the axis TPUs scale.  See Hoffman, Radul & Sountsov 2021
+(PAPERS.md — pattern only).
+
+Warmup (single compiled `lax.scan`):
+  * step size: dual averaging on the cross-chain mean accept (target 0.8)
+  * trajectory length T: Adam ascent on log T with the per-step ChEES
+    gradient (normalized by a second-moment EMA), jittered by a Halton
+    sequence: L_t = ceil(u_t * T / eps), u_t in (0, 2)
+  * diagonal mass: pooled cross-(chain x step) Welford over the second
+    half of warmup, applied at two window boundaries
+
+Sampling runs with everything frozen except the Halton jitter (required
+for ergodicity: any fixed L has nonergodic orbits on some targets).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .adaptation import (
+    DualAveragingState,
+    WelfordState,
+    build_warmup_schedule,
+    da_init,
+    da_update,
+    welford_init,
+    welford_variance,
+)
+from .kernels.chees import chees_transition, halton, init_ensemble
+from .model import Model, flatten_model, prepare_model_data
+from .sampler import Posterior, _constrain_draws
+
+
+class AdamState(NamedTuple):
+    m: jax.Array
+    v: jax.Array
+    t: jax.Array
+
+
+def _adam_ascent(s: AdamState, grad, lr=0.025, b1=0.9, b2=0.95):
+    t = s.t + 1
+    m = b1 * s.m + (1.0 - b1) * grad
+    v = b2 * s.v + (1.0 - b2) * grad * grad
+    tf = t.astype(grad.dtype)
+    mhat = m / (1.0 - b1**tf)
+    vhat = v / (1.0 - b2**tf)
+    step = lr * mhat / (jnp.sqrt(vhat) + 1e-8)
+    return AdamState(m, v, t), step
+
+
+def _welford_batch(w: WelfordState, xs: jax.Array) -> WelfordState:
+    """Merge a (C, d) batch into the accumulator (Chan parallel combine)."""
+    bc = xs.shape[0]
+    bmean = jnp.mean(xs, axis=0)
+    bm2 = jnp.sum((xs - bmean[None, :]) ** 2, axis=0)
+    na = w.count.astype(xs.dtype)
+    nb = jnp.asarray(bc, xs.dtype)
+    delta = bmean - w.mean
+    tot = na + nb
+    mean = w.mean + delta * nb / tot
+    m2 = w.m2 + bm2 + delta * delta * na * nb / tot
+    return WelfordState(w.count + bc, mean, m2)
+
+
+def chees_sample(
+    model: Model,
+    data: Any = None,
+    *,
+    chains: int = 16,
+    num_warmup: int = 500,
+    num_samples: int = 1000,
+    init_step_size: float = 0.1,
+    init_traj_length: Optional[float] = None,
+    max_leapfrog: int = 1000,
+    target_accept: float = 0.8,
+    dispatch_steps: Optional[int] = None,
+    seed: int = 0,
+    init_params: Optional[Dict[str, Any]] = None,
+) -> Posterior:
+    """Run ChEES-HMC; returns a Posterior (same surface as `sample`).
+
+    chains: ChEES adapts from the ensemble — 16+ chains recommended (the
+    chains are vmapped on one device; they are cheap on a TPU).
+    dispatch_steps: when set, the warmup and sampling scans are issued as
+    bounded device programs of at most this many transitions (runtimes
+    that kill long executions — same mechanism as JaxBackend).
+    """
+    data = prepare_model_data(model, data)
+    fm = flatten_model(model)
+    potential_fn = fm.bind(data)
+    d = fm.ndim
+
+    key = jax.random.PRNGKey(seed)
+    key, key_init, key_warm, key_run = jax.random.split(key, 4)
+    if init_params is not None:
+        z0 = jnp.broadcast_to(fm.unconstrain(init_params), (chains, d))
+    else:
+        z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
+
+    T0 = init_traj_length if init_traj_length is not None else init_step_size
+    # Stan-style doubling windows (shared with the NUTS warmup): the metric
+    # refreshes at EVERY window end, so eps recovers quickly as conditioning
+    # improves and L = T/eps stays bounded.  T ascent starts after the
+    # first metric refresh — adapting T against the un-whitened geometry
+    # chases the condition number and blows trajectories to hundreds of
+    # leapfrogs (measured 5x the whole run's wall-clock).
+    sched = build_warmup_schedule(num_warmup)
+    adapt_mass = jnp.asarray(np.asarray(sched.adapt_mass))
+    window_end = jnp.asarray(np.asarray(sched.window_end))
+    ends = np.flatnonzero(sched.window_end)
+    t_start = int(ends[0]) + 1 if len(ends) else num_warmup // 4
+    # cap warmup trajectories: pre-convergence T estimates are unreliable
+    # and a single bad window must not cost max_leapfrog grads per draw
+    warm_cap = min(max_leapfrog, 128)
+
+    u_warm = jnp.asarray(2.0 * halton(num_warmup), jnp.float32)
+    u_run = jnp.asarray(2.0 * halton(num_samples), jnp.float32)
+
+    def num_steps(u, log_T, log_eps, cap):
+        L = jnp.ceil(u * jnp.exp(log_T - log_eps)).astype(jnp.int32)
+        return jnp.clip(L, 1, cap)
+
+    def warm_body(carry, x):
+        states, da, adam, log_T, wf, inv_mass = carry
+        key, u, idx, accum, at_window = x
+        log_eps = da.log_step
+        states, info = chees_transition(
+            key, states, potential_fn, jnp.exp(log_eps), inv_mass,
+            num_steps(u, log_T, log_eps, warm_cap),
+        )
+        da = da_update(da, jnp.mean(info.accept_prob), target_accept)
+        # chain rule d/dlogT = T * d/dT on the criterion-relative gradient
+        adam, step = _adam_ascent(
+            adam, info.grad_rel_T * jnp.exp(log_T), lr=0.05
+        )
+        new_log_T = jnp.where(idx >= t_start, log_T + step, log_T)
+        # a single non-finite step must not poison T for the rest of warmup
+        log_T = jnp.where(jnp.isfinite(new_log_T), new_log_T, log_T)
+        # keep T inside the regime warmup actually executes (warm_cap):
+        # letting it ratchet past the executed length would let sampling
+        # run trajectory lengths no warmup step ever validated
+        log_T = jnp.clip(log_T, log_eps, log_eps + jnp.log(float(warm_cap)))
+        wf = jax.tree.map(
+            lambda new, old: jnp.where(accum, new, old),
+            _welford_batch(wf, states.z),
+            wf,
+        )
+        # window end: apply pooled variance as the metric, restart the
+        # accumulator and step-size averaging
+        inv_mass = jnp.where(at_window, welford_variance(wf), inv_mass)
+        wf = jax.tree.map(
+            lambda w0, w: jnp.where(at_window, w0, w), welford_init(d), wf
+        )
+        da = jax.tree.map(
+            lambda a, b: jnp.where(at_window, a, b),
+            da_init(jnp.exp(da.log_step)),
+            da,
+        )
+        return (states, da, adam, log_T, wf, inv_mass), (
+            info.accept_prob.mean(),
+            info.is_divergent,
+        )
+
+    def sample_body(carry, x):
+        states, log_eps, log_T, inv_mass = carry
+        key, u = x
+        states, info = chees_transition(
+            key, states, potential_fn, jnp.exp(log_eps), inv_mass,
+            num_steps(u, log_T, log_eps, max_leapfrog),
+        )
+        out = (
+            states.z,
+            info.accept_prob,
+            info.is_divergent,
+            info.num_leapfrog,
+        )
+        return (states, log_eps, log_T, inv_mass), out
+
+    warm_seg = jax.jit(
+        lambda carry, xs: jax.lax.scan(warm_body, carry, xs)
+    )
+    sample_seg = jax.jit(
+        lambda carry, xs: jax.lax.scan(sample_body, carry, xs)
+    )
+
+    def segments(total):
+        seg = dispatch_steps if dispatch_steps else total
+        starts = list(range(0, total, seg))
+        return [(s, min(s + seg, total)) for s in starts]
+
+    warm_keys = jax.random.split(key_warm, num_warmup)
+    idxs = jnp.arange(num_warmup)
+    carry = (
+        init_ensemble(potential_fn, z0),
+        da_init(jnp.asarray(init_step_size)),
+        AdamState(jnp.zeros(()), jnp.zeros(()), jnp.zeros((), jnp.int32)),
+        jnp.log(jnp.asarray(T0)),
+        welford_init(d),
+        jnp.ones((d,)),
+    )
+    wdiv_total = 0
+    for lo, hi in segments(num_warmup):
+        carry, (_, wdiv) = jax.block_until_ready(
+            warm_seg(
+                carry,
+                (
+                    warm_keys[lo:hi],
+                    u_warm[lo:hi],
+                    idxs[lo:hi],
+                    adapt_mass[lo:hi],
+                    window_end[lo:hi],
+                ),
+            )
+        )
+        wdiv_total += int(np.sum(np.asarray(wdiv)))
+    states, da, _, log_T, _, inv_mass = carry
+    log_eps = da.log_avg_step
+
+    run_keys = jax.random.split(key_run, num_samples)
+    carry = (states, log_eps, log_T, inv_mass)
+    outs = []
+    for lo, hi in segments(num_samples):
+        carry, out = jax.block_until_ready(
+            sample_seg(carry, (run_keys[lo:hi], u_run[lo:hi]))
+        )
+        outs.append(jax.tree.map(np.asarray, out))
+    zs, acc, div, nleap = (
+        np.concatenate([o[i] for o in outs], axis=0) for i in range(4)
+    )
+    zs = np.swapaxes(zs, 0, 1)  # (chains, draws, d)
+    draws = _constrain_draws(fm, jnp.asarray(zs))
+    stats = {
+        "accept_prob": acc.T,
+        "is_divergent": div.T,
+        # post-warmup only (repo-wide convention); warmup count separate —
+        # warmup divergences are routine while eps is still adapting
+        "num_divergent": np.asarray(int(div.sum())),
+        "num_warmup_divergent": np.asarray(wdiv_total),
+        "num_grad_evals": np.asarray(nleap.sum()),
+        "step_size": np.full((chains,), float(np.exp(log_eps))),
+        "traj_length": np.asarray(np.exp(log_T)),
+        "inv_mass": np.asarray(inv_mass),
+    }
+    return Posterior(draws, stats, flat_model=fm, draws_flat=zs)

@@ -27,7 +27,7 @@ class RunConfig:
 
     name: str
     model: Dict[str, Any]  # {"type": <registry name>, ...kwargs}
-    sampler: Dict[str, Any]  # {"entry": sample|until_converged|consensus|tempered|sghmc, ...kwargs}
+    sampler: Dict[str, Any]  # {"entry": sample|until_converged|consensus|tempered|sghmc|chees, ...kwargs}
     data: Optional[Dict[str, Any]] = None  # {"synth": <name>, ...kwargs} | None
     execution: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # execution: {"backend": jax|cpu|sharded, "mesh": {axis: size}, "chains": N, "seed": S}
@@ -201,6 +201,10 @@ def run_config(cfg: RunConfig):
         post = sghmc_sample(
             model, data, chains=chains, seed=seed, mesh=mesh, **sampler
         )
+    elif entry == "chees":
+        from .chees import chees_sample
+
+        post = chees_sample(model, data, chains=chains, seed=seed, **sampler)
     else:
         raise ValueError(f"unknown sampler entry {entry!r}")
     wall = time.perf_counter() - t0
