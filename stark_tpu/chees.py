@@ -8,8 +8,10 @@ gradient ascent on the ChEES criterion (kernels/chees.py), runs plain
 jittered fixed-length trajectories (static per-step cost, no tree control
 flow), and uses the vectorized chains themselves as the adaptation signal
 — the more chains the device runs, the better the adaptation, which is
-exactly the axis TPUs scale.  See Hoffman, Radul & Sountsov 2021
-(PAPERS.md — pattern only).
+exactly the axis TPUs scale.  Pattern: Hoffman, Radul & Sountsov 2021
+(AISTATS), as deployed in tfp.mcmc — see PAPERS.md ("tfp.mcmc: Modern
+MCMC Tools Built for Modern Hardware", "Running MCMC on Modern Hardware
+and Software"); patterns only, no code reused.
 
 Warmup (single compiled `lax.scan`):
   * step size: dual averaging on the cross-chain mean accept (target 0.8)
