@@ -194,9 +194,12 @@ def chees_sample(
     def sample_body(carry, x):
         states, log_eps, log_T, inv_mass = carry
         key, u = x
+        # cap at warm_cap, not max_leapfrog: with the u in (0,2) jitter a
+        # larger cap would let sampling run trajectory lengths warmup never
+        # executed (T itself is clipped to warm_cap, but 2x jitter is not)
         states, info = chees_transition(
             key, states, potential_fn, jnp.exp(log_eps), inv_mass,
-            num_steps(u, log_T, log_eps, max_leapfrog),
+            num_steps(u, log_T, log_eps, warm_cap),
         )
         out = (
             states.z,
@@ -293,7 +296,10 @@ def chees_sample(
         # warmup divergences are routine while eps is still adapting
         "num_divergent": np.asarray(int(div.sum())),
         "num_warmup_divergent": np.asarray(wdiv_total),
-        "num_grad_evals": np.asarray(nleap.sum()),
+        # nleap is the SHARED per-transition length; the ensemble total is
+        # chains x that, matching the per-chain arrays HMC/NUTS report (so
+        # cross-sampler gradient-budget comparisons are apples-to-apples)
+        "num_grad_evals": np.asarray(int(nleap.sum()) * chains),
         "step_size": np.full((chains,), float(np.exp(log_eps))),
         "traj_length": np.asarray(np.exp(log_T)),
         "inv_mass": np.asarray(inv_mass),

@@ -132,8 +132,12 @@ def sample_until_converged(
             # checkpoint: drop rows the checkpoint doesn't account for, or
             # the re-run block double-counts.  The accounted count rides in
             # the meta (the original run's block size, not this call's —
-            # they may differ legally).
-            accounted = meta.get("draw_rows", blocks_done * block_size)
+            # they may differ legally, so the fallback must use the
+            # checkpointed block_size, never the resuming call's).
+            accounted = meta.get(
+                "draw_rows",
+                blocks_done * int(meta.get("block_size", block_size)),
+            )
             truncate_draws(draw_store_path, accounted)
             stored, _, _ = read_draws(draw_store_path, mmap=False)
             if stored.shape[0]:
@@ -242,6 +246,7 @@ def sample_until_converged(
                     arrays,
                     {
                         "blocks_done": blocks_done,
+                        "block_size": block_size,
                         "draw_rows": int(all_draws.shape[1]),
                         "num_divergent": total_div,
                         "history": history,

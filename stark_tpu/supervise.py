@@ -111,6 +111,17 @@ def supervised_sample(
     kwargs.setdefault("health_check", True)
 
     store_path = kwargs.get("draw_store_path")
+
+    def quarantine(path: str) -> None:
+        # numbered suffixes: a second quarantine in the same workdir must
+        # not overwrite the forensic copy of an earlier failure
+        dst = path + ".bad"
+        n = 1
+        while os.path.exists(dst):
+            n += 1
+            dst = f"{path}.bad{n}"
+        os.replace(path, dst)
+
     attempt = 0
     while True:
         resume: Optional[str] = None
@@ -119,11 +130,11 @@ def supervised_sample(
                 resume = ckpt_path
             else:
                 # corrupt/poisoned checkpoint: quarantine it and cold-start
-                os.replace(ckpt_path, ckpt_path + ".bad")
+                quarantine(ckpt_path)
         if resume is None and store_path and os.path.exists(store_path):
             # cold start: draws persisted by a discarded run must not mix
             # into this run's store (a later resume reads the whole store)
-            os.replace(store_path, store_path + ".bad")
+            quarantine(store_path)
         try:
             return sample_until_converged(
                 model,

@@ -95,7 +95,8 @@ def test_chees_grad_budget_beats_nuts_tree_budget():
     post = chees_sample(
         CorrGauss(), chains=16, num_warmup=400, num_samples=400, seed=0
     )
-    grads_per_draw = float(post.sample_stats["num_grad_evals"]) / 400.0
+    # num_grad_evals is the ensemble total; normalize to per-chain per-draw
+    grads_per_draw = float(post.sample_stats["num_grad_evals"]) / (400.0 * 16)
     # NUTS would need depth ~9-10 here => 512-1024 grads per vmapped step
     assert grads_per_draw < 128, grads_per_draw
     assert post.min_ess() > 500
