@@ -7,9 +7,13 @@ convergence diagnostics from sufficient statistics"):
   and ``ess`` (Geyer initial-monotone-sequence estimator via FFT), used for
   reported results and tests;
 * streaming, from per-chain Welford sufficient statistics ``(count, mean,
-  M2)`` accumulated inside the device scan: ``rhat_from_suffstats`` — this is
-  what the adaptive runner uses to stop at R-hat < 1.01 without hauling draws
-  to the host, allreduced over the chain mesh axis on TPU.
+  M2)``: ``ChainSuffStats`` (host-side accumulator, O(chains*d) per block)
+  feeding ``rhat_from_suffstats`` — the adaptive runner's per-block stopping
+  signal, so the convergence check costs O(chains*d) per block instead of
+  recomputing split-R-hat/ESS over the whole accumulated history; the full
+  split-form diagnostics run only to VALIDATE a candidate stop and once at
+  the end (runner.py).  ``rhat_from_suffstats`` is jnp so the same reduction
+  can run on device / psum'd over a chain mesh axis.
 """
 
 from __future__ import annotations
@@ -53,62 +57,127 @@ def _autocov_fft(x: np.ndarray) -> np.ndarray:
     return acov / n
 
 
-def ess(x) -> np.ndarray:
-    """Effective sample size over (chains, draws, *event), Geyer-truncated.
+# FFT workspace cap for ess() column chunking; module-level so tests can
+# shrink it to exercise the multi-chunk path
+_ESS_WORKSPACE_BYTES = 256e6
 
-    Plain (mean-estimand) ESS on split chains; returns (*event,).
-    """
-    x = np.asarray(x, np.float64)
-    x = _split_chains(x)
+
+def _ess_chunk(x: np.ndarray) -> np.ndarray:
+    """ESS for split chains (m, n, cols) — fully vectorized over cols."""
     m, n = x.shape[0], x.shape[1]
-    acov = _autocov_fft(x)  # (m, n, ...)
+    acov = _autocov_fft(x)  # (m, n, cols)
     chain_var = acov[:, 0] * n / (n - 1.0)
     mean_var = chain_var.mean(axis=0)
     var_plus = mean_var * (n - 1.0) / n
     if m > 1:
         var_plus = var_plus + x.mean(axis=1).var(axis=0, ddof=1)
 
-    rho = 1.0 - (mean_var - acov.mean(axis=0)) / var_plus  # (n, ...)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        rho = 1.0 - (mean_var - acov.mean(axis=0)) / var_plus  # (n, cols)
     rho[0] = 1.0
-    # Geyer initial positive + monotone sequence over pairs
-    # Gamma_t = rho[2t] + rho[2t+1], t = 0, 1, ...; tau = -1 + 2 * sum Gamma_t
+    # Geyer initial positive + monotone sequence over lag pairs:
+    #   Gamma_t = rho[2t] + rho[2t+1]; keep the prefix with Gamma_t >= 0,
+    #   then enforce monotone non-increase (running min); tau = -1 + 2*sum
     max_pairs = n // 2
-    event_shape = rho.shape[1:]
-    rho_flat = rho.reshape(n, -1)
-    tau_flat = np.ones(rho_flat.shape[1])
-    for j in range(rho_flat.shape[1]):
-        pair_sums = []
-        for t in range(max_pairs):
-            s = rho_flat[2 * t, j] + rho_flat[2 * t + 1, j]
-            if s < 0:
-                break
-            pair_sums.append(s)
-        # initial monotone sequence
-        for t in range(1, len(pair_sums)):
-            pair_sums[t] = min(pair_sums[t], pair_sums[t - 1])
-        tau_flat[j] = -1.0 + 2.0 * sum(pair_sums)
-        tau_flat[j] = max(tau_flat[j], 1.0 / np.log10(m * n + 10.0))
-    tau = tau_flat.reshape(event_shape) if event_shape else tau_flat[0]
-    return m * n / tau
+    pair = rho[0 : 2 * max_pairs : 2] + rho[1 : 2 * max_pairs : 2]
+    valid = np.cumprod(pair >= 0.0, axis=0).astype(bool)
+    mono = np.minimum.accumulate(np.where(valid, pair, np.inf), axis=0)
+    tau = -1.0 + 2.0 * np.sum(np.where(valid, mono, 0.0), axis=0)
+    tau = np.maximum(tau, 1.0 / np.log10(m * n + 10.0))
+    out = m * n / tau
+    # zero-variance / non-finite components have no defined ESS — NaN, so a
+    # stuck parameter fails (not passes) an `ess > target` gate.  Detect
+    # constancy via max==min per (chain, component) — exact even when the
+    # FFT's mean-subtraction leaves rounding noise on constant data
+    const = np.all(x.max(axis=1) == x.min(axis=1), axis=0)
+    out[const | ~np.isfinite(var_plus) | (var_plus <= 0.0)] = np.nan
+    return out
 
 
-def rhat_from_suffstats(count, mean, m2) -> jnp.ndarray:
+def ess(x) -> np.ndarray:
+    """Effective sample size over (chains, draws, *event), Geyer-truncated.
+
+    Plain (mean-estimand) ESS on split chains; returns (*event,).
+    Vectorized over components, processed in column chunks so the FFT
+    workspace stays bounded at LMM scale (d ~ 20k+ parameters).
+    """
+    x = np.asarray(x, np.float64)
+    x = _split_chains(x)
+    m, n = x.shape[0], x.shape[1]
+    event_shape = x.shape[2:]
+    x_flat = x.reshape(m, n, -1)
+    cols = x_flat.shape[2]
+    # complex128 FFT workspace is m * padded_n * chunk * 16B
+    size = 2 ** int(np.ceil(np.log2(2 * max(n, 1))))
+    chunk = max(1, int(_ESS_WORKSPACE_BYTES / (m * size * 16)))
+    out = np.empty(cols)
+    for lo in range(0, cols, chunk):
+        out[lo : lo + chunk] = _ess_chunk(x_flat[:, :, lo : lo + chunk])
+    return out.reshape(event_shape) if event_shape else out[0]
+
+
+def rhat_from_suffstats(count, mean, m2):
     """R-hat from per-chain Welford stats; shapes (chains, ...) -> (...).
 
-    jnp so it can run on device (inside jit / psum'd across a chain axis).
-    Uses the non-split form — chains are assumed independently initialized,
-    and the streaming path is only used for early stopping, with the final
-    reported R-hat always recomputed split from draws.
+    Namespace-generic: jnp arrays in -> jnp out (runs on device inside jit /
+    psum'd across a chain axis); numpy in -> numpy float64 out (the host
+    streaming path in ``ChainSuffStats`` — no device round-trip, no float32
+    downcast near the 1.01 threshold).  Uses the non-split form — chains are
+    assumed independently initialized, and the streaming path is only used
+    for early stopping, with the final reported R-hat always recomputed
+    split from draws.
     """
-    n = count.astype(mean.dtype)
+    xp = jnp if isinstance(mean, jnp.ndarray) else np
+    mean = xp.asarray(mean)
+    n = xp.asarray(count).astype(mean.dtype)
     if n.ndim < mean.ndim:
         n = n.reshape(n.shape + (1,) * (mean.ndim - n.ndim))
-    chain_var = m2 / (n - 1.0)
-    within = chain_var.mean(axis=0)
-    between = n.mean(axis=0) * jnp.var(mean, axis=0, ddof=1)
-    n_mean = n.mean(axis=0)
-    var_plus = (n_mean - 1.0) / n_mean * within + between / n_mean
-    return jnp.sqrt(var_plus / within)
+    # errstate: a frozen component (within == 0) must yield a quiet NaN on
+    # the numpy path, same as split_rhat — not a RuntimeWarning per block
+    with np.errstate(divide="ignore", invalid="ignore"):
+        chain_var = m2 / (n - 1.0)
+        within = chain_var.mean(axis=0)
+        between = n.mean(axis=0) * xp.var(mean, axis=0, ddof=1)
+        n_mean = n.mean(axis=0)
+        var_plus = (n_mean - 1.0) / n_mean * within + between / n_mean
+        return xp.sqrt(var_plus / within)
+
+
+class ChainSuffStats:
+    """Per-chain running Welford moments (count, mean, M2) on the host.
+
+    The streaming half of the diagnostics story (SURVEY.md §6 metrics row):
+    updated from each draw block in O(chains*d), so the adaptive runner's
+    per-block convergence signal never rescans the accumulated history.
+    Merging uses Chan's parallel-combine, so feeding one big block or many
+    small ones yields identical statistics.
+    """
+
+    def __init__(self, chains: int, ndim: int):
+        self.count = np.zeros((chains,), np.int64)
+        self.mean = np.zeros((chains, ndim))
+        self.m2 = np.zeros((chains, ndim))
+
+    def update(self, block: np.ndarray) -> None:
+        """Merge a (chains, block_draws, d) block into the accumulator."""
+        block = np.asarray(block, np.float64)
+        bc = block.shape[1]
+        if bc == 0:
+            return
+        bmean = block.mean(axis=1)
+        bm2 = ((block - bmean[:, None, :]) ** 2).sum(axis=1)
+        n = self.count[:, None].astype(np.float64)
+        tot = n + bc
+        delta = bmean - self.mean
+        self.mean += delta * bc / tot
+        self.m2 += bm2 + delta * delta * n * bc / tot
+        self.count += bc
+
+    def rhat(self) -> np.ndarray:
+        """Streaming (non-split) R-hat per component, numpy float64."""
+        return np.asarray(
+            rhat_from_suffstats(self.count, self.mean, self.m2)
+        )
 
 
 def summarize(draws: Dict[str, np.ndarray]) -> Dict[str, Dict[str, np.ndarray]]:

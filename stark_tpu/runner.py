@@ -62,6 +62,7 @@ def sample_until_converged(
     min_blocks: int = 2,
     rhat_target: float = 1.01,
     ess_target: float = 400.0,
+    diag_components: int = 64,
     seed: int = 0,
     checkpoint_path: Optional[str] = None,
     resume_from: Optional[str] = None,
@@ -73,10 +74,19 @@ def sample_until_converged(
     reseed: Optional[int] = None,
     **cfg_kwargs,
 ) -> AdaptiveResult:
-    """Run chains until split-R-hat < rhat_target AND min-ESS > ess_target.
+    """Run chains until R-hat < rhat_target AND min-ESS > ess_target.
 
-    Draw blocks are compiled once and reused; the host-side work per block is
-    O(draws so far) diagnostics on numpy arrays.
+    Draw blocks are compiled once and reused.  The per-block convergence
+    signal is STREAMING: per-chain Welford sufficient statistics updated in
+    O(chains*d) (`diagnostics.ChainSuffStats` -> `rhat_from_suffstats`), plus
+    Geyer ESS on only the ``diag_components`` worst-mixing components — so
+    the per-block full-history work is O(draws * diag_components),
+    independent of d (the old path rescanned all d components every
+    block).  When the streaming criteria pass, one full split-R-hat/ESS
+    pass over all draws VALIDATES the stop (recorded as ``full_max_rhat`` /
+    ``full_min_ess`` in the block's metrics line); failed validations back
+    off geometrically, so the O(draws*d) full diagnostics run O(log blocks)
+    times per run instead of every block.
     """
     cfg = SamplerConfig(**cfg_kwargs)
     fm = flatten_model(model)
@@ -163,8 +173,14 @@ def sample_until_converged(
             }
         )
 
+    suff = diagnostics.ChainSuffStats(chains, fm.ndim)
+    for blk in draw_blocks:
+        suff.update(blk)  # resume: rebuild streaming stats from stored draws
+    next_full_check = 0  # earliest block allowed to run full validation
+
     draw_store = None
     converged = False
+    cat_draws = None  # (re)built per block; None when stale or never built
     try:
         if draw_store_path:
             from .drawstore import DrawStore
@@ -205,21 +221,53 @@ def sample_until_converged(
                 draw_store.append(draw_blocks[-1])  # async; doesn't stall the loop
             total_div += int(np.sum(np.asarray(divergent)))
 
-            all_draws = np.concatenate(draw_blocks, axis=1)
-            rhat = diagnostics.split_rhat(all_draws)
-            max_rhat = float(np.max(rhat))
-            min_ess = float(np.min(diagnostics.ess(all_draws)))
-            wall = time.perf_counter() - t_start
+            cat_draws = None  # full-history concatenation, built at most once per block
+            suff.update(draw_blocks[-1])
+            srhat = suff.rhat()
+            # NaN streaming R-hat = frozen component; surface it explicitly
+            # (nanmax would report a healthy-looking max while never
+            # converging) and hard-block the stop gate below
+            n_stuck = int(np.count_nonzero(np.isnan(srhat)))
+            finite_rhat = srhat[~np.isnan(srhat)]
+            max_rhat = (
+                float(np.max(finite_rhat)) if finite_rhat.size else float("inf")
+            )
+            # ESS only on the worst-mixing components (by streaming R-hat);
+            # NaN R-hat counts as worst — it flags a suspicious component
+            k = min(diag_components, fm.ndim)
+            worst = np.argsort(np.where(np.isnan(srhat), -np.inf, -srhat))[:k]
+            subset = np.concatenate([b[:, :, worst] for b in draw_blocks], axis=1)
+            min_ess = float(np.min(diagnostics.ess(subset)))
+            draws_per_chain = int(suff.count[0])
             rec = {
                 "event": "block",
                 "block": blocks_done,
-                "draws_per_chain": int(all_draws.shape[1]),
+                "draws_per_chain": draws_per_chain,
                 "max_rhat": max_rhat,
                 "min_ess": min_ess,
+                "num_stuck_components": n_stuck,
                 "num_divergent": total_div,
                 "mean_accept": float(np.mean(np.asarray(accept))),
-                "wall_s": wall,
+                "wall_s": time.perf_counter() - t_start,
             }
+            if (
+                blocks_done >= min_blocks
+                and n_stuck == 0
+                and max_rhat < rhat_target
+                and min_ess > ess_target
+                and blocks_done >= next_full_check
+            ):
+                # candidate stop: validate with the full split-form pass
+                cat_draws = np.concatenate(draw_blocks, axis=1)
+                full_rhat = float(np.max(diagnostics.split_rhat(cat_draws)))
+                full_ess = float(np.min(diagnostics.ess(cat_draws)))
+                rec["full_max_rhat"] = full_rhat
+                rec["full_min_ess"] = full_ess
+                rec["wall_s"] = time.perf_counter() - t_start
+                if full_rhat < rhat_target and full_ess > ess_target:
+                    converged = True
+                else:
+                    next_full_check = blocks_done + max(1, blocks_done // 4)
             history.append(rec)
             emit(rec)
 
@@ -238,7 +286,9 @@ def sample_until_converged(
                     # no draw store -> draws ride in the checkpoint; with a
                     # store the draws are already persisted incrementally
                     # (avoids O(blocks^2) checkpoint I/O)
-                    arrays["draws"] = all_draws
+                    if cat_draws is None:
+                        cat_draws = np.concatenate(draw_blocks, axis=1)
+                    arrays["draws"] = cat_draws
                 else:
                     draw_store.flush()  # store on disk before state advances
                 save_checkpoint(
@@ -247,19 +297,14 @@ def sample_until_converged(
                     {
                         "blocks_done": blocks_done,
                         "block_size": block_size,
-                        "draw_rows": int(all_draws.shape[1]),
+                        "draw_rows": draws_per_chain,
                         "num_divergent": total_div,
                         "history": history,
                         "model": type(model).__name__,
                     },
                 )
 
-            if (
-                blocks_done >= min_blocks
-                and max_rhat < rhat_target
-                and min_ess > ess_target
-            ):
-                converged = True
+            if converged:
                 break
     finally:
         if metrics_f:
@@ -267,7 +312,11 @@ def sample_until_converged(
         if draw_store is not None:
             draw_store.close()
 
-    all_draws = np.concatenate(draw_blocks, axis=1)
+    # cat_draws from the final loop iteration (if any) is still current —
+    # draw_blocks does not change between its construction and loop exit
+    all_draws = cat_draws if cat_draws is not None else np.concatenate(
+        draw_blocks, axis=1
+    )
     draws = _constrain_draws(fm, all_draws)
     stats = {"num_divergent": np.asarray(total_div)}
     return AdaptiveResult(
