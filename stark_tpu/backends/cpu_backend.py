@@ -23,8 +23,19 @@ import jax
 import numpy as np
 
 from ..adaptation import build_warmup_schedule
+from ..kernels.chees import halton
 from ..model import Model, flatten_model
 from ..sampler import Posterior, SamplerConfig, _constrain_draws
+
+
+def _jittered_length(cfg: SamplerConfig, u: float, eps: float, cap: int) -> int:
+    """ChEES-style Halton-jittered leapfrog count: L = ceil(2u * T / eps)."""
+    T = (
+        cfg.init_traj_length
+        if cfg.init_traj_length is not None
+        else cfg.num_leapfrog * eps
+    )
+    return max(1, min(cap, math.ceil(2.0 * u * T / eps)))
 
 _DIVERGENCE_THRESHOLD = 1000.0
 
@@ -229,6 +240,18 @@ class CpuBackend:
         pot = _HostPotential(fm, data)
         schedule = build_warmup_schedule(cfg.num_warmup)
 
+        # kernel="chees" on the host reference: Halton-jittered
+        # trajectory-length HMC — the same transition family the device
+        # ChEES sampler runs after warmup (ChEES's cross-chain T learning
+        # is a device-side adaptation strategy; the invariant distribution
+        # is that of jittered fixed-length HMC, so this is a valid
+        # distribution-level oracle for backend-vs-backend parity).  The
+        # trajectory length in TIME units is cfg.init_traj_length, or
+        # num_leapfrog steps' worth when unset.
+        if cfg.kernel == "chees":
+            u_all = halton(cfg.num_warmup + cfg.num_samples * cfg.thin)
+            leap_cap = min(cfg.max_leapfrog, 512)
+
         all_draws = []
         all_accept = []
         all_div = []
@@ -255,6 +278,11 @@ class CpuBackend:
                 eps = math.exp(da.log_step) if cfg.adapt_step_size else cfg.init_step_size
                 if cfg.kernel == "nuts":
                     z, pe, grad, acc, _ = kernel.step(rng, z, pe, grad, eps)
+                elif cfg.kernel == "chees":
+                    z, pe, grad, acc = _hmc_transition(
+                        pot, rng, z, pe, grad, eps, inv_mass,
+                        _jittered_length(cfg, u_all[i], eps, leap_cap),
+                    )
                 else:
                     z, pe, grad, acc = _hmc_transition(
                         pot, rng, z, pe, grad, eps, inv_mass, cfg.num_leapfrog
@@ -286,6 +314,14 @@ class CpuBackend:
             for t in range(cfg.num_samples * cfg.thin):
                 if cfg.kernel == "nuts":
                     z, pe, grad, acc, div = kernel.step(rng, z, pe, grad, eps)
+                elif cfg.kernel == "chees":
+                    z, pe, grad, acc = _hmc_transition(
+                        pot, rng, z, pe, grad, eps, inv_mass,
+                        _jittered_length(
+                            cfg, u_all[cfg.num_warmup + t], eps, leap_cap
+                        ),
+                    )
+                    div = False
                 else:
                     z, pe, grad, acc = _hmc_transition(
                         pot, rng, z, pe, grad, eps, inv_mass, cfg.num_leapfrog

@@ -76,6 +76,24 @@ class JaxBackend:
         fm = flatten_model(model)
         data = prepare_model_data(model, data)
 
+        if cfg.kernel == "chees":
+            # ensemble kernel: served through the same backend boundary but
+            # driven by the chees parts (its warmup adapts cross-chain, so
+            # the per-chain vmapped runner does not apply)
+            from ..chees import run_chees
+
+            return run_chees(
+                fm,
+                cfg,
+                data,
+                chains=chains,
+                seed=seed,
+                init_params=init_params,
+                dispatch_steps=self.dispatch_steps,
+                jit_cache=self._cache.setdefault((model, cfg, "chees"), {}),
+                device=self.device,
+            )
+
         key = jax.random.PRNGKey(seed)
         key_init, key_run = jax.random.split(key)
         if init_params is not None:

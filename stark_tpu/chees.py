@@ -1,4 +1,4 @@
-"""ChEES-HMC driver — cross-chain adaptive HMC without NUTS trees.
+"""ChEES-HMC — cross-chain adaptive HMC without NUTS trees.
 
 Why this exists (the TPU argument): vmapped iterative NUTS executes the
 full 2^max_depth gradient budget for every chain at every transition —
@@ -13,21 +13,31 @@ exactly the axis TPUs scale.  Pattern: Hoffman, Radul & Sountsov 2021
 MCMC Tools Built for Modern Hardware", "Running MCMC on Modern Hardware
 and Software"); patterns only, no code reused.
 
-Warmup (single compiled `lax.scan`):
+Warmup (compiled `lax.scan` segments):
   * step size: dual averaging on the cross-chain mean accept (target 0.8)
   * trajectory length T: Adam ascent on log T with the per-step ChEES
     gradient (normalized by a second-moment EMA), jittered by a Halton
     sequence: L_t = ceil(u_t * T / eps), u_t in (0, 2)
   * diagonal mass: pooled cross-(chain x step) Welford over the second
-    half of warmup, applied at two window boundaries
+    half of warmup, applied at window boundaries
 
 Sampling runs with everything frozen except the Halton jitter (required
 for ergodicity: any fixed L has nonergodic orbits on some targets).
+
+Structure (the backend-plugin refactor): `make_chees_parts` builds the
+ensemble-level pieces — init_carry / warm_segment / finalize /
+sample_segment — with explicit carries, so every host driver composes
+with them: `JaxBackend` serves `kernel="chees"` through the same
+`SamplerBackend` boundary as NUTS/HMC, the adaptive runner checkpoints
+the run carry between draw blocks (supervised restart included), and the
+sharded mesh path wraps the same segments in `shard_map` with
+``chains_axis`` turning cross-chain reductions into collectives.
+`chees_sample` remains the one-call convenience driver.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple, Optional
+from typing import Any, Callable, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -42,10 +52,15 @@ from .adaptation import (
     welford_init,
     welford_variance,
 )
-from .kernels.base import value_and_grad_of
-from .kernels.chees import chees_transition, halton, init_ensemble
+from .kernels.base import HMCState, value_and_grad_of
+from .kernels.chees import (
+    _cmean,
+    chees_transition,
+    halton,
+    init_ensemble,
+)
 from .model import Model, flatten_model, prepare_model_data
-from .sampler import Posterior, _constrain_draws
+from .sampler import Posterior, SamplerConfig, _constrain_draws
 
 
 class AdamState(NamedTuple):
@@ -65,11 +80,21 @@ def _adam_ascent(s: AdamState, grad, lr=0.025, b1=0.9, b2=0.95):
     return AdamState(m, v, t), step
 
 
-def _welford_batch(w: WelfordState, xs: jax.Array) -> WelfordState:
-    """Merge a (C, d) batch into the accumulator (Chan parallel combine)."""
+def _welford_batch(w: WelfordState, xs: jax.Array, chains_axis=None) -> WelfordState:
+    """Merge a (C, d) batch into the accumulator (Chan parallel combine).
+
+    With ``chains_axis`` the batch spans the whole sharded ensemble: the
+    batch mean is pmean'd and the within-batch M2 psum'd, so every device
+    accumulates identical (global) statistics.
+    """
     bc = xs.shape[0]
     bmean = jnp.mean(xs, axis=0)
+    if chains_axis is not None:
+        bc = bc * jax.lax.psum(1, chains_axis)
+        bmean = jax.lax.pmean(bmean, chains_axis)
     bm2 = jnp.sum((xs - bmean[None, :]) ** 2, axis=0)
+    if chains_axis is not None:
+        bm2 = jax.lax.psum(bm2, chains_axis)
     na = w.count.astype(xs.dtype)
     nb = jnp.asarray(bc, xs.dtype)
     delta = bmean - w.mean
@@ -77,6 +102,337 @@ def _welford_batch(w: WelfordState, xs: jax.Array) -> WelfordState:
     mean = w.mean + delta * nb / tot
     m2 = w.m2 + bm2 + delta * delta * na * nb / tot
     return WelfordState(w.count + bc, mean, m2)
+
+
+class CheesWarmCarry(NamedTuple):
+    """Full warmup adaptation state — checkpointable between segments."""
+
+    states: HMCState  # ensemble (C, d) (local shard when chains_axis set)
+    da: DualAveragingState
+    adam: AdamState
+    log_T: jax.Array
+    wf: WelfordState
+    inv_mass: jax.Array
+
+
+class CheesRunCarry(NamedTuple):
+    """Frozen-adaptation sampling state — the per-block checkpoint unit."""
+
+    states: HMCState
+    log_eps: jax.Array
+    log_T: jax.Array
+    inv_mass: jax.Array
+
+
+class CheesParts(NamedTuple):
+    init_carry: Callable  # (key, z0, data) -> CheesWarmCarry
+    warm_segment: Callable  # (carry, keys, us, idxs, aflags, wflags, data)
+    finalize: Callable  # (CheesWarmCarry) -> CheesRunCarry
+    sample_segment: Callable  # (carry, keys, us, data) -> (carry, outs)
+    warm_cap: int
+    schedule: Any  # WarmupSchedule for cfg.num_warmup
+
+
+def make_chees_parts(
+    fm, cfg: SamplerConfig, *, chains_axis: Optional[str] = None
+) -> CheesParts:
+    """Ensemble-level ChEES building blocks with explicit carries.
+
+    The host drives the warmup/sampling schedules in bounded slices
+    (dispatch_steps) and may checkpoint any carry between slices; all
+    functions take the data pytree as a runtime argument so jitted
+    wrappers are reusable across same-shape datasets.  ``chains_axis``
+    names the mesh axis the ensemble is sharded over (shard_map caller);
+    cross-chain adaptation statistics then reduce with XLA collectives.
+    """
+    d = fm.ndim
+    T0 = (
+        cfg.init_traj_length
+        if cfg.init_traj_length is not None
+        else cfg.init_step_size
+    )
+    # Stan-style doubling windows (shared with the NUTS warmup): the metric
+    # refreshes at EVERY window end, so eps recovers quickly as conditioning
+    # improves and L = T/eps stays bounded.  T ascent starts after the
+    # first metric refresh — adapting T against the un-whitened geometry
+    # chases the condition number and blows trajectories to hundreds of
+    # leapfrogs (measured 5x the whole run's wall-clock).
+    sched = build_warmup_schedule(cfg.num_warmup)
+    ends = np.flatnonzero(sched.window_end)
+    t_start = int(ends[0]) + 1 if len(ends) else cfg.num_warmup // 4
+    # cap warmup trajectories: pre-convergence T estimates are unreliable
+    # and a single bad window must not cost max_leapfrog grads per draw.
+    # 512 leaves headroom for stiff posteriors (the 1M-row flagship needs
+    # L ~ 270; a 128 cap measured R-hat 8.8 where uncapped converged)
+    warm_cap = min(cfg.max_leapfrog, 512)
+
+    def num_steps(u, log_T, log_eps, cap):
+        L = jnp.ceil(u * jnp.exp(log_T - log_eps)).astype(jnp.int32)
+        return jnp.clip(L, 1, cap)
+
+    def init_carry(key, z0, data=None) -> CheesWarmCarry:
+        potential_fn = fm.bind(data)
+        if cfg.map_init_steps > 0:
+            # descend each chain toward the mode with Adam on the
+            # potential before warmup: on peaked big-N posteriors a random
+            # unconstrained init is thousands of posterior sds from the
+            # mode and warmup burns its whole budget descending; a few
+            # hundred fused-gradient Adam steps cost seconds and let
+            # warmup adapt in the typical set.  Chains stay distinct
+            # (each descends its own init, stopping well short of
+            # collapse).
+            vg_pot = jax.vmap(value_and_grad_of(potential_fn))
+
+            def adam_body(carry, _):
+                z, adam = carry
+                _, g = vg_pot(z)
+                g = jnp.where(jnp.isfinite(g), g, 0.0)
+                adam, step = _adam_ascent(adam, -g, lr=0.05, b2=0.999)
+                return (z + step, adam), None
+
+            (z0, _), _ = jax.lax.scan(
+                adam_body,
+                (
+                    z0,
+                    AdamState(
+                        jnp.zeros_like(z0),
+                        jnp.zeros_like(z0),
+                        jnp.zeros((), jnp.int32),
+                    ),
+                ),
+                None,
+                length=cfg.map_init_steps,
+            )
+        return CheesWarmCarry(
+            states=init_ensemble(potential_fn, z0),
+            da=da_init(jnp.asarray(cfg.init_step_size)),
+            adam=AdamState(
+                jnp.zeros(()), jnp.zeros(()), jnp.zeros((), jnp.int32)
+            ),
+            log_T=jnp.log(jnp.asarray(T0)),
+            wf=welford_init(d),
+            inv_mass=jnp.ones((d,)),
+        )
+
+    def warm_body(potential_fn):
+        def body(carry: CheesWarmCarry, x):
+            states, da, adam, log_T, wf, inv_mass = carry
+            key, u, idx, accum, at_window = x
+            log_eps = da.log_step
+            states, info = chees_transition(
+                key, states, potential_fn, jnp.exp(log_eps), inv_mass,
+                num_steps(u, log_T, log_eps, warm_cap),
+                chains_axis=chains_axis,
+            )
+            da = da_update(
+                da, _cmean(info.accept_prob, chains_axis), cfg.target_accept
+            )
+            # chain rule d/dlogT = T * d/dT on the criterion-relative grad
+            adam, step = _adam_ascent(
+                adam, info.grad_rel_T * jnp.exp(log_T), lr=0.05
+            )
+            new_log_T = jnp.where(idx >= t_start, log_T + step, log_T)
+            # one non-finite step must not poison T for the rest of warmup
+            log_T = jnp.where(jnp.isfinite(new_log_T), new_log_T, log_T)
+            # keep T inside the regime warmup actually executes (warm_cap):
+            # letting it ratchet past the executed length would let
+            # sampling run lengths no warmup step ever validated
+            log_T = jnp.clip(
+                log_T, log_eps, log_eps + jnp.log(float(warm_cap))
+            )
+            wf = jax.tree.map(
+                lambda new, old: jnp.where(accum, new, old),
+                _welford_batch(wf, states.z, chains_axis),
+                wf,
+            )
+            # window end: apply pooled variance as the metric, restart the
+            # accumulator and step-size averaging
+            inv_mass = jnp.where(at_window, welford_variance(wf), inv_mass)
+            wf = jax.tree.map(
+                lambda w0, w: jnp.where(at_window, w0, w), welford_init(d), wf
+            )
+            da = jax.tree.map(
+                lambda a, b: jnp.where(at_window, a, b),
+                da_init(jnp.exp(da.log_step)),
+                da,
+            )
+            return CheesWarmCarry(states, da, adam, log_T, wf, inv_mass), (
+                info.is_divergent,
+            )
+
+        return body
+
+    def warm_segment(carry, keys, us, idxs, aflags, wflags, data=None):
+        potential_fn = fm.bind(data)
+        carry, (div,) = jax.lax.scan(
+            warm_body(potential_fn), carry, (keys, us, idxs, aflags, wflags)
+        )
+        return carry, jnp.sum(div.astype(jnp.int32))
+
+    def finalize(carry: CheesWarmCarry) -> CheesRunCarry:
+        return CheesRunCarry(
+            states=carry.states,
+            log_eps=carry.da.log_avg_step,
+            log_T=carry.log_T,
+            inv_mass=carry.inv_mass,
+        )
+
+    def sample_segment(carry: CheesRunCarry, keys, us, data=None):
+        potential_fn = fm.bind(data)
+
+        def body(c: CheesRunCarry, x):
+            key, u = x
+            # cap at warm_cap, not max_leapfrog: with the u in (0,2)
+            # jitter a larger cap would let sampling run trajectory
+            # lengths warmup never executed
+            states, info = chees_transition(
+                key, c.states, potential_fn, jnp.exp(c.log_eps), c.inv_mass,
+                num_steps(u, c.log_T, c.log_eps, warm_cap),
+                chains_axis=chains_axis,
+            )
+            out = (
+                states.z,
+                info.accept_prob,
+                info.is_divergent,
+                info.num_leapfrog,
+            )
+            return CheesRunCarry(states, c.log_eps, c.log_T, c.inv_mass), out
+
+        return jax.lax.scan(body, carry, (keys, us))
+
+    return CheesParts(
+        init_carry=init_carry,
+        warm_segment=warm_segment,
+        finalize=finalize,
+        sample_segment=sample_segment,
+        warm_cap=warm_cap,
+        schedule=sched,
+    )
+
+
+def chees_init_positions(fm, key, chains, init_params=None):
+    """Shared ensemble init: random typical-set draws, or a jittered
+    user-provided point (identical chains have zero cross-chain variance,
+    which zeroes the ChEES criterion until momentum noise spreads them)."""
+    if init_params is not None:
+        z0 = jnp.broadcast_to(fm.unconstrain(init_params), (chains, fm.ndim))
+        return z0 + 0.1 * jax.random.normal(key, (chains, fm.ndim))
+    return jax.vmap(fm.init_flat)(jax.random.split(key, chains))
+
+
+def run_chees(
+    fm,
+    cfg: SamplerConfig,
+    data=None,
+    *,
+    chains: int,
+    seed: int = 0,
+    init_params: Optional[Dict[str, Any]] = None,
+    dispatch_steps: Optional[int] = None,
+    jit_cache: Optional[Dict[Any, Any]] = None,
+    device: Optional[Any] = None,
+) -> Posterior:
+    """Host driver over `make_chees_parts` — the JaxBackend chees path.
+
+    dispatch_steps: when set, warmup and sampling scans are issued as
+    bounded device programs of at most this many transitions (runtimes
+    that kill long executions — same mechanism as JaxBackend's segmented
+    NUTS/HMC path).  jit_cache: backend-owned dict so repeated runs reuse
+    compiled segments.  device: pins the run (committed inputs steer jit
+    placement), honoring JaxBackend(device=...).
+    """
+    parts = make_chees_parts(fm, cfg)
+    cache = jit_cache if jit_cache is not None else {}
+
+    def put(x):
+        return jax.device_put(x, device) if device is not None else x
+
+    def cached(tag, builder):
+        if tag not in cache:
+            cache[tag] = builder()
+        return cache[tag]
+
+    init_j = cached("chees_init", lambda: jax.jit(parts.init_carry))
+    warm_j = cached("chees_warm", lambda: jax.jit(parts.warm_segment))
+    samp_j = cached("chees_sample", lambda: jax.jit(parts.sample_segment))
+
+    key = jax.random.PRNGKey(seed)
+    key, key_init, key_warm, key_run = jax.random.split(key, 4)
+    z0 = put(chees_init_positions(fm, key_init, chains, init_params))
+
+    total = cfg.num_samples * cfg.thin
+    sched = parts.schedule
+    aflags = put(jnp.asarray(np.asarray(sched.adapt_mass)))
+    wflags = put(jnp.asarray(np.asarray(sched.window_end)))
+    u_warm = put(jnp.asarray(2.0 * halton(cfg.num_warmup), jnp.float32))
+    u_run = put(jnp.asarray(2.0 * halton(total), jnp.float32))
+    warm_keys = put(jax.random.split(key_warm, max(cfg.num_warmup, 1)))
+    idxs = put(jnp.arange(cfg.num_warmup))
+
+    def segments(n):
+        seg = dispatch_steps if dispatch_steps else max(n, 1)
+        return [(s, min(s + seg, n)) for s in range(0, n, seg)]
+
+    carry = jax.block_until_ready(init_j(key_init, z0, data))
+    wdiv_total = 0
+    for lo, hi in segments(cfg.num_warmup):
+        carry, wdiv = jax.block_until_ready(
+            warm_j(
+                carry,
+                warm_keys[lo:hi],
+                u_warm[lo:hi],
+                idxs[lo:hi],
+                aflags[lo:hi],
+                wflags[lo:hi],
+                data,
+            )
+        )
+        wdiv_total += int(wdiv)
+    run_carry = parts.finalize(carry)
+
+    run_keys = put(jax.random.split(key_run, max(total, 1)))
+    outs = []
+    for lo, hi in segments(total):
+        run_carry, out = jax.block_until_ready(
+            samp_j(run_carry, run_keys[lo:hi], u_run[lo:hi], data)
+        )
+        outs.append(jax.tree.map(np.asarray, out))
+    if outs:
+        zs, acc, div, nleap = (
+            np.concatenate([o[i] for o in outs], axis=0) for i in range(4)
+        )
+    else:  # warmup-only run (num_samples=0), like the segmented NUTS path
+        zs = np.zeros((0, chains, fm.ndim), np.float32)
+        acc = np.zeros((0, chains), np.float32)
+        div = np.zeros((0, chains), bool)
+        nleap = np.zeros((0,), np.int32)
+    # divergence count covers ALL transitions (repo convention), thinned-out
+    # included; the kept-draw arrays are thinned below
+    num_divergent = int(div.sum())
+    total_leapfrog = int(nleap.sum())  # over ALL transitions, pre-thinning
+    if cfg.thin > 1:
+        zs = zs[cfg.thin - 1 :: cfg.thin]
+        acc = acc[cfg.thin - 1 :: cfg.thin]
+        div = div[cfg.thin - 1 :: cfg.thin]
+    zs = np.swapaxes(zs, 0, 1)  # (chains, draws, d)
+    draws = _constrain_draws(fm, jnp.asarray(zs))
+    log_eps = float(np.asarray(run_carry.log_eps))
+    stats = {
+        "accept_prob": acc.T,
+        "is_divergent": div.T,
+        # post-warmup only (repo-wide convention); warmup count separate —
+        # warmup divergences are routine while eps is still adapting
+        "num_divergent": np.asarray(num_divergent),
+        "num_warmup_divergent": np.asarray(wdiv_total),
+        # the leapfrog count is the SHARED per-transition length; the
+        # ensemble total is chains x that, matching the per-chain arrays
+        # HMC/NUTS report (cross-sampler grad budgets apples-to-apples)
+        "num_grad_evals": np.asarray(total_leapfrog * chains),
+        "step_size": np.full((chains,), float(np.exp(log_eps))),
+        "traj_length": np.asarray(np.exp(np.asarray(run_carry.log_T))),
+        "inv_mass": np.asarray(run_carry.inv_mass),
+    }
+    return Posterior(draws, stats, flat_model=fm, draws_flat=zs)
 
 
 def chees_sample(
@@ -95,213 +451,31 @@ def chees_sample(
     seed: int = 0,
     init_params: Optional[Dict[str, Any]] = None,
 ) -> Posterior:
-    """Run ChEES-HMC; returns a Posterior (same surface as `sample`).
+    """One-call ChEES-HMC; returns a Posterior (same surface as `sample`).
 
     chains: ChEES adapts from the ensemble — 16+ chains recommended (the
     chains are vmapped on one device; they are cheap on a TPU).
-    dispatch_steps: when set, the warmup and sampling scans are issued as
-    bounded device programs of at most this many transitions (runtimes
-    that kill long executions — same mechanism as JaxBackend).
-    map_init_steps: when > 0, descend each chain toward the mode with
-    this many Adam steps on the potential before warmup.  On peaked
-    big-N posteriors a random unconstrained init is thousands of
-    posterior sds from the mode and warmup burns its whole budget
-    descending; a few hundred fused-gradient Adam steps cost seconds and
-    let warmup adapt in the typical set.  Chains stay distinct (each
-    descends its own init, stopping well short of collapse).
+    Equivalent to ``sample(model, data, kernel="chees", ...)`` through the
+    default JaxBackend; kept as the direct driver for scripts/benchmarks.
     """
+    cfg = SamplerConfig(
+        kernel="chees",
+        num_warmup=num_warmup,
+        num_samples=num_samples,
+        init_step_size=init_step_size,
+        init_traj_length=init_traj_length,
+        max_leapfrog=max_leapfrog,
+        target_accept=target_accept,
+        map_init_steps=map_init_steps,
+    )
     data = prepare_model_data(model, data)
     fm = flatten_model(model)
-    potential_fn = fm.bind(data)
-    d = fm.ndim
-
-    key = jax.random.PRNGKey(seed)
-    key, key_init, key_warm, key_run = jax.random.split(key, 4)
-    if init_params is not None:
-        # jitter: identical chains have zero cross-chain variance, which
-        # zeroes the ChEES criterion until momentum noise spreads them
-        z0 = jnp.broadcast_to(fm.unconstrain(init_params), (chains, d))
-        z0 = z0 + 0.1 * jax.random.normal(key_init, (chains, d))
-    else:
-        z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
-
-    T0 = init_traj_length if init_traj_length is not None else init_step_size
-    # Stan-style doubling windows (shared with the NUTS warmup): the metric
-    # refreshes at EVERY window end, so eps recovers quickly as conditioning
-    # improves and L = T/eps stays bounded.  T ascent starts after the
-    # first metric refresh — adapting T against the un-whitened geometry
-    # chases the condition number and blows trajectories to hundreds of
-    # leapfrogs (measured 5x the whole run's wall-clock).
-    sched = build_warmup_schedule(num_warmup)
-    adapt_mass = jnp.asarray(np.asarray(sched.adapt_mass))
-    window_end = jnp.asarray(np.asarray(sched.window_end))
-    ends = np.flatnonzero(sched.window_end)
-    t_start = int(ends[0]) + 1 if len(ends) else num_warmup // 4
-    # cap warmup trajectories: pre-convergence T estimates are unreliable
-    # and a single bad window must not cost max_leapfrog grads per draw.
-    # 512 leaves headroom for stiff posteriors (the 1M-row flagship needs
-    # L ~ 270; a 128 cap measured R-hat 8.8 where uncapped converged)
-    warm_cap = min(max_leapfrog, 512)
-
-    u_warm = jnp.asarray(2.0 * halton(num_warmup), jnp.float32)
-    u_run = jnp.asarray(2.0 * halton(num_samples), jnp.float32)
-
-    def num_steps(u, log_T, log_eps, cap):
-        L = jnp.ceil(u * jnp.exp(log_T - log_eps)).astype(jnp.int32)
-        return jnp.clip(L, 1, cap)
-
-    def warm_body(carry, x):
-        states, da, adam, log_T, wf, inv_mass = carry
-        key, u, idx, accum, at_window = x
-        log_eps = da.log_step
-        states, info = chees_transition(
-            key, states, potential_fn, jnp.exp(log_eps), inv_mass,
-            num_steps(u, log_T, log_eps, warm_cap),
-        )
-        da = da_update(da, jnp.mean(info.accept_prob), target_accept)
-        # chain rule d/dlogT = T * d/dT on the criterion-relative gradient
-        adam, step = _adam_ascent(
-            adam, info.grad_rel_T * jnp.exp(log_T), lr=0.05
-        )
-        new_log_T = jnp.where(idx >= t_start, log_T + step, log_T)
-        # a single non-finite step must not poison T for the rest of warmup
-        log_T = jnp.where(jnp.isfinite(new_log_T), new_log_T, log_T)
-        # keep T inside the regime warmup actually executes (warm_cap):
-        # letting it ratchet past the executed length would let sampling
-        # run trajectory lengths no warmup step ever validated
-        log_T = jnp.clip(log_T, log_eps, log_eps + jnp.log(float(warm_cap)))
-        wf = jax.tree.map(
-            lambda new, old: jnp.where(accum, new, old),
-            _welford_batch(wf, states.z),
-            wf,
-        )
-        # window end: apply pooled variance as the metric, restart the
-        # accumulator and step-size averaging
-        inv_mass = jnp.where(at_window, welford_variance(wf), inv_mass)
-        wf = jax.tree.map(
-            lambda w0, w: jnp.where(at_window, w0, w), welford_init(d), wf
-        )
-        da = jax.tree.map(
-            lambda a, b: jnp.where(at_window, a, b),
-            da_init(jnp.exp(da.log_step)),
-            da,
-        )
-        return (states, da, adam, log_T, wf, inv_mass), (
-            info.accept_prob.mean(),
-            info.is_divergent,
-        )
-
-    def sample_body(carry, x):
-        states, log_eps, log_T, inv_mass = carry
-        key, u = x
-        # cap at warm_cap, not max_leapfrog: with the u in (0,2) jitter a
-        # larger cap would let sampling run trajectory lengths warmup never
-        # executed (T itself is clipped to warm_cap, but 2x jitter is not)
-        states, info = chees_transition(
-            key, states, potential_fn, jnp.exp(log_eps), inv_mass,
-            num_steps(u, log_T, log_eps, warm_cap),
-        )
-        out = (
-            states.z,
-            info.accept_prob,
-            info.is_divergent,
-            info.num_leapfrog,
-        )
-        return (states, log_eps, log_T, inv_mass), out
-
-    warm_seg = jax.jit(
-        lambda carry, xs: jax.lax.scan(warm_body, carry, xs)
+    return run_chees(
+        fm,
+        cfg,
+        data,
+        chains=chains,
+        seed=seed,
+        init_params=init_params,
+        dispatch_steps=dispatch_steps,
     )
-    sample_seg = jax.jit(
-        lambda carry, xs: jax.lax.scan(sample_body, carry, xs)
-    )
-
-    def segments(total):
-        seg = dispatch_steps if dispatch_steps else total
-        starts = list(range(0, total, seg))
-        return [(s, min(s + seg, total)) for s in starts]
-
-    if map_init_steps > 0:
-        vg_pot = jax.vmap(value_and_grad_of(potential_fn))
-
-        def adam_body(carry, _):
-            z, adam = carry
-            _, g = vg_pot(z)
-            g = jnp.where(jnp.isfinite(g), g, 0.0)
-            # descend: ascent on -grad
-            adam, step = _adam_ascent(adam, -g, lr=0.05, b2=0.999)
-            return (z + step, adam), None
-
-        (z0, _), _ = jax.jit(
-            lambda z: jax.lax.scan(
-                adam_body,
-                (
-                    z,
-                    AdamState(
-                        jnp.zeros_like(z),
-                        jnp.zeros_like(z),
-                        jnp.zeros((), jnp.int32),
-                    ),
-                ),
-                None,
-                length=map_init_steps,
-            )
-        )(z0)
-
-    warm_keys = jax.random.split(key_warm, num_warmup)
-    idxs = jnp.arange(num_warmup)
-    carry = (
-        init_ensemble(potential_fn, z0),
-        da_init(jnp.asarray(init_step_size)),
-        AdamState(jnp.zeros(()), jnp.zeros(()), jnp.zeros((), jnp.int32)),
-        jnp.log(jnp.asarray(T0)),
-        welford_init(d),
-        jnp.ones((d,)),
-    )
-    wdiv_total = 0
-    for lo, hi in segments(num_warmup):
-        carry, (_, wdiv) = jax.block_until_ready(
-            warm_seg(
-                carry,
-                (
-                    warm_keys[lo:hi],
-                    u_warm[lo:hi],
-                    idxs[lo:hi],
-                    adapt_mass[lo:hi],
-                    window_end[lo:hi],
-                ),
-            )
-        )
-        wdiv_total += int(np.sum(np.asarray(wdiv)))
-    states, da, _, log_T, _, inv_mass = carry
-    log_eps = da.log_avg_step
-
-    run_keys = jax.random.split(key_run, num_samples)
-    carry = (states, log_eps, log_T, inv_mass)
-    outs = []
-    for lo, hi in segments(num_samples):
-        carry, out = jax.block_until_ready(
-            sample_seg(carry, (run_keys[lo:hi], u_run[lo:hi]))
-        )
-        outs.append(jax.tree.map(np.asarray, out))
-    zs, acc, div, nleap = (
-        np.concatenate([o[i] for o in outs], axis=0) for i in range(4)
-    )
-    zs = np.swapaxes(zs, 0, 1)  # (chains, draws, d)
-    draws = _constrain_draws(fm, jnp.asarray(zs))
-    stats = {
-        "accept_prob": acc.T,
-        "is_divergent": div.T,
-        # post-warmup only (repo-wide convention); warmup count separate —
-        # warmup divergences are routine while eps is still adapting
-        "num_divergent": np.asarray(int(div.sum())),
-        "num_warmup_divergent": np.asarray(wdiv_total),
-        # nleap is the SHARED per-transition length; the ensemble total is
-        # chains x that, matching the per-chain arrays HMC/NUTS report (so
-        # cross-sampler gradient-budget comparisons are apples-to-apples)
-        "num_grad_evals": np.asarray(int(nleap.sum()) * chains),
-        "step_size": np.full((chains,), float(np.exp(log_eps))),
-        "traj_length": np.asarray(np.exp(log_T)),
-        "inv_mass": np.asarray(inv_mass),
-    }
-    return Posterior(draws, stats, flat_model=fm, draws_flat=zs)

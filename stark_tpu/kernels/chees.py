@@ -17,12 +17,13 @@ transition (static cost, perfect for vmap/MXU pipelining), and that
 number is *learned* instead of being a worst-case tree budget.
 
 This module is the per-ensemble transition; cross-chain reductions are
-plain means over the leading chains axis — free inside one device, which
-is where the ensemble lives: the chain-batched fused kernel makes the
-marginal chain ~0.25 ms at C=64, so a single chip comfortably hosts the
-whole ensemble.  (Sharding chains over a mesh axis would turn these
-reductions into psums under shard_map; not implemented — data sharding
-is the axis that needs the mesh.)
+means over the leading chains axis — free inside one device, which is
+where the ensemble usually lives (the chain-batched fused kernel makes
+the marginal chain ~0.25 ms at C=64).  When the ensemble IS sharded over
+a mesh axis (``chains_axis=``), every cross-chain reduction becomes the
+matching XLA collective (pmean/psum/pmax over the axis) so the adapted
+step size, trajectory length, and mass matrix stay bit-identical on
+every device — the shard_map path in `parallel/mesh.py:run_chees_sharded`.
 """
 
 from __future__ import annotations
@@ -78,20 +79,44 @@ def dynamic_leapfrog(
     return jax.lax.fori_loop(0, num_steps, body, (z, r, grad, pe0))
 
 
+def _cmean(x: Array, chains_axis):
+    """Mean over the chain ensemble: local mean, pmean'd across the mesh
+    axis when the ensemble is sharded (equal local counts per device)."""
+    m = jnp.mean(x, axis=0)
+    return jax.lax.pmean(m, chains_axis) if chains_axis else m
+
+
+def _csum(x, chains_axis):
+    s = jnp.sum(x)
+    return jax.lax.psum(s, chains_axis) if chains_axis else s
+
+
+def _cmax(x, chains_axis):
+    m = jnp.max(x)
+    return jax.lax.pmax(m, chains_axis) if chains_axis else m
+
+
 def chees_transition(
     key: Array,
-    states: HMCState,  # leading axis (C,): the chain ensemble
+    states: HMCState,  # leading axis (C,): the chain ensemble (local shard)
     potential_fn: PotentialFn,  # single-chain potential (vmapped here)
     step_size: Array,
     inv_mass_diag: Array,  # (d,)
     num_leapfrog: Array,  # traced scalar int — shared by all chains
+    chains_axis=None,  # mesh axis name when the ensemble is sharded
 ):
     """One ensemble transition; returns (states, CheesInfo).
 
     The ChEES gradient w.r.t. log T is estimated from the proposals'
     end-velocities (Hoffman et al. eq. 6), weighted by accept prob.
+    With ``chains_axis`` set, cross-chain statistics are reduced with XLA
+    collectives so every device derives identical adaptation signals.
     """
     C = states.z.shape[0]
+    if chains_axis is not None:
+        # each device must draw DISTINCT momenta for its local chains — a
+        # replicated key would clone the ensemble across shards
+        key = jax.random.fold_in(key, jax.lax.axis_index(chains_axis))
     key_mom, key_acc = jax.random.split(key)
     r0 = jax.vmap(sample_momentum, in_axes=(0, None))(
         jax.random.split(key_mom, C), inv_mass_diag
@@ -127,8 +152,8 @@ def chees_transition(
     # (raw gradients span orders of magnitude across targets and warmup
     # phases, which starves Adam's normalizer; measured on hier-logistic:
     # raw gradient left T frozen, the relative form adapts in ~100 steps).
-    mu0 = jnp.mean(states.z, axis=0)
-    mu1 = jnp.mean(z1, axis=0)
+    mu0 = _cmean(states.z, chains_axis)
+    mu1 = _cmean(z1, chains_axis)
     d0 = jnp.sum((states.z - mu0) ** 2, axis=-1)
     d1 = jnp.sum((z1 - mu1) ** 2, axis=-1)
     half_gain = 0.5 * (d1 - d0)  # (C,)
@@ -140,12 +165,16 @@ def chees_transition(
     # early warmup on peaked posteriors the raw squares overflow float32
     # (measured on the 1M-row flagship: crit -> inf, grad -> NaN, T
     # poisoned for the rest of the run)
-    ch = jnp.maximum(jnp.max(jnp.where(w > 0, jnp.abs(half_gain), 0.0)), 1e-20)
-    ct = jnp.maximum(jnp.max(jnp.where(w > 0, jnp.abs(dir_term), 0.0)), 1e-20)
+    ch = jnp.maximum(
+        _cmax(jnp.where(w > 0, jnp.abs(half_gain), 0.0), chains_axis), 1e-20
+    )
+    ct = jnp.maximum(
+        _cmax(jnp.where(w > 0, jnp.abs(dir_term), 0.0), chains_axis), 1e-20
+    )
     h = jnp.where(jnp.isfinite(half_gain), half_gain / ch, 0.0)
     t = jnp.where(jnp.isfinite(dir_term), dir_term / ct, 0.0)
-    num = jnp.sum(w * h * t)
-    crit = jnp.sum(w * h * h)
+    num = _csum(w * h * t, chains_axis)
+    crit = _csum(w * h * h, chains_axis)
     grad_rel_T = jnp.where(
         crit > 1e-10, (num / jnp.maximum(crit, 1e-10)) * (ct / ch), 0.0
     )
@@ -167,14 +196,16 @@ def init_ensemble(potential_fn: PotentialFn, z: Array) -> HMCState:
     return HMCState(z=z, potential_energy=pe, grad=grad)
 
 
-def halton(n: int, base: int = 2):
-    """First n Halton-sequence points in (0,1) — the low-discrepancy
-    trajectory jitter used during sampling (host-side, feeds the scan)."""
+def halton(n: int, base: int = 2, start: int = 0):
+    """Halton-sequence points ``start..start+n-1`` in (0,1) — the
+    low-discrepancy trajectory jitter (host-side, feeds the scan).  The
+    ``start`` offset lets a resumed/segmented run continue the SAME
+    sequence instead of replaying it from the beginning."""
     import numpy as np
 
     out = np.zeros(n)
     for i in range(n):
-        f, r, idx = 1.0, 0.0, i + 1
+        f, r, idx = 1.0, 0.0, start + i + 1
         while idx > 0:
             f /= base
             r += f * (idx % base)

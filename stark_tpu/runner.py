@@ -92,13 +92,28 @@ def sample_until_converged(
     fm = flatten_model(model)
     data = prepare_model_data(model, data)
 
-    block_run = make_block_runner(fm, cfg, block_size)
-    v_block = jax.jit(jax.vmap(block_run, in_axes=(0, 0, 0, 0, None)))
+    is_chees = cfg.kernel == "chees"
+    if is_chees:
+        # ensemble kernel: blocks advance the whole ensemble through
+        # chees sample segments (frozen adaptation), checkpointed as a
+        # CheesRunCarry — same block/checkpoint/metrics protocol as the
+        # per-chain kernels below
+        from .chees import chees_init_positions, make_chees_parts
+        from .kernels.chees import halton
 
-    # warmup runs as block_size-bounded dispatches too (same device-program
-    # length cap as the draw blocks; the monolithic warmup faulted the axon
-    # tunnel at benchmark scale) — shared driver with the segmented backend
-    seg_warmup = make_segmented_warmup(fm, cfg)
+        parts = make_chees_parts(fm, cfg)
+        chees_init_j = jax.jit(parts.init_carry)
+        chees_warm_j = jax.jit(parts.warm_segment)
+        chees_samp_j = jax.jit(parts.sample_segment)
+    else:
+        block_run = make_block_runner(fm, cfg, block_size)
+        v_block = jax.jit(jax.vmap(block_run, in_axes=(0, 0, 0, 0, None)))
+
+        # warmup runs as block_size-bounded dispatches too (same
+        # device-program length cap as the draw blocks; the monolithic
+        # warmup faulted the axon tunnel at benchmark scale) — shared
+        # driver with the segmented backend
+        seg_warmup = make_segmented_warmup(fm, cfg)
 
     t_start = time.perf_counter()
     metrics_f = open(metrics_path, "a") if metrics_path else None
@@ -116,6 +131,19 @@ def sample_until_converged(
         from .checkpoint import load_checkpoint
 
         arrays, meta = load_checkpoint(resume_from)
+        ckpt_kernel = meta.get("kernel")
+        if ckpt_kernel is None and is_chees:
+            # legacy checkpoints (pre-kernel field) were only ever written
+            # by the per-chain kernels; they lack the chees carry arrays
+            raise ValueError(
+                "checkpoint has no kernel record (pre-chees format); "
+                "cannot resume it with kernel='chees'"
+            )
+        if ckpt_kernel is not None and ckpt_kernel != cfg.kernel:
+            raise ValueError(
+                f"checkpoint was written by kernel={ckpt_kernel!r}, "
+                f"resuming run uses kernel={cfg.kernel!r}"
+            )
         state = HMCState(
             z=jnp.asarray(arrays["z"]),
             potential_energy=jnp.asarray(arrays["pe"]),
@@ -124,6 +152,15 @@ def sample_until_converged(
         step_size = jnp.asarray(arrays["step_size"])
         inv_mass = jnp.asarray(arrays["inv_mass"])
         key = jnp.asarray(arrays["key"])
+        if is_chees:
+            from .chees import CheesRunCarry
+
+            run_carry = CheesRunCarry(
+                states=state,
+                log_eps=jnp.asarray(arrays["log_eps"]),
+                log_T=jnp.asarray(arrays["log_T"]),
+                inv_mass=inv_mass,
+            )
         if reseed is not None:
             # a deterministic numerical failure would otherwise replay
             # identically from the checkpointed key on every retry — the
@@ -156,14 +193,41 @@ def sample_until_converged(
     else:
         key = jax.random.PRNGKey(seed)
         key, key_init, key_warm = jax.random.split(key, 3)
-        if init_params is not None:
-            z0 = jnp.broadcast_to(fm.unconstrain(init_params), (chains, fm.ndim))
+        if is_chees:
+            z0 = chees_init_positions(fm, key_init, chains, init_params)
+            carry = jax.block_until_ready(chees_init_j(key_init, z0, data))
+            sched = parts.schedule
+            aflags = jnp.asarray(np.asarray(sched.adapt_mass))
+            wflags = jnp.asarray(np.asarray(sched.window_end))
+            u_warm = jnp.asarray(2.0 * halton(cfg.num_warmup), jnp.float32)
+            wkeys = jax.random.split(key_warm, max(cfg.num_warmup, 1))
+            idxs = jnp.arange(cfg.num_warmup)
+            n_div = 0
+            # warmup dispatches bounded by block_size, like the draw blocks
+            for s in range(0, cfg.num_warmup, block_size):
+                e = min(s + block_size, cfg.num_warmup)
+                carry, nd = jax.block_until_ready(
+                    chees_warm_j(
+                        carry, wkeys[s:e], u_warm[s:e], idxs[s:e],
+                        aflags[s:e], wflags[s:e], data,
+                    )
+                )
+                n_div += int(nd)
+            run_carry = parts.finalize(carry)
+            state = run_carry.states
+            step_size = jnp.exp(run_carry.log_eps)
+            inv_mass = run_carry.inv_mass
         else:
-            z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
-        warm_keys = jax.random.split(key_warm, chains)
-        state, step_size, inv_mass, n_div = seg_warmup(
-            warm_keys, z0, data, block_size
-        )
+            if init_params is not None:
+                z0 = jnp.broadcast_to(
+                    fm.unconstrain(init_params), (chains, fm.ndim)
+                )
+            else:
+                z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
+            warm_keys = jax.random.split(key_warm, chains)
+            state, step_size, inv_mass, n_div = seg_warmup(
+                warm_keys, z0, data, block_size
+            )
         emit(
             {
                 "event": "warmup_done",
@@ -187,19 +251,41 @@ def sample_until_converged(
 
             draw_store = DrawStore(draw_store_path, chains, fm.ndim)
 
+        def advance_block(key_block):
+            """One draw block; returns (zs (chains, block, d), accept,
+            divergent) and refreshes state/step_size/inv_mass."""
+            nonlocal state, step_size, inv_mass
+            if is_chees:
+                nonlocal run_carry
+                # Halton jitter continues the global sampling sequence
+                # (draws already taken = suff.count), so a resumed or
+                # blocked run walks the SAME low-discrepancy stream
+                us = jnp.asarray(
+                    2.0 * halton(block_size, start=int(suff.count[0])),
+                    jnp.float32,
+                )
+                bkeys = jax.random.split(key_block, block_size)
+                run_carry, (zs, accept, divergent, _) = jax.block_until_ready(
+                    chees_samp_j(run_carry, bkeys, us, data)
+                )
+                state = run_carry.states
+                step_size = jnp.exp(run_carry.log_eps)
+                inv_mass = run_carry.inv_mass
+                return np.asarray(zs).transpose(1, 0, 2), accept, divergent
+            block_keys = jax.random.split(key_block, chains)
+            out = jax.block_until_ready(
+                v_block(block_keys, state, step_size, inv_mass, data)
+            )
+            state, zs, accept, divergent, _energy, _ngrad = out
+            return np.asarray(zs), accept, divergent
+
         while blocks_done < max_blocks:
             key, key_block = jax.random.split(key)
-            block_keys = jax.random.split(key_block, chains)
             if profile_dir and blocks_done == 0:
                 with jax.profiler.trace(profile_dir):
-                    out = jax.block_until_ready(
-                        v_block(block_keys, state, step_size, inv_mass, data)
-                    )
+                    zs, accept, divergent = advance_block(key_block)
             else:
-                out = jax.block_until_ready(
-                    v_block(block_keys, state, step_size, inv_mass, data)
-                )
-            state, zs, accept, divergent, energy, ngrad = out
+                zs, accept, divergent = advance_block(key_block)
             if health_check:
                 # poisoned state must never reach the checkpoint; the
                 # supervisor (supervise.supervised_sample) restarts from
@@ -237,14 +323,22 @@ def sample_until_converged(
             k = min(diag_components, fm.ndim)
             worst = np.argsort(np.where(np.isnan(srhat), -np.inf, -srhat))[:k]
             subset = np.concatenate([b[:, :, worst] for b in draw_blocks], axis=1)
-            min_ess = float(np.min(diagnostics.ess(subset)))
+            ess_vals = diagnostics.ess(subset)
+            finite_ess = ess_vals[np.isfinite(ess_vals)]
+            # NaN ESS values (stuck components) are excluded from the
+            # reported minimum — num_stuck_components carries that signal;
+            # the all-NaN edge gives NaN, which fails the stop gate below
+            min_ess = (
+                float(np.min(finite_ess)) if finite_ess.size else float("nan")
+            )
             draws_per_chain = int(suff.count[0])
             rec = {
                 "event": "block",
                 "block": blocks_done,
                 "draws_per_chain": draws_per_chain,
-                "max_rhat": max_rhat,
-                "min_ess": min_ess,
+                # metrics must stay strict JSON: non-finite values -> null
+                "max_rhat": max_rhat if np.isfinite(max_rhat) else None,
+                "min_ess": min_ess if np.isfinite(min_ess) else None,
                 "num_stuck_components": n_stuck,
                 "num_divergent": total_div,
                 "mean_accept": float(np.mean(np.asarray(accept))),
@@ -282,6 +376,9 @@ def sample_until_converged(
                     "inv_mass": np.asarray(inv_mass),
                     "key": np.asarray(key),
                 }
+                if is_chees:
+                    arrays["log_eps"] = np.asarray(run_carry.log_eps)
+                    arrays["log_T"] = np.asarray(run_carry.log_T)
                 if draw_store is None:
                     # no draw store -> draws ride in the checkpoint; with a
                     # store the draws are already persisted incrementally
@@ -301,6 +398,7 @@ def sample_until_converged(
                         "num_divergent": total_div,
                         "history": history,
                         "model": type(model).__name__,
+                        "kernel": cfg.kernel,
                     },
                 )
 

@@ -39,7 +39,7 @@ Array = jax.Array
 
 @dataclasses.dataclass(frozen=True)
 class SamplerConfig:
-    kernel: str = "nuts"  # "nuts" | "hmc"
+    kernel: str = "nuts"  # "nuts" | "hmc" | "chees"
     num_warmup: int = 1000
     num_samples: int = 1000
     thin: int = 1
@@ -49,6 +49,11 @@ class SamplerConfig:
     init_step_size: float = 1.0
     adapt_step_size: bool = True
     adapt_mass: bool = True
+    # chees only (ensemble sampler — served by the backends via
+    # `chees.make_chees_parts`, not by the per-chain vmapped runner):
+    init_traj_length: Optional[float] = None
+    max_leapfrog: int = 1000
+    map_init_steps: int = 0
 
 
 def _tree_select(flag, a, b):
@@ -61,6 +66,11 @@ def make_kernel(cfg: SamplerConfig) -> Callable:
         return partial(nuts_step, max_depth=cfg.max_tree_depth)
     if cfg.kernel == "hmc":
         return partial(hmc_step, num_leapfrog=cfg.num_leapfrog)
+    if cfg.kernel == "chees":
+        raise ValueError(
+            "chees is an ensemble kernel with its own warmup; backends route "
+            "it through chees.make_chees_parts, not the per-chain runner"
+        )
     raise ValueError(f"unknown kernel {cfg.kernel!r}")
 
 

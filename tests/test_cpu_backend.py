@@ -76,3 +76,46 @@ def test_cpu_backend_hmc_kernel():
         num_samples=200, seed=3,
     )
     assert np.all(np.isfinite(post.draws["mu"]))
+
+
+def test_cpu_backend_chees_kernel_matches_analytic_posterior():
+    """kernel="chees" on the host reference: Halton-jittered fixed-length
+    HMC — the ChEES sampling-phase transition family — must hit the same
+    analytic posterior, making it a distribution-level oracle for the
+    device ChEES path."""
+    y = np.asarray(2.0 + np.random.default_rng(4).standard_normal(32), np.float32)
+    data = {"y": jnp.asarray(y)}
+    post = stark_tpu.sample(
+        ConjugateNormal(), data, backend=CpuBackend(), chains=2,
+        kernel="chees", num_leapfrog=8, num_warmup=150, num_samples=250,
+        init_step_size=0.1, seed=0,
+    )
+    mu_true, var_true = _true_posterior(y)
+    draws = post.draws["mu"]
+    assert abs(draws.mean() - mu_true) < 4 * np.sqrt(var_true / draws.size)
+    assert 0.5 * var_true < draws.var() < 1.8 * var_true
+    assert post.max_rhat() < 1.05
+
+
+def test_chees_cpu_and_jax_backends_agree():
+    """Same posterior through the SamplerBackend boundary: host-driven
+    jittered-HMC reference vs the compiled ensemble ChEES sampler."""
+    y = np.asarray(1.0 + 0.5 * np.random.default_rng(5).standard_normal(24), np.float32)
+    data = {"y": jnp.asarray(y)}
+    post_cpu = stark_tpu.sample(
+        ConjugateNormal(), data, backend=CpuBackend(), chains=2,
+        kernel="chees", num_leapfrog=8, num_warmup=150, num_samples=250,
+        init_step_size=0.1, seed=0,
+    )
+    post_jax = stark_tpu.sample(
+        ConjugateNormal(), data, chains=8, kernel="chees",
+        num_warmup=300, num_samples=300, init_step_size=0.1, seed=0,
+    )
+    mu_true, var_true = _true_posterior(y)
+    se = np.sqrt(var_true / 500)
+    assert abs(post_cpu.draws["mu"].mean() - mu_true) < 5 * se
+    assert abs(post_jax.draws["mu"].mean() - mu_true) < 5 * se
+    assert (
+        abs(post_cpu.draws["mu"].std() - post_jax.draws["mu"].std())
+        < 0.3 * np.sqrt(var_true)
+    )
