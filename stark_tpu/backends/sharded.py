@@ -38,10 +38,14 @@ class ShardedBackend:
     "data" axis size.
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None):
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 dispatch_steps: Optional[int] = None):
         self.mesh = mesh if mesh is not None else make_mesh()
         if "data" not in self.mesh.axis_names or "chains" not in self.mesh.axis_names:
             raise ValueError("mesh must have axes ('data', 'chains')")
+        # bounded device programs for runtimes that cap execution wall-clock
+        # (chees path only for now; the per-chain runner is monolithic)
+        self.dispatch_steps = dispatch_steps
         self._cache: Dict[Tuple[int, SamplerConfig, Any], Any] = {}
 
     def _get_runner(self, model: Model, fm, cfg: SamplerConfig, data, row_axes):
@@ -100,6 +104,13 @@ class ShardedBackend:
             else:
                 data = shard_data(data, self.mesh, "data", row_axes=row_axes)
 
+        if cfg.kernel == "chees":
+            return self._run_chees(
+                model, fm, cfg, data, row_axes,
+                chains=chains, seed=seed, init_params=init_params,
+                multiproc=multiproc,
+            )
+
         key = jax.random.PRNGKey(seed)
         key_init, key_run = jax.random.split(key)
         if init_params is not None:
@@ -108,21 +119,9 @@ class ShardedBackend:
             z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
         chain_keys = jax.random.split(key_run, chains)
 
-        chain_sharding = NamedSharding(self.mesh, P("chains"))
-        if multiproc:
-            # every process computed the full (identical, same-seed) z0/keys;
-            # each contributes just its addressable shards
-            def to_global(x):
-                x = np.asarray(x)
-                return jax.make_array_from_callback(
-                    x.shape, chain_sharding, lambda idx: x[idx]
-                )
-
-            z0 = to_global(z0)
-            chain_keys = to_global(chain_keys)
-        else:
-            z0 = jax.device_put(z0, chain_sharding)
-            chain_keys = jax.device_put(chain_keys, chain_sharding)
+        put_chains = self._chain_placer(multiproc)
+        z0 = put_chains(z0)
+        chain_keys = put_chains(chain_keys)
 
         run = self._get_runner(model, fm, cfg, data, row_axes)
         if data is None:
@@ -149,3 +148,117 @@ class ShardedBackend:
             "num_divergent": np.asarray(res.num_divergent),
         }
         return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(res.draws))
+
+    def _chain_placer(self, multiproc: bool):
+        """Place a host-computed (chains, ...) array over the "chains" axis.
+
+        Multiproc: every process computed the full (identical, same-seed)
+        array; each contributes just its addressable shards.
+        """
+        chain_sharding = NamedSharding(self.mesh, P("chains"))
+        if not multiproc:
+            return lambda x: jax.device_put(x, chain_sharding)
+
+        def to_global(x):
+            x = np.asarray(x)
+            return jax.make_array_from_callback(
+                x.shape, chain_sharding, lambda idx: x[idx]
+            )
+
+        return to_global
+
+    def _run_chees(
+        self, model, fm, cfg, data, row_axes, *, chains, seed, init_params,
+        multiproc,
+    ):
+        """kernel="chees" over the mesh: the ensemble is sharded over
+        "chains", the dataset over "data" (per-shard likelihood psum'd
+        inside the potential — model.py's packed single-psum path), and the
+        cross-chain adaptation statistics reduce with collectives
+        (chains_axis in kernels/chees.py), so every device advances its
+        chain slice in lockstep with identical eps / T / mass.
+        """
+        from ..adaptation import DualAveragingState, WelfordState
+        from ..chees import (
+            AdamState,
+            CheesRunCarry,
+            CheesWarmCarry,
+            drive_chees_segments,
+            make_chees_parts,
+        )
+        from ..distributed import gather_draws
+        from ..kernels.base import HMCState
+
+        mesh = self.mesh
+        parts = make_chees_parts(fm, cfg, chains_axis="chains")
+
+        S, R = P("chains"), P()
+        state_spec = HMCState(z=S, potential_energy=S, grad=S)
+        warm_spec = CheesWarmCarry(
+            states=state_spec,
+            da=DualAveragingState(R, R, R, R, R),
+            adam=AdamState(R, R, R),
+            log_T=R,
+            wf=WelfordState(R, R, R),
+            inv_mass=R,
+        )
+        run_spec = CheesRunCarry(
+            states=state_spec, log_eps=R, log_T=R, inv_mass=R
+        )
+        out_spec = (P(None, "chains"), P(None, "chains"), P(None, "chains"), R)
+        data_specs = (
+            row_partition_specs(data, "data", row_axes)
+            if data is not None
+            else None
+        )
+
+        def smap(fn, in_specs, out_specs):
+            if data is None:
+                return jax.jit(
+                    shard_map(
+                        lambda *a: fn(*a, None), mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_vma=False,
+                    )
+                )
+            return jax.jit(
+                shard_map(
+                    fn, mesh=mesh, in_specs=in_specs + (data_specs,),
+                    out_specs=out_specs, check_vma=False,
+                )
+            )
+
+        cache_key = (
+            model, cfg, "chees",
+            None if data is None else jax.tree.structure(data),
+        )
+        if cache_key not in self._cache:
+            self._cache[cache_key] = (
+                smap(parts.init_carry, (R, S), warm_spec),
+                smap(
+                    parts.warm_segment, (warm_spec, R, R, R, R, R),
+                    (warm_spec, R),
+                ),
+                smap(parts.sample_segment, (run_spec, R, R), (run_spec, out_spec)),
+            )
+        init_j, warm_j, samp_j = self._cache[cache_key]
+
+        # shared schedule driver (chees.drive_chees_segments): only
+        # placement (chains-sharded z0), the shard_mapped segments, and
+        # draw collection (allgather on pods — the Posterior's replicated
+        # carry leaves materialize on every host without one) differ from
+        # the single-device path
+        return drive_chees_segments(
+            parts,
+            fm,
+            cfg,
+            chains=chains,
+            seed=seed,
+            init_params=init_params,
+            dispatch_steps=self.dispatch_steps,
+            init_j=init_j,
+            warm_j=warm_j,
+            samp_j=samp_j,
+            extra=() if data is None else (data,),
+            put_z0=self._chain_placer(multiproc),
+            collect=gather_draws,
+        )

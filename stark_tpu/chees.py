@@ -267,7 +267,11 @@ def make_chees_parts(
         carry, (div,) = jax.lax.scan(
             warm_body(potential_fn), carry, (keys, us, idxs, aflags, wflags)
         )
-        return carry, jnp.sum(div.astype(jnp.int32))
+        n_div = jnp.sum(div.astype(jnp.int32))
+        if chains_axis is not None:
+            # global count: the host reads one replicated scalar
+            n_div = jax.lax.psum(n_div, chains_axis)
+        return carry, n_div
 
     def finalize(carry: CheesWarmCarry) -> CheesRunCarry:
         return CheesRunCarry(
@@ -320,6 +324,76 @@ def chees_init_positions(fm, key, chains, init_params=None):
     return jax.vmap(fm.init_flat)(jax.random.split(key, chains))
 
 
+def drive_chees_segments(
+    parts: CheesParts,
+    fm,
+    cfg: SamplerConfig,
+    *,
+    chains: int,
+    seed: int,
+    init_params,
+    dispatch_steps: Optional[int],
+    init_j,
+    warm_j,
+    samp_j,
+    extra: tuple,
+    put_z0=lambda x: x,
+    put_aux=lambda x: x,
+    collect=lambda out: jax.tree.map(np.asarray, out),
+) -> Posterior:
+    """The ONE host-side schedule driver over chees parts.
+
+    Both the single-device path (`run_chees`) and the mesh path
+    (`ShardedBackend._run_chees`) drive the same warmup/sampling schedule
+    through this function — only placement (`put_z0`/`put_aux`), the
+    jitted/shard_mapped segment callables, the trailing data args
+    (``extra``), and draw collection (``collect``; allgather on pods)
+    differ — so the two paths cannot drift.
+    """
+    key = jax.random.PRNGKey(seed)
+    key, key_init, key_warm, key_run = jax.random.split(key, 4)
+    z0 = put_z0(chees_init_positions(fm, key_init, chains, init_params))
+
+    total = cfg.num_samples * cfg.thin
+    sched = parts.schedule
+    aflags = put_aux(jnp.asarray(np.asarray(sched.adapt_mass)))
+    wflags = put_aux(jnp.asarray(np.asarray(sched.window_end)))
+    u_warm = put_aux(jnp.asarray(2.0 * halton(cfg.num_warmup), jnp.float32))
+    u_run = put_aux(jnp.asarray(2.0 * halton(total), jnp.float32))
+    warm_keys = put_aux(jax.random.split(key_warm, max(cfg.num_warmup, 1)))
+    run_keys = put_aux(jax.random.split(key_run, max(total, 1)))
+    idxs = put_aux(jnp.arange(cfg.num_warmup))
+
+    def segments(n):
+        seg = dispatch_steps if dispatch_steps else max(n, 1)
+        return [(s, min(s + seg, n)) for s in range(0, n, seg)]
+
+    carry = jax.block_until_ready(init_j(key_init, z0, *extra))
+    wdiv_total = 0
+    for lo, hi in segments(cfg.num_warmup):
+        carry, wdiv = jax.block_until_ready(
+            warm_j(
+                carry,
+                warm_keys[lo:hi],
+                u_warm[lo:hi],
+                idxs[lo:hi],
+                aflags[lo:hi],
+                wflags[lo:hi],
+                *extra,
+            )
+        )
+        wdiv_total += int(np.asarray(wdiv))
+    run_carry = parts.finalize(carry)
+
+    outs = []
+    for lo, hi in segments(total):
+        run_carry, out = jax.block_until_ready(
+            samp_j(run_carry, run_keys[lo:hi], u_run[lo:hi], *extra)
+        )
+        outs.append(collect(out))
+    return assemble_chees_posterior(fm, cfg, chains, outs, run_carry, wdiv_total)
+
+
 def run_chees(
     fm,
     cfg: SamplerConfig,
@@ -332,7 +406,7 @@ def run_chees(
     jit_cache: Optional[Dict[Any, Any]] = None,
     device: Optional[Any] = None,
 ) -> Posterior:
-    """Host driver over `make_chees_parts` — the JaxBackend chees path.
+    """Single-device chees path (JaxBackend): jitted parts + shared driver.
 
     dispatch_steps: when set, warmup and sampling scans are issued as
     bounded device programs of at most this many transitions (runtimes
@@ -352,51 +426,29 @@ def run_chees(
             cache[tag] = builder()
         return cache[tag]
 
-    init_j = cached("chees_init", lambda: jax.jit(parts.init_carry))
-    warm_j = cached("chees_warm", lambda: jax.jit(parts.warm_segment))
-    samp_j = cached("chees_sample", lambda: jax.jit(parts.sample_segment))
+    return drive_chees_segments(
+        parts,
+        fm,
+        cfg,
+        chains=chains,
+        seed=seed,
+        init_params=init_params,
+        dispatch_steps=dispatch_steps,
+        init_j=cached("chees_init", lambda: jax.jit(parts.init_carry)),
+        warm_j=cached("chees_warm", lambda: jax.jit(parts.warm_segment)),
+        samp_j=cached("chees_sample", lambda: jax.jit(parts.sample_segment)),
+        extra=(data,),
+        put_z0=put,
+        put_aux=put,
+    )
 
-    key = jax.random.PRNGKey(seed)
-    key, key_init, key_warm, key_run = jax.random.split(key, 4)
-    z0 = put(chees_init_positions(fm, key_init, chains, init_params))
 
-    total = cfg.num_samples * cfg.thin
-    sched = parts.schedule
-    aflags = put(jnp.asarray(np.asarray(sched.adapt_mass)))
-    wflags = put(jnp.asarray(np.asarray(sched.window_end)))
-    u_warm = put(jnp.asarray(2.0 * halton(cfg.num_warmup), jnp.float32))
-    u_run = put(jnp.asarray(2.0 * halton(total), jnp.float32))
-    warm_keys = put(jax.random.split(key_warm, max(cfg.num_warmup, 1)))
-    idxs = put(jnp.arange(cfg.num_warmup))
-
-    def segments(n):
-        seg = dispatch_steps if dispatch_steps else max(n, 1)
-        return [(s, min(s + seg, n)) for s in range(0, n, seg)]
-
-    carry = jax.block_until_ready(init_j(key_init, z0, data))
-    wdiv_total = 0
-    for lo, hi in segments(cfg.num_warmup):
-        carry, wdiv = jax.block_until_ready(
-            warm_j(
-                carry,
-                warm_keys[lo:hi],
-                u_warm[lo:hi],
-                idxs[lo:hi],
-                aflags[lo:hi],
-                wflags[lo:hi],
-                data,
-            )
-        )
-        wdiv_total += int(wdiv)
-    run_carry = parts.finalize(carry)
-
-    run_keys = put(jax.random.split(key_run, max(total, 1)))
-    outs = []
-    for lo, hi in segments(total):
-        run_carry, out = jax.block_until_ready(
-            samp_j(run_carry, run_keys[lo:hi], u_run[lo:hi], data)
-        )
-        outs.append(jax.tree.map(np.asarray, out))
+def assemble_chees_posterior(
+    fm, cfg: SamplerConfig, chains: int, outs, run_carry, wdiv_total: int
+) -> Posterior:
+    """Build the Posterior from collected segment outputs (numpy tuples of
+    (zs, accept, divergent, nleap) stacked step-major) — shared by the
+    single-device and sharded drivers."""
     if outs:
         zs, acc, div, nleap = (
             np.concatenate([o[i] for o in outs], axis=0) for i in range(4)

@@ -23,7 +23,8 @@ the marginal chain ~0.25 ms at C=64).  When the ensemble IS sharded over
 a mesh axis (``chains_axis=``), every cross-chain reduction becomes the
 matching XLA collective (pmean/psum/pmax over the axis) so the adapted
 step size, trajectory length, and mass matrix stay bit-identical on
-every device — the shard_map path in `parallel/mesh.py:run_chees_sharded`.
+every device — the shard_map path in `backends/sharded.py`
+(`ShardedBackend._run_chees`).
 """
 
 from __future__ import annotations
@@ -113,14 +114,20 @@ def chees_transition(
     collectives so every device derives identical adaptation signals.
     """
     C = states.z.shape[0]
-    if chains_axis is not None:
-        # each device must draw DISTINCT momenta for its local chains — a
-        # replicated key would clone the ensemble across shards
-        key = jax.random.fold_in(key, jax.lax.axis_index(chains_axis))
     key_mom, key_acc = jax.random.split(key)
-    r0 = jax.vmap(sample_momentum, in_axes=(0, None))(
-        jax.random.split(key_mom, C), inv_mass_diag
+    # per-chain randomness is derived by folding the GLOBAL chain id, so a
+    # chains-sharded ensemble draws exactly the momenta/uniforms the
+    # unsharded ensemble would (sharded == unsharded transitions, up to
+    # psum reassociation) — and distinct shards never clone each other
+    if chains_axis is not None:
+        offset = jax.lax.axis_index(chains_axis) * C
+    else:
+        offset = 0
+    chain_ids = offset + jnp.arange(C)
+    mom_keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        key_mom, chain_ids
     )
+    r0 = jax.vmap(sample_momentum, in_axes=(0, None))(mom_keys, inv_mass_diag)
     ke0 = jax.vmap(kinetic_energy, in_axes=(0, None))(r0, inv_mass_diag)
     energy0 = states.potential_energy + ke0
 
@@ -137,7 +144,10 @@ def chees_transition(
     delta = jnp.where(jnp.isnan(delta), jnp.inf, delta)
     is_divergent = delta > _DIVERGENCE_THRESHOLD
     accept_prob = jnp.minimum(1.0, jnp.exp(-delta))
-    accept = jax.random.uniform(key_acc, (C,)) < accept_prob
+    acc_u = jax.vmap(
+        lambda i: jax.random.uniform(jax.random.fold_in(key_acc, i))
+    )(chain_ids)
+    accept = acc_u < accept_prob
 
     proposal = HMCState(z=z1, potential_energy=pe1, grad=grad1)
     new_states = jax.tree.map(
