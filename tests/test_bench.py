@@ -1,0 +1,65 @@
+"""bench.py result-selection and denominator-extrapolation logic.
+
+The driver metric must never report an unconverged ESS/s as the value when
+a converged result exists (VERDICT r1 #1), and the CPU extrapolation must
+follow the measured cost curve, not a one-point linear assumption.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+
+_spec = importlib.util.spec_from_file_location(
+    "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def test_select_result_prefers_converged_over_faster_unconverged():
+    results = [
+        ("nuts fallback", 50.0, 1.8),  # fast but meaningless (unconverged)
+        ("chees", 2.9, 1.008),
+    ]
+    tag, eps, rhat, converged = bench.select_result(results)
+    assert tag == "chees" and eps == 2.9 and converged
+
+
+def test_select_result_flags_unconverged_only():
+    results = [("nuts fallback", 0.05, 1.8)]
+    tag, eps, rhat, converged = bench.select_result(results)
+    assert not converged and eps == 0.05
+
+
+def test_select_result_best_among_converged():
+    results = [("a", 1.0, 1.005), ("b", 3.0, 1.009), ("c", 9.9, 1.2)]
+    tag, eps, rhat, converged = bench.select_result(results)
+    assert tag == "b" and converged
+
+
+def test_select_result_empty():
+    assert bench.select_result([]) is None
+
+
+def test_cpu_extrapolation_follows_cost_curve():
+    # cost = 1ms + 1us/row: at n0=10k -> 11 ms/eval; at 1M -> 1.001 s/eval
+    rec = {
+        "n": 10_000,
+        "ess_per_sec": 0.005,
+        "fit": {"a": 1e-3, "b": 1e-6},
+    }
+    got = bench.cpu_ess_per_sec_at(1_000_000, rec)
+    expected = 0.005 * (1e-3 + 1e-6 * 1e4) / (1e-3 + 1e-6 * 1e6)
+    np.testing.assert_allclose(got, expected, rtol=1e-12)
+    # the fixed overhead makes the fitted denominator LARGER (cpu faster)
+    # than the legacy linear-in-N assumption — i.e. more honest to us
+    legacy = {"n": 10_000, "ess_per_sec": 0.005}
+    assert got > bench.cpu_ess_per_sec_at(1_000_000, legacy)
+
+
+def test_cpu_extrapolation_legacy_record():
+    legacy = {"n": 10_000, "ess_per_sec": 0.005}
+    np.testing.assert_allclose(
+        bench.cpu_ess_per_sec_at(1_000_000, legacy), 0.005 / 100.0
+    )
