@@ -58,21 +58,26 @@ def _cmd_bench_all(args) -> int:
         try:
             res = ALL_BENCHMARKS[name]()
             print(res.row(), file=sys.stderr)
+            converged = "yes" if res.max_rhat < 1.01 else "no"
             rows.append(
                 f"| {res.name} | {res.ess_per_sec:.2f} | {res.min_ess:.0f} | "
-                f"{res.wall_s:.1f} | {res.max_rhat:.3f} | {platform} |"
+                f"{res.wall_s:.1f} | {res.max_rhat:.3f} | {converged} | "
+                f"{platform} |"
             )
         except Exception as e:  # noqa: BLE001 — record partial results
             print(f"{name}: FAILED {e!r}", file=sys.stderr)
-            rows.append(f"| {name} | — | — | — | — | FAILED |")
+            rows.append(f"| {name} | — | — | — | — | — | FAILED |")
     stamp = datetime.date.today().isoformat()
     table = "\n".join(
         [
             "",
             f"## Measured (smoke scale, {stamp}, platform={platform})",
             "",
-            "| benchmark | ESS/s | min ESS | wall (s) | max R-hat | platform |",
-            "|---|---|---|---|---|---|",
+            "wall = end-to-end wall-clock of the timed (cached-compile) run,",
+            "i.e. wall to the final R-hat in the table; ESS/s = min-ESS/wall.",
+            "",
+            "| benchmark | ESS/s | min ESS | wall (s) | max R-hat | R-hat<1.01 | platform |",
+            "|---|---|---|---|---|---|---|",
             *rows,
             "",
         ]
