@@ -43,11 +43,22 @@ lo, hi = dist.local_row_range(2048)
 local = {k: np.asarray(v)[lo:hi] for k, v in data.items()}
 
 mesh = make_mesh({"data": 4, "chains": 2})
-post = stark_tpu.sample(
-    Logistic(num_features=4), local, backend=ShardedBackend(mesh),
-    chains=2, kernel="nuts", max_tree_depth=5, num_warmup=150,
-    num_samples=150, seed=0,
-)
+kernel = sys.argv[2] if len(sys.argv) > 2 else "nuts"
+if kernel == "chees":
+    # the ensemble sampler: chains sharded over the cross-process
+    # "chains" axis, per-block draw allgather riding gather_draws
+    post = stark_tpu.sample(
+        Logistic(num_features=4), local, backend=ShardedBackend(mesh),
+        chains=8, kernel="chees", num_warmup=200, num_samples=150,
+        init_step_size=0.1, seed=0,
+    )
+else:
+    assert kernel == "nuts", f"worker has no branch for kernel={kernel!r}"
+    post = stark_tpu.sample(
+        Logistic(num_features=4), local, backend=ShardedBackend(mesh),
+        chains=2, kernel="nuts", max_tree_depth=5, num_warmup=150,
+        num_samples=150, seed=0,
+    )
 beta = np.asarray(post.draws["beta"])
 print("RESULT " + json.dumps({
     "proc": dist.process_index(),
@@ -65,7 +76,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_sharded_sampling(tmp_path):
+@pytest.mark.parametrize("kernel", ["nuts", "chees"])
+def test_two_process_sharded_sampling(tmp_path, kernel):
     script = tmp_path / "worker.py"
     script.write_text(_WORKER % {"port": _free_port()})
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -79,7 +91,7 @@ def test_two_process_sharded_sampling(tmp_path):
     }
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(pid)],
+            [sys.executable, str(script), str(pid), kernel],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
         for pid in range(2)
