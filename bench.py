@@ -229,6 +229,40 @@ def main():
                 f"max_rhat={rhat:.3f}",
                 file=sys.stderr,
             )
+            # phase breakdown from the runner's metrics JSONL, so the
+            # on-chip wall decomposes (compile+MAP+warmup vs draw blocks)
+            # instead of being one opaque number
+            try:
+                recs = [
+                    json.loads(l)
+                    for l in open(os.path.join(workdir, "metrics.jsonl"))
+                ]
+                n_restarts = sum(1 for r in recs if r["event"] == "restart")
+                # wall_s restarts at each attempt's own t_start, so only
+                # compare records WITHIN the final attempt (after the last
+                # restart event); a resumed attempt has no warmup_done
+                last = max(
+                    (i for i, r in enumerate(recs) if r["event"] == "restart"),
+                    default=-1,
+                )
+                attempt = recs[last + 1 :]
+                warm = [r for r in attempt if r["event"] == "warmup_done"]
+                blocks = [r for r in attempt if r["event"] == "block"]
+                if blocks:
+                    w = warm[-1]["wall_s"] if warm else 0.0
+                    tag = (
+                        f"warmup(+init/compile) {w:.1f}s, "
+                        if warm
+                        else "resumed (no warmup), "
+                    )
+                    print(
+                        f"[bench] chees phases (final attempt): {tag}blocks "
+                        f"{blocks[-1]['wall_s'] - w:.1f}s "
+                        f"({len(blocks)} blocks), restarts {n_restarts}",
+                        file=sys.stderr,
+                    )
+            except Exception:  # noqa: BLE001 — diagnostics only
+                pass
         except Exception as e:  # noqa: BLE001 — after supervised retries
             print(f"[bench] chees path failed after retries: {e!r}",
                   file=sys.stderr)
