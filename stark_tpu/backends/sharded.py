@@ -236,7 +236,7 @@ class ShardedBackend:
                 smap(parts.init_carry, (R, S), warm_spec),
                 smap(
                     parts.warm_segment, (warm_spec, R, R, R, R, R),
-                    (warm_spec, R),
+                    (warm_spec, (R, R)),
                 ),
                 smap(parts.sample_segment, (run_spec, R, R), (run_spec, out_spec)),
             )

@@ -258,20 +258,24 @@ def make_chees_parts(
             )
             return CheesWarmCarry(states, da, adam, log_T, wf, inv_mass), (
                 info.is_divergent,
+                info.num_leapfrog,
             )
 
         return body
 
     def warm_segment(carry, keys, us, idxs, aflags, wflags, data=None):
         potential_fn = fm.bind(data)
-        carry, (div,) = jax.lax.scan(
+        carry, (div, nleap) = jax.lax.scan(
             warm_body(potential_fn), carry, (keys, us, idxs, aflags, wflags)
         )
         n_div = jnp.sum(div.astype(jnp.int32))
         if chains_axis is not None:
             # global count: the host reads one replicated scalar
             n_div = jax.lax.psum(n_div, chains_axis)
-        return carry, n_div
+        # nleap is the SHARED per-transition length (replicated across the
+        # chains axis) — summed so the host can see where the warmup
+        # gradient budget goes (the flagship wall is warmup-dominated)
+        return carry, (n_div, jnp.sum(nleap))
 
     def finalize(carry: CheesWarmCarry) -> CheesRunCarry:
         return CheesRunCarry(
@@ -370,8 +374,9 @@ def drive_chees_segments(
 
     carry = jax.block_until_ready(init_j(key_init, z0, *extra))
     wdiv_total = 0
+    wleap_total = 0
     for lo, hi in segments(cfg.num_warmup):
-        carry, wdiv = jax.block_until_ready(
+        carry, (wdiv, wleap) = jax.block_until_ready(
             warm_j(
                 carry,
                 warm_keys[lo:hi],
@@ -383,6 +388,7 @@ def drive_chees_segments(
             )
         )
         wdiv_total += int(np.asarray(wdiv))
+        wleap_total += int(np.asarray(wleap))
     run_carry = parts.finalize(carry)
 
     outs = []
@@ -391,7 +397,9 @@ def drive_chees_segments(
             samp_j(run_carry, run_keys[lo:hi], u_run[lo:hi], *extra)
         )
         outs.append(collect(out))
-    return assemble_chees_posterior(fm, cfg, chains, outs, run_carry, wdiv_total)
+    return assemble_chees_posterior(
+        fm, cfg, chains, outs, run_carry, wdiv_total, wleap_total
+    )
 
 
 def run_chees(
@@ -444,7 +452,13 @@ def run_chees(
 
 
 def assemble_chees_posterior(
-    fm, cfg: SamplerConfig, chains: int, outs, run_carry, wdiv_total: int
+    fm,
+    cfg: SamplerConfig,
+    chains: int,
+    outs,
+    run_carry,
+    wdiv_total: int,
+    wleap_total: int,
 ) -> Posterior:
     """Build the Posterior from collected segment outputs (numpy tuples of
     (zs, accept, divergent, nleap) stacked step-major) — shared by the
@@ -480,6 +494,12 @@ def assemble_chees_posterior(
         # ensemble total is chains x that, matching the per-chain arrays
         # HMC/NUTS report (cross-sampler grad budgets apples-to-apples)
         "num_grad_evals": np.asarray(total_leapfrog * chains),
+        # warmup budget accounting: where the (dominant) warmup wall goes —
+        # warm-transition leapfrogs plus the MAP warm-start descent
+        # (map_init_steps Adam steps, one fused gradient each, per chain)
+        "num_warmup_grad_evals": np.asarray(
+            (wleap_total + cfg.map_init_steps) * chains
+        ),
         "step_size": np.full((chains,), float(np.exp(log_eps))),
         "traj_length": np.asarray(np.exp(np.asarray(run_carry.log_T))),
         "inv_mass": np.asarray(run_carry.inv_mass),

@@ -203,16 +203,18 @@ def sample_until_converged(
             wkeys = jax.random.split(key_warm, max(cfg.num_warmup, 1))
             idxs = jnp.arange(cfg.num_warmup)
             n_div = 0
+            n_warm_leap = 0
             # warmup dispatches bounded by block_size, like the draw blocks
             for s in range(0, cfg.num_warmup, block_size):
                 e = min(s + block_size, cfg.num_warmup)
-                carry, nd = jax.block_until_ready(
+                carry, (nd, nl) = jax.block_until_ready(
                     chees_warm_j(
                         carry, wkeys[s:e], u_warm[s:e], idxs[s:e],
                         aflags[s:e], wflags[s:e], data,
                     )
                 )
                 n_div += int(nd)
+                n_warm_leap += int(nl)
             run_carry = parts.finalize(carry)
             state = run_carry.states
             step_size = jnp.exp(run_carry.log_eps)
@@ -228,14 +230,19 @@ def sample_until_converged(
             state, step_size, inv_mass, n_div = seg_warmup(
                 warm_keys, z0, data, block_size
             )
-        emit(
-            {
-                "event": "warmup_done",
-                "wall_s": time.perf_counter() - t_start,
-                "num_divergent": int(np.sum(np.asarray(n_div))),
-                "step_size": np.asarray(step_size).tolist(),
-            }
-        )
+        warm_rec = {
+            "event": "warmup_done",
+            "wall_s": time.perf_counter() - t_start,
+            "num_divergent": int(np.sum(np.asarray(n_div))),
+            "step_size": np.asarray(step_size).tolist(),
+        }
+        if is_chees:
+            # ensemble gradient evals spent before sampling: MAP descent
+            # (one fused gradient per Adam step per chain) + warm leapfrogs
+            warm_rec["warmup_grad_evals"] = (
+                n_warm_leap + cfg.map_init_steps
+            ) * chains
+        emit(warm_rec)
 
     suff = diagnostics.ChainSuffStats(chains, fm.ndim)
     for blk in draw_blocks:
