@@ -54,6 +54,8 @@ def _model_registry() -> Dict[str, Callable]:
         "HorseshoeRegression": models.HorseshoeRegression,
         "OrderedLogistic": models.OrderedLogistic,
         "StochasticVolatility": models.StochasticVolatility,
+        "IRT2PL": models.IRT2PL,
+        "CoxPH": models.CoxPH,
     }
 
 
@@ -82,6 +84,8 @@ def _synth_registry() -> Dict[str, Callable]:
         "horseshoe": seeded(models.synth_horseshoe_data),
         "ordinal": seeded(models.synth_ordinal_data),
         "sv": seeded(models.synth_sv_data),
+        "irt": seeded(models.synth_irt_data),
+        "survival": seeded(models.synth_survival_data),
     }
 
 

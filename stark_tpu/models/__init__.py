@@ -7,6 +7,7 @@ from .glm import (
     synth_poisson_data,
 )
 from .gmm import GaussianMixture, synth_gmm_data
+from .irt import IRT2PL, synth_irt_data
 from .lmm import LinearMixedModel, synth_lmm_data
 from .logistic import (
     FusedHierLogistic,
@@ -24,16 +25,19 @@ from .robust import (
     synth_negbinom_data,
     synth_studentt_data,
 )
+from .survival import CoxPH, synth_survival_data
 from .timeseries import StochasticVolatility, synth_sv_data
 
 __all__ = [
     "BayesianMLP",
+    "CoxPH",
     "EightSchools",
     "FusedHierLogistic",
     "FusedLogistic",
     "GaussianMixture",
     "HierLogistic",
     "HorseshoeRegression",
+    "IRT2PL",
     "LinearMixedModel",
     "LinearRegression",
     "NegBinomialRegression",
@@ -46,6 +50,7 @@ __all__ = [
     "synth_bnn_data",
     "synth_gmm_data",
     "synth_horseshoe_data",
+    "synth_irt_data",
     "synth_linreg_data",
     "synth_lmm_data",
     "synth_negbinom_data",
@@ -53,5 +58,6 @@ __all__ = [
     "synth_poisson_data",
     "synth_logistic_data",
     "synth_studentt_data",
+    "synth_survival_data",
     "synth_sv_data",
 ]

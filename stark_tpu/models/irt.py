@@ -1,0 +1,77 @@
+"""Item-response theory: 2-parameter-logistic (2PL) model.
+
+A classic hierarchical Bayesian workload (ability/difficulty/
+discrimination estimation from binary response matrices).  The
+likelihood is one long row-wise Bernoulli over (person, item, response)
+triples with two gathers — embarrassingly data-parallel, so it shards
+over the "data" mesh axis like the logistic models (the gathers stay
+local to each row shard; only the scalar log-lik partial is psum'd).
+
+Capability-surface entry per SURVEY.md §3 "Model abstraction" — the
+reference's model class is user-defined models of exactly this shape
+(log-prior + per-row log-lik); no reference file to cite (SURVEY.md §0:
+the tree was absent; built against the capability surface).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+
+from ..bijectors import Exp
+from ..model import Model, ParamSpec
+
+
+class IRT2PL(Model):
+    """y_{pi} ~ Bernoulli(sigmoid(a_i * (theta_p - b_i))).
+
+    Non-centered priors: theta ~ N(0,1) (the scale anchor), b ~ N(0,1),
+    a ~ LogNormal(0, 0.5) — positivity via the Exp bijector keeps the
+    discrimination sign identified.
+    """
+
+    def __init__(self, num_persons: int, num_items: int):
+        self.num_persons = num_persons
+        self.num_items = num_items
+
+    def param_spec(self):
+        return {
+            "theta": ParamSpec((self.num_persons,)),
+            "a": ParamSpec((self.num_items,), Exp()),
+            "b": ParamSpec((self.num_items,)),
+        }
+
+    def log_prior(self, p):
+        lp = jnp.sum(jstats.norm.logpdf(p["theta"]))
+        lp += jnp.sum(jstats.norm.logpdf(p["b"]))
+        # a ~ LogNormal(0, 0.5): normal density on log a plus the |d log a|
+        # Jacobian (the Exp bijector's fldj covers the transform side)
+        lp += jnp.sum(
+            jstats.norm.logpdf(jnp.log(p["a"]), 0.0, 0.5) - jnp.log(p["a"])
+        )
+        return lp
+
+    def log_lik(self, p, data):
+        from .logistic import _bernoulli_logit_loglik
+
+        logits = p["a"][data["item"]] * (
+            p["theta"][data["person"]] - p["b"][data["item"]]
+        )
+        return _bernoulli_logit_loglik(logits, data["y"])
+
+
+def synth_irt_data(key, num_persons, num_items, *, dtype=jnp.float32):
+    """Full response matrix as (P*I,) triples + the true parameters."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    theta = jax.random.normal(k1, (num_persons,), dtype)
+    a = jnp.exp(0.5 * jax.random.normal(k2, (num_items,), dtype))
+    b = jax.random.normal(k3, (num_items,), dtype)
+    person = jnp.repeat(jnp.arange(num_persons), num_items)
+    item = jnp.tile(jnp.arange(num_items), num_persons)
+    logits = a[item] * (theta[person] - b[item])
+    y = (jax.random.uniform(k4, person.shape) < jax.nn.sigmoid(logits)).astype(
+        dtype
+    )
+    data = {"person": person.astype(jnp.int32), "item": item.astype(jnp.int32), "y": y}
+    return data, {"theta": theta, "a": a, "b": b}

@@ -1,0 +1,113 @@
+"""Survival analysis: Cox proportional hazards (Breslow partial likelihood).
+
+The partial likelihood couples each event to its risk set (everyone
+still at risk at that time).  With rows pre-sorted by DESCENDING time,
+the risk-set denominator at row i is a prefix log-sum-exp over rows
+0..i — one `cumulative_logsumexp` pass, XLA-friendly static shapes, no
+per-event Python.  That prefix scan makes the likelihood sequential in
+the row ordering, so rows cannot be sharded over the data axis (same
+fail-fast contract as StochasticVolatility); chain parallelism applies.
+
+Capability-surface entry per SURVEY.md §3 "Model abstraction" (reference
+tree absent — built against the capability surface).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+
+from ..model import Model, ParamSpec
+
+
+def _cumulative_logsumexp(x):
+    """Numerically-stable prefix logsumexp along axis 0 (running max +
+    running sum of rescaled exps via an associative scan)."""
+
+    def combine(a, b):
+        m_a, s_a = a
+        m_b, s_b = b
+        m = jnp.maximum(m_a, m_b)
+        return m, s_a * jnp.exp(m_a - m) + s_b * jnp.exp(m_b - m)
+
+    m, s = jax.lax.associative_scan(combine, (x, jnp.ones_like(x)))
+    return m + jnp.log(s)
+
+
+def _fill_from_right(vals, valid):
+    """For each i, the value at the NEAREST valid index j >= i.
+
+    Associative ("latest valid wins") prefix over the reversed sequence —
+    static shapes, no per-row scan serialization.
+    """
+
+    def op(a, b):  # b is the element closer to position i
+        va, ha = a
+        vb, hb = b
+        return jnp.where(hb, vb, va), ha | hb
+
+    rv, _ = jax.lax.associative_scan(op, (vals[::-1], valid[::-1]))
+    return rv[::-1]
+
+
+class CoxPH(Model):
+    """Breslow partial likelihood with tie-correct risk sets.
+
+    data: {"x": (N, D), "t": (N,) survival/censoring times, "event": (N,)
+    1=event/0=censored}.  ``prepare_data`` sorts rows by descending time
+    on the host (outside jit — free, and it makes unsorted user data
+    correct rather than silently wrong); the likelihood then takes one
+    prefix-logsumexp pass, with every member of a tied-time block
+    assigned the SAME denominator — the logsumexp through the END of its
+    block, i.e. the full Breslow risk set (a plain prefix would give
+    tied events arbitrary, sort-order-dependent risk sets).
+    """
+
+    def __init__(self, num_features: int, prior_scale: float = 2.5):
+        self.num_features = num_features
+        self.prior_scale = prior_scale
+
+    def param_spec(self):
+        return {"beta": ParamSpec((self.num_features,))}
+
+    def prepare_data(self, data):
+        order = jnp.argsort(-jnp.asarray(data["t"]))
+        return {k: jnp.asarray(v)[order] for k, v in data.items()}
+
+    def data_row_axes(self, data):
+        raise NotImplementedError(
+            "CoxPH's risk-set prefix scan couples every row to all "
+            "longer-surviving rows: rows cannot be sharded or "
+            "minibatched. Use a single-shard backend (JaxBackend/"
+            "CpuBackend); chain parallelism still applies."
+        )
+
+    def log_prior(self, p):
+        return jnp.sum(jstats.norm.logpdf(p["beta"], 0.0, self.prior_scale))
+
+    def log_lik(self, p, data):
+        eta = data["x"] @ p["beta"]  # (N,) rows sorted by descending time
+        prefix = _cumulative_logsumexp(eta)
+        t = data["t"]
+        # tie-block ends: last row of each equal-time run (sorted order)
+        is_block_end = jnp.concatenate(
+            [t[1:] != t[:-1], jnp.ones((1,), bool)]
+        )
+        log_risk = _fill_from_right(prefix, is_block_end)
+        return jnp.sum(data["event"] * (eta - log_risk))
+
+
+def synth_survival_data(
+    key, n, d, *, censor_rate=0.3, dtype=jnp.float32
+):
+    """Exponential survival times with hazard exp(x@beta); rows returned
+    sorted by descending time (CoxPH's contract)."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    x = jax.random.normal(k1, (n, d), dtype)
+    beta = 0.5 * jax.random.normal(k2, (d,), dtype)
+    rate = jnp.exp(x @ beta)
+    t = jax.random.exponential(k3, (n,)) / rate
+    event = (jax.random.uniform(k4, (n,)) > censor_rate).astype(dtype)
+    data = {"x": x, "t": t.astype(dtype), "event": event}
+    return data, {"beta": beta}
