@@ -105,6 +105,83 @@ def sample_until_converged(
         chees_init_j = jax.jit(parts.init_carry)
         chees_warm_j = jax.jit(parts.warm_segment)
         chees_samp_j = jax.jit(parts.sample_segment)
+
+        def save_warmup_checkpoint(path, carry, key, key_warm, done, nd, nl):
+            """Warmup-phase checkpoint: the full CheesWarmCarry, so a
+            fault mid-warmup resumes at the last finished segment instead
+            of burning the whole (dominant) warmup budget again."""
+            from .checkpoint import save_checkpoint
+
+            arrays = {
+                # standard names so checkpoint_is_healthy's finite check
+                # covers position/grad/step/mass exactly like sample-phase
+                "z": np.asarray(carry.states.z),
+                "pe": np.asarray(carry.states.potential_energy),
+                "grad": np.asarray(carry.states.grad),
+                "step_size": np.exp(np.asarray(carry.da.log_step)),
+                "inv_mass": np.asarray(carry.inv_mass),
+                "da_log_step": np.asarray(carry.da.log_step),
+                "da_log_avg_step": np.asarray(carry.da.log_avg_step),
+                "da_h_avg": np.asarray(carry.da.h_avg),
+                "da_mu": np.asarray(carry.da.mu),
+                "da_count": np.asarray(carry.da.count),
+                "adam_m": np.asarray(carry.adam.m),
+                "adam_v": np.asarray(carry.adam.v),
+                "adam_t": np.asarray(carry.adam.t),
+                "log_T": np.asarray(carry.log_T),
+                "wf_count": np.asarray(carry.wf.count),
+                "wf_mean": np.asarray(carry.wf.mean),
+                "wf_m2": np.asarray(carry.wf.m2),
+                "key": np.asarray(key),
+                "key_warm": np.asarray(key_warm),
+            }
+            if health_check:
+                # a poisoned adaptation carry must never land on disk
+                # (the load-side check in supervise covers old files)
+                from .supervise import check_finite_state
+
+                check_finite_state(arrays)
+            save_checkpoint(
+                path,
+                arrays,
+                {
+                    "kernel": cfg.kernel,
+                    "phase": "warmup",
+                    "warm_done": done,
+                    "warm_div": nd,
+                    "warm_leap": nl,
+                    "model": type(model).__name__,
+                },
+            )
+
+        def run_chees_warmup(carry, start, key, key_warm, nd0, nl0):
+            """Drive warmup segments from ``start``; checkpoint each."""
+            sched = parts.schedule
+            aflags = jnp.asarray(np.asarray(sched.adapt_mass))
+            wflags = jnp.asarray(np.asarray(sched.window_end))
+            u_warm = jnp.asarray(2.0 * halton(cfg.num_warmup), jnp.float32)
+            wkeys = jax.random.split(key_warm, max(cfg.num_warmup, 1))
+            idxs = jnp.arange(cfg.num_warmup)
+            n_div, n_leap = nd0, nl0
+            for s in range(start, cfg.num_warmup, block_size):
+                e = min(s + block_size, cfg.num_warmup)
+                carry, (nd, nl) = jax.block_until_ready(
+                    chees_warm_j(
+                        carry, wkeys[s:e], u_warm[s:e], idxs[s:e],
+                        aflags[s:e], wflags[s:e], data,
+                    )
+                )
+                n_div += int(nd)
+                n_leap += int(nl)
+                if checkpoint_path and e < cfg.num_warmup:
+                    # the final segment's state is captured by the first
+                    # sample-phase checkpoint; persisting it here too
+                    # would only duplicate I/O
+                    save_warmup_checkpoint(
+                        checkpoint_path, carry, key, key_warm, e, n_div,
+                        n_leap,
+                    )
+            return carry, n_div, n_leap
     else:
         block_run = make_block_runner(fm, cfg, block_size)
         v_block = jax.jit(jax.vmap(block_run, in_axes=(0, 0, 0, 0, None)))
@@ -122,6 +199,22 @@ def sample_until_converged(
         if metrics_f:
             metrics_f.write(json.dumps(rec) + "\n")
             metrics_f.flush()
+
+    def emit_warmup_done(n_div_total, step_size, warmup_grads=None,
+                         resumed_from=None):
+        """One builder for the warmup_done record — fresh and
+        warmup-resumed paths must emit identical shapes."""
+        rec = {
+            "event": "warmup_done",
+            "wall_s": time.perf_counter() - t_start,
+            "num_divergent": int(n_div_total),
+            "step_size": np.asarray(step_size).tolist(),
+        }
+        if warmup_grads is not None:
+            rec["warmup_grad_evals"] = int(warmup_grads)
+        if resumed_from is not None:
+            rec["resumed_from_step"] = int(resumed_from)
+        emit(rec)
 
     blocks_done = 0
     total_div = 0
@@ -152,7 +245,61 @@ def sample_until_converged(
         step_size = jnp.asarray(arrays["step_size"])
         inv_mass = jnp.asarray(arrays["inv_mass"])
         key = jnp.asarray(arrays["key"])
-        if is_chees:
+        if reseed is not None:
+            # a deterministic numerical failure would otherwise replay
+            # identically from the checkpointed key on every retry — the
+            # supervisor passes the attempt number to branch the stream
+            key = jax.random.fold_in(key, reseed)
+        chains = state.z.shape[0]
+        if is_chees and meta.get("phase") == "warmup":
+            # mid-warmup checkpoint: rebuild the full adaptation carry and
+            # finish the remaining warmup segments before sampling
+            from .adaptation import DualAveragingState, WelfordState
+            from .chees import AdamState, CheesWarmCarry
+
+            carry = CheesWarmCarry(
+                states=state,
+                da=DualAveragingState(
+                    log_step=jnp.asarray(arrays["da_log_step"]),
+                    log_avg_step=jnp.asarray(arrays["da_log_avg_step"]),
+                    h_avg=jnp.asarray(arrays["da_h_avg"]),
+                    mu=jnp.asarray(arrays["da_mu"]),
+                    count=jnp.asarray(arrays["da_count"]),
+                ),
+                adam=AdamState(
+                    m=jnp.asarray(arrays["adam_m"]),
+                    v=jnp.asarray(arrays["adam_v"]),
+                    t=jnp.asarray(arrays["adam_t"]),
+                ),
+                log_T=jnp.asarray(arrays["log_T"]),
+                wf=WelfordState(
+                    count=jnp.asarray(arrays["wf_count"]),
+                    mean=jnp.asarray(arrays["wf_mean"]),
+                    m2=jnp.asarray(arrays["wf_m2"]),
+                ),
+                inv_mass=inv_mass,
+            )
+            key_warm = jnp.asarray(arrays["key_warm"])
+            if reseed is not None:
+                key_warm = jax.random.fold_in(key_warm, reseed)
+            carry, n_div, n_warm_leap = run_chees_warmup(
+                carry,
+                int(meta["warm_done"]),
+                key,
+                key_warm,
+                int(meta.get("warm_div", 0)),
+                int(meta.get("warm_leap", 0)),
+            )
+            run_carry = parts.finalize(carry)
+            state = run_carry.states
+            step_size = jnp.exp(run_carry.log_eps)
+            inv_mass = run_carry.inv_mass
+            emit_warmup_done(
+                n_div, step_size,
+                warmup_grads=(n_warm_leap + cfg.map_init_steps) * chains,
+                resumed_from=int(meta["warm_done"]),
+            )
+        elif is_chees:
             from .chees import CheesRunCarry
 
             run_carry = CheesRunCarry(
@@ -161,15 +308,9 @@ def sample_until_converged(
                 log_T=jnp.asarray(arrays["log_T"]),
                 inv_mass=inv_mass,
             )
-        if reseed is not None:
-            # a deterministic numerical failure would otherwise replay
-            # identically from the checkpointed key on every retry — the
-            # supervisor passes the attempt number to branch the stream
-            key = jax.random.fold_in(key, reseed)
         blocks_done = int(meta.get("blocks_done", 0))
         total_div = int(meta.get("num_divergent", 0))
         history = list(meta.get("history", []))
-        chains = state.z.shape[0]
         if "draws" in arrays:
             draw_blocks = [arrays["draws"]]
         elif draw_store_path and os.path.exists(draw_store_path):
@@ -196,25 +337,11 @@ def sample_until_converged(
         if is_chees:
             z0 = chees_init_positions(fm, key_init, chains, init_params)
             carry = jax.block_until_ready(chees_init_j(key_init, z0, data))
-            sched = parts.schedule
-            aflags = jnp.asarray(np.asarray(sched.adapt_mass))
-            wflags = jnp.asarray(np.asarray(sched.window_end))
-            u_warm = jnp.asarray(2.0 * halton(cfg.num_warmup), jnp.float32)
-            wkeys = jax.random.split(key_warm, max(cfg.num_warmup, 1))
-            idxs = jnp.arange(cfg.num_warmup)
-            n_div = 0
-            n_warm_leap = 0
-            # warmup dispatches bounded by block_size, like the draw blocks
-            for s in range(0, cfg.num_warmup, block_size):
-                e = min(s + block_size, cfg.num_warmup)
-                carry, (nd, nl) = jax.block_until_ready(
-                    chees_warm_j(
-                        carry, wkeys[s:e], u_warm[s:e], idxs[s:e],
-                        aflags[s:e], wflags[s:e], data,
-                    )
-                )
-                n_div += int(nd)
-                n_warm_leap += int(nl)
+            # warmup dispatches bounded by block_size, like the draw
+            # blocks, each segment checkpointed for mid-warmup resume
+            carry, n_div, n_warm_leap = run_chees_warmup(
+                carry, 0, key, key_warm, 0, 0
+            )
             run_carry = parts.finalize(carry)
             state = run_carry.states
             step_size = jnp.exp(run_carry.log_eps)
@@ -230,19 +357,18 @@ def sample_until_converged(
             state, step_size, inv_mass, n_div = seg_warmup(
                 warm_keys, z0, data, block_size
             )
-        warm_rec = {
-            "event": "warmup_done",
-            "wall_s": time.perf_counter() - t_start,
-            "num_divergent": int(np.sum(np.asarray(n_div))),
-            "step_size": np.asarray(step_size).tolist(),
-        }
-        if is_chees:
-            # ensemble gradient evals spent before sampling: MAP descent
-            # (one fused gradient per Adam step per chain) + warm leapfrogs
-            warm_rec["warmup_grad_evals"] = (
-                n_warm_leap + cfg.map_init_steps
-            ) * chains
-        emit(warm_rec)
+        # chees: ensemble gradient evals spent before sampling — MAP
+        # descent (one fused gradient per Adam step per chain) + warm
+        # leapfrogs; per-chain kernels have no shared-budget equivalent
+        emit_warmup_done(
+            np.sum(np.asarray(n_div)),
+            step_size,
+            warmup_grads=(
+                (n_warm_leap + cfg.map_init_steps) * chains
+                if is_chees
+                else None
+            ),
+        )
 
     suff = diagnostics.ChainSuffStats(chains, fm.ndim)
     for blk in draw_blocks:

@@ -48,7 +48,15 @@ class ChainHealthError(RuntimeError):
     """Sampler state went non-finite (detected before checkpointing)."""
 
 
-_HEALTH_KEYS = ("z", "pe", "grad", "step_size", "inv_mass")
+_HEALTH_KEYS = (
+    "z", "pe", "grad", "step_size", "inv_mass",
+    # chees warmup-phase checkpoints carry adaptation state whose
+    # poisoning would otherwise survive the position/grad check and be
+    # resumed on every restart (keys absent from other checkpoints are
+    # simply skipped)
+    "log_T", "da_log_step", "da_h_avg", "adam_m", "adam_v",
+    "wf_mean", "wf_m2",
+)
 
 
 def check_finite_state(arrays: Dict[str, Any]) -> None:

@@ -182,3 +182,61 @@ def test_chees_supervised_restart_resumes_from_checkpoint(tmp_path, monkeypatch)
     # one warmup_done == the restarted attempt resumed instead of cold-starting
     assert sum(1 for l in lines if l["event"] == "warmup_done") == 1
     assert post.converged
+
+
+def test_chees_midwarmup_checkpoint_resume(tmp_path):
+    """A fault mid-warmup resumes from the last finished warmup segment
+    instead of restarting warmup from zero."""
+    import json
+
+    ckpt = str(tmp_path / "c.npz")
+    metrics = str(tmp_path / "m.jsonl")
+
+    # fault injection: count jax.block_until_ready calls on the chees
+    # path (1 = init_carry, then one per 50-step warmup segment) and
+    # raise on the 3rd warmup segment, leaving a warm_done=100 checkpoint
+    import stark_tpu.runner as runner_mod
+    from stark_tpu.checkpoint import load_checkpoint
+
+    calls = {"n": 0}
+
+    real_sample = runner_mod.sample_until_converged
+
+    def run(**kw):
+        return real_sample(
+            CorrGauss(), chains=8, block_size=50, max_blocks=2, min_blocks=2,
+            rhat_target=0.5, kernel="chees", num_warmup=200,
+            init_step_size=0.5, seed=0, checkpoint_path=ckpt,
+            metrics_path=metrics, **kw,
+        )
+
+    # First: fault during warmup by making jax.block_until_ready raise on
+    # the 3rd warmup segment (segments are 50 steps; ckpt lands at 50/100)
+    import jax as jax_mod
+
+    orig_bur = jax_mod.block_until_ready
+
+    def flaky_bur(x):
+        calls["n"] += 1
+        if calls["n"] == 4:  # init_carry + 2 warm segments, then boom
+            raise RuntimeError("injected mid-warmup fault")
+        return orig_bur(x)
+
+    jax_mod.block_until_ready = flaky_bur
+    try:
+        with pytest.raises(RuntimeError, match="mid-warmup"):
+            run()
+    finally:
+        jax_mod.block_until_ready = orig_bur
+
+    _, meta = load_checkpoint(ckpt)
+    assert meta["phase"] == "warmup"
+    assert meta["warm_done"] == 100  # two finished 50-step segments
+
+    # Second: resume — must complete warmup from step 100 and sample
+    post = run(resume_from=ckpt)
+    assert post.num_samples == 100
+    recs = [json.loads(l) for l in open(metrics)]
+    done = [r for r in recs if r["event"] == "warmup_done"]
+    assert len(done) == 1 and done[0]["resumed_from_step"] == 100
+    assert np.isfinite(post.draws_flat).all()
