@@ -318,6 +318,31 @@ def make_chees_parts(
     )
 
 
+def chees_schedule_arrays(parts: CheesParts, cfg: SamplerConfig):
+    """Host-side per-step scan inputs shared by every chees driver:
+    (aflags, wflags, u_warm, u_run, idxs).  One builder so the schedule
+    slicing/Halton conventions cannot drift between drivers."""
+    sched = parts.schedule
+    total = cfg.num_samples * cfg.thin
+    return (
+        jnp.asarray(np.asarray(sched.adapt_mass)),
+        jnp.asarray(np.asarray(sched.window_end)),
+        jnp.asarray(2.0 * halton(cfg.num_warmup), jnp.float32),
+        jnp.asarray(2.0 * halton(total), jnp.float32),
+        jnp.arange(cfg.num_warmup),
+    )
+
+
+def chees_segments(dispatch_steps: Optional[int], n: int):
+    """[(lo, hi)) dispatch slices covering n steps; validates the bound."""
+    if dispatch_steps is not None and dispatch_steps < 0:
+        raise ValueError(
+            f"dispatch_steps must be >= 0, got {dispatch_steps}"
+        )
+    seg = dispatch_steps if dispatch_steps else max(n, 1)
+    return [(s, min(s + seg, n)) for s in range(0, n, seg)]
+
+
 def chees_init_positions(fm, key, chains, init_params=None):
     """Shared ensemble init: random typical-set draws, or a jittered
     user-provided point (identical chains have zero cross-chain variance,
@@ -359,18 +384,13 @@ def drive_chees_segments(
     z0 = put_z0(chees_init_positions(fm, key_init, chains, init_params))
 
     total = cfg.num_samples * cfg.thin
-    sched = parts.schedule
-    aflags = put_aux(jnp.asarray(np.asarray(sched.adapt_mass)))
-    wflags = put_aux(jnp.asarray(np.asarray(sched.window_end)))
-    u_warm = put_aux(jnp.asarray(2.0 * halton(cfg.num_warmup), jnp.float32))
-    u_run = put_aux(jnp.asarray(2.0 * halton(total), jnp.float32))
+    aflags, wflags, u_warm, u_run, idxs = (
+        put_aux(a) for a in chees_schedule_arrays(parts, cfg)
+    )
     warm_keys = put_aux(jax.random.split(key_warm, max(cfg.num_warmup, 1)))
     run_keys = put_aux(jax.random.split(key_run, max(total, 1)))
-    idxs = put_aux(jnp.arange(cfg.num_warmup))
 
-    def segments(n):
-        seg = dispatch_steps if dispatch_steps else max(n, 1)
-        return [(s, min(s + seg, n)) for s in range(0, n, seg)]
+    segments = lambda n: chees_segments(dispatch_steps, n)
 
     carry = jax.block_until_ready(init_j(key_init, z0, *extra))
     wdiv_total = 0

@@ -41,6 +41,118 @@ def _combine_precision_weighted(draws_flat: jax.Array) -> jax.Array:
     return num / jnp.sum(w, axis=0)
 
 
+def _run_chees_shards(
+    fm, cfg, sharded, num_shards, chains, key_init, key_run, mesh,
+    init_params, dispatch_steps,
+):
+    """ChEES sub-posterior sampling: each shard runs its own ensemble.
+
+    The chees parts are vmapped over the shard axis — every shard gets its
+    own adaptation state (eps, T, mass) and RNG stream, with zero
+    cross-shard communication, exactly like the per-chain NUTS layout.
+    On a mesh the vmapped segments are shard_mapped over "data" (shards
+    resident per device; the only collective is the final gather).
+    Returns (draws_sub (S, C, T, d), stats dict).
+    """
+    from ..chees import (
+        chees_init_positions,
+        chees_schedule_arrays,
+        chees_segments,
+        make_chees_parts,
+    )
+
+    parts = make_chees_parts(fm, cfg)
+    S, C = num_shards, chains
+    total = cfg.num_samples * cfg.thin
+
+    ikeys = jax.random.split(key_init, S)
+    z0 = jax.vmap(
+        lambda k: chees_init_positions(fm, k, C, init_params)
+    )(ikeys)  # (S, C, d)
+
+    key_warm, key_samp = jax.random.split(key_run)
+    wkeys = jax.random.split(
+        key_warm, S * max(cfg.num_warmup, 1)
+    ).reshape(S, max(cfg.num_warmup, 1), 2)
+    rkeys = jax.random.split(key_samp, S * max(total, 1)).reshape(
+        S, max(total, 1), 2
+    )
+    aflags, wflags, u_warm, u_run, idxs = chees_schedule_arrays(parts, cfg)
+
+    v_init = jax.vmap(parts.init_carry, in_axes=(0, 0, 0))
+    v_warm = jax.vmap(
+        parts.warm_segment, in_axes=(0, 0, None, None, None, None, 0)
+    )
+    v_samp = jax.vmap(parts.sample_segment, in_axes=(0, 0, None, 0))
+
+    if mesh is None:
+        init_j = jax.jit(v_init)
+        warm_j = jax.jit(v_warm)
+        samp_j = jax.jit(v_samp)
+    else:
+        D = P("data")  # prefix spec: every leaf carries the shard axis
+        R = P()
+        init_j = jax.jit(
+            shard_map(v_init, mesh=mesh, in_specs=(D, D, D),
+                      out_specs=D, check_vma=False)
+        )
+        warm_j = jax.jit(
+            shard_map(v_warm, mesh=mesh, in_specs=(D, D, R, R, R, R, D),
+                      out_specs=(D, D), check_vma=False)
+        )
+        samp_j = jax.jit(
+            shard_map(v_samp, mesh=mesh, in_specs=(D, D, R, D),
+                      out_specs=(D, D), check_vma=False)
+        )
+        put = lambda x: jax.device_put(x, NamedSharding(mesh, P("data")))
+        z0, wkeys, rkeys = put(z0), put(wkeys), put(rkeys)
+        sharded = jax.tree.map(put, sharded)
+        ikeys = put(ikeys)
+
+    segments = lambda n: chees_segments(dispatch_steps, n)
+
+    carry = jax.block_until_ready(init_j(ikeys, z0, sharded))
+    wdiv = 0
+    for lo, hi in segments(cfg.num_warmup):
+        carry, (nd, _) = jax.block_until_ready(
+            warm_j(
+                carry, wkeys[:, lo:hi], u_warm[lo:hi], idxs[lo:hi],
+                aflags[lo:hi], wflags[lo:hi], sharded,
+            )
+        )
+        wdiv += int(np.sum(np.asarray(nd)))
+    run_carry = jax.vmap(parts.finalize)(carry)
+
+    zs_parts, acc_parts, div_parts = [], [], []
+    for lo, hi in segments(total):
+        run_carry, (zs, acc, div, _) = jax.block_until_ready(
+            samp_j(run_carry, rkeys[:, lo:hi], u_run[lo:hi], sharded)
+        )
+        zs_parts.append(np.asarray(zs))
+        acc_parts.append(np.asarray(acc))
+        div_parts.append(np.asarray(div))
+    if zs_parts:
+        zs = np.concatenate(zs_parts, axis=1)  # (S, T, C, d)
+        acc = np.concatenate(acc_parts, axis=1)
+        div = np.concatenate(div_parts, axis=1)
+    else:  # warmup-only (num_samples=0)
+        zs = np.zeros((S, 0, C, fm.ndim), np.float32)
+        acc = np.zeros((S, 0, C), np.float32)
+        div = np.zeros((S, 0, C), bool)
+    if cfg.thin > 1:
+        zs = zs[:, cfg.thin - 1 :: cfg.thin]
+        acc = acc[:, cfg.thin - 1 :: cfg.thin]
+    draws_sub = jnp.asarray(zs.transpose(0, 2, 1, 3))  # (S, C, T, d)
+    stats = {
+        "accept_prob": acc.transpose(0, 2, 1).reshape(S * C, -1),
+        "num_divergent": np.asarray(int(div.sum())),
+        "num_warmup_divergent": np.asarray(wdiv),
+        "step_size": np.exp(np.asarray(run_carry.log_eps)),  # (S,)
+        "traj_length": np.exp(np.asarray(run_carry.log_T)),  # (S,)
+    }
+    return draws_sub, stats
+
+
 def consensus_sample(
     model: Model,
     data,
@@ -51,6 +163,7 @@ def consensus_sample(
     mesh: Optional[Mesh] = None,
     combine: str = "precision",  # "precision" | "uniform"
     init_params: Optional[Dict[str, Any]] = None,
+    dispatch_steps: Optional[int] = None,
     **cfg_kwargs,
 ) -> Posterior:
     """Run consensus MC and return the combined Posterior.
@@ -83,48 +196,83 @@ def consensus_sample(
 
     key = jax.random.PRNGKey(seed)
     key_init, key_run = jax.random.split(key)
-    if init_params is not None:
-        z0 = jnp.broadcast_to(
-            fm.unconstrain(init_params), (num_shards, chains, fm.ndim)
-        )
-    else:
-        z0 = jax.vmap(jax.vmap(fm.init_flat))(
-            jax.random.split(key_init, num_shards * chains).reshape(
-                num_shards, chains, 2
-            )
-        )
-    keys = jax.random.split(key_run, num_shards * chains).reshape(
-        num_shards, chains, 2
-    )
 
-    runner = make_chain_runner(fm, cfg)
-    vchains = jax.vmap(runner, in_axes=(0, 0, None))  # chains within a shard
-    vshards = jax.vmap(vchains, in_axes=(0, 0, 0))  # across shards
-
-    if mesh is None:
-        run = jax.jit(vshards)
-        res = jax.block_until_ready(run(keys, z0, sharded))
-        draws_sub = res.draws  # (S, C, T, d)
-    else:
+    if mesh is not None:
         if "data" not in mesh.axis_names:
             raise ValueError("mesh must have a 'data' axis for consensus shards")
         if num_shards % mesh.shape["data"]:
             raise ValueError("num_shards must divide the mesh 'data' axis")
-        specs = jax.tree.map(lambda _: P("data"), sharded)
-        fn = shard_map(
-            vshards,
-            mesh=mesh,
-            in_specs=(P("data"), P("data"), specs),
-            out_specs=P("data"),
-            check_vma=False,
+
+    if dispatch_steps is not None and cfg.kernel != "chees":
+        raise ValueError(
+            "dispatch_steps is only implemented for kernel='chees' in "
+            "consensus_sample (the per-chain runner path is monolithic)"
         )
-        keys = jax.device_put(keys, NamedSharding(mesh, P("data")))
-        z0 = jax.device_put(z0, NamedSharding(mesh, P("data")))
-        sharded = jax.tree.map(
-            lambda x: jax.device_put(x, NamedSharding(mesh, P("data"))), sharded
+
+    if cfg.kernel == "chees":
+        if mesh is not None:
+            extra_devs = [
+                (ax, sz) for ax, sz in mesh.shape.items()
+                if ax != "data" and sz > 1
+            ]
+            if extra_devs:
+                # consensus shards only over "data": devices along other
+                # axes would silently recompute identical shard ensembles
+                raise ValueError(
+                    "chees consensus shards only over the 'data' mesh "
+                    f"axis; axes {extra_devs} would duplicate work — use "
+                    "a mesh with all non-'data' axes of size 1"
+                )
+        draws_sub, stats_extra = _run_chees_shards(
+            fm, cfg, sharded, num_shards, chains, key_init, key_run, mesh,
+            init_params, dispatch_steps,
         )
-        res = jax.block_until_ready(jax.jit(fn)(keys, z0, sharded))
-        draws_sub = res.draws
+    else:
+        if init_params is not None:
+            z0 = jnp.broadcast_to(
+                fm.unconstrain(init_params), (num_shards, chains, fm.ndim)
+            )
+        else:
+            z0 = jax.vmap(jax.vmap(fm.init_flat))(
+                jax.random.split(key_init, num_shards * chains).reshape(
+                    num_shards, chains, 2
+                )
+            )
+        keys = jax.random.split(key_run, num_shards * chains).reshape(
+            num_shards, chains, 2
+        )
+
+        runner = make_chain_runner(fm, cfg)
+        vchains = jax.vmap(runner, in_axes=(0, 0, None))  # chains within a shard
+        vshards = jax.vmap(vchains, in_axes=(0, 0, 0))  # across shards
+
+        if mesh is None:
+            run = jax.jit(vshards)
+            res = jax.block_until_ready(run(keys, z0, sharded))
+        else:
+            specs = jax.tree.map(lambda _: P("data"), sharded)
+            fn = shard_map(
+                vshards,
+                mesh=mesh,
+                in_specs=(P("data"), P("data"), specs),
+                out_specs=P("data"),
+                check_vma=False,
+            )
+            keys = jax.device_put(keys, NamedSharding(mesh, P("data")))
+            z0 = jax.device_put(z0, NamedSharding(mesh, P("data")))
+            sharded = jax.tree.map(
+                lambda x: jax.device_put(x, NamedSharding(mesh, P("data"))),
+                sharded,
+            )
+            res = jax.block_until_ready(jax.jit(fn)(keys, z0, sharded))
+        draws_sub = res.draws  # (S, C, T, d)
+        stats_extra = {
+            "accept_prob": np.asarray(res.accept_prob).reshape(
+                -1, res.accept_prob.shape[-1]
+            ),
+            "num_divergent": np.asarray(res.num_divergent),
+            "step_size": np.asarray(res.step_size),
+        }
 
     if combine == "precision":
         combined = _combine_precision_weighted(draws_sub)
@@ -135,9 +283,7 @@ def consensus_sample(
 
     draws = _constrain_draws(fm, combined)
     stats = {
-        "accept_prob": np.asarray(res.accept_prob).reshape(-1, res.accept_prob.shape[-1]),
-        "num_divergent": np.asarray(res.num_divergent),
-        "step_size": np.asarray(res.step_size),
+        **stats_extra,
         "num_shards": num_shards,
         "sub_draws_flat": np.asarray(draws_sub),
     }

@@ -58,3 +58,57 @@ def test_consensus_bad_shards(setup):
     with pytest.raises(ValueError, match="divisible"):
         consensus_sample(model, data, num_shards=3, chains=1,
                          num_warmup=10, num_samples=10)
+
+
+def test_consensus_chees_matches_full_posterior():
+    """ChEES sub-posterior sampling through the consensus combine must
+    recover the same posterior as full-data sampling (vmap layout)."""
+    model = Logistic(num_features=4)
+    data, true = synth_logistic_data(jax.random.PRNGKey(3), 16384, 4)
+    post = consensus_sample(
+        model, data, num_shards=4, chains=8, kernel="chees",
+        num_warmup=250, num_samples=250, init_step_size=0.1,
+        map_init_steps=100, seed=0,
+    )
+    full = stark_tpu.sample(
+        model, data, chains=8, kernel="chees", num_warmup=250,
+        num_samples=250, init_step_size=0.1, seed=0,
+    )
+    assert post.max_rhat() < 1.05
+    m_c = np.asarray(post.draws["beta"]).mean((0, 1))
+    m_f = np.asarray(full.draws["beta"]).mean((0, 1))
+    sd_f = np.asarray(full.draws["beta"]).std((0, 1))
+    np.testing.assert_allclose(m_c, m_f, atol=4 * np.max(sd_f))
+    np.testing.assert_allclose(
+        m_c, np.asarray(true["beta"]), atol=5 * np.max(sd_f) + 0.05
+    )
+
+
+def test_consensus_chees_mesh_layout():
+    """Shards over the 8-device mesh, chees ensembles per device."""
+    from stark_tpu.parallel.mesh import make_mesh
+
+    model = Logistic(num_features=4)
+    data, _ = synth_logistic_data(jax.random.PRNGKey(4), 8192, 4)
+    mesh = make_mesh({"data": 8, "chains": 1})
+    post = consensus_sample(
+        model, data, num_shards=8, chains=4, kernel="chees",
+        num_warmup=200, num_samples=150, init_step_size=0.1,
+        mesh=mesh, dispatch_steps=100, seed=0,
+    )
+    assert post.num_samples == 150
+    assert post.max_rhat() < 1.1
+    assert np.isfinite(post.draws_flat).all()
+    # a mesh whose non-data axes would duplicate shard work is rejected
+    bad = make_mesh({"data": 4, "chains": 2})
+    with pytest.raises(ValueError, match="duplicate work"):
+        consensus_sample(
+            model, data, num_shards=4, chains=4, kernel="chees",
+            num_warmup=10, num_samples=10, mesh=bad, seed=0,
+        )
+    # dispatch bounding is chees-only for now; NUTS must say so
+    with pytest.raises(ValueError, match="dispatch_steps"):
+        consensus_sample(
+            model, data, num_shards=4, chains=2, kernel="nuts",
+            num_warmup=10, num_samples=10, dispatch_steps=5, seed=0,
+        )
