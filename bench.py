@@ -35,6 +35,7 @@ import json
 import math
 import os
 import shutil
+import subprocess
 import sys
 import time
 
@@ -121,15 +122,54 @@ def cpu_ess_per_sec_at(n, rec):
     return rec["ess_per_sec"] * rec["n"] / n
 
 
+def _probe_accelerator() -> bool:
+    """True iff accelerator client init completes; probed in a SUBPROCESS
+    with a timeout, because a dead axon relay makes jax.devices() hang
+    forever (observed r2: relay died mid-round and every client froze) —
+    and a bench that hangs records nothing at all.
+    """
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return False
+    try:
+        subprocess.run(
+            [sys.executable, "-u", "-c", "import jax; jax.devices()"],
+            timeout=_env_int("BENCH_PROBE_TIMEOUT", 180),
+            check=True,
+            capture_output=True,
+        )
+        return True
+    except Exception as e:  # noqa: BLE001 — timeout/crash both mean "no"
+        print(f"[bench] accelerator probe failed ({type(e).__name__}); "
+              "falling back to CPU platform", file=sys.stderr)
+        return False
+
+
 def main():
     import jax
+
+    fell_back = False
+    if not _probe_accelerator():
+        fell_back = os.environ.get("JAX_PLATFORMS", "") != "cpu"
+        # honored because the backend has not initialized yet in THIS
+        # process (same mechanism as conftest.py's platform override)
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — already initialized: keep going
+            pass
     import numpy as np
 
     import stark_tpu
     from stark_tpu.backends import CpuBackend, JaxBackend
     from stark_tpu.models import HierLogistic, synth_logistic_data
 
+    platform = jax.devices()[0].platform
     n = _env_int("BENCH_N", 1_000_000)
+    if fell_back and "BENCH_N" not in os.environ:
+        # dead-accelerator fallback at the 1M-row chip scale would not
+        # finish on the host; shrink so the round still records a result
+        # (deliberate CPU runs keep the documented default)
+        n = 100_000
+        print("[bench] fallback: shrinking default N to 100000", file=sys.stderr)
     n_cpu = _env_int("BENCH_CPU_N", 10_000)
     d = _env_int("BENCH_D", 32)
     groups = _env_int("BENCH_GROUPS", 1000)
@@ -138,7 +178,6 @@ def main():
     num_samples = _env_int("BENCH_SAMPLES", 200)
     depth = _env_int("BENCH_TREE_DEPTH", 6)
 
-    platform = jax.devices()[0].platform
     print(f"[bench] platform={platform} n={n} chains={chains}", file=sys.stderr)
 
     model = HierLogistic(num_features=d, num_groups=groups)
@@ -178,7 +217,9 @@ def main():
     # Pallas model is the production path, so by default spend the wall
     # budget there (BENCH_AUTODIFF=1 forces both)
     try_autodiff = os.environ.get("BENCH_AUTODIFF", "auto")
-    if try_autodiff == "1" or (try_autodiff == "auto" and platform == "cpu"):
+    if try_autodiff == "1" or (
+        try_autodiff == "auto" and platform == "cpu" and not fell_back
+    ):
         timed_run(model, "NUTS autodiff")
 
     # ChEES-HMC with a wide ensemble is the production sampler on
@@ -186,9 +227,14 @@ def main():
     # chain ~free (measured 0.25 ms/chain at C=64 vs 1.7 at C=8), and
     # ChEES spends far fewer gradients per draw than vmapped NUTS's
     # fixed 2^depth budget.  BENCH_CHEES=0 opts out.
+    # on a dead-accelerator fallback, still run the production chees leg
+    # (the fused kernel interprets on CPU and converges where the CPU
+    # autodiff NUTS leg at this scale would not)
     try_chees = os.environ.get("BENCH_CHEES", "auto")
     chees_converged = False
-    if try_chees == "1" or (try_chees == "auto" and platform != "cpu"):
+    if try_chees == "1" or (
+        try_chees == "auto" and (platform != "cpu" or fell_back)
+    ):
         try:
             from stark_tpu.models import FusedHierLogistic
             from stark_tpu.supervise import supervised_sample
@@ -355,6 +401,10 @@ def main():
                 "vs_baseline": round(vs_baseline, 2),
                 "converged": converged,
                 "max_rhat": round(rhat, 4),
+                "platform": platform,
+                # distinguishes a dead-accelerator degraded run from a
+                # deliberate CPU run in the recorded artifact itself
+                "accelerator_fallback": fell_back,
             }
         )
     )
