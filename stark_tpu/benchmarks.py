@@ -123,18 +123,22 @@ def bench_consensus_logistic(
     Default sub-posterior sampler is ensemble ChEES (the judged config
     pins "consensus Monte Carlo", not the within-shard kernel): measured
     on the CPU replica (n=100k, 8 shards), chees 6.2 ESS/s vs NUTS 2.3
-    at equal posterior accuracy.
+    at equal posterior accuracy.  On accelerators the fused Pallas
+    likelihood serves each shard's ensemble with one X pass per
+    evaluation (posterior parity with the plain model verified on CPU;
+    interpret mode there is slower, so CPU keeps the XLA autodiff path).
     """
-    from .models import Logistic
+    from .models import FusedLogistic, Logistic
 
-    model = Logistic(num_features=d)
+    on_accel = jax.devices()[0].platform != "cpu"
+    model = FusedLogistic(num_features=d) if on_accel else Logistic(num_features=d)
     data, _ = synth_logistic_data(jax.random.PRNGKey(seed), n, d)
 
     if sampler == "chees":
         # bound device programs on accelerators (6 transitions x the
         # 512-leapfrog warmup cap ~ the 3k-grad dispatch budget); on CPU
         # the monolithic dispatch avoids per-segment overhead
-        dispatch = 6 if jax.devices()[0].platform != "cpu" else None
+        dispatch = 6 if on_accel else None
 
         def run():
             return consensus_sample(

@@ -112,3 +112,24 @@ def test_consensus_chees_mesh_layout():
             model, data, num_shards=4, chains=2, kernel="nuts",
             num_warmup=10, num_samples=10, dispatch_steps=5, seed=0,
         )
+
+
+def test_consensus_chees_fused_model_parity():
+    """The fused Pallas likelihood composes with shard-vmapped ChEES
+    (custom_vmap batches chains inside each shard, lax.map over shards)
+    and matches the plain-autodiff posterior."""
+    from stark_tpu.models import FusedLogistic
+
+    data, _ = synth_logistic_data(jax.random.PRNGKey(5), 8192, 4)
+    kw = dict(num_shards=4, chains=8, kernel="chees", num_warmup=150,
+              num_samples=150, init_step_size=0.1, seed=0)
+    post_f = consensus_sample(FusedLogistic(num_features=4), data, **kw)
+    post_p = consensus_sample(Logistic(num_features=4), data, **kw)
+    assert post_f.max_rhat() < 1.05
+    assert post_p.max_rhat() < 1.05  # a sloppy plain run must not loosen sd
+    m_f = np.asarray(post_f.draws["beta"]).mean((0, 1))
+    m_p = np.asarray(post_p.draws["beta"]).mean((0, 1))
+    sd = np.asarray(post_p.draws["beta"]).std((0, 1))
+    # MC-error-scale tolerance: ~1200 correlated draws -> se ~ sd/20; a
+    # kernel bug shifting the posterior by ~1 sd must FAIL this
+    np.testing.assert_allclose(m_f, m_p, atol=0.5 * np.max(sd))
