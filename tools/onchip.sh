@@ -1,0 +1,20 @@
+#!/bin/sh
+# Round-2 on-chip measurement set. Run when the axon tunnel is alive
+# (probe: timeout 60 python -c "import jax; print(jax.devices())").
+#
+# Rules (see tpu notes in DESIGN.md / memory):
+#  - ONE TPU process at a time; never SIGTERM a TPU process mid-dispatch
+#    (a killed client can wedge the relay for the whole session) — no
+#    `timeout` wrappers here on purpose.
+#  - Each step is restartable; bench.py supervises/resumes itself.
+set -ex
+
+# 1. kernel roofline with the fixed timing methodology (distinct inputs,
+#    warm input excluded, per-dispatch synced) -> tools/roofline_results.json
+python tools/roofline.py
+
+# 2. five judged configs -> appends the measured table to BASELINE.md
+python -m stark_tpu bench-all --update-baseline BASELINE.md
+
+# 3. flagship (supervised ChEES, 1M rows) -> one JSON line + phase breakdown
+python bench.py
