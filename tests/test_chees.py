@@ -240,3 +240,11 @@ def test_chees_midwarmup_checkpoint_resume(tmp_path):
     done = [r for r in recs if r["event"] == "warmup_done"]
     assert len(done) == 1 and done[0]["resumed_from_step"] == 100
     assert np.isfinite(post.draws_flat).all()
+
+
+def test_halton_start_offset_continues_sequence():
+    """Resumed/segmented runs must continue the SAME low-discrepancy
+    stream: halton(n, start=k) == halton(n+k)[k:]."""
+    full = halton(64)
+    np.testing.assert_array_equal(halton(24, start=40), full[40:])
+    np.testing.assert_array_equal(halton(64, start=0), full)

@@ -142,3 +142,40 @@ def test_sbc_fused_hier_logistic():
         # span check: a collapsed/stuck sampler bunches ranks; uniform
         # ranks over [0, 127] must cover most of the range
         assert np.ptp(r) > 90, (int(np.min(r)), int(np.max(r)))
+
+
+def test_sbc_cox_ph():
+    """SBC on the Breslow partial likelihood with CONTINUOUS times.
+
+    Continuous times only: with heavy ties Breslow's denominator is a
+    known-biased approximation of the tied-event likelihood, and SBC
+    correctly flags that statistical bias (measured chi2 ~ 125 with
+    8-per-unit discretized times) — an estimator property, not an
+    implementation bug.  The implementation's tie-block handling is
+    pinned exactly by test_cox_breslow_ties_match_reference (O(N^2)
+    reference); this test covers the sampler+likelihood calibration in
+    the regime where the partial likelihood is the right estimator.
+    """
+    from stark_tpu.models import CoxPH
+
+    _n, _d = 96, 2
+    x_fix = jax.random.normal(jax.random.PRNGKey(44), (_n, _d))
+
+    def prior(key):
+        return {"beta": 2.5 * jax.random.normal(key, (_d,))}
+
+    def simulate(key, p):
+        k1, k2 = jax.random.split(key)
+        rate = jnp.exp(x_fix @ p["beta"])
+        t = jax.random.exponential(k1, (_n,)) / rate
+        event = (jax.random.uniform(k2, (_n,)) > 0.3).astype(jnp.float32)
+        return {"x": x_fix, "t": t, "event": event}
+
+    res = sbc(
+        CoxPH(num_features=_d), prior, simulate, jax.random.PRNGKey(5),
+        num_replicates=64, num_bins=8,
+        kernel="hmc", num_leapfrog=8, num_warmup=200, num_samples=127,
+        thin=2,
+    )
+    stats = res.chi2()
+    assert max(stats.values()) < 25.0, stats
