@@ -248,7 +248,12 @@ def main():
             # and warmup never recovers).
             chees_warm = _env_int("BENCH_CHEES_WARMUP", 400)
             chees_samp = _env_int("BENCH_CHEES_SAMPLES", 500)
-            block = dispatch if dispatch else chees_samp
+            # cap the block even without a dispatch bound: one monolithic
+            # 500-draw block means no mid-sampling checkpoint and no
+            # progress signal (the CPU-fallback validation spent 1.8h in
+            # a single silent block; a kill there loses everything past
+            # warmup)
+            block = dispatch if dispatch else min(chees_samp, 100)
             workdir = os.path.join(_REPO, ".bench_chees_workdir")
             # fresh run per bench invocation; WITHIN the invocation any
             # fault restarts from the last healthy block checkpoint
