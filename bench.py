@@ -252,8 +252,16 @@ def main():
             # 500-draw block means no mid-sampling checkpoint and no
             # progress signal (the CPU-fallback validation spent 1.8h in
             # a single silent block; a kill there loses everything past
-            # warmup)
-            block = dispatch if dispatch else min(chees_samp, 100)
+            # warmup).  Prefer a divisor of the draw budget so
+            # max_blocks * block == chees_samp exactly; fall back to a
+            # flat 100 (<= block-1 draws of overshoot) for awkward counts
+            block = dispatch
+            if not block:
+                block = next(
+                    (b for b in range(min(chees_samp, 100), 24, -1)
+                     if chees_samp % b == 0),
+                    min(chees_samp, 100),
+                )
             workdir = os.path.join(_REPO, ".bench_chees_workdir")
             # fresh run per bench invocation; WITHIN the invocation any
             # fault restarts from the last healthy block checkpoint
