@@ -179,7 +179,14 @@ def bench_lmm(
     6 / warmup 300 measured R-hat > 100; depth 9 / warmup 600+
     converges — hence the depth-9 default).
     """
-    model = LinearMixedModel(num_features=d, num_groups=groups, num_random=2)
+    from .models import FusedLinearMixedModel
+
+    # fused gaussian kernel on accelerators: one X pass per value+grad,
+    # ensemble-shared under vmap (posterior parity tested on CPU; the
+    # interpret-mode kernel is slower there, so CPU keeps autodiff)
+    on_accel = jax.devices()[0].platform != "cpu"
+    mk = FusedLinearMixedModel if on_accel else LinearMixedModel
+    model = mk(num_features=d, num_groups=groups, num_random=2)
     data, _ = synth_lmm_data(jax.random.PRNGKey(seed), n, d, groups)
     # d ~ 2*groups+... is large here; bound each device program so a single
     # dispatch stays within the ~3k-grad-eval budget device execution

@@ -45,6 +45,7 @@ def _model_registry() -> Dict[str, Callable]:
         "FusedLogistic": models.FusedLogistic,
         "FusedHierLogistic": models.FusedHierLogistic,
         "LinearMixedModel": models.LinearMixedModel,
+        "FusedLinearMixedModel": models.FusedLinearMixedModel,
         "LinearRegression": models.LinearRegression,
         "PoissonRegression": models.PoissonRegression,
         "GaussianMixture": models.GaussianMixture,

@@ -8,7 +8,7 @@ from .glm import (
 )
 from .gmm import GaussianMixture, synth_gmm_data
 from .irt import IRT2PL, synth_irt_data
-from .lmm import LinearMixedModel, synth_lmm_data
+from .lmm import FusedLinearMixedModel, LinearMixedModel, synth_lmm_data
 from .logistic import (
     FusedHierLogistic,
     FusedLogistic,
@@ -33,6 +33,7 @@ __all__ = [
     "CoxPH",
     "EightSchools",
     "FusedHierLogistic",
+    "FusedLinearMixedModel",
     "FusedLogistic",
     "GaussianMixture",
     "HierLogistic",

@@ -58,6 +58,37 @@ class LinearMixedModel(Model):
         return jnp.sum(jstats.norm.logpdf(data["y"], mu, p["sigma"]))
 
 
+class FusedLinearMixedModel(LinearMixedModel):
+    """LMM with the fused gaussian Pallas kernel.
+
+    Identical posterior; the (N, D) fixed-effects stream is read ONCE per
+    value+gradient evaluation (vs twice under autodiff), and under vmap
+    the whole chain ensemble shares that single pass — same treatment the
+    flagship logistic gets from `ops/logistic_fused.py`.  The
+    random-effects rowwise dot and its scatter-add VJP stay in XLA via
+    the offsets input (∂/∂offsets = residual/sigma²).
+    """
+
+    def prepare_data(self, data):
+        from .logistic import _transpose_x
+
+        return _transpose_x(data)
+
+    def data_row_axes(self, data):
+        from .logistic import _row_axes_xt
+
+        return _row_axes_xt(data)
+
+    def log_lik(self, p, data):
+        from ..ops.logistic_fused import gaussian_offset_loglik
+
+        u = p["u_raw"] * p["tau"][None, :]  # (G, Q) non-centered
+        offsets = p["intercept"] + jnp.sum(data["z"] * u[data["g"]], axis=-1)
+        return gaussian_offset_loglik(
+            p["beta"], offsets, data["xT"], data["y"], p["sigma"]
+        )
+
+
 def synth_lmm_data(
     key, n, num_features, num_groups, *, num_random=2, noise=0.5,
     dtype=jnp.float32,

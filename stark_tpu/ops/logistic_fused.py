@@ -59,7 +59,30 @@ def _default_lane_tile(d: int) -> int:
     return max(128, min(_LANE_TILE, (_SLAB_BUDGET_ELEMS // max(d, 1)) // 128 * 128))
 
 
-def _make_kernel(n, lane_tile, with_offset):
+def _link_parts(link, y, logits, mask):
+    """Per-link elementwise math shared by both tile kernels.
+
+    Returns (val_terms, resid): ``val_terms`` summed into the kernel's
+    value output, ``resid`` the per-row quantity whose X-weighted sum is
+    the beta-gradient direction.
+      bernoulli_logit: val = log-lik terms,    resid = y - sigmoid(logits)
+      gaussian:        val = (y - mu)^2 (SSR), resid = y - mu
+    (the gaussian value/gradient are SCALE-FREE: the caller applies
+    1/sigma^2 outside, so sigma never enters the kernel)
+    """
+    if link == "bernoulli_logit":
+        ll = y * jax.nn.log_sigmoid(logits) + (1.0 - y) * jax.nn.log_sigmoid(
+            -logits
+        )
+        resid = jnp.where(mask, y - jax.nn.sigmoid(logits), 0.0)
+        return jnp.where(mask, ll, 0.0), resid
+    if link == "gaussian":
+        resid = jnp.where(mask, y - logits, 0.0)
+        return resid * resid, resid
+    raise ValueError(f"unknown link {link!r}")
+
+
+def _make_kernel(n, lane_tile, with_offset, link):
     """Tile kernel for a dataset of ``n`` rows (static)."""
 
     def kernel(*refs):
@@ -77,11 +100,10 @@ def _make_kernel(n, lane_tile, with_offset):
         logits = jnp.sum(xt * beta, axis=0, keepdims=True)  # (1, TILE)
         if off_ref is not None:
             logits = logits + jnp.where(mask, off_ref[...], 0.0)
-        ll = y * jax.nn.log_sigmoid(logits) + (1.0 - y) * jax.nn.log_sigmoid(-logits)
+        val_terms, resid = _link_parts(link, y, logits, mask)
         # partial-sum rows shaped (1, 1, ·)/(1, D, 1) to satisfy TPU tiling
         # (block last-two dims must equal the array's when not (8, 128)-aligned)
-        val_ref[...] = jnp.sum(jnp.where(mask, ll, 0.0)).reshape(1, 1, 1)
-        resid = jnp.where(mask, y - jax.nn.sigmoid(logits), 0.0)  # (1, TILE)
+        val_ref[...] = jnp.sum(val_terms).reshape(1, 1, 1)
         if resid_ref is not None:
             resid_ref[...] = resid
         grad_ref[...] = jnp.sum(xt * resid, axis=1, keepdims=True)[None]  # (1, D, 1)
@@ -89,7 +111,7 @@ def _make_kernel(n, lane_tile, with_offset):
     return kernel
 
 
-def _make_batched_kernel(n, lane_tile, with_offset):
+def _make_batched_kernel(n, lane_tile, with_offset, link):
     """Chain-batched tile kernel: one X slab read serves ALL chains.
 
     Per-chain evaluation under ``vmap`` re-streams the (D, N) row matrix
@@ -124,11 +146,8 @@ def _make_batched_kernel(n, lane_tile, with_offset):
         )  # (C, TILE) — MXU
         if off_ref is not None:
             logits = logits + jnp.where(mask, off_ref[...], 0.0)  # (C, TILE)
-        ll = y * jax.nn.log_sigmoid(logits) + (1.0 - y) * jax.nn.log_sigmoid(
-            -logits
-        )
-        val_ref[...] = jnp.sum(jnp.where(mask, ll, 0.0), axis=1)[None, :, None]
-        resid = jnp.where(mask, y - jax.nn.sigmoid(logits), 0.0)  # (C, TILE)
+        val_terms, resid = _link_parts(link, y, logits, mask)  # (C, TILE)
+        val_ref[...] = jnp.sum(val_terms, axis=1)[None, :, None]
         if resid_ref is not None:
             resid_ref[...] = resid
         # (C, TILE) x (TILE, D) -> (C, D) — second MXU pass, in-VMEM
@@ -140,7 +159,8 @@ def _make_batched_kernel(n, lane_tile, with_offset):
     return kernel
 
 
-def _batched_call(beta, xt, y, offsets, *, lane_tile, interpret):
+def _batched_call(beta, xt, y, offsets, *, lane_tile, interpret,
+                  link="bernoulli_logit"):
     """Chain-batched fused pass.
 
     beta: (C, D); offsets: (C, N) or None -> (val (C,), grad (C, D)
@@ -187,7 +207,7 @@ def _batched_call(beta, xt, y, offsets, *, lane_tile, interpret):
         )
 
     out = pl.pallas_call(
-        _make_batched_kernel(n, lane_tile, offsets is not None),
+        _make_batched_kernel(n, lane_tile, offsets is not None, link),
         grid=(grid,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -201,11 +221,15 @@ def _batched_call(beta, xt, y, offsets, *, lane_tile, interpret):
     return val, grad
 
 
-def _fused_call(beta, xt, y, offsets, *, lane_tile, interpret):
+def _fused_call(beta, xt, y, offsets, *, lane_tile, interpret,
+                link="bernoulli_logit"):
     """Build specs and invoke the tile kernel.
 
-    -> (ll scalar, dll/dbeta (D,)), plus the (N,) per-row residual when
-    ``offsets`` is given.
+    -> (val scalar, X-weighted resid (D,)), plus the (N,) per-row
+    residual when ``offsets`` is given.  Semantics are link-dependent
+    (see _link_parts): for bernoulli_logit val IS the log-lik and the
+    (D,) output its beta-gradient; for gaussian val is the SSR and the
+    outputs are SCALE-FREE — the caller applies the 1/sigma^2 factors.
     """
     if interpret is None:
         interpret = jax.default_backend() == "cpu"  # non-CPU (tpu/axon): real Mosaic lowering
@@ -242,7 +266,7 @@ def _fused_call(beta, xt, y, offsets, *, lane_tile, interpret):
         out_shape.append(jax.ShapeDtypeStruct((1, grid * lane_tile), jnp.float32))
 
     out = pl.pallas_call(
-        _make_kernel(n, lane_tile, offsets is not None),
+        _make_kernel(n, lane_tile, offsets is not None, link),
         grid=(grid,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -287,29 +311,42 @@ def _vg_noff_vmap(axis_size, in_batched, beta, xt, y):
     )
 
 
-@jax.custom_batching.custom_vmap
-def _vg_off(beta, offsets, xt, y):
-    return _fused_call(beta, xt, y, offsets, lane_tile=None, interpret=None)
+def _make_vg_off(link):
+    """Offset-taking fused op with the chain-batching rule, per link —
+    one body so the batching logic cannot drift between links."""
 
-
-@_vg_off.def_vmap
-def _vg_off_vmap(axis_size, in_batched, beta, offsets, xt, y):
-    beta_b, off_b, xt_b, y_b = in_batched
-    if xt_b or y_b:
-        out = jax.lax.map(
-            lambda a: _vg_off(*a),
-            tuple(
-                _bcast(v, b, axis_size)
-                for v, b in zip((beta, offsets, xt, y), in_batched)
-            ),
+    @jax.custom_batching.custom_vmap
+    def vg_off(beta, offsets, xt, y):
+        return _fused_call(
+            beta, xt, y, offsets, lane_tile=None, interpret=None, link=link
         )
-        return out, (True, True, True)
-    beta = _bcast(beta, beta_b, axis_size)
-    offsets = _bcast(offsets, off_b, axis_size)
-    return (
-        _batched_call(beta, xt, y, offsets, lane_tile=None, interpret=None),
-        (True, True, True),
-    )
+
+    @vg_off.def_vmap
+    def _vmap_rule(axis_size, in_batched, beta, offsets, xt, y):
+        beta_b, off_b, xt_b, y_b = in_batched
+        if xt_b or y_b:
+            out = jax.lax.map(
+                lambda a: vg_off(*a),
+                tuple(
+                    _bcast(v, b, axis_size)
+                    for v, b in zip((beta, offsets, xt, y), in_batched)
+                ),
+            )
+            return out, (True, True, True)
+        beta = _bcast(beta, beta_b, axis_size)
+        offsets = _bcast(offsets, off_b, axis_size)
+        return (
+            _batched_call(
+                beta, xt, y, offsets, lane_tile=None, interpret=None,
+                link=link,
+            ),
+            (True, True, True),
+        )
+
+    return vg_off
+
+
+_vg_off = _make_vg_off("bernoulli_logit")
 
 
 @functools.partial(jax.jit, static_argnames=("lane_tile", "interpret"))
@@ -381,3 +418,54 @@ def _noff_bwd(gbeta, ct):
 
 
 logistic_loglik.defvjp(_noff_fwd, _noff_bwd)
+
+
+# --- gaussian link: fused SSR + gradient direction in one X pass --------
+# The kernel is SCALE-FREE (sigma never enters): it returns the sum of
+# squared residuals, X·resid, and the residual vector; the normal
+# log-density and every gradient are assembled outside from those three,
+# so the same one-pass kernel serves any noise scale (and its sigma
+# gradient comes from the already-computed SSR).
+
+
+_vg_gauss_off = _make_vg_off("gaussian")
+
+_LOG_2PI = 1.8378770664093453
+
+
+@jax.custom_vjp
+def gaussian_offset_loglik(beta, offsets, xt, y, sigma):
+    """Fused normal log-lik of y ~ N(Xβ + offsets, sigma) in one X pass.
+
+    ``xt`` is X transposed, (D, N); offsets (N,) carries everything that
+    is not Xβ (intercept, gathered random effects, ...), so ∂/∂offsets —
+    the residual/sigma² — chains through whatever produced them in XLA.
+    Under ``vmap`` over chains the whole ensemble shares ONE X pass
+    (`_vg_gauss_off`'s batching rule).
+    """
+    ssr, _, _ = _vg_gauss_off(beta, offsets, xt, y)
+    n = y.shape[-1]
+    return -0.5 * ssr / sigma**2 - n * jnp.log(sigma) - 0.5 * n * _LOG_2PI
+
+
+def _gauss_fwd(beta, offsets, xt, y, sigma):
+    ssr, xresid, resid = _vg_gauss_off(beta, offsets, xt, y)
+    n = y.shape[-1]
+    val = -0.5 * ssr / sigma**2 - n * jnp.log(sigma) - 0.5 * n * _LOG_2PI
+    return val, (xresid, resid, ssr, sigma)
+
+
+def _gauss_bwd(res, ct):
+    xresid, resid, ssr, sigma = res
+    n = resid.shape[-1]
+    inv2 = 1.0 / (sigma * sigma)
+    return (
+        ct * inv2 * xresid,
+        ct * inv2 * resid,
+        None,
+        None,
+        ct * (ssr * inv2 / sigma - n / sigma),
+    )
+
+
+gaussian_offset_loglik.defvjp(_gauss_fwd, _gauss_bwd)

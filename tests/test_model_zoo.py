@@ -240,3 +240,30 @@ def test_cox_unsorted_input_handled_by_prepare_data():
         np.asarray(post.draws["beta"]).mean((0, 1)),
         np.asarray(true["beta"]), atol=0.15,
     )
+
+
+def test_fused_lmm_matches_plain_posterior():
+    """FusedLinearMixedModel (gaussian Pallas kernel) reaches the same
+    posterior as the autodiff LMM under the ensemble sampler."""
+    from stark_tpu.models import (
+        FusedLinearMixedModel,
+        LinearMixedModel,
+        synth_lmm_data,
+    )
+
+    data, _ = synth_lmm_data(jax.random.PRNGKey(12), 6000, 4, 50)
+    kw = dict(chains=8, kernel="chees", num_warmup=300, num_samples=300,
+              init_step_size=0.1, map_init_steps=100, seed=0)
+    post_f = stark_tpu.sample(
+        FusedLinearMixedModel(num_features=4, num_groups=50), data, **kw
+    )
+    post_p = stark_tpu.sample(
+        LinearMixedModel(num_features=4, num_groups=50), data, **kw
+    )
+    assert post_f.max_rhat() < 1.05
+    assert post_p.max_rhat() < 1.05
+    for name in ("beta", "intercept", "sigma", "tau"):
+        m_f = np.asarray(post_f.draws[name]).mean((0, 1))
+        m_p = np.asarray(post_p.draws[name]).mean((0, 1))
+        sd = np.asarray(post_p.draws[name]).std((0, 1))
+        np.testing.assert_allclose(m_f, m_p, atol=0.5 * np.max(sd) + 1e-3)
