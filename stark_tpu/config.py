@@ -47,6 +47,7 @@ def _model_registry() -> Dict[str, Callable]:
         "LinearMixedModel": models.LinearMixedModel,
         "FusedLinearMixedModel": models.FusedLinearMixedModel,
         "LinearRegression": models.LinearRegression,
+        "FusedLinearRegression": models.FusedLinearRegression,
         "PoissonRegression": models.PoissonRegression,
         "GaussianMixture": models.GaussianMixture,
         "BayesianMLP": models.BayesianMLP,

@@ -1,6 +1,7 @@
 from .bnn import BayesianMLP, synth_bnn_data
 from .eight_schools import EightSchools, eight_schools_data
 from .glm import (
+    FusedLinearRegression,
     LinearRegression,
     PoissonRegression,
     synth_linreg_data,
@@ -34,6 +35,7 @@ __all__ = [
     "EightSchools",
     "FusedHierLogistic",
     "FusedLinearMixedModel",
+    "FusedLinearRegression",
     "FusedLogistic",
     "GaussianMixture",
     "HierLogistic",

@@ -15,6 +15,7 @@ import jax.scipy.stats as jstats
 
 from ..bijectors import Exp
 from ..model import Model, ParamSpec
+from .logistic import TransposedXMixin as _TransposedXMixin
 
 
 class LinearRegression(Model):
@@ -38,6 +39,18 @@ class LinearRegression(Model):
     def log_lik(self, p, data):
         mu = data["x"] @ p["beta"]
         return jnp.sum(jstats.norm.logpdf(data["y"], mu, p["sigma"]))
+
+
+class FusedLinearRegression(_TransposedXMixin, LinearRegression):
+    """LinearRegression with the fused gaussian Pallas kernel: value +
+    gradient direction in one pass over X, no offset stream (the
+    no-offset entry skips the (N,) offset read and residual write the
+    offset variant pays — same split as logistic_loglik)."""
+
+    def log_lik(self, p, data):
+        from ..ops.logistic_fused import gaussian_loglik
+
+        return gaussian_loglik(p["beta"], data["xT"], data["y"], p["sigma"])
 
 
 class PoissonRegression(Model):

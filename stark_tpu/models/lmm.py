@@ -22,6 +22,7 @@ import jax.scipy.stats as jstats
 
 from ..bijectors import Exp
 from ..model import Model, ParamSpec
+from .logistic import TransposedXMixin as _TransposedXMixin
 
 
 class LinearMixedModel(Model):
@@ -58,7 +59,7 @@ class LinearMixedModel(Model):
         return jnp.sum(jstats.norm.logpdf(data["y"], mu, p["sigma"]))
 
 
-class FusedLinearMixedModel(LinearMixedModel):
+class FusedLinearMixedModel(_TransposedXMixin, LinearMixedModel):
     """LMM with the fused gaussian Pallas kernel.
 
     Identical posterior; the (N, D) fixed-effects stream is read ONCE per
@@ -68,16 +69,6 @@ class FusedLinearMixedModel(LinearMixedModel):
     random-effects rowwise dot and its scatter-add VJP stay in XLA via
     the offsets input (∂/∂offsets = residual/sigma²).
     """
-
-    def prepare_data(self, data):
-        from .logistic import _transpose_x
-
-        return _transpose_x(data)
-
-    def data_row_axes(self, data):
-        from .logistic import _row_axes_xt
-
-        return _row_axes_xt(data)
 
     def log_lik(self, p, data):
         from ..ops.logistic_fused import gaussian_offset_loglik

@@ -94,18 +94,25 @@ def _row_axes_xt(data):
     return {k: (1 if k == "xT" else 0) for k in data}
 
 
-class FusedLogistic(Logistic):
-    """Logistic with the one-pass Pallas likelihood kernel.
-
-    Identical posterior; the per-evaluation HBM traffic over the row
-    matrix is halved vs autodiff (see ops/logistic_fused.py).
-    """
+class TransposedXMixin:
+    """Shared layout hooks for every fused-kernel model: replace the
+    (N, D) row matrix with its (D, N) transpose once, host-side, and
+    declare the moved row axis for the data sharder.  ONE copy of the
+    fused-layout convention — all Fused* models mix this in."""
 
     def prepare_data(self, data):
         return _transpose_x(data)
 
     def data_row_axes(self, data):
         return _row_axes_xt(data)
+
+
+class FusedLogistic(TransposedXMixin, Logistic):
+    """Logistic with the one-pass Pallas likelihood kernel.
+
+    Identical posterior; the per-evaluation HBM traffic over the row
+    matrix is halved vs autodiff (see ops/logistic_fused.py).
+    """
 
     def log_lik(self, p, data):
         from ..ops.logistic_fused import logistic_loglik
@@ -113,16 +120,10 @@ class FusedLogistic(Logistic):
         return logistic_loglik(p["beta"], data["xT"], data["y"])
 
 
-class FusedHierLogistic(HierLogistic):
+class FusedHierLogistic(TransposedXMixin, HierLogistic):
     """HierLogistic with the fused kernel: the X-pass runs in Pallas; the
     group-intercept gather and its segment-sum VJP stay in XLA via the
     custom_vjp residual output."""
-
-    def prepare_data(self, data):
-        return _transpose_x(data)
-
-    def data_row_axes(self, data):
-        return _row_axes_xt(data)
 
     def log_lik(self, p, data):
         from ..ops.logistic_fused import logistic_offset_loglik

@@ -290,25 +290,39 @@ def _bcast(x, batched, axis_size):
     return x if batched else jnp.broadcast_to(x[None], (axis_size,) + x.shape)
 
 
-@jax.custom_batching.custom_vmap
-def _vg_noff(beta, xt, y):
-    return _fused_call(beta, xt, y, None, lane_tile=None, interpret=None)
+def _make_vg_noff(link):
+    """No-offset fused op with the chain-batching rule, per link."""
 
-
-@_vg_noff.def_vmap
-def _vg_noff_vmap(axis_size, in_batched, beta, xt, y):
-    beta_b, xt_b, y_b = in_batched
-    if xt_b or y_b:  # batched data: nothing to share — map chain-wise
-        out = jax.lax.map(
-            lambda a: _vg_noff(*a),
-            tuple(_bcast(v, b, axis_size) for v, b in zip((beta, xt, y), in_batched)),
+    @jax.custom_batching.custom_vmap
+    def vg_noff(beta, xt, y):
+        return _fused_call(
+            beta, xt, y, None, lane_tile=None, interpret=None, link=link
         )
-        return out, (True, True)
-    beta = _bcast(beta, beta_b, axis_size)
-    return (
-        _batched_call(beta, xt, y, None, lane_tile=None, interpret=None),
-        (True, True),
-    )
+
+    @vg_noff.def_vmap
+    def _vmap_rule(axis_size, in_batched, beta, xt, y):
+        beta_b, xt_b, y_b = in_batched
+        if xt_b or y_b:  # batched data: nothing to share — map chain-wise
+            out = jax.lax.map(
+                lambda a: vg_noff(*a),
+                tuple(
+                    _bcast(v, b, axis_size)
+                    for v, b in zip((beta, xt, y), in_batched)
+                ),
+            )
+            return out, (True, True)
+        beta = _bcast(beta, beta_b, axis_size)
+        return (
+            _batched_call(
+                beta, xt, y, None, lane_tile=None, interpret=None, link=link
+            ),
+            (True, True),
+        )
+
+    return vg_noff
+
+
+_vg_noff = _make_vg_noff("bernoulli_logit")
 
 
 def _make_vg_off(link):
@@ -429,6 +443,7 @@ logistic_loglik.defvjp(_noff_fwd, _noff_bwd)
 
 
 _vg_gauss_off = _make_vg_off("gaussian")
+_vg_gauss_noff = _make_vg_noff("gaussian")
 
 _LOG_2PI = 1.8378770664093453
 
@@ -469,3 +484,37 @@ def _gauss_bwd(res, ct):
 
 
 gaussian_offset_loglik.defvjp(_gauss_fwd, _gauss_bwd)
+
+
+@jax.custom_vjp
+def gaussian_loglik(beta, xt, y, sigma):
+    """Fused normal log-lik of y ~ N(Xβ, sigma), no offsets.
+
+    Like `logistic_loglik` vs its offset variant: no (N,) offset stream
+    in and no (N,) residual written back per evaluation — only the SSR
+    and X·resid leave the kernel.
+    """
+    ssr, _ = _vg_gauss_noff(beta, xt, y)
+    n = y.shape[-1]
+    return -0.5 * ssr / sigma**2 - n * jnp.log(sigma) - 0.5 * n * _LOG_2PI
+
+
+def _gauss_noff_fwd(beta, xt, y, sigma):
+    ssr, xresid = _vg_gauss_noff(beta, xt, y)
+    n = y.shape[-1]
+    val = -0.5 * ssr / sigma**2 - n * jnp.log(sigma) - 0.5 * n * _LOG_2PI
+    return val, (xresid, ssr, sigma, jnp.asarray(float(n), jnp.float32))
+
+
+def _gauss_noff_bwd(res, ct):
+    xresid, ssr, sigma, n = res
+    inv2 = 1.0 / (sigma * sigma)
+    return (
+        ct * inv2 * xresid,
+        None,
+        None,
+        ct * (ssr * inv2 / sigma - n / sigma),
+    )
+
+
+gaussian_loglik.defvjp(_gauss_noff_fwd, _gauss_noff_bwd)

@@ -66,3 +66,35 @@ def test_debug_nans_raises_in_model_code():
             kernel="hmc", num_leapfrog=4, num_warmup=50, num_samples=50,
             seed=0, debug_nans=True,
         )
+
+
+def test_fused_linreg_matches_plain():
+    """FusedLinearRegression (gaussian kernel, zero offsets) matches the
+    autodiff LinearRegression: potential+grad parity and posterior parity."""
+    import jax
+
+    from stark_tpu.model import flatten_model, prepare_model_data
+    from stark_tpu.models import FusedLinearRegression, LinearRegression
+
+    data, true = synth_linreg_data(jax.random.PRNGKey(6), 4096, 5)
+    m_f = FusedLinearRegression(num_features=5)
+    m_p = LinearRegression(num_features=5)
+    fm_f, fm_p = flatten_model(m_f), flatten_model(m_p)
+    d_f, d_p = prepare_model_data(m_f, data), prepare_model_data(m_p, data)
+    z = 0.3 * jax.random.normal(jax.random.PRNGKey(7), (fm_p.ndim,))
+    v_f, g_f = jax.value_and_grad(fm_f.potential)(z, d_f)
+    v_p, g_p = jax.value_and_grad(fm_p.potential)(z, d_p)
+    np.testing.assert_allclose(float(v_f), float(v_p), rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(g_f), np.asarray(g_p), rtol=2e-4, atol=2e-4
+    )
+
+    post = stark_tpu.sample(
+        m_f, data, chains=2, kernel="nuts", max_tree_depth=6,
+        num_warmup=250, num_samples=250, seed=0,
+    )
+    assert post.max_rhat() < 1.05
+    np.testing.assert_allclose(
+        np.asarray(post.draws["beta"]).mean((0, 1)),
+        np.asarray(true["beta"]), atol=0.1,
+    )
