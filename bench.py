@@ -1,5 +1,17 @@
 #!/usr/bin/env python
-"""Driver benchmark entry point — prints ONE JSON line.
+"""Driver benchmark entry point — prints best-so-far JSON lines; the LAST
+line is the result.
+
+A single final-line-only contract lost two rounds of results to capture
+timeouts (BENCH_r01/r02 both null), so this bench is timeout-proof: it
+prints a parseable best-so-far JSON line at start, after warmup, and after
+EVERY supervised draw block (each flagged ``"partial": true``), then the
+final authoritative line (no ``partial`` flag).  Whatever kills the
+process — driver timeout, SIGKILL, tunnel fault past the retry budget —
+the artifact still carries the latest measured state.  BENCH_TIME_BUDGET
+(seconds; default 900 on a dead-accelerator fallback, unlimited otherwise)
+additionally bounds the sampling loop itself so the designed configuration
+finishes inside a plausible capture window.
 
 Metric (BASELINE.json:2): effective samples/sec/chip on the hierarchical
 logistic workload (the north-star config, BASELINE.json:5,8).
@@ -28,7 +40,8 @@ scaling — deliberately generous to the baseline.
 
 Env knobs: BENCH_N (default 1000000), BENCH_CHAINS (8), BENCH_WARMUP (200),
 BENCH_SAMPLES (200), BENCH_CHEES_CHAINS (32), BENCH_CHEES_WARMUP (400),
-BENCH_CHEES_SAMPLES (500), BENCH_DISPATCH, BENCH_MAX_RESTARTS (3).
+BENCH_CHEES_SAMPLES (500), BENCH_DISPATCH, BENCH_MAX_RESTARTS (3),
+BENCH_TIME_BUDGET (seconds; 0 = unlimited).
 """
 
 import json
@@ -122,6 +135,57 @@ def cpu_ess_per_sec_at(n, rec):
     return rec["ess_per_sec"] * rec["n"] / n
 
 
+def load_or_measure_cpu_denominator(d, groups, depth, n_cpu, num_warmup,
+                                    num_samples):
+    """The committed host-driven reference record (measure if absent).
+
+    Runs BEFORE the accelerator legs so every best-so-far partial line can
+    already carry a vs_baseline — a bench killed mid-run must not leave a
+    denominator-less artifact.
+    """
+    import jax
+
+    import stark_tpu
+    from stark_tpu.backends import CpuBackend
+    from stark_tpu.models import HierLogistic, synth_logistic_data
+
+    rec = None
+    if os.path.exists(_BASELINE_FILE) and not os.environ.get("BENCH_FORCE_CPU"):
+        with open(_BASELINE_FILE) as f:
+            rec = json.load(f)
+        if "ess_per_sec" not in rec:
+            rec = None  # partial record (cost curve only) — re-measure fully
+    if rec is None or "fit" not in rec:
+        model_cpu = HierLogistic(num_features=d, num_groups=groups)
+        if rec is None:
+            data_cpu, _ = synth_logistic_data(
+                jax.random.PRNGKey(0), n_cpu, d, num_groups=groups
+            )
+            t0 = time.perf_counter()
+            post_cpu = stark_tpu.sample(
+                model_cpu, data_cpu, backend=CpuBackend(), chains=2, seed=0,
+                kernel="nuts", max_tree_depth=depth,
+                num_warmup=max(num_warmup // 2, 50),
+                num_samples=max(num_samples // 2, 50),
+            )
+            wall_cpu = time.perf_counter() - t0
+            rec = {
+                "n": n_cpu,
+                "ess_per_sec": post_cpu.min_ess() / wall_cpu,
+                "config": f"HierLogistic d={d} g={groups}, NUTS depth{depth}, "
+                          "2 chains, host-driven reference",
+            }
+        points, fit = measure_cpu_cost_curve(model_cpu, d, groups)
+        rec["cost_points"] = points
+        rec["fit"] = fit
+        try:
+            with open(_BASELINE_FILE, "w") as f:
+                json.dump(rec, f, indent=1)
+        except OSError:
+            pass
+    return rec
+
+
 def _probe_accelerator() -> bool:
     """True iff accelerator client init completes; probed in a SUBPROCESS
     with a timeout, because a dead axon relay makes jax.devices() hang
@@ -147,6 +211,7 @@ def _probe_accelerator() -> bool:
 def main():
     import jax
 
+    t_bench = time.perf_counter()
     fell_back = False
     if not _probe_accelerator():
         fell_back = os.environ.get("JAX_PLATFORMS", "") != "cpu"
@@ -163,14 +228,57 @@ def main():
     from stark_tpu.models import HierLogistic, synth_logistic_data
 
     platform = jax.devices()[0].platform
+    time_budget = float(
+        os.environ.get("BENCH_TIME_BUDGET", "900" if fell_back else "0")
+    )
+    if fell_back:
+        # Dead-accelerator fallback: the chip config scaled only in N
+        # measured ~8,100 s on the host (BASELINE.md r2 validation) — no
+        # plausible capture window survives that, so the r2 artifact was
+        # empty.  Scale EVERY axis to a config the host finishes inside
+        # BENCH_TIME_BUDGET; explicit env settings still win.
+        # measured end-to-end (r3 validation): 197 s wall at the smaller
+        # 300+300 budget — this 400+500 config has convergence headroom
+        # and still fits the 900 s default budget with ~2x margin
+        for name, v in (
+            ("BENCH_N", "20000"),
+            ("BENCH_CHEES_CHAINS", "16"),
+            ("BENCH_CHEES_WARMUP", "400"),
+            ("BENCH_CHEES_SAMPLES", "500"),
+            ("BENCH_MAP_INIT", "300"),
+        ):
+            os.environ.setdefault(name, v)
+        print(
+            "[bench] fallback: capture-sized config "
+            f"(budget {time_budget:.0f}s): "
+            + " ".join(f"{k}={os.environ[k]}" for k, _ in (
+                ("BENCH_N", 0), ("BENCH_CHEES_CHAINS", 0),
+                ("BENCH_CHEES_WARMUP", 0), ("BENCH_CHEES_SAMPLES", 0),
+                ("BENCH_MAP_INIT", 0),
+            )),
+            file=sys.stderr,
+        )
     n = _env_int("BENCH_N", 1_000_000)
-    if fell_back and "BENCH_N" not in os.environ:
-        # dead-accelerator fallback at the 1M-row chip scale would not
-        # finish on the host; shrink so the round still records a result
-        # (deliberate CPU runs keep the documented default)
-        n = 100_000
-        print("[bench] fallback: shrinking default N to 100000", file=sys.stderr)
     n_cpu = _env_int("BENCH_CPU_N", 10_000)
+    # first parseable line BEFORE any measurement work: a kill during the
+    # denominator load/measure phase must still leave an artifact
+    print(
+        json.dumps(
+            {
+                "metric": f"min-ESS/sec/chip, hierarchical logistic N={n} "
+                "(starting)",
+                "value": 0.0,
+                "unit": "ess/sec/chip",
+                "vs_baseline": 0.0,
+                "converged": False,
+                "partial": True,
+                "phase": "starting",
+                "platform": platform,
+                "accelerator_fallback": fell_back,
+            }
+        ),
+        flush=True,
+    )
     d = _env_int("BENCH_D", 32)
     groups = _env_int("BENCH_GROUPS", 1000)
     chains = _env_int("BENCH_CHAINS", 8)
@@ -179,6 +287,52 @@ def main():
     depth = _env_int("BENCH_TREE_DEPTH", 6)
 
     print(f"[bench] platform={platform} n={n} chains={chains}", file=sys.stderr)
+
+    # ---- CPU reference denominator, FIRST (host-driven, reference-style):
+    # partial lines need vs_baseline before any sampling starts ----
+    rec = load_or_measure_cpu_denominator(
+        d, groups, depth, n_cpu, num_warmup, num_samples
+    )
+    cpu_eps_at_n = cpu_ess_per_sec_at(n, rec)
+    print(
+        f"[bench] cpu-ref: ess/s={rec['ess_per_sec']:.4f} at n={rec['n']}, "
+        f"extrapolated {cpu_eps_at_n:.6f} at n={n} "
+        f"(cost fit: {rec['fit']['a']*1e3:.2f} ms + {rec['fit']['b']*1e9:.2f} ns/row)",
+        file=sys.stderr,
+    )
+    # The north star compares against a 32-EXECUTOR Spark-CPU cluster
+    # (BASELINE.json:5); the recorded reference ran on one core, so scale
+    # the denominator up by the executor count (ideal linear scaling — a
+    # deliberately generous assumption for the baseline).
+    executors = _env_int("BENCH_CPU_EXECUTORS", 32)
+    denom = max(cpu_eps_at_n * executors, 1e-12)
+
+    best_partial = {"value": 0.0, "max_rhat": None, "min_ess": 0.0}
+
+    def emit_partial(phase):
+        """Best-so-far JSON line (``"partial": true``); last line wins, so
+        a kill at any point still leaves the latest measured state."""
+        print(
+            json.dumps(
+                {
+                    "metric": "min-ESS/sec/chip, hierarchical logistic "
+                    f"N={n} (ChEES supervised, best-so-far)",
+                    "value": round(best_partial["value"], 3),
+                    "unit": "ess/sec/chip",
+                    "vs_baseline": round(best_partial["value"] / denom, 2),
+                    "converged": False,
+                    "partial": True,
+                    "phase": phase,
+                    "max_rhat": best_partial["max_rhat"],
+                    "platform": platform,
+                    "accelerator_fallback": fell_back,
+                    "wall_s": round(time.perf_counter() - t_bench, 1),
+                }
+            ),
+            flush=True,
+        )
+
+    emit_partial("started")
 
     model = HierLogistic(num_features=d, num_groups=groups)
     data, _ = synth_logistic_data(jax.random.PRNGKey(0), n, d, num_groups=groups)
@@ -193,8 +347,15 @@ def main():
         num_samples=num_samples,
     )
     results = []  # (tag, ess_per_sec, max_rhat)
+    budget_hit = False
 
     def timed_run(m, tag):
+        if time_budget and time.perf_counter() - t_bench > time_budget:
+            # stark_tpu.sample has no internal budget hook; the only safe
+            # enforcement for these cross-check legs is not starting them
+            print(f"[bench] budget exhausted; skipping leg {tag!r}",
+                  file=sys.stderr)
+            return None, 0.0
         # compile pass (cached runner), then the timed run
         stark_tpu.sample(m, data, backend=backend, chains=chains, seed=0, **kwargs)
         t0 = time.perf_counter()
@@ -267,17 +428,47 @@ def main():
             # fault restarts from the last healthy block checkpoint
             shutil.rmtree(workdir, ignore_errors=True)
             t0 = time.perf_counter()
+
+            def on_progress(r):
+                ev = r.get("event")
+                if ev == "warmup_done":
+                    emit_partial("warmup_done")
+                elif ev == "block":
+                    # latest cumulative state, not max-over-time: an early
+                    # high-rate unconverged moment must never outlive a
+                    # later, better-converged line.  value and max_rhat are
+                    # always set TOGETHER from this block — a null min_ess
+                    # (stuck components) zeroes the rate rather than pair
+                    # an old rate with this block's diagnostics
+                    ess = r.get("min_ess")
+                    best_partial["value"] = (
+                        ess / max(time.perf_counter() - t0, 1e-9)
+                        if ess is not None
+                        else 0.0
+                    )
+                    best_partial["max_rhat"] = r.get("max_rhat")
+                    emit_partial(f"block {r['block']}")
+
+            remaining = (
+                max(time_budget - (time.perf_counter() - t_bench), 1.0)
+                if time_budget
+                else None
+            )
             post = supervised_sample(
                 fused, data, workdir=workdir, chains=cc,
-                kernel="chees", num_warmup=chees_warm, map_init_steps=500,
+                kernel="chees", num_warmup=chees_warm,
+                map_init_steps=_env_int("BENCH_MAP_INIT", 500),
                 init_step_size=0.1, block_size=block,
                 max_blocks=math.ceil(chees_samp / block),
                 min_blocks=math.ceil(chees_samp / block),
                 rhat_target=0.0,  # run the full draw budget, no early stop
                 max_restarts=_env_int("BENCH_MAX_RESTARTS", 3),
+                progress_cb=on_progress,
+                time_budget_s=remaining,
                 seed=1,
             )
             wall = time.perf_counter() - t0
+            budget_hit = getattr(post, "budget_exhausted", False)
             eps_chees = post.min_ess() / wall
             rhat = post.max_rhat()
             chees_converged = rhat < _RHAT_TARGET
@@ -344,82 +535,50 @@ def main():
         except Exception as e:  # noqa: BLE001 — any compile/runtime failure
             print(f"[bench] fused path unavailable: {e!r}", file=sys.stderr)
     if not results and try_autodiff != "0":
-        # nothing measured (chees+fused skipped/failed); an explicit
-        # BENCH_AUTODIFF=0 opt-out is respected even here
-        timed_run(model, "NUTS autodiff")
+        if time_budget and time.perf_counter() - t_bench > time_budget:
+            # the budget is already blown; a last-resort leg with no
+            # internal budget bound would be the r2 failure all over again
+            print("[bench] budget exhausted; skipping last-resort leg",
+                  file=sys.stderr)
+        else:
+            # nothing measured (chees+fused skipped/failed); an explicit
+            # BENCH_AUTODIFF=0 opt-out is respected even here
+            timed_run(model, "NUTS autodiff")
 
     picked = select_result(results)
     if picked is None:
         print(json.dumps({"metric": "bench failed: no result", "value": 0.0,
-                          "unit": "ess/sec/chip", "vs_baseline": 0.0}))
+                          "unit": "ess/sec/chip", "vs_baseline": 0.0}),
+              flush=True)
         return
     sampler_tag, ess_per_sec, rhat, converged = picked
 
-    # ---- CPU reference denominator (host-driven loop, reference-style) ----
-    rec = None
-    if os.path.exists(_BASELINE_FILE) and not os.environ.get("BENCH_FORCE_CPU"):
-        with open(_BASELINE_FILE) as f:
-            rec = json.load(f)
-        if "ess_per_sec" not in rec:
-            rec = None  # partial record (cost curve only) — re-measure fully
-    if rec is None or "fit" not in rec:
-        model_cpu = HierLogistic(num_features=d, num_groups=groups)
-        if rec is None:
-            data_cpu, _ = synth_logistic_data(
-                jax.random.PRNGKey(0), n_cpu, d, num_groups=groups
-            )
-            t0 = time.perf_counter()
-            post_cpu = stark_tpu.sample(
-                model_cpu, data_cpu, backend=CpuBackend(), chains=2, seed=0,
-                kernel="nuts", max_tree_depth=depth,
-                num_warmup=max(num_warmup // 2, 50),
-                num_samples=max(num_samples // 2, 50),
-            )
-            wall_cpu = time.perf_counter() - t0
-            rec = {
-                "n": n_cpu,
-                "ess_per_sec": post_cpu.min_ess() / wall_cpu,
-                "config": f"HierLogistic d={d} g={groups}, NUTS depth{depth}, "
-                          "2 chains, host-driven reference",
-            }
-        points, fit = measure_cpu_cost_curve(model_cpu, d, groups)
-        rec["cost_points"] = points
-        rec["fit"] = fit
-        try:
-            with open(_BASELINE_FILE, "w") as f:
-                json.dump(rec, f, indent=1)
-        except OSError:
-            pass
-    cpu_eps_at_n = cpu_ess_per_sec_at(n, rec)
-    print(
-        f"[bench] cpu-ref: ess/s={rec['ess_per_sec']:.4f} at n={rec['n']}, "
-        f"extrapolated {cpu_eps_at_n:.6f} at n={n} "
-        f"(cost fit: {rec['fit']['a']*1e3:.2f} ms + {rec['fit']['b']*1e9:.2f} ns/row)",
-        file=sys.stderr,
-    )
-
-    # The north star compares against a 32-EXECUTOR Spark-CPU cluster
-    # (BASELINE.json:5); the recorded reference ran on one core, so scale
-    # the denominator up by the executor count (ideal linear scaling — a
-    # deliberately generous assumption for the baseline).
-    executors = _env_int("BENCH_CPU_EXECUTORS", 32)
     vs_baseline = ess_per_sec / max(cpu_eps_at_n * executors, 1e-12)
+    # strict JSON even when diagnostics go non-finite (stuck components
+    # propagate NaN through min_ess/max_rhat): non-finite -> null / 0.0,
+    # mirroring the runner's metrics-path guard
     print(
         json.dumps(
             {
                 "metric": "min-ESS/sec/chip, hierarchical logistic "
                 f"N={n} ({sampler_tag})",
-                "value": round(ess_per_sec, 3),
+                "value": round(ess_per_sec, 3) if math.isfinite(ess_per_sec) else 0.0,
                 "unit": "ess/sec/chip",
-                "vs_baseline": round(vs_baseline, 2),
-                "converged": converged,
-                "max_rhat": round(rhat, 4),
+                "vs_baseline": (
+                    round(vs_baseline, 2) if math.isfinite(vs_baseline) else 0.0
+                ),
+                "converged": converged and math.isfinite(ess_per_sec),
+                "max_rhat": round(rhat, 4) if math.isfinite(rhat) else None,
                 "platform": platform,
                 # distinguishes a dead-accelerator degraded run from a
                 # deliberate CPU run in the recorded artifact itself
                 "accelerator_fallback": fell_back,
+                "time_budget_s": time_budget or None,
+                "budget_exhausted": budget_hit,
+                "wall_s": round(time.perf_counter() - t_bench, 1),
             }
-        )
+        ),
+        flush=True,
     )
 
 

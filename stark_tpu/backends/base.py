@@ -15,7 +15,41 @@ backend decision.  Provided backends:
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Protocol, runtime_checkable
+from typing import Any, Dict, NamedTuple, Optional, Protocol, runtime_checkable
+
+
+class AdaptiveParts(NamedTuple):
+    """What a backend hands the adaptive runner (`sample_until_converged`)
+    so convergence-driven blocks, checkpointing, and supervision compose
+    with ANY execution layout (single device or sharded mesh).
+
+    The runner owns the schedule/blocks/diagnostics/checkpoint protocol;
+    the backend owns compilation and placement:
+
+      fm / data    flat model + placed (possibly mesh-sharded) data pytree
+      extra        () or (data,) — trailing args for every segment call
+      chees        CheesParts (schedule/finalize) when kernel == "chees"
+      init_j/warm_j/samp_j   compiled chees segment callables
+      seg_warmup   run(warm_keys, z0, data, seg) for per-chain kernels
+      get_block    get_block(block_size) -> compiled v_block(keys, state,
+                   step_size, inv_mass, data)
+      put_chains   place a host (chains, ...) array on the chains layout
+      put_rep      place a host replicated array (adaptation state)
+      collect      device pytree -> host numpy (allgather on pods)
+    """
+
+    fm: Any
+    data: Any
+    extra: tuple
+    put_chains: Any
+    put_rep: Any
+    collect: Any
+    chees: Any = None
+    init_j: Any = None
+    warm_j: Any = None
+    samp_j: Any = None
+    seg_warmup: Any = None
+    get_block: Any = None
 
 
 @runtime_checkable

@@ -25,6 +25,7 @@ from ..sampler import (
     Posterior,
     SamplerConfig,
     _constrain_draws,
+    drive_segmented_sampling,
     make_block_runner,
     make_chain_runner,
     make_segmented_warmup,
@@ -128,78 +129,89 @@ class JaxBackend:
             draws, stats, flat_model=fm, draws_flat=np.asarray(res.draws)
         )
 
-    def _run_segmented(self, model, fm, cfg, data, chain_keys, z0):
-        """Warmup + sampling as bounded-length dispatches (see class doc).
+    def _cached(self, model, cfg, tag, builder):
+        key = (model, cfg, tag)
+        if key not in self._cache:
+            self._cache[key] = builder()
+        return self._cache[key]
 
-        At most two compiled variants per phase (the full segment and one
-        remainder length); all compiled functions are cached per
-        (model, cfg, segment length) on the backend.
-        """
-        seg = int(self.dispatch_steps)
-        chains = z0.shape[0]
-
-        def cached(tag, builder):
-            key = (model, cfg, tag)
-            if key not in self._cache:
-                self._cache[key] = builder()
-            return self._cache[key]
-
-        seg_warmup = cached("seg_warmup", lambda: make_segmented_warmup(fm, cfg))
-
-        keys = jax.vmap(lambda k: jax.random.split(k, 2))(chain_keys)
-        warm_keys, sample_keys = keys[:, 0], keys[:, 1]
-        state, step_size, inv_mass, warm_div = seg_warmup(
-            warm_keys, z0, data, seg
+    def _get_block(self, model, fm, cfg):
+        """get_block(length) -> jitted vmapped block runner (cached)."""
+        return lambda length: self._cached(
+            model, cfg, ("block", length),
+            lambda: jax.jit(jax.vmap(
+                make_block_runner(fm, cfg, length),
+                in_axes=(0, 0, 0, 0, None),
+            )),
         )
 
-        total = cfg.num_samples * cfg.thin
-        skeys = np.asarray(
-            jax.vmap(lambda k: jax.random.split(k, max(total, 1)))(sample_keys)
-        )  # (chains, >=1, 2)
-        # empty seeds keep the num_samples=0 (warmup-only) case concatenable;
-        # thinning happens PER BLOCK so host memory holds only kept draws
-        zs_blocks = [np.zeros((chains, 0, z0.shape[1]), np.asarray(z0).dtype)]
-        acc_blocks = [np.zeros((chains, 0), np.float32)]
-        div_blocks = [np.zeros((chains, 0), bool)]
-        en_blocks = [np.zeros((chains, 0), np.float32)]
-        ng_blocks = [np.zeros((chains, 0), np.int32)]
-        num_divergent = np.zeros((chains,), np.int64)
-        for s in range(0, total, seg):
-            e = min(s + seg, total)
-            v_block = cached(("block", e - s), lambda: jax.jit(jax.vmap(
-                make_block_runner(fm, cfg, e - s),
-                in_axes=(0, 0, 0, 0, None))))
-            # block_run splits its own per-step keys from one key per chain
-            bkeys = jnp.asarray(skeys[:, s, :])
-            state, zs, accept, divergent, energy, ngrad = jax.block_until_ready(
-                v_block(bkeys, state, step_size, inv_mass, data)
+    def _run_segmented(self, model, fm, cfg, data, chain_keys, z0):
+        """Warmup + sampling as bounded-length dispatches (see class doc),
+        via the shared `sampler.drive_segmented_sampling` host driver."""
+        seg_warmup = self._cached(
+            model, cfg, "seg_warmup", lambda: make_segmented_warmup(fm, cfg)
+        )
+        return drive_segmented_sampling(
+            fm, cfg, seg_warmup, self._get_block(model, fm, cfg),
+            chain_keys, z0, data, int(self.dispatch_steps),
+        )
+
+    def adaptive_parts(self, model, cfg: SamplerConfig, data):
+        """Compiled segment callables + placement hooks for the adaptive
+        block runner (`runner.sample_until_converged`) — see
+        `backends.base.AdaptiveParts`.  Single-device flavor: plain
+        jit(+vmap), identity/device_put placement, host np collection.
+        """
+        from .base import AdaptiveParts
+
+        fm = flatten_model(model)
+        data = prepare_model_data(model, data)
+        extra = () if data is None else (data,)
+
+        def put(x):
+            return (
+                jax.device_put(x, self.device)
+                if self.device is not None
+                else x
             )
-            divergent = np.asarray(divergent)
-            num_divergent += divergent.astype(np.int64).sum(axis=1)
-            # global transition i is kept when (i+1) % thin == 0
-            keep = np.arange(s, e)
-            keep = (keep[(keep + 1) % cfg.thin == 0] - s) if cfg.thin > 1 else slice(None)
-            zs_blocks.append(np.asarray(zs)[:, keep])
-            acc_blocks.append(np.asarray(accept)[:, keep])
-            div_blocks.append(divergent[:, keep])
-            en_blocks.append(np.asarray(energy)[:, keep])
-            ng_blocks.append(np.asarray(ngrad)[:, keep])
 
-        zs = np.concatenate(zs_blocks, axis=1)  # (chains, num_samples, d)
-        accept = np.concatenate(acc_blocks, axis=1)
-        divergent = np.concatenate(div_blocks, axis=1)
-        energy = np.concatenate(en_blocks, axis=1)
-        ngrad = np.concatenate(ng_blocks, axis=1)
+        bundle = AdaptiveParts(
+            fm=fm,
+            data=data,
+            extra=extra,
+            put_chains=put,
+            put_rep=put,
+            collect=lambda t: jax.tree.map(np.asarray, t),
+        )
+        if cfg.kernel == "chees":
+            from ..chees import make_chees_parts
 
-        draws = _constrain_draws(fm, jnp.asarray(zs))
-        stats = {
-            "accept_prob": accept,
-            "is_divergent": divergent,
-            "energy": energy,
-            "num_grad_evals": ngrad,
-            "step_size": np.asarray(step_size),
-            "inv_mass_diag": np.asarray(inv_mass),
-            "num_warmup_divergent": warm_div,
-            "num_divergent": num_divergent,
-        }
-        return Posterior(draws, stats, flat_model=fm, draws_flat=zs)
+            parts = self._cached(
+                model, cfg, "chees_parts", lambda: make_chees_parts(fm, cfg)
+            )
+
+            def jit_part(tag, fn):
+                # bind data=None explicitly when absent so every backend's
+                # segment callables share the (*args, *extra) convention
+                wrapped = fn if data is not None else (
+                    lambda *a, _fn=fn: _fn(*a, None)
+                )
+                # data-ness is part of the key: the wrapper's arity differs
+                return self._cached(
+                    model, cfg, ("chees_j", tag, data is None),
+                    lambda: jax.jit(wrapped),
+                )
+
+            return bundle._replace(
+                chees=parts,
+                init_j=jit_part("init", parts.init_carry),
+                warm_j=jit_part("warm", parts.warm_segment),
+                samp_j=jit_part("samp", parts.sample_segment),
+            )
+        seg_warmup = self._cached(
+            model, cfg, "seg_warmup", lambda: make_segmented_warmup(fm, cfg)
+        )
+        return bundle._replace(
+            seg_warmup=seg_warmup,
+            get_block=self._get_block(model, fm, cfg),
+        )

@@ -27,7 +27,16 @@ from ..parallel.mesh import (
     row_partition_specs,
     shard_data,
 )
-from ..sampler import Posterior, SamplerConfig, _constrain_draws, make_chain_runner
+from ..sampler import (
+    Posterior,
+    SamplerConfig,
+    _constrain_draws,
+    drive_segmented_sampling,
+    drive_segmented_warmup,
+    make_block_runner,
+    make_chain_runner,
+    make_warmup_parts,
+)
 
 
 class ShardedBackend:
@@ -44,7 +53,8 @@ class ShardedBackend:
         if "data" not in self.mesh.axis_names or "chains" not in self.mesh.axis_names:
             raise ValueError("mesh must have axes ('data', 'chains')")
         # bounded device programs for runtimes that cap execution wall-clock
-        # (chees path only for now; the per-chain runner is monolithic)
+        # (served for chees AND the per-chain kernels via the segmented
+        # drivers; single-process meshes only)
         self.dispatch_steps = dispatch_steps
         self._cache: Dict[Tuple[int, SamplerConfig, Any], Any] = {}
 
@@ -123,6 +133,25 @@ class ShardedBackend:
         z0 = put_chains(z0)
         chain_keys = put_chains(chain_keys)
 
+        if self.dispatch_steps:
+            # bounded device programs for the per-chain kernels too (the
+            # monolithic whole-run dispatch faults wall-clock-capped
+            # runtimes like the axon tunnel at benchmark scale)
+            if multiproc:
+                raise NotImplementedError(
+                    "dispatch-bounded NUTS/HMC over a multi-process mesh "
+                    "is not supported yet; unset dispatch_steps"
+                )
+            seg_warmup, get_block = self._segmented_parts(
+                model, fm, cfg, data, row_axes
+            )
+            from ..distributed import gather_draws
+
+            return drive_segmented_sampling(
+                fm, cfg, seg_warmup, get_block, chain_keys, z0, data,
+                int(self.dispatch_steps), collect=gather_draws,
+            )
+
         run = self._get_runner(model, fm, cfg, data, row_axes)
         if data is None:
             res = jax.block_until_ready(run(chain_keys, z0))
@@ -167,29 +196,43 @@ class ShardedBackend:
 
         return to_global
 
-    def _run_chees(
-        self, model, fm, cfg, data, row_axes, *, chains, seed, init_params,
-        multiproc,
-    ):
-        """kernel="chees" over the mesh: the ensemble is sharded over
-        "chains", the dataset over "data" (per-shard likelihood psum'd
-        inside the potential — model.py's packed single-psum path), and the
-        cross-chain adaptation statistics reduce with collectives
-        (chains_axis in kernels/chees.py), so every device advances its
-        chain slice in lockstep with identical eps / T / mass.
-        """
+    def _smap(self, fn, in_specs, out_specs, data, data_specs):
+        """shard_map + jit over the backend mesh; a ``None`` dataset is
+        bound here so every compiled segment shares the (*args, *extra)
+        calling convention with the single-device backend."""
+        if data is None:
+            return jax.jit(
+                shard_map(
+                    lambda *a: fn(*a, None), mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False,
+                )
+            )
+        return jax.jit(
+            shard_map(
+                fn, mesh=self.mesh, in_specs=in_specs + (data_specs,),
+                out_specs=out_specs, check_vma=False,
+            )
+        )
+
+    def _data_specs(self, data, row_axes):
+        return (
+            row_partition_specs(data, "data", row_axes)
+            if data is not None
+            else None
+        )
+
+    def _chees_smapped(self, model, fm, cfg, data, row_axes):
+        """(parts, init_j, warm_j, samp_j): the chees segment callables
+        shard_mapped over the mesh, cached per (model, cfg, data layout)."""
         from ..adaptation import DualAveragingState, WelfordState
         from ..chees import (
             AdamState,
             CheesRunCarry,
             CheesWarmCarry,
-            drive_chees_segments,
             make_chees_parts,
         )
-        from ..distributed import gather_draws
         from ..kernels.base import HMCState
 
-        mesh = self.mesh
         parts = make_chees_parts(fm, cfg, chains_axis="chains")
 
         S, R = P("chains"), P()
@@ -206,26 +249,7 @@ class ShardedBackend:
             states=state_spec, log_eps=R, log_T=R, inv_mass=R
         )
         out_spec = (P(None, "chains"), P(None, "chains"), P(None, "chains"), R)
-        data_specs = (
-            row_partition_specs(data, "data", row_axes)
-            if data is not None
-            else None
-        )
-
-        def smap(fn, in_specs, out_specs):
-            if data is None:
-                return jax.jit(
-                    shard_map(
-                        lambda *a: fn(*a, None), mesh=mesh, in_specs=in_specs,
-                        out_specs=out_specs, check_vma=False,
-                    )
-                )
-            return jax.jit(
-                shard_map(
-                    fn, mesh=mesh, in_specs=in_specs + (data_specs,),
-                    out_specs=out_specs, check_vma=False,
-                )
-            )
+        data_specs = self._data_specs(data, row_axes)
 
         cache_key = (
             model, cfg, "chees",
@@ -233,14 +257,130 @@ class ShardedBackend:
         )
         if cache_key not in self._cache:
             self._cache[cache_key] = (
-                smap(parts.init_carry, (R, S), warm_spec),
-                smap(
+                self._smap(parts.init_carry, (R, S), warm_spec, data, data_specs),
+                self._smap(
                     parts.warm_segment, (warm_spec, R, R, R, R, R),
-                    (warm_spec, (R, R)),
+                    (warm_spec, (R, R)), data, data_specs,
                 ),
-                smap(parts.sample_segment, (run_spec, R, R), (run_spec, out_spec)),
+                self._smap(
+                    parts.sample_segment, (run_spec, R, R),
+                    (run_spec, out_spec), data, data_specs,
+                ),
             )
-        init_j, warm_j, samp_j = self._cache[cache_key]
+        return (parts,) + self._cache[cache_key]
+
+    def _segmented_parts(self, model, fm, cfg, data, row_axes):
+        """(seg_warmup, get_block) for the per-chain kernels, shard_mapped:
+        chains-sharded state/keys, data-sharded likelihood, driven by the
+        same host drivers as the single-device backend."""
+        S, R = P("chains"), P()
+        data_specs = self._data_specs(data, row_axes)
+        cache_key = (
+            model, cfg, "segmented",
+            None if data is None else jax.tree.structure(data),
+        )
+        if cache_key not in self._cache:
+
+            def smap_seg(fn, in_specs, out_specs):
+                # the segmented drivers pass data as a trailing arg even
+                # when it is None (the single-device vmapped parts need
+                # it); tolerate-and-drop it in the dataless mesh case
+                inner = self._smap(fn, in_specs, out_specs, data, data_specs)
+                if data is None:
+                    return lambda *a: inner(*a[:-1])
+                return inner
+
+            init_carry, segment, finalize = make_warmup_parts(fm, cfg)
+            v_init = smap_seg(
+                jax.vmap(init_carry, in_axes=(0, 0, None)), (S, S), S
+            )
+            v_seg = smap_seg(
+                jax.vmap(segment, in_axes=(1, None, None, 0, 0, 0, 0, None)),
+                (P(None, "chains"), R, R, S, S, S, S), S,
+            )
+
+            def seg_warmup(warm_keys, z0, data_arg, seg):
+                return drive_segmented_warmup(
+                    cfg, v_init, v_seg, finalize, warm_keys, z0, data_arg, seg
+                )
+
+            blocks: Dict[int, Any] = {}
+
+            def get_block(length):
+                if length not in blocks:
+                    blocks[length] = smap_seg(
+                        jax.vmap(
+                            make_block_runner(fm, cfg, length),
+                            in_axes=(0, 0, 0, 0, None),
+                        ),
+                        (S, S, S, S), S,
+                    )
+                return blocks[length]
+
+            self._cache[cache_key] = (seg_warmup, get_block)
+        return self._cache[cache_key]
+
+    def adaptive_parts(self, model, cfg: SamplerConfig, data):
+        """Mesh flavor of `backends.base.AdaptiveParts`: the adaptive
+        runner's blocks/checkpoint/supervision protocol drives shard_mapped
+        segments; chain state lives sharded over "chains", data over
+        "data", adaptation state replicated.  Checkpoint arrays round-trip
+        through host numpy, so resume re-places them via put_chains/put_rep.
+        """
+        from .base import AdaptiveParts
+        from ..distributed import gather_draws
+
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "the adaptive runner over a multi-process mesh is not "
+                "supported yet (host-side checkpoints of non-addressable "
+                "arrays); use ShardedBackend.run per host"
+            )
+        fm = flatten_model(model, axis_name="data" if data is not None else None)
+        row_axes = None
+        if data is not None:
+            data = prepare_model_data(model, data)
+            row_axes = model.data_row_axes(data)
+            data = shard_data(data, self.mesh, "data", row_axes=row_axes)
+        rep = NamedSharding(self.mesh, P())
+
+        bundle = AdaptiveParts(
+            fm=fm,
+            data=data,
+            extra=() if data is None else (data,),
+            put_chains=self._chain_placer(False),
+            put_rep=lambda x: jax.device_put(x, rep),
+            collect=gather_draws,
+        )
+        if cfg.kernel == "chees":
+            parts, init_j, warm_j, samp_j = self._chees_smapped(
+                model, fm, cfg, data, row_axes
+            )
+            return bundle._replace(
+                chees=parts, init_j=init_j, warm_j=warm_j, samp_j=samp_j
+            )
+        seg_warmup, get_block = self._segmented_parts(
+            model, fm, cfg, data, row_axes
+        )
+        return bundle._replace(seg_warmup=seg_warmup, get_block=get_block)
+
+    def _run_chees(
+        self, model, fm, cfg, data, row_axes, *, chains, seed, init_params,
+        multiproc,
+    ):
+        """kernel="chees" over the mesh: the ensemble is sharded over
+        "chains", the dataset over "data" (per-shard likelihood psum'd
+        inside the potential — model.py's packed single-psum path), and the
+        cross-chain adaptation statistics reduce with collectives
+        (chains_axis in kernels/chees.py), so every device advances its
+        chain slice in lockstep with identical eps / T / mass.
+        """
+        from ..chees import drive_chees_segments
+        from ..distributed import gather_draws
+
+        parts, init_j, warm_j, samp_j = self._chees_smapped(
+            model, fm, cfg, data, row_axes
+        )
 
         # shared schedule driver (chees.drive_chees_segments): only
         # placement (chains-sharded z0), the shard_mapped segments, and

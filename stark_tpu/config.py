@@ -140,14 +140,21 @@ def build_backend(cfg: RunConfig):
     from .parallel.mesh import make_mesh
 
     name = cfg.execution.get("backend", "jax")
+    dispatch = cfg.execution.get("dispatch_steps")
     if name == "jax":
-        return JaxBackend()
+        return JaxBackend(dispatch_steps=dispatch)
     if name == "cpu":
+        if dispatch is not None:
+            # never silently drop an execution key the user set
+            raise ValueError(
+                "execution.dispatch_steps is not supported by the cpu "
+                "backend (host-driven loop has no device programs to bound)"
+            )
         return CpuBackend()
     if name == "sharded":
         mesh_spec = cfg.execution.get("mesh")
         mesh = make_mesh(dict(mesh_spec)) if mesh_spec else None
-        return ShardedBackend(mesh)
+        return ShardedBackend(mesh, dispatch_steps=dispatch)
     raise ValueError(f"unknown backend {name!r}")
 
 
@@ -171,7 +178,8 @@ def run_config(cfg: RunConfig):
     # every execution key must be consumed by the chosen entry — silently
     # dropping e.g. backend:sharded would report unsharded results as sharded
     supported = {"chains", "seed"}
-    supported |= {"backend", "mesh"} if entry == "sample" else set()
+    if entry in ("sample", "until_converged"):
+        supported |= {"backend", "mesh", "dispatch_steps"}
     supported |= {"mesh"} if entry in ("consensus", "tempered", "sghmc") else set()
     unused = set(cfg.execution) - supported
     if unused:
@@ -188,7 +196,7 @@ def run_config(cfg: RunConfig):
         )
     elif entry == "until_converged":
         post = stark_tpu.sample_until_converged(
-            model, data, chains=chains, seed=seed,
+            model, data, backend=build_backend(cfg), chains=chains, seed=seed,
             metrics_path=cfg.outputs.get("metrics_path"),
             checkpoint_path=cfg.outputs.get("checkpoint_path"),
             draw_store_path=cfg.outputs.get("draw_store_path"),

@@ -102,12 +102,19 @@ def synth_survival_data(
     key, n, d, *, censor_rate=0.3, dtype=jnp.float32
 ):
     """Exponential survival times with hazard exp(x@beta); rows returned
-    sorted by descending time (CoxPH's contract)."""
+    sorted by descending time (CoxPH's log_lik contract — honored here by
+    actually sorting, so calling log_lik directly on this data is correct;
+    CoxPH.prepare_data re-sorts idempotently for arbitrary user data)."""
     k1, k2, k3, k4 = jax.random.split(key, 4)
     x = jax.random.normal(k1, (n, d), dtype)
     beta = 0.5 * jax.random.normal(k2, (d,), dtype)
     rate = jnp.exp(x @ beta)
     t = jax.random.exponential(k3, (n,)) / rate
     event = (jax.random.uniform(k4, (n,)) > censor_rate).astype(dtype)
-    data = {"x": x, "t": t.astype(dtype), "event": event}
+    order = jnp.argsort(-t)
+    data = {
+        "x": x[order],
+        "t": t[order].astype(dtype),
+        "event": event[order],
+    }
     return data, {"beta": beta}

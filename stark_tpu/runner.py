@@ -32,14 +32,8 @@ import numpy as np
 
 from . import diagnostics
 from .kernels.base import HMCState
-from .model import Model, flatten_model, prepare_model_data
-from .sampler import (
-    Posterior,
-    SamplerConfig,
-    _constrain_draws,
-    make_block_runner,
-    make_segmented_warmup,
-)
+from .model import Model
+from .sampler import Posterior, SamplerConfig, _constrain_draws
 
 
 class AdaptiveResult(Posterior):
@@ -50,12 +44,14 @@ class AdaptiveResult(Posterior):
         self.history = history or []
         self.converged = converged
         self.wall_s = wall_s
+        self.budget_exhausted = False
 
 
 def sample_until_converged(
     model: Model,
     data: Any = None,
     *,
+    backend: Optional[Any] = None,
     chains: int = 4,
     block_size: int = 100,
     max_blocks: int = 50,
@@ -72,6 +68,8 @@ def sample_until_converged(
     init_params: Optional[Dict[str, Any]] = None,
     health_check: bool = False,
     reseed: Optional[int] = None,
+    progress_cb: Optional[Any] = None,
+    time_budget_s: Optional[float] = None,
     **cfg_kwargs,
 ) -> AdaptiveResult:
     """Run chains until R-hat < rhat_target AND min-ESS > ess_target.
@@ -87,10 +85,36 @@ def sample_until_converged(
     ``full_min_ess`` in the block's metrics line); failed validations back
     off geometrically, so the O(draws*d) full diagnostics run O(log blocks)
     times per run instead of every block.
+
+    ``progress_cb`` (if given) is invoked with every metrics record
+    (warmup_done and block events) as it is emitted — callers use it to
+    surface best-so-far results while the run is still in flight, so an
+    external kill/timeout never erases all evidence of progress.
+    ``time_budget_s`` bounds the SAMPLING wall-clock: after any block that
+    ends past the budget (measured from this call's start) the run stops
+    and returns what it has, with ``budget_exhausted=True`` on the result.
+    Warmup is not interrupted — a run whose warmup alone exceeds the
+    budget is misconfigured, and an aborted warmup would leave nothing
+    usable to return.
+
+    ``backend`` (default: a fresh `JaxBackend`) supplies the compiled
+    execution layer via `SamplerBackend.adaptive_parts` — pass a
+    `ShardedBackend` to run the SAME convergence/checkpoint/supervision
+    protocol with chains and data sharded over a device mesh (checkpoints
+    round-trip through host numpy; resume re-places state on the mesh).
     """
     cfg = SamplerConfig(**cfg_kwargs)
-    fm = flatten_model(model)
-    data = prepare_model_data(model, data)
+    if backend is None:
+        from .backends.jax_backend import JaxBackend
+
+        backend = JaxBackend()
+    if not hasattr(backend, "adaptive_parts"):
+        raise TypeError(
+            f"{type(backend).__name__} does not support the adaptive "
+            "runner (no adaptive_parts); use JaxBackend or ShardedBackend"
+        )
+    ap = backend.adaptive_parts(model, cfg, data)
+    fm, data, extra = ap.fm, ap.data, ap.extra
 
     is_chees = cfg.kernel == "chees"
     if is_chees:
@@ -98,13 +122,13 @@ def sample_until_converged(
         # chees sample segments (frozen adaptation), checkpointed as a
         # CheesRunCarry — same block/checkpoint/metrics protocol as the
         # per-chain kernels below
-        from .chees import chees_init_positions, make_chees_parts
+        from .chees import chees_init_positions
         from .kernels.chees import halton
 
-        parts = make_chees_parts(fm, cfg)
-        chees_init_j = jax.jit(parts.init_carry)
-        chees_warm_j = jax.jit(parts.warm_segment)
-        chees_samp_j = jax.jit(parts.sample_segment)
+        parts = ap.chees
+        chees_init_j, chees_warm_j, chees_samp_j = (
+            ap.init_j, ap.warm_j, ap.samp_j,
+        )
 
         def save_warmup_checkpoint(path, carry, key, key_warm, done, nd, nl):
             """Warmup-phase checkpoint: the full CheesWarmCarry, so a
@@ -168,7 +192,7 @@ def sample_until_converged(
                 carry, (nd, nl) = jax.block_until_ready(
                     chees_warm_j(
                         carry, wkeys[s:e], u_warm[s:e], idxs[s:e],
-                        aflags[s:e], wflags[s:e], data,
+                        aflags[s:e], wflags[s:e], *extra,
                     )
                 )
                 n_div += int(nd)
@@ -183,14 +207,12 @@ def sample_until_converged(
                     )
             return carry, n_div, n_leap
     else:
-        block_run = make_block_runner(fm, cfg, block_size)
-        v_block = jax.jit(jax.vmap(block_run, in_axes=(0, 0, 0, 0, None)))
-
+        v_block = ap.get_block(block_size)
         # warmup runs as block_size-bounded dispatches too (same
         # device-program length cap as the draw blocks; the monolithic
         # warmup faulted the axon tunnel at benchmark scale) — shared
-        # driver with the segmented backend
-        seg_warmup = make_segmented_warmup(fm, cfg)
+        # driver with the segmented backend paths
+        seg_warmup = ap.seg_warmup
 
     t_start = time.perf_counter()
     metrics_f = open(metrics_path, "a") if metrics_path else None
@@ -199,6 +221,14 @@ def sample_until_converged(
         if metrics_f:
             metrics_f.write(json.dumps(rec) + "\n")
             metrics_f.flush()
+        if progress_cb is not None:
+            try:
+                progress_cb(rec)
+            except Exception:  # noqa: BLE001 — observability must not kill
+                # the run: e.g. a BrokenPipeError from a closed capture
+                # pipe would otherwise surface as a sampler fault and burn
+                # the supervisor's restart budget on healthy state
+                pass
 
     def emit_warmup_done(n_div_total, step_size, warmup_grads=None,
                          resumed_from=None):
@@ -218,6 +248,7 @@ def sample_until_converged(
 
     blocks_done = 0
     total_div = 0
+    budget_exhausted = False
     history = []
     draw_blocks = []
     if resume_from:
@@ -237,13 +268,20 @@ def sample_until_converged(
                 f"checkpoint was written by kernel={ckpt_kernel!r}, "
                 f"resuming run uses kernel={cfg.kernel!r}"
             )
+        # checkpoints are host numpy; re-place on the backend's layout
+        # (chains-sharded state, replicated ensemble adaptation on a mesh;
+        # identity/device_put on a single device)
+        pc, pr = ap.put_chains, ap.put_rep
         state = HMCState(
-            z=jnp.asarray(arrays["z"]),
-            potential_energy=jnp.asarray(arrays["pe"]),
-            grad=jnp.asarray(arrays["grad"]),
+            z=pc(jnp.asarray(arrays["z"])),
+            potential_energy=pc(jnp.asarray(arrays["pe"])),
+            grad=pc(jnp.asarray(arrays["grad"])),
         )
-        step_size = jnp.asarray(arrays["step_size"])
-        inv_mass = jnp.asarray(arrays["inv_mass"])
+        # chees adaptation is ensemble-shared; per-chain kernels carry
+        # per-chain step/mass
+        put_sm = pr if is_chees else pc
+        step_size = put_sm(jnp.asarray(arrays["step_size"]))
+        inv_mass = put_sm(jnp.asarray(arrays["inv_mass"]))
         key = jnp.asarray(arrays["key"])
         if reseed is not None:
             # a deterministic numerical failure would otherwise replay
@@ -257,25 +295,26 @@ def sample_until_converged(
             from .adaptation import DualAveragingState, WelfordState
             from .chees import AdamState, CheesWarmCarry
 
+            rep = lambda name: pr(jnp.asarray(arrays[name]))  # noqa: E731
             carry = CheesWarmCarry(
                 states=state,
                 da=DualAveragingState(
-                    log_step=jnp.asarray(arrays["da_log_step"]),
-                    log_avg_step=jnp.asarray(arrays["da_log_avg_step"]),
-                    h_avg=jnp.asarray(arrays["da_h_avg"]),
-                    mu=jnp.asarray(arrays["da_mu"]),
-                    count=jnp.asarray(arrays["da_count"]),
+                    log_step=rep("da_log_step"),
+                    log_avg_step=rep("da_log_avg_step"),
+                    h_avg=rep("da_h_avg"),
+                    mu=rep("da_mu"),
+                    count=rep("da_count"),
                 ),
                 adam=AdamState(
-                    m=jnp.asarray(arrays["adam_m"]),
-                    v=jnp.asarray(arrays["adam_v"]),
-                    t=jnp.asarray(arrays["adam_t"]),
+                    m=rep("adam_m"),
+                    v=rep("adam_v"),
+                    t=rep("adam_t"),
                 ),
-                log_T=jnp.asarray(arrays["log_T"]),
+                log_T=rep("log_T"),
                 wf=WelfordState(
-                    count=jnp.asarray(arrays["wf_count"]),
-                    mean=jnp.asarray(arrays["wf_mean"]),
-                    m2=jnp.asarray(arrays["wf_m2"]),
+                    count=rep("wf_count"),
+                    mean=rep("wf_mean"),
+                    m2=rep("wf_m2"),
                 ),
                 inv_mass=inv_mass,
             )
@@ -304,8 +343,8 @@ def sample_until_converged(
 
             run_carry = CheesRunCarry(
                 states=state,
-                log_eps=jnp.asarray(arrays["log_eps"]),
-                log_T=jnp.asarray(arrays["log_T"]),
+                log_eps=pr(jnp.asarray(arrays["log_eps"])),
+                log_T=pr(jnp.asarray(arrays["log_T"])),
                 inv_mass=inv_mass,
             )
         blocks_done = int(meta.get("blocks_done", 0))
@@ -335,8 +374,10 @@ def sample_until_converged(
         key = jax.random.PRNGKey(seed)
         key, key_init, key_warm = jax.random.split(key, 3)
         if is_chees:
-            z0 = chees_init_positions(fm, key_init, chains, init_params)
-            carry = jax.block_until_ready(chees_init_j(key_init, z0, data))
+            z0 = ap.put_chains(
+                chees_init_positions(fm, key_init, chains, init_params)
+            )
+            carry = jax.block_until_ready(chees_init_j(key_init, z0, *extra))
             # warmup dispatches bounded by block_size, like the draw
             # blocks, each segment checkpointed for mid-warmup resume
             carry, n_div, n_warm_leap = run_chees_warmup(
@@ -353,7 +394,8 @@ def sample_until_converged(
                 )
             else:
                 z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
-            warm_keys = jax.random.split(key_warm, chains)
+            z0 = ap.put_chains(z0)
+            warm_keys = ap.put_chains(jax.random.split(key_warm, chains))
             state, step_size, inv_mass, n_div = seg_warmup(
                 warm_keys, z0, data, block_size
             )
@@ -399,7 +441,7 @@ def sample_until_converged(
                 )
                 bkeys = jax.random.split(key_block, block_size)
                 run_carry, (zs, accept, divergent, _) = jax.block_until_ready(
-                    chees_samp_j(run_carry, bkeys, us, data)
+                    chees_samp_j(run_carry, bkeys, us, *extra)
                 )
                 state = run_carry.states
                 step_size = jnp.exp(run_carry.log_eps)
@@ -537,6 +579,21 @@ def sample_until_converged(
 
             if converged:
                 break
+            if (
+                time_budget_s is not None
+                and time.perf_counter() - t_start > time_budget_s
+            ):
+                # stop AFTER the block is emitted and checkpointed, so the
+                # returned (and persisted) result accounts for every draw
+                budget_exhausted = True
+                emit(
+                    {
+                        "event": "budget_exhausted",
+                        "time_budget_s": float(time_budget_s),
+                        "wall_s": time.perf_counter() - t_start,
+                    }
+                )
+                break
     finally:
         if metrics_f:
             metrics_f.close()
@@ -550,7 +607,7 @@ def sample_until_converged(
     )
     draws = _constrain_draws(fm, all_draws)
     stats = {"num_divergent": np.asarray(total_div)}
-    return AdaptiveResult(
+    result = AdaptiveResult(
         draws,
         stats,
         flat_model=fm,
@@ -559,3 +616,5 @@ def sample_until_converged(
         converged=converged,
         wall_s=time.perf_counter() - t_start,
     )
+    result.budget_exhausted = budget_exhausted
+    return result

@@ -219,17 +219,48 @@ def make_warmup_parts(fm: FlatModel, cfg: SamplerConfig):
     return init_carry, segment, finalize
 
 
+def drive_segmented_warmup(cfg, v_init, v_seg, finalize, warm_keys, z0, data,
+                           seg):
+    """The ONE host-side schedule driver over compiled warmup segments.
+
+    ``v_init(keys, z0, data)`` and ``v_seg(keys, aflags, wflags, state, da,
+    welford, inv_mass, data)`` are the chain-vmapped warmup parts — plain
+    jitted on one device (``make_segmented_warmup``) or shard_mapped over a
+    mesh (``ShardedBackend``); the schedule slicing and key layout live
+    here so the two execution paths cannot drift.
+    """
+    kinit = jax.vmap(lambda k: jax.random.split(k, 2))(warm_keys)
+    state, da, welford, inv_mass = jax.block_until_ready(
+        v_init(kinit[:, 0], z0, data)
+    )
+    schedule = build_warmup_schedule(cfg.num_warmup)
+    aflags = np.asarray(schedule.adapt_mass)
+    wflags = np.asarray(schedule.window_end)
+    # (num_warmup, chains, 2) step keys, sliced per segment on the host
+    wkeys = np.asarray(
+        jax.vmap(lambda k: jax.random.split(k, max(cfg.num_warmup, 1)))(
+            kinit[:, 1]
+        )
+    ).transpose(1, 0, 2)
+    warm_div = np.zeros((np.asarray(warm_keys).shape[0],), np.int64)
+    for s in range(0, cfg.num_warmup, seg):
+        e = min(s + seg, cfg.num_warmup)
+        state, da, welford, inv_mass, ndiv = jax.block_until_ready(
+            v_seg(jnp.asarray(wkeys[s:e]), jnp.asarray(aflags[s:e]),
+                  jnp.asarray(wflags[s:e]), state, da, welford, inv_mass,
+                  data)
+        )
+        warm_div += np.asarray(ndiv)
+    return state, finalize(da), inv_mass, warm_div
+
+
 def make_segmented_warmup(fm: FlatModel, cfg: SamplerConfig):
-    """The shared host-side driver over ``make_warmup_parts`` — built once
-    (callers cache the returned runner; the jitted init/segment functions
-    are closed over, and one wrapper serves every segment length since the
-    length lives in the input shapes).
+    """Single-device segmented warmup: jit+vmap the warmup parts, return
+    ``run(warm_keys, z0, data, seg) -> (state, step_size, inv_mass,
+    warm_div numpy (chains,))`` driven by ``drive_segmented_warmup``.
 
-      run(warm_keys, z0, data, seg) -> (state, step_size, inv_mass,
-                                        warm_div numpy (chains,))
-
-    Used by both JaxBackend._run_segmented and the adaptive runner so the
-    key layout / schedule slicing cannot drift between them.
+    Used by JaxBackend._run_segmented and the adaptive runner; the sharded
+    backend builds shard_mapped parts and shares the same driver.
     """
     init_carry, segment, finalize = make_warmup_parts(fm, cfg)
     v_init = jax.jit(jax.vmap(init_carry, in_axes=(0, 0, None)))
@@ -238,29 +269,9 @@ def make_segmented_warmup(fm: FlatModel, cfg: SamplerConfig):
     )
 
     def run(warm_keys, z0, data, seg):
-        kinit = jax.vmap(lambda k: jax.random.split(k, 2))(warm_keys)
-        state, da, welford, inv_mass = jax.block_until_ready(
-            v_init(kinit[:, 0], z0, data)
+        return drive_segmented_warmup(
+            cfg, v_init, v_seg, finalize, warm_keys, z0, data, seg
         )
-        schedule = build_warmup_schedule(cfg.num_warmup)
-        aflags = np.asarray(schedule.adapt_mass)
-        wflags = np.asarray(schedule.window_end)
-        # (num_warmup, chains, 2) step keys, sliced per segment on the host
-        wkeys = np.asarray(
-            jax.vmap(lambda k: jax.random.split(k, max(cfg.num_warmup, 1)))(
-                kinit[:, 1]
-            )
-        ).transpose(1, 0, 2)
-        warm_div = np.zeros((z0.shape[0],), np.int64)
-        for s in range(0, cfg.num_warmup, seg):
-            e = min(s + seg, cfg.num_warmup)
-            state, da, welford, inv_mass, ndiv = jax.block_until_ready(
-                v_seg(jnp.asarray(wkeys[s:e]), jnp.asarray(aflags[s:e]),
-                      jnp.asarray(wflags[s:e]), state, da, welford, inv_mass,
-                      data)
-            )
-            warm_div += np.asarray(ndiv)
-        return state, finalize(da), inv_mass, warm_div
 
     return run
 
@@ -373,6 +384,79 @@ def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int):
         return state, zs, accept, divergent, energy, ngrad
 
     return block_run
+
+
+def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
+                             get_block, chain_keys, z0, data, seg,
+                             collect=None):
+    """Warmup + sampling as bounded-length dispatches, one host driver for
+    every backend (see JaxBackend docstring for why dispatches are
+    bounded).  ``seg_warmup(warm_keys, z0, data, seg)`` and
+    ``get_block(length) -> v_block(keys, state, step_size, inv_mass,
+    data)`` are backend-compiled (jit or shard_map + jit); ``collect``
+    materializes a device pytree on the host (allgather on pods).
+
+    At most two compiled block variants run per call (the full segment and
+    one remainder length).
+    """
+    if collect is None:
+        collect = lambda t: jax.tree.map(np.asarray, t)  # noqa: E731
+    chains = np.asarray(z0).shape[0]
+    keys = jax.vmap(lambda k: jax.random.split(k, 2))(chain_keys)
+    warm_keys, sample_keys = keys[:, 0], keys[:, 1]
+    state, step_size, inv_mass, warm_div = seg_warmup(warm_keys, z0, data, seg)
+
+    total = cfg.num_samples * cfg.thin
+    skeys = np.asarray(
+        jax.vmap(lambda k: jax.random.split(k, max(total, 1)))(sample_keys)
+    )  # (chains, >=1, 2)
+    # empty seeds keep the num_samples=0 (warmup-only) case concatenable;
+    # thinning happens PER BLOCK so host memory holds only kept draws
+    d = np.asarray(z0).shape[1]
+    zs_blocks = [np.zeros((chains, 0, d), np.asarray(z0).dtype)]
+    acc_blocks = [np.zeros((chains, 0), np.float32)]
+    div_blocks = [np.zeros((chains, 0), bool)]
+    en_blocks = [np.zeros((chains, 0), np.float32)]
+    ng_blocks = [np.zeros((chains, 0), np.int32)]
+    num_divergent = np.zeros((chains,), np.int64)
+    for s in range(0, total, seg):
+        e = min(s + seg, total)
+        v_block = get_block(e - s)
+        # block_run splits its own per-step keys from one key per chain
+        bkeys = jnp.asarray(skeys[:, s, :])
+        out = jax.block_until_ready(
+            v_block(bkeys, state, step_size, inv_mass, data)
+        )
+        state = out[0]
+        zs, accept, divergent, energy, ngrad = collect(out[1:])
+        num_divergent += divergent.astype(np.int64).sum(axis=1)
+        # global transition i is kept when (i+1) % thin == 0
+        keep = np.arange(s, e)
+        keep = (
+            (keep[(keep + 1) % cfg.thin == 0] - s)
+            if cfg.thin > 1
+            else slice(None)
+        )
+        zs_blocks.append(zs[:, keep])
+        acc_blocks.append(accept[:, keep])
+        div_blocks.append(divergent[:, keep])
+        en_blocks.append(energy[:, keep])
+        ng_blocks.append(ngrad[:, keep])
+
+    zs = np.concatenate(zs_blocks, axis=1)  # (chains, num_samples, d)
+    step_size, inv_mass = collect((step_size, inv_mass))
+    draws = _constrain_draws(fm, jnp.asarray(zs))
+    stats = {
+        "accept_prob": np.concatenate(acc_blocks, axis=1),
+        "is_divergent": np.concatenate(div_blocks, axis=1),
+        "energy": np.concatenate(en_blocks, axis=1),
+        "num_grad_evals": np.concatenate(ng_blocks, axis=1),
+        "step_size": step_size,
+        "inv_mass_diag": inv_mass,
+        "num_warmup_divergent": warm_div,
+        "num_divergent": num_divergent,
+    }
+    return Posterior(draws, stats, flat_model=fm, draws_flat=zs)
 
 
 class Posterior:

@@ -110,6 +110,14 @@ def supervised_sample(
     """
     from .runner import sample_until_converged
 
+    # a wall-clock budget is an absolute deadline across ALL attempts — a
+    # crash at 80% of the budget leaves the retry only the remaining 20%,
+    # never a fresh full budget (the caller's capture window doesn't reset)
+    time_budget_s = kwargs.pop("time_budget_s", None)
+    deadline = (
+        time.monotonic() + time_budget_s if time_budget_s is not None else None
+    )
+
     os.makedirs(workdir, exist_ok=True)
     ckpt_path = os.path.join(workdir, "chain.ckpt.npz")
     metrics_path = kwargs.pop(
@@ -144,6 +152,14 @@ def supervised_sample(
             # into this run's store (a later resume reads the whole store)
             quarantine(store_path)
         try:
+            remaining = (
+                # floor at 1s: with the deadline already blown the attempt
+                # still runs (resuming its checkpoint) and the runner stops
+                # it at the first completed block — partial > nothing
+                max(deadline - time.monotonic(), 1.0)
+                if deadline is not None
+                else None
+            )
             return sample_until_converged(
                 model,
                 data,
@@ -152,6 +168,7 @@ def supervised_sample(
                 resume_from=resume,
                 metrics_path=metrics_path,
                 reseed=attempt if (attempt and reseed_on_restart) else None,
+                time_budget_s=remaining,
                 **kwargs,
             )
         except Exception as e:  # noqa: BLE001 — supervision boundary

@@ -1,0 +1,127 @@
+"""Adaptive runner + supervision composed with ShardedBackend (VERDICT r2
+missing #4): the convergence-driven block protocol, checkpoint/resume, and
+failure supervision must work WITH chains/data sharded over the mesh — not
+only on a single device.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import stark_tpu
+from stark_tpu import supervise
+from stark_tpu.backends.sharded import ShardedBackend
+from stark_tpu.models.logistic import Logistic, synth_logistic_data
+from stark_tpu.parallel.mesh import make_mesh
+from stark_tpu.supervise import supervised_sample
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = Logistic(num_features=4)
+    data, _ = synth_logistic_data(jax.random.PRNGKey(0), 1024, 4)
+    return model, data
+
+
+CHEES_KW = dict(
+    kernel="chees",
+    chains=8,
+    num_warmup=150,
+    block_size=50,
+    max_blocks=12,
+    min_blocks=2,
+    rhat_target=1.02,
+    ess_target=200.0,
+    init_step_size=0.1,
+)
+
+
+def _mesh():
+    return make_mesh({"data": 2, "chains": 4})
+
+
+def test_adaptive_chees_on_mesh_matches_single_device(setup):
+    """Same seed, same schedule: the mesh run's collective adaptation must
+    reproduce the single-device ensemble statistics (psum of shard sums ==
+    global sum), so the posterior summaries agree."""
+    model, data = setup
+    post_mesh = stark_tpu.sample_until_converged(
+        model, data, backend=ShardedBackend(_mesh()), seed=3, **CHEES_KW
+    )
+    post_one = stark_tpu.sample_until_converged(
+        model, data, seed=3, **CHEES_KW
+    )
+    assert post_mesh.converged and post_one.converged
+    for name in post_mesh.draws:
+        np.testing.assert_allclose(
+            post_mesh.draws[name].mean(axis=(0, 1)),
+            post_one.draws[name].mean(axis=(0, 1)),
+            atol=0.15,
+        )
+
+
+def test_adaptive_nuts_on_mesh_converges(setup):
+    """Per-chain kernels through the mesh adaptive path (shard_mapped
+    segmented warmup + block runner)."""
+    model, data = setup
+    post = stark_tpu.sample_until_converged(
+        model, data, backend=ShardedBackend(_mesh()), seed=0,
+        kernel="nuts", max_tree_depth=6, chains=8, num_warmup=200,
+        block_size=50, max_blocks=10, min_blocks=2,
+        rhat_target=1.02, ess_target=200.0,
+    )
+    assert post.converged
+    assert post.draws_flat.shape[0] == 8
+
+
+def test_sharded_backend_dispatch_bounded_nuts(setup):
+    """ShardedBackend.run with dispatch_steps: bounded device programs for
+    the per-chain kernels (previously chees-only)."""
+    model, data = setup
+    post = stark_tpu.sample(
+        model, data, backend=ShardedBackend(_mesh(), dispatch_steps=60),
+        chains=8, num_warmup=200, num_samples=200, seed=1,
+    )
+    assert post.max_rhat() < 1.05
+    assert post.num_samples == 200
+
+
+def test_supervised_sharded_chees_kill_resume(tmp_path, monkeypatch, setup):
+    """THE composition the flagship bench relies on: supervised ChEES over
+    the mesh, killed mid-sampling, resumes from the block checkpoint on
+    the mesh (state re-placed from host numpy) and finishes."""
+    model, data = setup
+    wd = str(tmp_path / "run")
+    backend = ShardedBackend(_mesh())
+    real = stark_tpu.runner.sample_until_converged
+    calls = {"n": 0, "resumes": []}
+
+    def flaky(m, d=None, **kw):
+        calls["n"] += 1
+        calls["resumes"].append(kw.get("resume_from"))
+        if calls["n"] == 1:
+            # two real blocks land a checkpoint, then the "device" dies
+            real(m, d, **dict(kw, max_blocks=2, rhat_target=0.5))
+            raise RuntimeError("injected mesh fault")
+        return real(m, d, **kw)
+
+    monkeypatch.setattr(supervise, "sample_until_converged", flaky,
+                        raising=False)
+    monkeypatch.setattr(stark_tpu.runner, "sample_until_converged", flaky)
+    post = supervised_sample(
+        model, data, workdir=wd, backend=backend, seed=0, max_restarts=2,
+        **CHEES_KW,
+    )
+    assert post.converged
+    assert calls["n"] == 2
+    assert calls["resumes"][0] is None
+    assert calls["resumes"][1] is not None  # resumed from the checkpoint
+    lines = [json.loads(l) for l in open(os.path.join(wd, "metrics.jsonl"))]
+    assert sum(1 for l in lines if l["event"] == "restart") == 1
+    # the resumed run keeps the pre-kill draws: its first block record
+    # continues from the checkpointed count, not from zero
+    resumed_blocks = [l for l in lines if l["event"] == "block"]
+    assert resumed_blocks[-1]["draws_per_chain"] >= 150
