@@ -236,16 +236,25 @@ def bench_gmm_tempered(
     def run():
         # NUTS replicas: adaptive trajectories mix the 3K-1-dim mixture
         # posterior far better than fixed-length leapfrog (measured ~5x
-        # min-ESS at equal draws)
+        # min-ESS at equal draws); adapt_ladder gives the rungs ΔE-matched
+        # spacing so swaps actually fire at this N (DESIGN.md §4b)
         return tempered_sample(
             model, data, chains=chains, num_temps=num_temps, kernel="nuts",
             max_tree_depth=max_tree_depth, num_warmup=num_warmup,
             num_samples=num_samples, swap_every=5, seed=seed,
-            init_params=init,
+            init_params=init, adapt_ladder=True,
         )
 
     post, wall = _timed(run)
-    return _result("gmm16_tempered", post, wall, num_temps=num_temps)
+    stats = post.sample_stats
+    return _result(
+        "gmm16_tempered", post, wall, num_temps=num_temps,
+        swap_accept_rate=round(float(np.mean(stats["swap_accept_rate"])), 4),
+        swap_accept_min_pair=round(
+            float(np.min(stats["swap_accept_per_pair"])), 4
+        ),
+        beta_hot=round(float(np.min(stats["betas"])), 5),
+    )
 
 
 def bench_bnn_sghmc(

@@ -61,6 +61,17 @@ def geometric_ladder(num_temps: int, beta_min: float = 0.05) -> jnp.ndarray:
     )
 
 
+def _betas_from_rho(rho: Array, t0: float = 1.0) -> Array:
+    """Ladder from log-gap parameters: T_k = T_0 + Σ_{j≤k} e^{ρ_j}, β = 1/T.
+
+    β_0 is pinned at 1/T_0 (the caller's cold temperature — the target
+    posterior — no matter what adaptation does); every gap stays strictly
+    positive, so the ladder is always monotone decreasing.
+    """
+    temps = t0 + jnp.concatenate([jnp.zeros((1,)), jnp.cumsum(jnp.exp(rho))])
+    return 1.0 / temps
+
+
 def tempered_sample(
     model: Model,
     data,
@@ -79,12 +90,27 @@ def tempered_sample(
     seed: int = 0,
     mesh: Optional[Mesh] = None,
     init_params: Optional[Dict[str, Any]] = None,
+    adapt_ladder: bool = False,
+    target_swap: float = 0.35,
+    ladder_adapt_rate: float = 0.4,
 ) -> Posterior:
     """Run parallel-tempered MCMC; returns the β=1 replica's Posterior.
 
     Step sizes adapt per temperature with dual averaging during warmup
     (hot replicas want larger steps).  ``sample_stats["swap_accept_rate"]``
-    reports the realized adjacent-swap acceptance per chain.
+    reports the realized adjacent-swap acceptance per chain, and
+    ``sample_stats["swap_accept_per_pair"]`` the per-rung rates — the
+    evidence that the ladder is doing statistical work, not decoration.
+
+    ``adapt_ladder=True`` turns on ΔE-matched spacing: during warmup each
+    chain runs Robbins–Monro on its log-temperature-gaps ρ (β from
+    ``_betas_from_rho``), nudging every adjacent pair's expected swap
+    acceptance toward ``target_swap`` — pairs that never swap pull closer,
+    pairs that always swap push apart, so the ladder spends its K replicas
+    exactly where the energy gaps are (the fix for the measured
+    Δβ·ΔE ≫ 1 dead ladder at N=50k, DESIGN.md §4b).  The ladder freezes at
+    the end of warmup; the cold rung stays pinned at β=1 throughout, so
+    adaptation never biases the returned posterior.
     """
     if data is None:
         raise ValueError("tempering requires a data likelihood to temper")
@@ -92,6 +118,14 @@ def tempered_sample(
     fm = flatten_model(model)
     betas = geometric_ladder(num_temps) if betas is None else jnp.asarray(betas)
     num_temps = betas.shape[0]
+    if num_temps > 1 and not bool(jnp.all(jnp.diff(betas) < 0)):
+        # a non-monotone ladder would NaN-poison the adaptive
+        # parameterization (log of a negative gap) and is wrong for the
+        # fixed ladder too — fail loudly, not with NaN draws
+        raise ValueError(
+            f"betas must be strictly decreasing from the cold chain; got "
+            f"{np.asarray(betas)}"
+        )
 
     def prior_pot(z):
         return fm.potential(z, None)
@@ -124,30 +158,41 @@ def tempered_sample(
 
     v_step = jax.vmap(one_replica_step, in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
 
-    temps_idx = jnp.arange(num_temps)
+    num_gaps = num_temps - 1
+    gaps_idx = jnp.arange(num_gaps)  # empty when num_temps == 1: no swaps
 
-    def swap(key, rs: ReplicaState, parity):
-        """Even-odd adjacent exchange; returns (new state, n_accept, n_pairs)."""
-        k = temps_idx
-        partner = jnp.where((k - parity) % 2 == 0, k + 1, k - 1)
-        valid = (partner >= 0) & (partner < num_temps)
-        partner = jnp.clip(partner, 0, num_temps - 1)
-        delta = (betas - betas[partner]) * (rs.ll[partner] - rs.ll)
-        u = jax.random.uniform(key, (num_temps,))
-        u_pair = u[jnp.minimum(k, partner)]  # one draw per pair
-        accept = valid & (jnp.log(u_pair) < delta)
-        perm = jnp.where(accept, partner, k)
+    def swap(key, rs: ReplicaState, bs, parity):
+        """Even-odd adjacent exchange, gap-centric.
+
+        Gap g joins replicas g (colder) and g+1 (hotter); gaps of one parity
+        are active per round, so accepted swaps never overlap.  Returns the
+        permuted state plus per-gap (accepted, active, accept_prob) — the
+        accept_prob drives ladder adaptation, the booleans the swap-rate
+        accounting.
+        """
+        active = (gaps_idx % 2) == (parity % 2)
+        delta = (bs[:-1] - bs[1:]) * (rs.ll[1:] - rs.ll[:-1])
+        u = jax.random.uniform(key, (num_gaps,))
+        accept = active & (jnp.log(u) < delta)
+        # accepted gaps are non-adjacent by parity, so the swaps commute
+        swap_up = jnp.concatenate([accept, jnp.zeros((1,), bool)])
+        swap_dn = jnp.concatenate([jnp.zeros((1,), bool), accept])
+        k = jnp.arange(num_temps)
+        perm = jnp.where(swap_up, k + 1, jnp.where(swap_dn, k - 1, k))
         new = ReplicaState(*[x[perm] for x in rs])
-        is_lower = k < partner
-        n_acc = jnp.sum((accept & is_lower).astype(jnp.int32))
-        n_pairs = jnp.sum((valid & is_lower).astype(jnp.int32))
-        return new, n_acc, n_pairs
+        acc_prob = jnp.where(active, jnp.minimum(1.0, jnp.exp(delta)), 0.0)
+        return new, accept, active, acc_prob
 
     swap_flags = np.zeros(num_warmup + num_samples, bool)
     if swap_every > 0:
         swap_flags[swap_every - 1 :: swap_every] = True
     parities = np.cumsum(swap_flags) % 2  # alternate parity across swap rounds
+    swap_rounds = np.cumsum(swap_flags)  # 1-based round number, for RM decay
     is_warm = np.arange(num_warmup + num_samples) < num_warmup
+    cold_t0 = float(1.0 / betas[0])  # adaptation pins β_0 at the caller's value
+    rho0 = (
+        jnp.log(jnp.diff(1.0 / betas)) if num_gaps > 0 else jnp.zeros((0,))
+    )
 
     def run_chain(key, z0):
         ppe, pgr, ll, llg = jax.vmap(refresh)(z0)
@@ -155,31 +200,40 @@ def tempered_sample(
         da = jax.vmap(da_init)(jnp.full((num_temps,), init_step_size))
 
         def body(carry, x):
-            rs, da = carry
-            key, do_swap, parity, warm = x
+            rs, da, rho = carry
+            key, do_swap, parity, rnd, warm = x
+            bs = _betas_from_rho(rho, cold_t0) if adapt_ladder else betas
             key_step, key_swap = jax.random.split(key)
             step_size = jnp.where(warm, jnp.exp(da.log_step), jnp.exp(da.log_avg_step))
             keys = jax.random.split(key_step, num_temps)
             (z, ppe, pgr, ll, llg), info = v_step(
                 keys, rs.z, rs.prior_pe, rs.prior_grad, rs.ll, rs.ll_grad,
-                betas, step_size,
+                bs, step_size,
             )
             rs = ReplicaState(z, ppe, pgr, ll, llg)
             da_new = jax.vmap(lambda d, a: da_update(d, a, target_accept))(
                 da, info.accept_prob
             )
             da = jax.tree.map(lambda a, b: jnp.where(warm, a, b), da_new, da)
-            swapped, n_acc, n_pairs = swap(key_swap, rs, parity)
+            swapped, accept, active, acc_prob = swap(key_swap, rs, bs, parity)
             rs = jax.tree.map(
                 lambda a, b: jnp.where(do_swap, a, b), swapped, rs
             )
-            out = (
-                rs.z[0],
-                info.is_divergent[0],
-                jnp.where(do_swap, n_acc, 0),
-                jnp.where(do_swap, n_pairs, 0),
-            )
-            return (rs, da), out
+            if adapt_ladder and num_gaps > 0:
+                # Robbins–Monro toward target_swap on active gaps: a pair
+                # accepting too rarely pulls its temperatures together, too
+                # eagerly pushes them apart (ΔE-matched spacing)
+                gamma = ladder_adapt_rate / (1.0 + rnd) ** 0.6
+                # a non-finite acc_prob (e.g. inf-inf lls out of support)
+                # must reject one swap, not poison the ladder forever
+                rho_new = rho + gamma * jnp.where(
+                    active & jnp.isfinite(acc_prob), acc_prob - target_swap, 0.0
+                )
+                rho = jnp.where(warm & do_swap, rho_new, rho)
+            acc_i = (accept & do_swap).astype(jnp.int32)
+            pairs_i = (active & do_swap).astype(jnp.int32)
+            out = (rs.z[0], info.is_divergent[0], acc_i, pairs_i)
+            return (rs, da, rho), out
 
         total = num_warmup + num_samples
         keys = jax.random.split(key, total)
@@ -187,15 +241,25 @@ def tempered_sample(
             keys,
             jnp.asarray(swap_flags),
             jnp.asarray(parities, jnp.int32),
+            jnp.asarray(swap_rounds, jnp.float32),
             jnp.asarray(is_warm),
         )
-        (rs, da), (z_cold, div, n_acc, n_pairs) = jax.lax.scan(
-            body, (rs, da), xs
+        (rs, da, rho), (z_cold, div, acc_g, pairs_g) = jax.lax.scan(
+            body, (rs, da, rho0), xs
         )
         zs = z_cold[num_warmup:]
         n_div = jnp.sum(div[num_warmup:].astype(jnp.int32))
-        swap_rate = jnp.sum(n_acc) / jnp.maximum(jnp.sum(n_pairs), 1)
-        return zs, n_div, swap_rate, jnp.exp(da.log_avg_step)
+        # swap-rate accounting over the SAMPLING phase only — the warmup
+        # ladder is still moving, its rates aren't evidence of anything
+        acc_sum = jnp.sum(acc_g[num_warmup:], axis=0)
+        pairs_sum = jnp.sum(pairs_g[num_warmup:], axis=0)
+        rate_per_pair = acc_sum / jnp.maximum(pairs_sum, 1)
+        swap_rate = jnp.sum(acc_sum) / jnp.maximum(jnp.sum(pairs_sum), 1)
+        betas_final = _betas_from_rho(rho, cold_t0) if adapt_ladder else betas
+        return (
+            zs, n_div, swap_rate, rate_per_pair, betas_final,
+            jnp.exp(da.log_avg_step),
+        )
 
     key = jax.random.PRNGKey(seed)
     key_init, key_run = jax.random.split(key)
@@ -219,12 +283,14 @@ def tempered_sample(
 
         out = run_over_chains(mesh, vrun, chain_keys, z0)
 
-    zs, n_div, swap_rate, step_sizes = out
+    zs, n_div, swap_rate, rate_per_pair, betas_final, step_sizes = out
     draws = _constrain_draws(fm, zs)
     stats = {
         "num_divergent": np.asarray(n_div),
         "swap_accept_rate": np.asarray(swap_rate),
+        "swap_accept_per_pair": np.asarray(rate_per_pair),
         "step_size_per_temp": np.asarray(step_sizes),
-        "betas": np.asarray(betas),
+        "betas_init": np.asarray(betas),
+        "betas": np.asarray(betas_final),  # (chains, K); per-chain if adapted
     }
     return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(zs))

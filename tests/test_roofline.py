@@ -1,0 +1,49 @@
+"""Roofline sanity-gate tests (VERDICT r2 #3 / ADVICE r2 medium).
+
+The axon tunnel memoizes repeated (executable, args) executions, which can
+fake >spec-peak HBM rates; tools/roofline.py must never commit such rows as
+real data.  These tests pin the gate's behavior and assert the committed
+artifact itself contains no un-flagged impossible rates.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+from roofline import V5E_PEAK_GBS, gate  # noqa: E402
+
+_RESULTS = os.path.join(
+    os.path.dirname(__file__), "..", "tools", "roofline_results.json"
+)
+
+
+def test_gate_passes_sane_rates():
+    entry = {"per_dispatch_gbs": 50.0, "amortized_gbs": 320.0,
+             "pct_of_spec_peak": 39.0}
+    assert gate(entry)
+    assert "invalid_memoized" not in entry
+    assert entry["pct_of_spec_peak"] == 39.0
+
+
+@pytest.mark.parametrize("field", ["per_dispatch_gbs", "amortized_gbs"])
+def test_gate_flags_impossible_rates(field):
+    entry = {"per_dispatch_gbs": 100.0, "amortized_gbs": 300.0,
+             "pct_of_spec_peak": 36.0}
+    entry[field] = V5E_PEAK_GBS * 10  # the measured memoization signature
+    assert not gate(entry)
+    assert entry["invalid_memoized"] is True
+    assert entry["pct_of_spec_peak"] is None
+
+
+def test_committed_artifact_has_no_unflagged_impossible_rows():
+    with open(_RESULTS) as f:
+        results = json.load(f)
+    rows = list(results["cases"]) + [results["stream"]]
+    for row in rows:
+        if row.get("invalid_memoized"):
+            continue
+        assert row["per_dispatch_gbs"] <= V5E_PEAK_GBS, row
+        assert row["amortized_gbs"] <= V5E_PEAK_GBS, row

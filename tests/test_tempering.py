@@ -64,6 +64,65 @@ def test_tempered_visits_both_modes():
     assert abs(abs(draws).mean() - 4.0) < 1.0
 
 
+class GaussLoc(Model):
+    """d-dim Gaussian location — the BvM-regime ladder stress case.
+
+    Between tempered posteriors the mean log-lik gap is (d/2)(1/β_hot −
+    1/β_cold) (χ²_d at temperature), so at d=16 a geometric ladder to
+    β=1e-2 has per-gap E[log A] ≈ −22: statistically dead by design,
+    independent of row count.  (A 1-d toy CANNOT produce a dead ladder —
+    measured 0.44 min-pair acceptance at β_min=1e-3 — which is why this
+    test needs dimensions, not more rows.)
+    """
+
+    def __init__(self, d=16):
+        self.d = d
+
+    def param_spec(self):
+        return {"theta": ParamSpec((self.d,))}
+
+    def log_prior(self, p):
+        return jnp.sum(jax.scipy.stats.norm.logpdf(p["theta"], 0.0, 10.0))
+
+    def log_lik(self, p, data):
+        return jnp.sum(jax.scipy.stats.norm.logpdf(data["x"], p["theta"], 1.0))
+
+
+def test_adaptive_ladder_revives_dead_swaps():
+    """ΔE-matched adaptation (VERDICT r2 #8): start from a ladder whose
+    rung gaps are far too wide to ever swap and check warmup swap-rate
+    matching pulls every adjacent pair back to working acceptance while
+    keeping the cold rung pinned at β=1."""
+    from stark_tpu.parallel.tempering import geometric_ladder
+
+    key = jax.random.PRNGKey(2)
+    data = {"x": jax.random.normal(key, (256, 16))}
+    kwargs = dict(
+        chains=2, num_temps=4, kernel="hmc", num_leapfrog=8,
+        num_warmup=600, num_samples=400, swap_every=1, seed=7,
+        betas=geometric_ladder(4, beta_min=1e-2),
+    )
+    dead = tempered_sample(GaussLoc(16), data, **kwargs)
+    live = tempered_sample(
+        GaussLoc(16), data, adapt_ladder=True, ladder_adapt_rate=1.0,
+        **kwargs,
+    )
+
+    dead_min = dead.sample_stats["swap_accept_per_pair"].min()
+    live_min = live.sample_stats["swap_accept_per_pair"].min()
+    assert dead_min < 0.02, f"ladder unexpectedly alive: {dead_min}"
+    assert live_min > 0.1, f"adaptation failed to revive swaps: {live_min}"
+    # cold rung stays pinned at beta=1; ladder is monotone after adaptation
+    betas = live.sample_stats["betas"]
+    np.testing.assert_allclose(betas[:, 0], 1.0, rtol=1e-6)
+    assert np.all(np.diff(betas, axis=1) < 0)
+    # the cold chain's posterior is unaffected by adaptation: theta_hat
+    # shrinks the data mean by n/(n + 1/sigma0^2)
+    post_mean = live.draws["theta"].mean(axis=(0, 1))
+    expect = np.asarray(data["x"]).mean(axis=0) * (256 / (256 + 0.01))
+    np.testing.assert_allclose(post_mean, expect, atol=0.08)
+
+
 def test_gmm_init_1d_recovers_uneven_mixture():
     """EM init must find ALL components of an uneven, well-separated
     mixture — quantile/k-means seeding loses light components (which is a

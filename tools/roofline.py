@@ -32,6 +32,25 @@ D = int(os.environ.get("ROOF_D", 32))
 K = int(os.environ.get("ROOF_K", 20))  # amortized iterations per program
 REPS = int(os.environ.get("ROOF_REPS", 10))
 V5E_PEAK_GBS = 819.0  # v5e HBM spec
+SANITY_ATTEMPTS = 3
+
+
+def gate(entry, *, peak_gbs=V5E_PEAK_GBS):
+    """Memoization sanity gate (VERDICT r2 #3).
+
+    A measured HBM rate above the chip's spec peak is physically impossible
+    — it means the axon tunnel served at least one timed rep from its
+    (executable, args) cache instead of executing it.  Tag such entries
+    ``invalid_memoized`` so they can never be mistaken for real data, and
+    null the %-of-peak field.  Returns True when the entry is sane.
+    """
+    rates = [entry.get("per_dispatch_gbs", 0.0), entry.get("amortized_gbs", 0.0)]
+    if any(r > peak_gbs for r in rates):
+        entry["invalid_memoized"] = True
+        if "pct_of_spec_peak" in entry:
+            entry["pct_of_spec_peak"] = None
+        return False
+    return True
 
 
 def timeit(fn, warm_arg, arglist, *, sync_each=False):
@@ -78,22 +97,47 @@ def main():
 
         return jax.lax.fori_loop(0, K, body, jnp.float32(0))
 
-    scales = [jnp.float32(1.0 + i * 1e-6) for i in range(REPS)]
-    warm_s = jnp.float32(0.5)
+    def measure_gated(tag, measure_attempt):
+        """Run measure_attempt(attempt) -> entry until the sanity gate
+        passes (fresh inputs each attempt so a cache-tainted retry cannot
+        replay earlier (executable, args) pairs); keep the last entry —
+        tagged invalid_memoized — if every attempt is impossible."""
+        for attempt in range(SANITY_ATTEMPTS):
+            entry = measure_attempt(attempt)
+            if gate(entry):
+                return entry
+            print(
+                f"[roofline] {tag} attempt {attempt}: rate above spec peak "
+                f"(memoized) — regenerating inputs and retrying",
+                file=sys.stderr,
+            )
+        return entry
+
+    def invalid_or(entry, text):
+        return "INVALID (memoized)" if entry.get("invalid_memoized") else text
+
     xt_bytes = xt.size * 4
-    t1 = timeit(stream_once, warm_s, scales, sync_each=True)
-    tk = timeit(stream_loop, warm_s, scales) / K
-    results["stream"] = {
-        "bytes": xt_bytes,
-        "per_dispatch_s": t1,
-        "amortized_s": tk,
-        "per_dispatch_gbs": xt_bytes / t1 / 1e9,
-        "amortized_gbs": xt_bytes / tk / 1e9,
-    }
+
+    def stream_attempt(attempt):
+        base = 1.0 + attempt * 0.37
+        scales = [jnp.float32(base + i * 1e-6) for i in range(REPS)]
+        warm_s = jnp.float32(base - 0.5)
+        t1 = timeit(stream_once, warm_s, scales, sync_each=True)
+        tk = timeit(stream_loop, warm_s, scales) / K
+        return {
+            "bytes": xt_bytes,
+            "per_dispatch_s": t1,
+            "amortized_s": tk,
+            "per_dispatch_gbs": xt_bytes / t1 / 1e9,
+            "amortized_gbs": xt_bytes / tk / 1e9,
+        }
+
+    stream = results["stream"] = measure_gated("stream", stream_attempt)
     print(
         f"[roofline] plain XLA sum over {xt_bytes/1e6:.0f} MB: "
-        f"per-dispatch {t1*1e3:.2f} ms ({xt_bytes/t1/1e9:.0f} GB/s), "
-        f"amortized {tk*1e3:.2f} ms ({xt_bytes/tk/1e9:.0f} GB/s)",
+        f"per-dispatch {stream['per_dispatch_s']*1e3:.2f} ms, "
+        f"amortized {stream['amortized_s']*1e3:.2f} ms "
+        + invalid_or(stream, f"({stream['amortized_gbs']:.0f} GB/s)"),
         file=sys.stderr,
     )
 
@@ -119,31 +163,44 @@ def main():
 
             return jax.lax.fori_loop(0, K, body, beta)
 
-        betas = [
-            0.01 * jax.random.normal(jax.random.PRNGKey(10 + i), (C, D), jnp.float32)
-            for i in range(REPS + 1)
-        ]
         # bytes: read xt + y + offsets, write resid (+ tiny partials)
         nbytes = xt_bytes + 4 * N + 4 * N * C + 4 * N * C
-        t1 = timeit(one, betas[0], betas[1:], sync_each=True)
-        tk = timeit(loop, betas[0], betas[1:]) / K
-        case = {
-            "chains": C,
-            "bytes": nbytes,
-            "per_dispatch_s": t1,
-            "amortized_s": tk,
-            "per_dispatch_gbs": nbytes / t1 / 1e9,
-            "amortized_gbs": nbytes / tk / 1e9,
-            "dispatch_overhead_ms": (t1 - tk) * 1e3,
-            "pct_of_spec_peak": 100.0 * nbytes / tk / 1e9 / V5E_PEAK_GBS,
-        }
+
+        def case_attempt(attempt, C=C, one=one, loop=loop, nbytes=nbytes):
+            betas = [
+                0.01
+                * jax.random.normal(
+                    jax.random.PRNGKey(10 + 1000 * attempt + i), (C, D), jnp.float32
+                )
+                for i in range(REPS + 1)
+            ]
+            t1 = timeit(one, betas[0], betas[1:], sync_each=True)
+            tk = timeit(loop, betas[0], betas[1:]) / K
+            return {
+                "chains": C,
+                "bytes": nbytes,
+                "per_dispatch_s": t1,
+                "amortized_s": tk,
+                "per_dispatch_gbs": nbytes / t1 / 1e9,
+                "amortized_gbs": nbytes / tk / 1e9,
+                "dispatch_overhead_ms": (t1 - tk) * 1e3,
+                "pct_of_spec_peak": 100.0 * nbytes / tk / 1e9 / V5E_PEAK_GBS,
+            }
+
+        case = measure_gated(f"C={C}", case_attempt)
         results["cases"].append(case)
+        if case.get("invalid_memoized"):
+            rate_str = "INVALID (memoized)"  # pct is None — don't format it
+        else:
+            rate_str = (
+                f"({case['amortized_gbs']:.0f} GB/s = "
+                f"{case['pct_of_spec_peak']:.0f}% of v5e spec peak)"
+            )
         print(
             f"[roofline] C={C}: {nbytes/1e6:.0f} MB/eval; per-dispatch "
-            f"{t1*1e3:.2f} ms ({case['per_dispatch_gbs']:.0f} GB/s), "
-            f"amortized {tk*1e3:.2f} ms ({case['amortized_gbs']:.0f} GB/s = "
-            f"{case['pct_of_spec_peak']:.0f}% of v5e spec peak); "
-            f"dispatch overhead {case['dispatch_overhead_ms']:.2f} ms",
+            f"{case['per_dispatch_s']*1e3:.2f} ms, amortized "
+            f"{case['amortized_s']*1e3:.2f} ms " + rate_str
+            + f"; dispatch overhead {case['dispatch_overhead_ms']:.2f} ms",
             file=sys.stderr,
         )
 
