@@ -428,7 +428,7 @@ def sample_until_converged(
 
         def advance_block(key_block):
             """One draw block; returns (zs (chains, block, d), accept,
-            divergent) and refreshes state/step_size/inv_mass."""
+            divergent, grad_evals) and refreshes state/step_size/inv_mass."""
             nonlocal state, step_size, inv_mass
             if is_chees:
                 nonlocal run_carry
@@ -440,27 +440,38 @@ def sample_until_converged(
                     jnp.float32,
                 )
                 bkeys = jax.random.split(key_block, block_size)
-                run_carry, (zs, accept, divergent, _) = jax.block_until_ready(
-                    chees_samp_j(run_carry, bkeys, us, *extra)
+                run_carry, (zs, accept, divergent, n_leap) = (
+                    jax.block_until_ready(
+                        chees_samp_j(run_carry, bkeys, us, *extra)
+                    )
                 )
                 state = run_carry.states
                 step_size = jnp.exp(run_carry.log_eps)
                 inv_mass = run_carry.inv_mass
-                return np.asarray(zs).transpose(1, 0, 2), accept, divergent
+                # n_leap is the SHARED per-transition trajectory length;
+                # the ensemble total is chains x that (chees.py convention)
+                return (
+                    np.asarray(zs).transpose(1, 0, 2), accept, divergent,
+                    int(np.sum(np.asarray(n_leap))) * chains,
+                )
             block_keys = jax.random.split(key_block, chains)
             out = jax.block_until_ready(
                 v_block(block_keys, state, step_size, inv_mass, data)
             )
-            state, zs, accept, divergent, _energy, _ngrad = out
-            return np.asarray(zs), accept, divergent
+            state, zs, accept, divergent, _energy, ngrad = out
+            return np.asarray(zs), accept, divergent, int(
+                np.sum(np.asarray(ngrad))
+            )
 
         while blocks_done < max_blocks:
             key, key_block = jax.random.split(key)
+            t_blk = time.perf_counter()
             if profile_dir and blocks_done == 0:
                 with jax.profiler.trace(profile_dir):
-                    zs, accept, divergent = advance_block(key_block)
+                    zs, accept, divergent, blk_grads = advance_block(key_block)
             else:
-                zs, accept, divergent = advance_block(key_block)
+                zs, accept, divergent, blk_grads = advance_block(key_block)
+            t_dispatch = time.perf_counter() - t_blk
             if health_check:
                 # poisoned state must never reach the checkpoint; the
                 # supervisor (supervise.supervised_sample) restarts from
@@ -517,6 +528,12 @@ def sample_until_converged(
                 "num_stuck_components": n_stuck,
                 "num_divergent": total_div,
                 "mean_accept": float(np.mean(np.asarray(accept))),
+                # wall attribution (VERDICT r2 weak #6): dispatch+execute+
+                # fetch vs host-side diagnostics; grad_evals divides out to
+                # device cost per gradient
+                "t_dispatch_s": round(t_dispatch, 3),
+                "t_diag_s": round(time.perf_counter() - t_blk - t_dispatch, 3),
+                "block_grad_evals": blk_grads,
                 "wall_s": time.perf_counter() - t_start,
             }
             if (
@@ -532,6 +549,11 @@ def sample_until_converged(
                 full_ess = float(np.min(diagnostics.ess(cat_draws)))
                 rec["full_max_rhat"] = full_rhat
                 rec["full_min_ess"] = full_ess
+                # the full pass is host diagnostics too — re-stamp so the
+                # attribution covers the expensive validation blocks
+                rec["t_diag_s"] = round(
+                    time.perf_counter() - t_blk - t_dispatch, 3
+                )
                 rec["wall_s"] = time.perf_counter() - t_start
                 if full_rhat < rhat_target and full_ess > ess_target:
                     converged = True
