@@ -39,7 +39,8 @@ denominator class, scaled by the 32-executor count with ideal linear
 scaling — deliberately generous to the baseline.
 
 Env knobs: BENCH_N (default 1000000), BENCH_CHAINS (8), BENCH_WARMUP (200),
-BENCH_SAMPLES (200), BENCH_CHEES_CHAINS (32), BENCH_CHEES_WARMUP (400),
+BENCH_SAMPLES (200), BENCH_GROUPED (1 = grouped hierarchical kernel),
+BENCH_CHEES_CHAINS (64 grouped / 32 offset-path), BENCH_CHEES_WARMUP (400),
 BENCH_CHEES_SAMPLES (500), BENCH_DISPATCH, BENCH_MAX_RESTARTS (3),
 BENCH_TIME_BUDGET (seconds; 0 = unlimited).
 """
@@ -397,16 +398,32 @@ def main():
         try_chees == "auto" and (platform != "cpu" or fell_back)
     ):
         try:
-            from stark_tpu.models import FusedHierLogistic
+            from stark_tpu.models import (
+                FusedHierLogistic,
+                FusedHierLogisticGrouped,
+            )
             from stark_tpu.supervise import supervised_sample
 
-            fused = FusedHierLogistic(num_features=d, num_groups=groups)
-            cc = _env_int("BENCH_CHEES_CHAINS", 32)
-            # measured on-chip (N=1M): C=32, warmup 400, samples 500,
-            # MAP-init 500 -> R-hat 1.008, min-ESS 3527, 2.87 ESS/s
-            # (NUTS at a 200+200 budget: 0.05, unconverged).  MAP init is
-            # what makes the metric adapt (random init leaves eps ~0.007
-            # and warmup never recovers).
+            # grouped kernel: group offsets + group gradient fused into the
+            # Pallas pass over group-sorted rows — measured 11.8 -> 2.1 ms
+            # per ensemble gradient (C=32, N=1M, on-chip K=100 amortized);
+            # BENCH_GROUPED=0 falls back to the offset-path kernel
+            grouped = os.environ.get("BENCH_GROUPED", "1") == "1"
+            if grouped:
+                fused = FusedHierLogisticGrouped(
+                    num_features=d, num_groups=groups
+                )
+            else:
+                fused = FusedHierLogistic(num_features=d, num_groups=groups)
+            # C=64 measured 19.2 ESS/s vs 14.8 at C=32 (grouped kernel,
+            # 2026-07-31): the ensemble gradient's X stream is shared, so
+            # doubling chains nearly doubles min-ESS at sublinear wall
+            # cost.  The offset-path escape hatch keeps its measured C=32
+            # configuration so BENCH_GROUPED=0 reproduces the r3 baseline.
+            cc = _env_int("BENCH_CHEES_CHAINS", 64 if grouped else 32)
+            # MAP init is what makes the metric adapt (random init leaves
+            # eps ~0.007 and warmup never recovers); NUTS at a 200+200
+            # budget measured 0.05 ESS/s unconverged vs ChEES converged
             chees_warm = _env_int("BENCH_CHEES_WARMUP", 400)
             chees_samp = _env_int("BENCH_CHEES_SAMPLES", 500)
             # cap the block even without a dispatch bound: one monolithic

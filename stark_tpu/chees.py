@@ -501,7 +501,9 @@ def assemble_chees_posterior(
         acc = acc[cfg.thin - 1 :: cfg.thin]
         div = div[cfg.thin - 1 :: cfg.thin]
     zs = np.swapaxes(zs, 0, 1)  # (chains, draws, d)
-    draws = _constrain_draws(fm, jnp.asarray(zs))
+    # zs stays host-side: _constrain_draws pins the elementwise
+    # constrain to the CPU backend (no tunnel round trip)
+    draws = _constrain_draws(fm, zs)
     log_eps = float(np.asarray(run_carry.log_eps))
     stats = {
         "accept_prob": acc.T,

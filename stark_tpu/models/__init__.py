@@ -12,6 +12,7 @@ from .irt import IRT2PL, synth_irt_data
 from .lmm import FusedLinearMixedModel, LinearMixedModel, synth_lmm_data
 from .logistic import (
     FusedHierLogistic,
+    FusedHierLogisticGrouped,
     FusedLogistic,
     HierLogistic,
     Logistic,
@@ -34,6 +35,7 @@ __all__ = [
     "CoxPH",
     "EightSchools",
     "FusedHierLogistic",
+    "FusedHierLogisticGrouped",
     "FusedLinearMixedModel",
     "FusedLinearRegression",
     "FusedLogistic",

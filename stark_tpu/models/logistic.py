@@ -15,6 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import jax.scipy.stats as jstats
+import numpy as np
 
 from ..bijectors import Exp
 from ..model import Model, ParamSpec
@@ -131,6 +132,74 @@ class FusedHierLogistic(TransposedXMixin, HierLogistic):
         alpha = p["alpha0"] + p["sigma_alpha"] * p["alpha_raw"]
         return logistic_offset_loglik(
             p["beta"], alpha[data["g"]], data["xT"], data["y"]
+        )
+
+
+class FusedHierLogisticGrouped(HierLogistic):
+    """HierLogistic with the fully-fused grouped kernel: rows pre-sorted
+    by group so the group-intercept offsets AND the group gradient live
+    inside the Pallas pass — no (C, N) gather/scatter/stream per
+    evaluation (measured 16x the offset path's gradient cost on one v5e
+    chip at N=1M, C=32; see ops/hier_fused.py).
+
+    Same posterior as HierLogistic/FusedHierLogistic (the log-lik is a
+    row sum — sorting is a permutation).  When the data defeats the
+    dense-window layout (some lane tile spans > _K_LOC_MAX groups),
+    prepare_data falls back to the offset-path layout and log_lik routes
+    accordingly.  Rows are NOT shardable across a data mesh axis: the
+    tile layout is global (first_gid indexes absolute tiles) — use
+    FusedHierLogistic for sharded runs.
+    """
+
+    def prepare_data(self, data):
+        if "gl" in data or "offsets_path" in data:
+            return data  # already prepared (resume path)
+        from ..ops.hier_fused import grouped_layout
+
+        g = np.asarray(data["g"])
+        order = np.argsort(g, kind="stable")
+        g_sorted = g[order]
+        layout = grouped_layout(g_sorted, int(np.asarray(data["x"]).shape[1]))
+        if layout is None:
+            # degenerate grouping (tiny groups scattered wide): keep the
+            # offset-path layout, just transposed
+            out = _transpose_x(data)
+            out["offsets_path"] = jnp.zeros((0,))
+            return out
+        _, k_loc, first_gid, gl = layout
+        x = np.asarray(data["x"])[order]
+        out = {k: jnp.asarray(np.asarray(v)[order])
+               for k, v in data.items() if k != "x"}
+        out["xT"] = jnp.asarray(x.T)
+        out["gl"] = jnp.asarray(gl)
+        out["first_gid"] = jnp.asarray(first_gid)
+        # static window size rides in the SHAPE (never the values)
+        out["k_loc"] = jnp.zeros((k_loc,), jnp.float32)
+        return out
+
+    def data_row_axes(self, data):
+        if "gl" not in data:  # fallback offset layout shards like the base
+            return _row_axes_xt(data)
+        raise NotImplementedError(
+            "FusedHierLogisticGrouped's tile layout is global (first_gid "
+            "indexes absolute lane tiles): rows cannot be re-sharded. "
+            "Use FusedHierLogistic for data-sharded meshes; chain "
+            "parallelism still applies."
+        )
+
+    def log_lik(self, p, data):
+        alpha = p["alpha0"] + p["sigma_alpha"] * p["alpha_raw"]
+        if "gl" not in data:  # fallback layout
+            from ..ops.logistic_fused import logistic_offset_loglik
+
+            return logistic_offset_loglik(
+                p["beta"], alpha[data["g"]], data["xT"], data["y"]
+            )
+        from ..ops.hier_fused import hier_logistic_loglik
+
+        return hier_logistic_loglik(
+            p["beta"], alpha, data["xT"], data["y"], data["gl"],
+            data["first_gid"], data["k_loc"],
         )
 
 

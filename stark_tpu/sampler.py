@@ -445,7 +445,7 @@ def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
 
     zs = np.concatenate(zs_blocks, axis=1)  # (chains, num_samples, d)
     step_size, inv_mass = collect((step_size, inv_mass))
-    draws = _constrain_draws(fm, jnp.asarray(zs))
+    draws = _constrain_draws(fm, zs)
     stats = {
         "accept_prob": np.concatenate(acc_blocks, axis=1),
         "is_divergent": np.concatenate(div_blocks, axis=1),
@@ -520,7 +520,18 @@ class Posterior:
 
 
 def _constrain_draws(fm: FlatModel, zs) -> Dict[str, np.ndarray]:
-    constrained = jax.vmap(jax.vmap(fm.constrain))(zs)
+    # constraining is elementwise over the full draw history — force it
+    # onto the host CPU backend: routing ~100 MB of finished draws
+    # through the accelerator tunnel for an exp() measured ~108 s of the
+    # flagship wall (44%), vs sub-second on host
+    # local_devices, not devices: in a multi-process (jax.distributed)
+    # run, devices()[0] can belong to another process — device_put onto it
+    # fails with an addressability error
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        constrained = jax.jit(jax.vmap(jax.vmap(fm.constrain)))(
+            jax.device_put(np.asarray(zs), cpu)
+        )
     return {k: np.asarray(v) for k, v in constrained.items()}
 
 
