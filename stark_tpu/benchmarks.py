@@ -165,7 +165,7 @@ def bench_consensus_logistic(
 
 
 def bench_lmm(
-    *, n=100_000, d=8, groups=10_000, chains=16, num_warmup=600,
+    *, n=100_000, d=8, groups=10_000, chains=16, num_warmup=700,
     num_samples=500, sampler="chees", max_tree_depth=9, seed=0,
 ):
     """Config 3: hierarchical LMM, random slopes, 10k groups.
@@ -179,13 +179,15 @@ def bench_lmm(
     6 / warmup 300 measured R-hat > 100; depth 9 / warmup 600+
     converges — hence the depth-9 default).
     """
-    from .models import FusedLinearMixedModel
+    from .models import FusedLinearMixedModelGrouped
 
-    # fused gaussian kernel on accelerators: one X pass per value+grad,
-    # ensemble-shared under vmap (posterior parity tested on CPU; the
-    # interpret-mode kernel is slower there, so CPU keeps autodiff)
+    # grouped fused kernel on accelerators: group offsets + u-gradient
+    # inside the one X pass (measured 7.2 -> 1.5 ms/ensemble grad at
+    # C=16, N=100k, G=10k); falls back to the offset layout internally
+    # if the grouping defeats the dense-window trick.  CPU keeps
+    # autodiff (interpret-mode Pallas is slower there).
     on_accel = jax.devices()[0].platform != "cpu"
-    mk = FusedLinearMixedModel if on_accel else LinearMixedModel
+    mk = FusedLinearMixedModelGrouped if on_accel else LinearMixedModel
     model = mk(num_features=d, num_groups=groups, num_random=2)
     data, _ = synth_lmm_data(jax.random.PRNGKey(seed), n, d, groups)
     # d ~ 2*groups+... is large here; bound each device program so a single

@@ -9,7 +9,12 @@ from .glm import (
 )
 from .gmm import GaussianMixture, synth_gmm_data
 from .irt import IRT2PL, synth_irt_data
-from .lmm import FusedLinearMixedModel, LinearMixedModel, synth_lmm_data
+from .lmm import (
+    FusedLinearMixedModel,
+    FusedLinearMixedModelGrouped,
+    LinearMixedModel,
+    synth_lmm_data,
+)
 from .logistic import (
     FusedHierLogistic,
     FusedHierLogisticGrouped,
@@ -37,6 +42,7 @@ __all__ = [
     "FusedHierLogistic",
     "FusedHierLogisticGrouped",
     "FusedLinearMixedModel",
+    "FusedLinearMixedModelGrouped",
     "FusedLinearRegression",
     "FusedLogistic",
     "GaussianMixture",

@@ -80,6 +80,65 @@ class FusedLinearMixedModel(_TransposedXMixin, LinearMixedModel):
         )
 
 
+class FusedLinearMixedModelGrouped(LinearMixedModel):
+    """LMM with the fully-fused grouped kernel (ops/hier_fused.py): rows
+    pre-sorted by group; the random-effect offsets AND the (G, Q)
+    u-gradient live inside the Pallas pass — no (C, N) gather/scatter
+    per evaluation.  At 10k groups over 100k rows the layout shrinks the
+    lane tile until each tile's group window is static and small.
+
+    Same posterior as LinearMixedModel/FusedLinearMixedModel (row sums
+    are permutation-invariant).  Falls back to the offset-path layout
+    when no tile size keeps the window bounded.  Rows are NOT shardable
+    (global tile layout) — use FusedLinearMixedModel on data meshes.
+    """
+
+    def prepare_data(self, data):
+        if "gl" in data or "offsets_path" in data:
+            return data  # already prepared (resume path)
+        from ..ops.hier_fused import prepare_grouped
+
+        d_eff = self.num_features + self.num_random  # x + z slabs share VMEM
+        out = prepare_grouped(data, d_eff, transpose_keys=("x", "z"))
+        if out is None:
+            out = {
+                k: jnp.asarray(v) for k, v in data.items() if k not in ("x",)
+            }
+            out["xT"] = jnp.asarray(data["x"]).T
+            out["offsets_path"] = jnp.zeros((0,))
+        return out
+
+    def data_row_axes(self, data):
+        if "gl" not in data:
+            from .logistic import _row_axes_xt
+
+            return _row_axes_xt(data)
+        raise NotImplementedError(
+            "FusedLinearMixedModelGrouped's tile layout is global: rows "
+            "cannot be re-sharded. Use FusedLinearMixedModel for "
+            "data-sharded meshes; chain parallelism still applies."
+        )
+
+    def log_lik(self, p, data):
+        u = p["u_raw"] * p["tau"][None, :]  # (G, Q) non-centered
+        if "gl" not in data:  # fallback: offset path
+            from ..ops.logistic_fused import gaussian_offset_loglik
+
+            offsets = p["intercept"] + jnp.sum(
+                data["z"] * u[data["g"]], axis=-1
+            )
+            return gaussian_offset_loglik(
+                p["beta"], offsets, data["xT"], data["y"], p["sigma"]
+            )
+        from ..ops.hier_fused import lmm_grouped_loglik
+
+        return lmm_grouped_loglik(
+            p["beta"], u, p["intercept"], p["sigma"], data["xT"],
+            data["zT"], data["y"], data["gl"], data["first_gid"],
+            data["k_loc"], data["lt128"],
+        )
+
+
 def synth_lmm_data(
     key, n, num_features, num_groups, *, num_random=2, noise=0.5,
     dtype=jnp.float32,

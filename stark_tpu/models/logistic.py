@@ -154,27 +154,14 @@ class FusedHierLogisticGrouped(HierLogistic):
     def prepare_data(self, data):
         if "gl" in data or "offsets_path" in data:
             return data  # already prepared (resume path)
-        from ..ops.hier_fused import grouped_layout
+        from ..ops.hier_fused import prepare_grouped
 
-        g = np.asarray(data["g"])
-        order = np.argsort(g, kind="stable")
-        g_sorted = g[order]
-        layout = grouped_layout(g_sorted, int(np.asarray(data["x"]).shape[1]))
-        if layout is None:
+        out = prepare_grouped(data, int(np.asarray(data["x"]).shape[1]))
+        if out is None:
             # degenerate grouping (tiny groups scattered wide): keep the
             # offset-path layout, just transposed
             out = _transpose_x(data)
             out["offsets_path"] = jnp.zeros((0,))
-            return out
-        _, k_loc, first_gid, gl = layout
-        x = np.asarray(data["x"])[order]
-        out = {k: jnp.asarray(np.asarray(v)[order])
-               for k, v in data.items() if k != "x"}
-        out["xT"] = jnp.asarray(x.T)
-        out["gl"] = jnp.asarray(gl)
-        out["first_gid"] = jnp.asarray(first_gid)
-        # static window size rides in the SHAPE (never the values)
-        out["k_loc"] = jnp.zeros((k_loc,), jnp.float32)
         return out
 
     def data_row_axes(self, data):
@@ -199,7 +186,7 @@ class FusedHierLogisticGrouped(HierLogistic):
 
         return hier_logistic_loglik(
             p["beta"], alpha, data["xT"], data["y"], data["gl"],
-            data["first_gid"], data["k_loc"],
+            data["first_gid"], data["k_loc"], data["lt128"],
         )
 
 

@@ -51,8 +51,7 @@ _K_LOC_MAX = 128
 
 
 def grouped_lane_tile(d: int) -> int:
-    """Deterministic lane tile for the grouped kernel — prepare_data and
-    the kernel call must agree on it, so it depends only on D."""
+    """Default (largest) lane tile for the grouped kernel."""
     return _default_lane_tile(d + 2)
 
 
@@ -60,23 +59,68 @@ def grouped_layout(g_sorted: np.ndarray, d: int):
     """Host-side layout from SORTED group ids.
 
     Returns (lane_tile, k_loc, first_gid (grid,) int32, gl (N,) int32)
-    or None when some tile spans more than _K_LOC_MAX groups (many tiny
-    groups — the dense-window trick stops paying; use the offset path).
+    or None when no tile size keeps the group window within _K_LOC_MAX.
+    Dense groupings (few rows per group, e.g. the LMM's 10k groups over
+    100k rows) get a SMALLER lane tile so each tile still spans few
+    groups — the one-hot stays cheap and the window static.  The chosen
+    lane_tile rides back to the kernel call in the data layout (shape-
+    encoded), so prepare and call cannot disagree.
     """
     g_sorted = np.asarray(g_sorted)
     if g_sorted.ndim != 1 or np.any(np.diff(g_sorted) < 0):
         raise ValueError("grouped_layout requires sorted 1-D group ids")
     n = g_sorted.shape[0]
     lane_tile = grouped_lane_tile(d)
-    first_gid = g_sorted[::lane_tile].astype(np.int32)  # (grid,)
-    grid = first_gid.shape[0]
-    last = g_sorted[np.minimum(np.arange(1, grid + 1) * lane_tile - 1, n - 1)]
-    span = int(np.max(last - first_gid)) + 1
-    k_loc = -(-span // 8) * 8  # sublane-pad
-    if k_loc > _K_LOC_MAX:
+    while lane_tile >= 256:
+        # the tile MUST stay a multiple of 128: it is shape-encoded as
+        # lane_tile // 128 dummies, so any remainder would silently
+        # reconstruct a different tile than the layout was built for
+        assert lane_tile % 128 == 0, lane_tile
+        first_gid = g_sorted[::lane_tile].astype(np.int32)  # (grid,)
+        grid = first_gid.shape[0]
+        last = g_sorted[
+            np.minimum(np.arange(1, grid + 1) * lane_tile - 1, n - 1)
+        ]
+        span = int(np.max(last - first_gid)) + 1
+        k_loc = -(-span // 8) * 8  # sublane-pad
+        if k_loc <= _K_LOC_MAX:
+            gl = (
+                g_sorted - np.repeat(first_gid, lane_tile)[:n]
+            ).astype(np.int32)
+            return lane_tile, k_loc, first_gid, gl
+        lane_tile = (lane_tile // 2) // 128 * 128
+    return None
+
+
+def prepare_grouped(data, d_eff, transpose_keys=("x",)):
+    """Shared grouped-layout packing for the Grouped models.
+
+    Sorts every leaf by data['g'] (stable), transposes the design
+    matrices named in ``transpose_keys`` to lane-major ``<k>T`` layout,
+    and packs the layout as gl/first_gid plus the SHAPE-encoded
+    k_loc/lt128 dummies — one copy of the encoding convention.  Returns
+    None when `grouped_layout` finds no workable tile (caller falls back
+    to the offset-path layout).
+    """
+    g = np.asarray(data["g"])
+    order = np.argsort(g, kind="stable")
+    layout = grouped_layout(g[order], d_eff)
+    if layout is None:
         return None
-    gl = (g_sorted - np.repeat(first_gid, lane_tile)[:n]).astype(np.int32)
-    return lane_tile, k_loc, first_gid, gl
+    lane_tile, k_loc, first_gid, gl = layout
+    out = {
+        k: jnp.asarray(np.asarray(v)[order])
+        for k, v in data.items()
+        if k not in transpose_keys
+    }
+    for k in transpose_keys:
+        out[k + "T"] = jnp.asarray(np.asarray(data[k])[order].T)
+    out["gl"] = jnp.asarray(gl)
+    out["first_gid"] = jnp.asarray(first_gid)
+    # static window size and lane tile ride in SHAPES (never values)
+    out["k_loc"] = jnp.zeros((k_loc,), jnp.float32)
+    out["lt128"] = jnp.zeros((lane_tile // 128,), jnp.float32)
+    return out
 
 
 def _make_grouped_kernel(n, lane_tile, k_loc, link):
@@ -116,8 +160,8 @@ def _make_grouped_kernel(n, lane_tile, k_loc, link):
     return kernel
 
 
-def _grouped_call(beta, alpha, xt, y, gl, first_gid, *, k_loc, interpret,
-                  link="bernoulli_logit"):
+def _grouped_call(beta, alpha, xt, y, gl, first_gid, *, k_loc, lane_tile,
+                  interpret, link="bernoulli_logit"):
     """Chain-batched fused hierarchical pass.
 
     beta: (C, D), alpha: (C, G) -> (val (C,), gbeta (C, D),
@@ -128,7 +172,6 @@ def _grouped_call(beta, alpha, xt, y, gl, first_gid, *, k_loc, interpret,
     c, d = beta.shape
     g_total = alpha.shape[1]
     n = xt.shape[1]
-    lane_tile = grouped_lane_tile(d)
     grid = -(-n // lane_tile)
     cpad = -(-c // 8) * 8
     if cpad != c:
@@ -193,22 +236,24 @@ def _bcast(x, batched, axis_size):
 
 
 @functools.partial(jax.custom_batching.custom_vmap)
-def _vg_grouped(beta, alpha, xt, y, gl, first_gid, k_loc_arr):
-    # k_loc rides as a (k_loc,)-shaped dummy so it stays static via shape
+def _vg_grouped(beta, alpha, xt, y, gl, first_gid, k_loc_arr, lt_arr):
+    # k_loc and lane_tile ride as shape-encoded dummies so they stay
+    # static through jit/vmap (lane_tile = 128 * lt_arr.shape[0])
     val, gbeta, galpha = _grouped_call(
         beta[None], alpha[None], xt, y, gl, first_gid,
-        k_loc=k_loc_arr.shape[0], interpret=None,
+        k_loc=k_loc_arr.shape[0], lane_tile=128 * lt_arr.shape[0],
+        interpret=None,
     )
     return val[0], gbeta[0], galpha[0]
 
 
 @_vg_grouped.def_vmap
 def _vg_grouped_vmap(axis_size, in_batched, beta, alpha, xt, y, gl,
-                     first_gid, k_loc_arr):
-    beta_b, alpha_b, xt_b, y_b, gl_b, fg_b, _ = in_batched
+                     first_gid, k_loc_arr, lt_arr):
+    beta_b, alpha_b, xt_b, y_b, gl_b, fg_b, _, _ = in_batched
     if xt_b or y_b or gl_b or fg_b:
         out = jax.lax.map(
-            lambda a: _vg_grouped(*a, k_loc_arr),
+            lambda a: _vg_grouped(*a, k_loc_arr, lt_arr),
             tuple(
                 _bcast(v, b, axis_size)
                 for v, b in zip(
@@ -223,14 +268,14 @@ def _vg_grouped_vmap(axis_size, in_batched, beta, alpha, xt, y, gl,
     return (
         _grouped_call(
             beta, alpha, xt, y, gl, first_gid, k_loc=k_loc_arr.shape[0],
-            interpret=None,
+            lane_tile=128 * lt_arr.shape[0], interpret=None,
         ),
         (True, True, True),
     )
 
 
 @jax.custom_vjp
-def hier_logistic_loglik(beta, alpha, xt, y, gl, first_gid, k_loc_arr):
+def hier_logistic_loglik(beta, alpha, xt, y, gl, first_gid, k_loc_arr, lt_arr):
     """Differentiable fused hierarchical Bernoulli-logit log-lik.
 
     One Pallas pass over group-sorted data yields the value, ∂/∂beta and
@@ -240,20 +285,240 @@ def hier_logistic_loglik(beta, alpha, xt, y, gl, first_gid, k_loc_arr):
     in its shape (all three produced by `grouped_layout`).  Under vmap
     over chains the ensemble shares ONE X pass.
     """
-    val, _, _ = _vg_grouped(beta, alpha, xt, y, gl, first_gid, k_loc_arr)
+    val, _, _ = _vg_grouped(
+        beta, alpha, xt, y, gl, first_gid, k_loc_arr, lt_arr
+    )
     return val
 
 
-def _hier_fwd(beta, alpha, xt, y, gl, first_gid, k_loc_arr):
+def _hier_fwd(beta, alpha, xt, y, gl, first_gid, k_loc_arr, lt_arr):
     val, gbeta, galpha = _vg_grouped(
-        beta, alpha, xt, y, gl, first_gid, k_loc_arr
+        beta, alpha, xt, y, gl, first_gid, k_loc_arr, lt_arr
     )
     return val, (gbeta, galpha)
 
 
 def _hier_bwd(res, ct):
     gbeta, galpha = res
-    return ct * gbeta, ct * galpha, None, None, None, None, None
+    return ct * gbeta, ct * galpha, None, None, None, None, None, None
 
 
 hier_logistic_loglik.defvjp(_hier_fwd, _hier_bwd)
+
+
+# --- grouped LMM: gaussian link, Q random effects per group -------------
+# Same dense-window trick for benchmark config 3 (random intercept +
+# slopes, 10k groups over 100k rows — ~10 rows/group, so grouped_layout
+# shrinks the lane tile until each tile's window fits).  The kernel
+# computes mu = intercept + X·beta + Σ_q z_q ⊙ (u_q-window @ onehot)
+# entirely in-register and emits SSR, Σresid, X·resid and the per-tile
+# windowed u-gradient partials; sigma stays outside (scale-free kernel,
+# like ops/logistic_fused.py's gaussian link).
+
+
+def _make_grouped_lmm_kernel(n, lane_tile, k_loc, q):
+    def kernel(xt_ref, zt_ref, y_ref, gl_ref, beta_ref, ic_ref, u_ref,
+               acc_ref, gbeta_ref, gu_ref):
+        lane0 = pl.program_id(0) * lane_tile
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, lane_tile), 1)
+        mask = lane0 + iota < n
+        xt = jnp.where(mask, xt_ref[...], 0.0)  # (D, TILE)
+        zt = jnp.where(mask, zt_ref[...], 0.0)  # (Q, TILE)
+        y = jnp.where(mask, y_ref[...], 0.0)  # (1, TILE)
+        gl = jnp.where(mask, gl_ref[...], 0)  # (1, TILE)
+        beta = beta_ref[...]  # (C, D)
+        ic = ic_ref[...]  # (C, 1)
+        u = u_ref[0]  # (C, Q*K_LOC) — per-q windows side by side
+        krows = jax.lax.broadcasted_iota(jnp.int32, (k_loc, lane_tile), 0)
+        onehot = jnp.where(krows == gl, 1.0, 0.0)  # (K_LOC, TILE)
+        mu = ic + jax.lax.dot(
+            beta, xt, precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )  # (C, TILE)
+        for j in range(q):  # static unroll: Q is 2-3
+            uq = u[:, j * k_loc : (j + 1) * k_loc]  # (C, K_LOC)
+            mu = mu + jax.lax.dot(
+                uq, onehot, precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            ) * zt[j : j + 1, :]
+        resid = jnp.where(mask, y - mu, 0.0)  # (C, TILE)
+        ssr = jnp.sum(resid * resid, axis=1)  # (C,)
+        sresid = jnp.sum(resid, axis=1)  # (C,) — the intercept gradient
+        acc_ref[...] = jnp.stack([ssr, sresid], axis=-1)[None]  # (1, C, 2)
+        gbeta_ref[...] = jax.lax.dot(
+            resid, xt.T, precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )[None]
+        parts = [
+            jax.lax.dot(
+                resid * zt[j : j + 1, :], onehot.T,
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )
+            for j in range(q)
+        ]
+        gu_ref[...] = jnp.concatenate(parts, axis=-1)[None]  # (1, C, Q*K_LOC)
+
+    return kernel
+
+
+def _grouped_lmm_call(beta, u, intercept, xt, zt, y, gl, first_gid, *,
+                      k_loc, lane_tile, interpret):
+    """beta (C, D), u (C, G, Q), intercept (C,) ->
+    (ssr (C,), sum_resid (C,), gbeta (C, D), gu (C, G, Q))."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    c, d = beta.shape
+    g_total, q = u.shape[1], u.shape[2]
+    n = xt.shape[1]
+    grid = -(-n // lane_tile)
+    cpad = -(-c // 8) * 8
+    if cpad != c:
+        beta = jnp.pad(beta, ((0, cpad - c), (0, 0)))
+        u = jnp.pad(u, ((0, cpad - c), (0, 0), (0, 0)))
+        intercept = jnp.pad(intercept, (0, cpad - c))
+    u_pad = jnp.pad(u.astype(jnp.float32), ((0, 0), (0, k_loc), (0, 0)))
+    win = first_gid[:, None] + jnp.arange(k_loc)[None, :]  # (grid, K_LOC)
+    # (C, grid, K_LOC, Q) -> (grid, C, Q*K_LOC): q-windows side by side
+    u_tiles = jnp.moveaxis(u_pad[:, win, :], 0, 1)
+    u_tiles = u_tiles.transpose(0, 1, 3, 2).reshape(grid, cpad, q * k_loc)
+
+    def lane_spec(height=1):
+        return pl.BlockSpec((height, lane_tile), lambda i: (0, i))
+
+    args = [
+        xt.astype(jnp.float32),
+        zt.astype(jnp.float32),
+        y.astype(jnp.float32)[None, :],
+        gl.astype(jnp.int32)[None, :],
+        beta.astype(jnp.float32),
+        intercept.astype(jnp.float32)[:, None],
+        u_tiles,
+    ]
+    in_specs = [
+        lane_spec(d),
+        lane_spec(q),
+        lane_spec(),
+        lane_spec(),
+        pl.BlockSpec((cpad, d), lambda i: (0, 0)),
+        pl.BlockSpec((cpad, 1), lambda i: (0, 0)),
+        pl.BlockSpec((1, cpad, q * k_loc), lambda i: (i, 0, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, cpad, 2), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, cpad, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, cpad, q * k_loc), lambda i: (i, 0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((grid, cpad, 2), jnp.float32),
+        jax.ShapeDtypeStruct((grid, cpad, d), jnp.float32),
+        jax.ShapeDtypeStruct((grid, cpad, q * k_loc), jnp.float32),
+    ]
+    out = pl.pallas_call(
+        _make_grouped_lmm_kernel(n, lane_tile, k_loc, q),
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*args)
+    acc = jnp.sum(out[0], axis=0)  # (cpad, 2)
+    ssr, sresid = acc[:c, 0], acc[:c, 1]
+    gbeta = jnp.sum(out[1], axis=0)[:c]
+    parts = out[2].reshape(grid, cpad, q, k_loc)
+    gu = jnp.stack(
+        [
+            jnp.zeros((cpad, g_total + k_loc), jnp.float32)
+            .at[:, win.reshape(-1)]
+            .add(parts[:, :, j, :].transpose(1, 0, 2).reshape(cpad, -1))[
+                :c, :g_total
+            ]
+            for j in range(q)
+        ],
+        axis=-1,
+    )  # (C, G, Q)
+    return ssr, sresid, gbeta, gu
+
+
+_LOG_2PI = 1.8378770664093453
+
+
+@functools.partial(jax.custom_batching.custom_vmap)
+def _vg_lmm(beta, u, intercept, xt, zt, y, gl, first_gid, k_loc_arr, lt_arr):
+    ssr, sresid, gbeta, gu = _grouped_lmm_call(
+        beta[None], u[None], intercept[None], xt, zt, y, gl, first_gid,
+        k_loc=k_loc_arr.shape[0], lane_tile=128 * lt_arr.shape[0],
+        interpret=None,
+    )
+    return ssr[0], sresid[0], gbeta[0], gu[0]
+
+
+@_vg_lmm.def_vmap
+def _vg_lmm_vmap(axis_size, in_batched, beta, u, intercept, xt, zt, y, gl,
+                 first_gid, k_loc_arr, lt_arr):
+    beta_b, u_b, ic_b, xt_b, zt_b, y_b, gl_b, fg_b, _, _ = in_batched
+    if xt_b or zt_b or y_b or gl_b or fg_b:
+        out = jax.lax.map(
+            lambda a: _vg_lmm(*a, k_loc_arr, lt_arr),
+            tuple(
+                _bcast(v, b, axis_size)
+                for v, b in zip(
+                    (beta, u, intercept, xt, zt, y, gl, first_gid),
+                    (beta_b, u_b, ic_b, xt_b, zt_b, y_b, gl_b, fg_b),
+                )
+            ),
+        )
+        return out, (True, True, True, True)
+    beta = _bcast(beta, beta_b, axis_size)
+    u = _bcast(u, u_b, axis_size)
+    intercept = _bcast(intercept, ic_b, axis_size)
+    return (
+        _grouped_lmm_call(
+            beta, u, intercept, xt, zt, y, gl, first_gid,
+            k_loc=k_loc_arr.shape[0], lane_tile=128 * lt_arr.shape[0],
+            interpret=None,
+        ),
+        (True, True, True, True),
+    )
+
+
+@jax.custom_vjp
+def lmm_grouped_loglik(beta, u, intercept, sigma, xt, zt, y, gl, first_gid,
+                       k_loc_arr, lt_arr):
+    """Differentiable fused LMM normal log-lik over group-sorted rows.
+
+    mu = intercept + X·beta + Σ_q z_q ⊙ u[g, q]; one Pallas pass yields
+    the SSR, Σresid, ∂/∂beta and the windowed ∂/∂u — no (C, N)
+    intermediate.  sigma applies outside (scale-free kernel).  Layout
+    args (gl, first_gid, k_loc_arr, lt_arr) come from `grouped_layout`.
+    """
+    ssr, _, _, _ = _vg_lmm(
+        beta, u, intercept, xt, zt, y, gl, first_gid, k_loc_arr, lt_arr
+    )
+    n = y.shape[-1]
+    return -0.5 * ssr / sigma**2 - n * jnp.log(sigma) - 0.5 * n * _LOG_2PI
+
+
+def _lmm_fwd(beta, u, intercept, sigma, xt, zt, y, gl, first_gid,
+             k_loc_arr, lt_arr):
+    ssr, sresid, gbeta, gu = _vg_lmm(
+        beta, u, intercept, xt, zt, y, gl, first_gid, k_loc_arr, lt_arr
+    )
+    n = y.shape[-1]
+    val = -0.5 * ssr / sigma**2 - n * jnp.log(sigma) - 0.5 * n * _LOG_2PI
+    return val, (ssr, sresid, gbeta, gu, sigma, y.shape[-1])
+
+
+def _lmm_bwd(res, ct):
+    ssr, sresid, gbeta, gu, sigma, n = res
+    inv2 = 1.0 / (sigma * sigma)
+    return (
+        ct * inv2 * gbeta,
+        ct * inv2 * gu,
+        ct * inv2 * sresid,
+        ct * (ssr * inv2 / sigma - n / sigma),
+        None, None, None, None, None, None, None,
+    )
+
+
+lmm_grouped_loglik.defvjp(_lmm_fwd, _lmm_bwd)

@@ -48,6 +48,25 @@ def test_grouped_layout_invariants():
         grouped_layout(g[::-1], d=8)  # unsorted
 
 
+def test_grouped_layout_halving_stays_128_aligned():
+    """d=63 starts at lane_tile 8064 (63*128); a dense grouping forces
+    halving, and naive /2 would give 4032 -> non-128-multiple encodings
+    that reconstruct the WRONG tile from lt128 (silent corruption)."""
+    rows_per_group = 50
+    n = 40_000
+    g = np.sort(np.arange(n) // rows_per_group)
+    out = grouped_layout(g, d=63)
+    assert out is not None
+    lane_tile, k_loc, first_gid, gl = out
+    assert lane_tile % 128 == 0
+    assert lane_tile * first_gid.shape[0] >= n
+    # shape-encoding round trip is exact
+    assert 128 * (lane_tile // 128) == lane_tile
+    rec = first_gid[np.arange(n) // lane_tile] + gl
+    np.testing.assert_array_equal(rec, g)
+    assert gl.max() < k_loc
+
+
 def test_grouped_matches_autodiff_value_and_grads():
     ref, rdata, grp, gdata = _models()
     params = {
@@ -105,6 +124,68 @@ def test_grouped_same_posterior_as_offset_path():
         outs[name] = post.summary()["beta"]["mean"]
     np.testing.assert_allclose(
         np.asarray(outs["offset"]), np.asarray(outs["grouped"]), atol=0.05
+    )
+
+
+def test_lmm_grouped_matches_autodiff():
+    """Grouped LMM kernel vs the plain autodiff LinearMixedModel on the
+    same sorted rows — value and every parameter gradient, including the
+    dense-grouping regime (few rows per group -> shrunken lane tile)."""
+    from stark_tpu.models import (
+        FusedLinearMixedModelGrouped,
+        LinearMixedModel,
+        synth_lmm_data,
+    )
+
+    n, d, groups, q = 12_288 + 55, 5, 1500, 2  # ~8 rows/group: dense
+    data, _ = synth_lmm_data(jax.random.PRNGKey(3), n, d, groups)
+    ref = LinearMixedModel(num_features=d, num_groups=groups)
+    grp = FusedLinearMixedModelGrouped(num_features=d, num_groups=groups)
+    gdata = prepare_model_data(grp, data)
+    assert "gl" in gdata, "layout unexpectedly fell back"
+    # dense grouping must have shrunk the tile below the default
+    from stark_tpu.ops.hier_fused import grouped_lane_tile
+
+    assert gdata["lt128"].shape[0] * 128 < grouped_lane_tile(d + q)
+    order = np.argsort(np.asarray(data["g"]), kind="stable")
+    rdata = {k: jnp.asarray(np.asarray(v)[order]) for k, v in data.items()}
+
+    params = {
+        "intercept": jnp.float32(0.8),
+        "beta": 0.2 * jnp.arange(d, dtype=jnp.float32),
+        "u_raw": 0.01 * jax.random.normal(jax.random.PRNGKey(5), (groups, q)),
+        "tau": jnp.asarray([0.7, 0.4]),
+        "sigma": jnp.float32(0.6),
+    }
+    v_ref = ref.log_lik(params, rdata)
+    v_grp = grp.log_lik(params, gdata)
+    np.testing.assert_allclose(v_ref, v_grp, rtol=2e-5)
+    g_ref = jax.grad(lambda p: ref.log_lik(p, rdata))(params)
+    g_grp = jax.grad(lambda p: grp.log_lik(p, gdata))(params)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(g_ref[k]), np.asarray(g_grp[k]), rtol=3e-4,
+            atol=3e-4, err_msg=k,
+        )
+
+
+def test_lmm_grouped_chain_batched_matches_per_chain():
+    from stark_tpu.models import FusedLinearMixedModelGrouped, synth_lmm_data
+
+    n, d, groups = 8192, 4, 800
+    data, _ = synth_lmm_data(jax.random.PRNGKey(6), n, d, groups)
+    grp = FusedLinearMixedModelGrouped(num_features=d, num_groups=groups)
+    gdata = prepare_model_data(grp, data)
+    fm = flatten_model(grp)
+    pot = fm.bind(gdata)
+    zs = 0.05 * jax.random.normal(jax.random.PRNGKey(7), (4, fm.ndim))
+    vg = jax.value_and_grad(pot)
+    v_b, g_b = jax.vmap(vg)(zs)
+    v_s = jnp.stack([vg(z)[0] for z in zs])
+    g_s = jnp.stack([vg(z)[1] for z in zs])
+    np.testing.assert_allclose(np.asarray(v_b), np.asarray(v_s), rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(g_b), np.asarray(g_s), rtol=3e-4, atol=3e-4
     )
 
 
