@@ -101,10 +101,9 @@ class FusedLinearMixedModelGrouped(LinearMixedModel):
         d_eff = self.num_features + self.num_random  # x + z slabs share VMEM
         out = prepare_grouped(data, d_eff, transpose_keys=("x", "z"))
         if out is None:
-            out = {
-                k: jnp.asarray(v) for k, v in data.items() if k not in ("x",)
-            }
-            out["xT"] = jnp.asarray(data["x"]).T
+            from .logistic import _transpose_x
+
+            out = _transpose_x(data)
             out["offsets_path"] = jnp.zeros((0,))
         return out
 

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from .logistic_fused import _default_lane_tile, _link_parts
+from .logistic_fused import _LOG_2PI, _default_lane_tile, _link_parts
 
 # Hard cap on the padded groups-per-tile: above this the one-hot slab and
 # the MXU extra work stop being negligible next to the X stream, and the
@@ -71,6 +71,11 @@ def grouped_layout(g_sorted: np.ndarray, d: int):
         raise ValueError("grouped_layout requires sorted 1-D group ids")
     n = g_sorted.shape[0]
     lane_tile = grouped_lane_tile(d)
+    # Floor at 256 ON PURPOSE: at tile 128 the window can never exceed
+    # _K_LOC_MAX (span <= rows-per-tile), so every grouping would
+    # "succeed" — including one-row-per-group degenerates where the
+    # per-tile fixed cost over N/128 tiles cancels the fused win.  Below
+    # 256 the offset path is the better kernel, so fall back to it.
     while lane_tile >= 256:
         # the tile MUST stay a multiple of 128: it is shape-encoded as
         # lane_tile // 128 dummies, so any remainder would silently
@@ -121,6 +126,24 @@ def prepare_grouped(data, d_eff, transpose_keys=("x",)):
     out["k_loc"] = jnp.zeros((k_loc,), jnp.float32)
     out["lt128"] = jnp.zeros((lane_tile // 128,), jnp.float32)
     return out
+
+
+def _check_chain_vmem(cpad, lane_tile, interpret):
+    """The kernel holds ~3 (C, TILE) f32 intermediates (logits, resid,
+    value terms) in scoped VMEM; past ~16 MB Mosaic refuses to compile
+    (measured: C=128 at TILE=8192 asked for 20 MB).  Fail with an
+    actionable message instead of the compiler OOM."""
+    if interpret:
+        return
+    budget = 10 * 1024 * 1024  # conservative: the OOM had >3 live (C,TILE)s
+    if 3 * cpad * lane_tile * 4 > budget:
+        raise ValueError(
+            f"chain batch C={cpad} at lane_tile={lane_tile} needs more "
+            f"scoped VMEM than the TPU core has (~16MB); use <= "
+            f"{budget // (3 * 4 * lane_tile) // 8 * 8} chains "
+            f"here, or the offset-path Fused model which tiles chains "
+            f"independently"
+        )
 
 
 def _make_grouped_kernel(n, lane_tile, k_loc, link):
@@ -174,6 +197,7 @@ def _grouped_call(beta, alpha, xt, y, gl, first_gid, *, k_loc, lane_tile,
     n = xt.shape[1]
     grid = -(-n // lane_tile)
     cpad = -(-c // 8) * 8
+    _check_chain_vmem(cpad, lane_tile, interpret)
     if cpad != c:
         beta = jnp.pad(beta, ((0, cpad - c), (0, 0)))
         alpha = jnp.pad(alpha, ((0, cpad - c), (0, 0)))
@@ -373,6 +397,7 @@ def _grouped_lmm_call(beta, u, intercept, xt, zt, y, gl, first_gid, *,
     n = xt.shape[1]
     grid = -(-n // lane_tile)
     cpad = -(-c // 8) * 8
+    _check_chain_vmem(cpad, lane_tile, interpret)
     if cpad != c:
         beta = jnp.pad(beta, ((0, cpad - c), (0, 0)))
         u = jnp.pad(u, ((0, cpad - c), (0, 0), (0, 0)))
@@ -438,9 +463,6 @@ def _grouped_lmm_call(beta, u, intercept, xt, zt, y, gl, first_gid, *,
         axis=-1,
     )  # (C, G, Q)
     return ssr, sresid, gbeta, gu
-
-
-_LOG_2PI = 1.8378770664093453
 
 
 @functools.partial(jax.custom_batching.custom_vmap)

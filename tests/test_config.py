@@ -154,3 +154,15 @@ def test_configs_match_benchmark_defaults():
     )
     if con.sampler["kernel"] == "chees":
         assert con.sampler.get("map_init_steps", 0) > 0
+
+    gmm = load_config(os.path.join(root, "gmm_tempered.yaml"))
+    assert gmm.sampler["entry"] == "tempered"
+    assert gmm.sampler["num_warmup"] == default(
+        benchmarks.bench_gmm_tempered, "num_warmup"
+    )
+    assert gmm.sampler["num_temps"] == default(
+        benchmarks.bench_gmm_tempered, "num_temps"
+    )
+    # the ladder must be the ΔE-matched adaptive one — a fixed geometric
+    # ladder is measured-dead at this N (no swaps; VERDICT r2 weak #5)
+    assert gmm.sampler.get("adapt_ladder", False) is True

@@ -127,6 +127,18 @@ def test_grouped_same_posterior_as_offset_path():
     )
 
 
+def test_chain_vmem_guard():
+    """C=128 at TILE=8192 measured a 20 MB scoped-VMEM Mosaic OOM on
+    chip; the guard must turn that into an actionable error (and stay
+    quiet in interpret mode and at the measured-good C=64)."""
+    from stark_tpu.ops.hier_fused import _check_chain_vmem
+
+    _check_chain_vmem(64, 8192, False)  # the flagship config: fine
+    _check_chain_vmem(128, 8192, True)  # interpreter: no VMEM, no guard
+    with pytest.raises(ValueError, match="chains"):
+        _check_chain_vmem(128, 8192, False)
+
+
 def test_lmm_grouped_matches_autodiff():
     """Grouped LMM kernel vs the plain autodiff LinearMixedModel on the
     same sorted rows — value and every parameter gradient, including the
