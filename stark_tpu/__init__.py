@@ -34,7 +34,7 @@ if not _explicit_skip and (
     _jax.config.update("jax_default_matmul_precision", _prec or "highest")
 del _prec, _explicit_skip
 
-from . import bijectors, diagnostics
+from . import bijectors, compare, diagnostics
 from .model import Model, ParamSpec, flatten_model, prepare_model_data
 from .chees import chees_sample
 from .runner import sample_until_converged

@@ -180,19 +180,98 @@ class ChainSuffStats:
         )
 
 
+def rank_normalize(x: np.ndarray) -> np.ndarray:
+    """Pooled fractional ranks -> normal scores (Vehtari et al. 2021 eq. 14).
+
+    (chains, draws, *event) -> same shape; ranks pool over chains*draws
+    per scalar component with average tie-handling, then map through the
+    normal quantile function with the (r - 3/8)/(S + 1/4) continuity
+    correction.  Makes every rank-based diagnostic invariant to monotone
+    transforms and robust to heavy tails.  Components are processed in
+    column chunks bounded by the same workspace budget as ``ess`` — the
+    ranking scratch would otherwise hold several full float64 copies of
+    a d≈20k flagship draw matrix at once.
+    """
+    from scipy.special import ndtri
+    from scipy.stats import rankdata
+
+    x = np.asarray(x, np.float64)
+    c, n = x.shape[0], x.shape[1]
+    flat = x.reshape(c * n, -1)
+    rows = flat.shape[0]
+    cols_per_chunk = max(1, int(_ESS_WORKSPACE_BYTES) // (8 * 4 * max(rows, 1)))
+    z = np.empty_like(flat)
+    for j0 in range(0, flat.shape[1], cols_per_chunk):
+        sl = slice(j0, j0 + cols_per_chunk)
+        r = rankdata(flat[:, sl], method="average", axis=0)
+        z[:, sl] = ndtri((r - 0.375) / (c * n + 0.25))
+    return z.reshape(x.shape)
+
+
+def rank_rhat(x) -> np.ndarray:
+    """Rank-normalized split-R-hat, the max of the bulk and tail (folded)
+    forms — Stan's modern default.  Catches both location disagreements
+    (bulk) and scale/tail disagreements (folded) that classic split-R-hat
+    on heavy-tailed draws can miss.  (chains, draws, *event) -> (*event,).
+    """
+    x = np.asarray(x, np.float64)
+    bulk = split_rhat(rank_normalize(x))
+    med = np.median(x.reshape(-1, *x.shape[2:]), axis=0)
+    folded = split_rhat(rank_normalize(np.abs(x - med)))
+    return np.maximum(bulk, folded)
+
+
+def ess_bulk(x) -> np.ndarray:
+    """Bulk ESS: Geyer ESS of the rank-normalized draws."""
+    return ess(rank_normalize(x))
+
+
+def ess_tail(x, prob: float = 0.05) -> np.ndarray:
+    """Tail ESS: min ESS of the two tail-indicator chains (I[x<=q05],
+    I[x>=q95]) — the reliability of reported tail quantiles, which bulk
+    ESS says nothing about."""
+    x = np.asarray(x, np.float64)
+    flat = x.reshape(-1, *x.shape[2:])
+    qlo = np.quantile(flat, prob, axis=0)
+    qhi = np.quantile(flat, 1.0 - prob, axis=0)
+    lo = ess((x <= qlo).astype(np.float64))
+    hi = ess((x >= qhi).astype(np.float64))
+    return np.minimum(lo, hi)
+
+
+def mcse_mean(x) -> np.ndarray:
+    """Monte-Carlo standard error of the posterior mean: sd/sqrt(ESS)."""
+    x = np.asarray(x, np.float64)
+    flat = x.reshape(-1, *x.shape[2:])
+    e = ess(x)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return flat.std(axis=0, ddof=1) / np.sqrt(e)
+
+
 def summarize(draws: Dict[str, np.ndarray]) -> Dict[str, Dict[str, np.ndarray]]:
-    """Per-parameter posterior summary: mean, sd, 5%/50%/95%, rhat, ess."""
+    """Per-parameter posterior summary: mean, sd, mcse, 5%/50%/95%,
+    classic + rank-normalized R-hat, classic/bulk/tail ESS ("ess" is the
+    classic Geyer estimator on the raw draws; "ess_bulk" the Stan-style
+    rank-normalized form)."""
     out = {}
     for name, x in draws.items():
         x = np.asarray(x)
         flat = x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+        sd = flat.std(axis=0, ddof=1)
+        e = ess(x)  # computed ONCE; mcse derives from it
+        with np.errstate(divide="ignore", invalid="ignore"):
+            mcse = sd / np.sqrt(e)
         out[name] = {
             "mean": flat.mean(axis=0),
-            "sd": flat.std(axis=0, ddof=1),
+            "sd": sd,
+            "mcse_mean": mcse,
             "q5": np.quantile(flat, 0.05, axis=0),
             "median": np.quantile(flat, 0.5, axis=0),
             "q95": np.quantile(flat, 0.95, axis=0),
             "rhat": split_rhat(x),
-            "ess": ess(x),
+            "rank_rhat": rank_rhat(x),
+            "ess": e,
+            "ess_bulk": ess_bulk(x),
+            "ess_tail": ess_tail(x),
         }
     return out

@@ -55,6 +55,14 @@ class Model:
     def log_lik(self, params: Dict[str, Array], data: PyTree) -> Array:
         raise NotImplementedError
 
+    def log_lik_rows(self, params: Dict[str, Array], data: PyTree) -> Array:
+        """Optional: the (N,) per-row log-likelihood terms whose sum is
+        ``log_lik``.  Enables pointwise model comparison (WAIC/PSIS-LOO,
+        ``stark_tpu.compare``); not used by the samplers."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not define per-row log-lik terms"
+        )
+
     def init_params(self, key: Array) -> Optional[Dict[str, Array]]:
         """Optional: return constrained init values; None -> U(-2,2) in
         unconstrained space (Stan-style random init)."""

@@ -41,5 +41,8 @@ class EightSchools(Model):
         return lp
 
     def log_lik(self, p, data):
+        return jnp.sum(self.log_lik_rows(p, data))
+
+    def log_lik_rows(self, p, data):
         theta = p["mu"] + p["tau"] * p["theta_raw"]
-        return jnp.sum(jstats.norm.logpdf(data["y"], theta, data["sigma"]))
+        return jstats.norm.logpdf(data["y"], theta, data["sigma"])

@@ -56,10 +56,13 @@ class GaussianMixture(Model):
         return lp
 
     def log_lik(self, p, data):
+        return jnp.sum(self.log_lik_rows(p, data))
+
+    def log_lik_rows(self, p, data):
         x = data["x"][:, None]  # (N, 1)
         comp = jstats.norm.logpdf(x, p["mu"][None, :], p["sigma"][None, :])
         log_w = jnp.log(jnp.maximum(p["weights"], 1e-30))[None, :]
-        return jnp.sum(logsumexp(comp + log_w, axis=1))
+        return logsumexp(comp + log_w, axis=1)
 
 
 def gmm_init_1d(

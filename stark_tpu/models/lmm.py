@@ -50,13 +50,18 @@ class LinearMixedModel(Model):
         return lp
 
     def log_lik(self, p, data):
+        return jnp.sum(self.log_lik_rows(p, data))
+
+    def log_lik_rows(self, p, data):
         u = p["u_raw"] * p["tau"][None, :]  # (G, Q) non-centered
+        x = data["x"] if "x" in data else data["xT"].T
+        z = data["z"] if "z" in data else data["zT"].T
         mu = (
             p["intercept"]
-            + data["x"] @ p["beta"]
-            + jnp.sum(data["z"] * u[data["g"]], axis=-1)
+            + x @ p["beta"]
+            + jnp.sum(z * u[data["g"]], axis=-1)
         )
-        return jnp.sum(jstats.norm.logpdf(data["y"], mu, p["sigma"]))
+        return jstats.norm.logpdf(data["y"], mu, p["sigma"])
 
 
 class FusedLinearMixedModel(_TransposedXMixin, LinearMixedModel):

@@ -21,9 +21,18 @@ from ..bijectors import Exp
 from ..model import Model, ParamSpec
 
 
+def _bernoulli_logit_rows(logits, y):
+    return y * jax.nn.log_sigmoid(logits) + (1.0 - y) * jax.nn.log_sigmoid(-logits)
+
+
 def _bernoulli_logit_loglik(logits, y):
     # sum_i [ y_i * log sigmoid(l_i) + (1-y_i) * log sigmoid(-l_i) ]
-    return jnp.sum(y * jax.nn.log_sigmoid(logits) + (1.0 - y) * jax.nn.log_sigmoid(-logits))
+    return jnp.sum(_bernoulli_logit_rows(logits, y))
+
+
+def _rows_x(data):
+    """(N, D) design from either layout (prepare_data may have transposed)."""
+    return data["x"] if "x" in data else data["xT"].T
 
 
 class Logistic(Model):
@@ -42,6 +51,9 @@ class Logistic(Model):
     def log_lik(self, p, data):
         logits = data["x"] @ p["beta"]
         return _bernoulli_logit_loglik(logits, data["y"])
+
+    def log_lik_rows(self, p, data):
+        return _bernoulli_logit_rows(_rows_x(data) @ p["beta"], data["y"])
 
 
 class HierLogistic(Model):
@@ -77,6 +89,11 @@ class HierLogistic(Model):
         alpha = p["alpha0"] + p["sigma_alpha"] * p["alpha_raw"]
         logits = data["x"] @ p["beta"] + alpha[data["g"]]
         return _bernoulli_logit_loglik(logits, data["y"])
+
+    def log_lik_rows(self, p, data):
+        alpha = p["alpha0"] + p["sigma_alpha"] * p["alpha_raw"]
+        logits = _rows_x(data) @ p["beta"] + alpha[data["g"]]
+        return _bernoulli_logit_rows(logits, data["y"])
 
 
 def _transpose_x(data):

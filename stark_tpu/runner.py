@@ -549,6 +549,12 @@ def sample_until_converged(
                 full_ess = float(np.min(diagnostics.ess(cat_draws)))
                 rec["full_max_rhat"] = full_rhat
                 rec["full_min_ess"] = full_ess
+                # recorded for the metrics trail, not gated: the robust
+                # rank form flags heavy-tail/scale disagreement the
+                # classic gate can miss
+                rec["full_max_rank_rhat"] = float(
+                    np.max(diagnostics.rank_rhat(cat_draws))
+                )
                 # the full pass is host diagnostics too — re-stamp so the
                 # attribution covers the expensive validation blocks
                 rec["t_diag_s"] = round(

@@ -492,8 +492,17 @@ class Posterior:
     def rhat(self) -> Dict[str, np.ndarray]:
         return {k: diagnostics.split_rhat(v) for k, v in self.draws.items()}
 
+    def rank_rhat(self) -> Dict[str, np.ndarray]:
+        """Rank-normalized split-R-hat (bulk ∨ folded) — robust to heavy
+        tails and monotone transforms; Stan's modern default."""
+        return {k: diagnostics.rank_rhat(v) for k, v in self.draws.items()}
+
     def ess(self) -> Dict[str, np.ndarray]:
         return {k: diagnostics.ess(v) for k, v in self.draws.items()}
+
+    def ess_tail(self) -> Dict[str, np.ndarray]:
+        """Tail ESS (reliability of reported tail quantiles)."""
+        return {k: diagnostics.ess_tail(v) for k, v in self.draws.items()}
 
     def summary(self):
         return diagnostics.summarize(self.draws)

@@ -1,0 +1,111 @@
+"""Model-comparison tests (stark_tpu/compare.py): WAIC + PSIS-LOO.
+
+Oracle 1: for a conjugate normal-mean model, exact LOO predictive
+densities are computable in closed form — PSIS-LOO and WAIC must both
+land on them (they are asymptotically equal estimators of elpd).
+Oracle 2: the true data-generating model must beat a misspecified one.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import stark_tpu
+from stark_tpu import compare
+from stark_tpu.model import Model, ParamSpec
+from stark_tpu.models import EightSchools, eight_schools_data
+
+
+class NormalMean(Model):
+    """y_i ~ N(mu, 1), mu ~ N(0, 10) — conjugate, exact LOO available."""
+
+    def param_spec(self):
+        return {"mu": ParamSpec(())}
+
+    def log_prior(self, p):
+        return jax.scipy.stats.norm.logpdf(p["mu"], 0.0, 10.0)
+
+    def log_lik(self, p, data):
+        return jnp.sum(self.log_lik_rows(p, data))
+
+    def log_lik_rows(self, p, data):
+        return jax.scipy.stats.norm.logpdf(data["y"], p["mu"], 1.0)
+
+
+def _exact_loo_elpd(y, prior_var=100.0):
+    """Σ_i log p(y_i | y_-i) for the conjugate model (unit noise)."""
+    out = 0.0
+    n = len(y)
+    for i in range(n):
+        rest = np.delete(y, i)
+        post_var = 1.0 / (1.0 / prior_var + (n - 1))
+        post_mean = post_var * rest.sum()
+        pred_var = post_var + 1.0
+        out += -0.5 * np.log(2 * np.pi * pred_var) - 0.5 * (
+            y[i] - post_mean
+        ) ** 2 / pred_var
+    return out
+
+
+def test_waic_and_loo_match_exact_conjugate_loo():
+    rng = np.random.RandomState(0)
+    y = rng.standard_normal(40) + 1.0
+    model = NormalMean()
+    data = {"y": jnp.asarray(y)}
+    post = stark_tpu.sample(
+        model, data, chains=4, kernel="nuts", num_warmup=300,
+        num_samples=800, seed=1,
+    )
+    ll = compare.pointwise_log_lik(model, post, data)
+    assert ll.shape == (4, 800, 40)
+    exact = _exact_loo_elpd(y)
+    w = compare.waic(ll)
+    l = compare.psis_loo(ll)
+    assert abs(w["elpd_waic"] - exact) < 1.0, (w["elpd_waic"], exact)
+    assert abs(l["elpd_loo"] - exact) < 1.0, (l["elpd_loo"], exact)
+    # one-parameter model: effective parameter counts near 1
+    assert 0.5 < w["p_waic"] < 2.0
+    assert 0.5 < l["p_loo"] < 2.0
+    # well-specified model: every pareto k comfortably reliable
+    assert np.all(l["pareto_k"] < 0.7), l["pareto_k"].max()
+
+
+class WrongScale(NormalMean):
+    """Misspecified: assumes noise sd 3 where the data has sd 1."""
+
+    def log_lik_rows(self, p, data):
+        return jax.scipy.stats.norm.logpdf(data["y"], p["mu"], 3.0)
+
+
+def test_compare_ranks_true_model_first():
+    rng = np.random.RandomState(2)
+    y = rng.standard_normal(60)
+    data = {"y": jnp.asarray(y)}
+    results = {}
+    for name, model in (("true", NormalMean()), ("wrong", WrongScale())):
+        post = stark_tpu.sample(
+            model, data, chains=4, kernel="nuts", num_warmup=200,
+            num_samples=500, seed=3,
+        )
+        results[name] = compare.psis_loo(
+            compare.pointwise_log_lik(model, post, data)
+        )
+    table = compare.compare(results)
+    assert table["true"]["rank"] == 1
+    assert table["wrong"]["rank"] == 2
+    # the difference must be decisive relative to its SE
+    assert table["wrong"]["elpd_diff"] > 2 * table["wrong"]["diff_se"]
+
+
+def test_eight_schools_pointwise_and_waic():
+    post = stark_tpu.sample(
+        EightSchools(), eight_schools_data(), chains=4, kernel="nuts",
+        num_warmup=300, num_samples=500, seed=4,
+    )
+    ll = compare.pointwise_log_lik(EightSchools(), post, eight_schools_data())
+    assert ll.shape == (4, 500, 8)
+    w = compare.waic(ll)
+    # published 8-schools elpd_waic is ~ -30.5 (loose band: MCMC noise)
+    assert -33.0 < w["elpd_waic"] < -28.0, w["elpd_waic"]
+    l = compare.psis_loo(ll)
+    assert abs(l["elpd_loo"] - w["elpd_waic"]) < 1.5

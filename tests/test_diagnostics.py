@@ -1,5 +1,6 @@
 import numpy as np
 
+from stark_tpu import diagnostics
 from stark_tpu.diagnostics import ess, rhat_from_suffstats, split_rhat
 
 
@@ -153,3 +154,51 @@ def test_chain_suffstats_streaming_matches_batch():
     r_stream = s.rhat()
     r_split = split_rhat(x)
     assert np.all(np.abs(r_stream - r_split) < 0.02)
+
+
+def test_rank_rhat_well_mixed_near_one():
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((4, 1000, 3))
+    r = diagnostics.rank_rhat(x)
+    assert r.shape == (3,)
+    assert np.all(r < 1.01), r
+
+
+def test_rank_rhat_catches_scale_disagreement():
+    """A chain with the right LOCATION but 5x the scale: classic split
+    R-hat can sit near 1 (means agree; pooled variance inflates both
+    between and within), the FOLDED rank form must flag it."""
+    rng = np.random.RandomState(1)
+    x = rng.standard_normal((4, 1000))
+    x[0] *= 5.0
+    assert diagnostics.rank_rhat(x[..., None])[0] > 1.1
+
+
+def test_rank_rhat_invariant_to_monotone_transform():
+    rng = np.random.RandomState(2)
+    x = rng.standard_normal((4, 500, 1))
+    a = diagnostics.rank_rhat(x)
+    b = diagnostics.rank_rhat(np.exp(x))  # heavy-tailed transform
+    np.testing.assert_allclose(a, b, rtol=1e-12)
+
+
+def test_ess_bulk_tail_and_mcse_iid():
+    rng = np.random.RandomState(3)
+    c, n = 4, 2000
+    x = rng.standard_normal((c, n, 2))
+    bulk = diagnostics.ess_bulk(x)
+    tail = diagnostics.ess_tail(x)
+    assert np.all(bulk > 0.5 * c * n) and np.all(bulk < 1.5 * c * n)
+    # tail indicators are bernoulli(0.05) chains — ESS similar order
+    assert np.all(tail > 0.3 * c * n)
+    mcse = diagnostics.mcse_mean(x)
+    # iid: mcse ~ sd/sqrt(cn) = 1/sqrt(8000) ~ 0.011
+    np.testing.assert_allclose(mcse, 1.0 / np.sqrt(c * n), rtol=0.5)
+
+
+def test_summary_carries_new_fields():
+    rng = np.random.RandomState(4)
+    s = diagnostics.summarize({"theta": rng.standard_normal((4, 300, 2))})
+    for key in ("mcse_mean", "rank_rhat", "ess_tail"):
+        assert key in s["theta"], key
+        assert np.all(np.isfinite(s["theta"][key]))
