@@ -255,6 +255,11 @@ def main():
             ("BENCH_CHEES_WARMUP", "400"),
             ("BENCH_CHEES_SAMPLES", "500"),
             ("BENCH_MAP_INIT", "300"),
+            # offset-path kernel for the host: the grouped kernel's
+            # one-hot tiles are ~1.75x slower under the Pallas
+            # interpreter (measured 18.2 vs 10.4 ms/ensemble-eval at
+            # this exact shape; autodiff 13.6)
+            ("BENCH_GROUPED", "0"),
         ):
             os.environ.setdefault(name, v)
         print(

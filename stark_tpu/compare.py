@@ -60,7 +60,14 @@ def waic(ll) -> Dict[str, Any]:
 
 def _gpd_fit(x: np.ndarray):
     """Zhang & Stephens (2009) profile-posterior-mean fit of the
-    generalized Pareto shape k and scale sigma to exceedances x > 0."""
+    generalized Pareto to exceedances x > 0.
+
+    Returns (xi, sigma) in the STANDARD shape convention (xi > 0 = heavy
+    tail) that `_gpd_quantiles` and the k > 0.7 reliability threshold
+    use — Zhang–Stephens' own k is -xi, and returning it unnegated made
+    heavy tails report large-NEGATIVE k that could never trip the gate
+    (caught by a sign-flipped fit on synthetic GPD(xi=0.5) samples).
+    """
     x = np.sort(np.asarray(x, np.float64))
     n = x.shape[0]
     m = 30 + int(np.sqrt(n))
@@ -70,11 +77,12 @@ def _gpd_fit(x: np.ndarray):
     bs = bs / (prior_bs * q1) + 1.0 / x[-1]
     ks = -np.mean(np.log1p(-bs[:, None] * x[None, :]), axis=1)
     L = n * (np.log(bs / ks) + ks - 1.0)
-    w = 1.0 / np.sum(np.exp(L[None, :] - L[:, None]), axis=1)
+    with np.errstate(over="ignore"):  # inf -> weight 0, the right limit
+        w = 1.0 / np.sum(np.exp(L[None, :] - L[:, None]), axis=1)
     b = np.sum(bs * w)
-    k = -np.mean(np.log1p(-b * x))
-    sigma = k / b
-    return k, sigma
+    xi = np.mean(np.log1p(-b * x))
+    sigma = -xi / b
+    return float(xi), float(sigma)
 
 
 def _gpd_quantiles(p, k, sigma):
@@ -93,7 +101,10 @@ def psis_smooth(logw: np.ndarray):
     logw = np.asarray(logw, np.float64)
     logw = logw - logw.max()  # stabilize exp(); raw max becomes 0
     S = logw.shape[0]
-    m = min(int(0.2 * S + 1), S - 1)
+    # tail size per the published recipe: min(0.2 S, 3 sqrt(S)) — the
+    # sqrt cap keeps the GPD fit on the extreme tail instead of bulk
+    # mass as S grows
+    m = min(int(0.2 * S + 1), int(3.0 * np.sqrt(S)), S - 1)
     if m < 5:
         # cannot diagnose the tail: k is UNKNOWN, not zero — NaN forces
         # the caller to notice (ArviZ convention)

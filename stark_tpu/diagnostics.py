@@ -208,14 +208,16 @@ def rank_normalize(x: np.ndarray) -> np.ndarray:
     return z.reshape(x.shape)
 
 
-def rank_rhat(x) -> np.ndarray:
+def rank_rhat(x, z_bulk=None) -> np.ndarray:
     """Rank-normalized split-R-hat, the max of the bulk and tail (folded)
     forms — Stan's modern default.  Catches both location disagreements
     (bulk) and scale/tail disagreements (folded) that classic split-R-hat
     on heavy-tailed draws can miss.  (chains, draws, *event) -> (*event,).
+    ``z_bulk`` lets a caller that already rank-normalized x (summarize)
+    skip that pass.
     """
     x = np.asarray(x, np.float64)
-    bulk = split_rhat(rank_normalize(x))
+    bulk = split_rhat(rank_normalize(x) if z_bulk is None else z_bulk)
     med = np.median(x.reshape(-1, *x.shape[2:]), axis=0)
     folded = split_rhat(rank_normalize(np.abs(x - med)))
     return np.maximum(bulk, folded)
@@ -259,6 +261,7 @@ def summarize(draws: Dict[str, np.ndarray]) -> Dict[str, Dict[str, np.ndarray]]:
         flat = x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
         sd = flat.std(axis=0, ddof=1)
         e = ess(x)  # computed ONCE; mcse derives from it
+        z_bulk = rank_normalize(x)  # shared by rank_rhat and ess_bulk
         with np.errstate(divide="ignore", invalid="ignore"):
             mcse = sd / np.sqrt(e)
         out[name] = {
@@ -269,9 +272,9 @@ def summarize(draws: Dict[str, np.ndarray]) -> Dict[str, Dict[str, np.ndarray]]:
             "median": np.quantile(flat, 0.5, axis=0),
             "q95": np.quantile(flat, 0.95, axis=0),
             "rhat": split_rhat(x),
-            "rank_rhat": rank_rhat(x),
+            "rank_rhat": rank_rhat(x, z_bulk=z_bulk),
             "ess": e,
-            "ess_bulk": ess_bulk(x),
+            "ess_bulk": ess(z_bulk),
             "ess_tail": ess_tail(x),
         }
     return out

@@ -109,3 +109,39 @@ def test_eight_schools_pointwise_and_waic():
     assert -33.0 < w["elpd_waic"] < -28.0, w["elpd_waic"]
     l = compare.psis_loo(ll)
     assert abs(l["elpd_loo"] - w["elpd_waic"]) < 1.5
+
+
+def test_gpd_fit_recovers_positive_shape():
+    """Sign-convention regression: exceedances from GPD(xi=0.5) must fit
+    a POSITIVE shape near 0.5 (the Zhang-Stephens paper's own k is -xi;
+    returning it unnegated made heavy tails look maximally reliable)."""
+    from stark_tpu.compare import _gpd_fit
+
+    rng = np.random.RandomState(0)
+    u = rng.uniform(size=4000)
+    xi, sigma = 0.5, 1.0
+    x = sigma * (np.power(u, -xi) - 1.0) / xi  # inverse-CDF GPD draws
+    xi_hat, sigma_hat = _gpd_fit(x)
+    assert 0.3 < xi_hat < 0.7, xi_hat
+    assert 0.7 < sigma_hat < 1.4, sigma_hat
+
+
+def test_psis_flags_heavy_tailed_ratios():
+    """Raw importance ratios with a Pareto(alpha=1) tail (xi = 1): the
+    reliability diagnostic must actually fire (k > 0.7)."""
+    from stark_tpu.compare import psis_smooth
+
+    rng = np.random.RandomState(1)
+    logw = -np.log(rng.uniform(size=4000))  # w ~ Pareto(1), xi = 1
+    smoothed, k = psis_smooth(logw)
+    assert k > 0.7, k
+    np.testing.assert_allclose(np.exp(smoothed).sum(), 1.0, rtol=1e-6)
+
+
+def test_psis_light_tail_low_k():
+    from stark_tpu.compare import psis_smooth
+
+    rng = np.random.RandomState(2)
+    logw = 0.3 * rng.standard_normal(4000)  # near-uniform weights
+    _, k = psis_smooth(logw)
+    assert k < 0.5, k
