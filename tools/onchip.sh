@@ -1,20 +1,30 @@
 #!/bin/sh
-# Round-2 on-chip measurement set. Run when the axon tunnel is alive
-# (probe: timeout 60 python -c "import jax; print(jax.devices())").
+# On-chip measurement set (r3-refreshed). Run when the axon tunnel is
+# alive (probe: timeout 60 python -c "import jax; print(jax.devices())";
+# relay listeners: ss -tln | grep 808).
 #
-# Rules (see tpu notes in DESIGN.md / memory):
+# Rules (see DESIGN.md §4d and the tpu notes in memory):
 #  - ONE TPU process at a time; never SIGTERM a TPU process mid-dispatch
 #    (a killed client can wedge the relay for the whole session) — no
 #    `timeout` wrappers here on purpose.
+#  - A DEVICE FAULT can also wedge the relay (observed r3: a depth-7
+#    monolithic NUTS program faulted and took the tunnel down for the
+#    rest of the session). Keep device programs dispatch-bounded; do not
+#    run experimental configs before the judged measurements are in.
+#  - Measure per-eval costs with K >= 100 iterations amortized INSIDE one
+#    program: the per-dispatch sync round-trip is ~108 ms, so K=10
+#    sync-each timings are floor-dominated garbage.
 #  - Each step is restartable; bench.py supervises/resumes itself.
 set -ex
 
-# 1. kernel roofline with the fixed timing methodology (distinct inputs,
-#    warm input excluded, per-dispatch synced) -> tools/roofline_results.json
+# 1. kernel roofline (memoization-gated methodology; rows above spec peak
+#    are retried and otherwise tagged invalid) -> tools/roofline_results.json
 python tools/roofline.py
 
 # 2. five judged configs -> appends the measured table to BASELINE.md
 python -m stark_tpu bench-all --update-baseline BASELINE.md
 
-# 3. flagship (supervised ChEES, 1M rows) -> one JSON line + phase breakdown
+# 3. flagship (supervised ChEES, 1M rows, grouped kernel, C=64)
+#    -> best-so-far JSON lines + phase breakdown; r3 measured 31.34
+#    ESS/s/chip converged (see BASELINE.md flagship table)
 python bench.py
