@@ -17,7 +17,11 @@ Metric (BASELINE.json:2): effective samples/sec/chip on the hierarchical
 logistic workload (the north-star config, BASELINE.json:5,8).
 
   value        TPU-backend min-ESS/sec/chip at N rows (default 1M)
-  vs_baseline  value / (CpuBackend ESS/sec extrapolated to the same N)
+  vs_baseline  value / (CpuBackend ESS/sec extrapolated to the same N).
+               On a dead-accelerator CPU fallback this is null — the
+               CPU-vs-CPU algorithm ratio is reported separately as
+               vs_baseline_cpu_algo so it can never be read as the judged
+               on-chip >=20x claim (VERDICT r3 weak #3)
   converged    whether the reported run reached R-hat < 1.01 — an
                unconverged ESS estimate is statistically meaningless, so
                it is NEVER reported as the value when a converged result
@@ -283,7 +287,7 @@ def main():
                 "(starting)",
                 "value": 0.0,
                 "unit": "ess/sec/chip",
-                "vs_baseline": 0.0,
+                "vs_baseline": None if fell_back else 0.0,
                 "converged": False,
                 "partial": True,
                 "phase": "starting",
@@ -333,7 +337,19 @@ def main():
                     f"N={n} (ChEES supervised, best-so-far)",
                     "value": round(best_partial["value"], 3),
                     "unit": "ess/sec/chip",
-                    "vs_baseline": round(best_partial["value"] / denom, 2),
+                    # On a dead-accelerator fallback the CPU-vs-CPU algorithm
+                    # ratio must never sit in the field that carries the
+                    # judged on-chip >=20x claim (VERDICT r3 weak #3): null
+                    # it and report the ratio under an unambiguous name.
+                    "vs_baseline": (
+                        None if fell_back
+                        else round(best_partial["value"] / denom, 2)
+                    ),
+                    **(
+                        {"vs_baseline_cpu_algo":
+                         round(best_partial["value"] / denom, 2)}
+                        if fell_back else {}
+                    ),
                     "converged": False,
                     "partial": True,
                     "phase": phase,
@@ -594,8 +610,20 @@ def main():
                 f"N={n} ({sampler_tag})",
                 "value": round(ess_per_sec, 3) if math.isfinite(ess_per_sec) else 0.0,
                 "unit": "ess/sec/chip",
+                # fallback lines carry no field readable as the on-chip
+                # >=20x claim (see emit_partial): the CPU-vs-CPU algorithm
+                # ratio moves to vs_baseline_cpu_algo, vs_baseline is null
                 "vs_baseline": (
-                    round(vs_baseline, 2) if math.isfinite(vs_baseline) else 0.0
+                    None if fell_back
+                    else round(vs_baseline, 2) if math.isfinite(vs_baseline)
+                    else 0.0
+                ),
+                **(
+                    {"vs_baseline_cpu_algo": (
+                        round(vs_baseline, 2) if math.isfinite(vs_baseline)
+                        else 0.0
+                    )}
+                    if fell_back else {}
                 ),
                 "converged": converged and math.isfinite(ess_per_sec),
                 "max_rhat": round(rhat, 4) if math.isfinite(rhat) else None,

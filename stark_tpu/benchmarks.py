@@ -268,7 +268,7 @@ def bench_gmm_tempered(
         swap_accept_min_pair=round(
             float(np.min(stats["swap_accept_per_pair"])), 4
         ),
-        beta_hot=round(float(np.min(stats["betas"])), 5),
+        beta_hot=round(float(np.min(stats["betas_adapted"])), 5),
     )
 
 

@@ -114,13 +114,22 @@ def psis_smooth(logw: np.ndarray):
     cutoff = logw[srt[-m - 1]]
     exceed = np.exp(logw[tail_idx]) - np.exp(cutoff)
     pos = exceed > 0
-    if int(pos.sum()) < 5:
+    n_fit = int(pos.sum())
+    if n_fit < 5:
         return logw - _logsumexp(logw), float("nan")
     k, sigma = _gpd_fit(exceed[pos])
-    p = (np.arange(1, m + 1) - 0.5) / m
+    # published-PSIS small-sample shape regularization: shrink khat toward
+    # 0.5 with prior weight 10 so tiny tails don't produce noisy k near
+    # the 0.7 reliability threshold (ADVICE r3: compare.py)
+    k = (n_fit * k + 5.0) / (n_fit + 10.0)
+    # smooth only the strictly-positive exceedances (the same set the GPD
+    # was fitted on); ties at the cutoff keep their raw value, which IS
+    # the cutoff — handing them GPD quantiles they never informed skewed
+    # the smoothed tail (ADVICE r3)
+    p = (np.arange(1, n_fit + 1) - 0.5) / n_fit
     smoothed = np.log(np.exp(cutoff) + _gpd_quantiles(p, k, sigma))
     out = logw.copy()
-    out[tail_idx] = np.minimum(smoothed, 0.0)  # cap at the raw max
+    out[tail_idx[pos]] = np.minimum(smoothed, 0.0)  # cap at the raw max
     return out - _logsumexp(out), float(k)
 
 

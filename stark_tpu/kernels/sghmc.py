@@ -117,17 +117,23 @@ def make_minibatch_grad(
     row_axes: per-leaf row-axis pytree (``Model.data_row_axes``); default
     axis 0 everywhere.  Leaves with transformed layouts (e.g. ``xT`` with
     rows on axis 1) are gathered along their own axis so every leaf of the
-    batch holds the SAME rows.
+    batch holds the SAME rows.  A negative row axis marks a row-less
+    sentinel leaf (see ``Model.data_row_axes``): passed through unbatched.
     """
     if row_axes is None:
         row_axes = jax.tree.map(lambda _: 0, data)
-    leaves, axes = jax.tree.leaves(data), jax.tree.leaves(row_axes)
-    n = leaves[0].shape[axes[0]]
+    pairs = [
+        (x, ax)
+        for x, ax in zip(jax.tree.leaves(data), jax.tree.leaves(row_axes))
+        if ax >= 0
+    ]
+    n = pairs[0][0].shape[pairs[0][1]]
 
     def grad_fn(key, z):
         idx = jax.random.randint(key, (batch_size,), 0, n)
         batch = jax.tree.map(
-            lambda x, ax: jnp.take(x, idx, axis=ax), data, row_axes
+            lambda x, ax: x if ax < 0 else jnp.take(x, idx, axis=ax),
+            data, row_axes,
         )
         return jax.grad(potential_with_data)(z, batch)
 

@@ -108,8 +108,20 @@ def _transpose_x(data):
 
 
 def _row_axes_xt(data):
-    # rows ride axis 1 of the transposed matrix, axis 0 everywhere else
-    return {k: (1 if k == "xT" else 0) for k in data}
+    # rows ride axis 1 of the transposed matrix, axis 0 everywhere else.
+    # Zero-length sentinel keys (e.g. the grouped model's 'offsets_path'
+    # fallback marker) carry no rows: mark them None = replicated so the
+    # data sharder never treats a (0,)-shaped marker as row-sharded data
+    # (ADVICE r3).  Keys must stay aligned with ``data`` for tree.map,
+    # and None is a zero-leaf pytree node, so -1 is the marker.  Shape
+    # metadata only — np.asarray here would pull device arrays (the whole
+    # (D, N) xT at flagship scale) back to the host on every backend setup.
+    def ax(k, v):
+        if np.ndim(v) == 0 or np.shape(v)[0] == 0:
+            return -1
+        return 1 if k == "xT" else 0
+
+    return {k: ax(k, v) for k, v in data.items()}
 
 
 class TransposedXMixin:

@@ -128,21 +128,29 @@ def prepare_grouped(data, d_eff, transpose_keys=("x",)):
     return out
 
 
-def _check_chain_vmem(cpad, lane_tile, interpret):
+def _check_chain_vmem(cpad, lane_tile, interpret, k_loc=0, q=1):
     """The kernel holds ~3 (C, TILE) f32 intermediates (logits, resid,
     value terms) in scoped VMEM; past ~16 MB Mosaic refuses to compile
-    (measured: C=128 at TILE=8192 asked for 20 MB).  Fail with an
+    (measured: C=128 at TILE=8192 asked for 20 MB).  The grouped kernels
+    additionally hold a (K_LOC, TILE) one-hot plus its iota slab and the
+    per-tile (C, Q*K_LOC) group window (ADVICE r3: a small-C /
+    large-K_LOC config could OOM past the C-only estimate).  Fail with an
     actionable message instead of the compiler OOM."""
     if interpret:
         return
     budget = 10 * 1024 * 1024  # conservative: the OOM had >3 live (C,TILE)s
-    if 3 * cpad * lane_tile * 4 > budget:
+    need = (
+        3 * cpad * lane_tile * 4        # (C, TILE) logits/resid/val terms
+        + 2 * k_loc * lane_tile * 4     # (K_LOC, TILE) one-hot + iota
+        + cpad * q * k_loc * 4          # (C, Q*K_LOC) group window block
+    )
+    if need > budget:
         raise ValueError(
-            f"chain batch C={cpad} at lane_tile={lane_tile} needs more "
-            f"scoped VMEM than the TPU core has (~16MB); use <= "
-            f"{budget // (3 * 4 * lane_tile) // 8 * 8} chains "
-            f"here, or the offset-path Fused model which tiles chains "
-            f"independently"
+            f"chain batch C={cpad} at lane_tile={lane_tile} "
+            f"(k_loc={k_loc}, q={q}) needs ~{need / 2**20:.1f} MB scoped "
+            f"VMEM, more than the TPU core's ~16MB allows with headroom; "
+            f"reduce chains per device program or use the offset-path "
+            f"Fused model which tiles chains independently"
         )
 
 
@@ -197,7 +205,7 @@ def _grouped_call(beta, alpha, xt, y, gl, first_gid, *, k_loc, lane_tile,
     n = xt.shape[1]
     grid = -(-n // lane_tile)
     cpad = -(-c // 8) * 8
-    _check_chain_vmem(cpad, lane_tile, interpret)
+    _check_chain_vmem(cpad, lane_tile, interpret, k_loc=k_loc)
     if cpad != c:
         beta = jnp.pad(beta, ((0, cpad - c), (0, 0)))
         alpha = jnp.pad(alpha, ((0, cpad - c), (0, 0)))
@@ -397,7 +405,7 @@ def _grouped_lmm_call(beta, u, intercept, xt, zt, y, gl, first_gid, *,
     n = xt.shape[1]
     grid = -(-n // lane_tile)
     cpad = -(-c // 8) * 8
-    _check_chain_vmem(cpad, lane_tile, interpret)
+    _check_chain_vmem(cpad, lane_tile, interpret, k_loc=k_loc, q=q)
     if cpad != c:
         beta = jnp.pad(beta, ((0, cpad - c), (0, 0)))
         u = jnp.pad(u, ((0, cpad - c), (0, 0), (0, 0)))

@@ -182,6 +182,8 @@ def consensus_sample(
     # layout: (..., N, ...) -> (S, ..., N/S, ...); shard k = k-th row block
     def to_shards(x, ax):
         x = jnp.asarray(x)
+        if ax < 0:  # row-less sentinel leaf: replicate to every shard
+            return jnp.broadcast_to(x, (num_shards,) + x.shape)
         n = x.shape[ax]
         if n % num_shards:
             raise ValueError(

@@ -59,11 +59,14 @@ def row_partition_specs(data, axis: str = "data", row_axes=None):
     row_axes: per-leaf row-axis pytree (``Model.data_row_axes``); default
     axis 0 everywhere.  A leaf with rows on axis 1 (e.g. a transposed
     ``xT``) gets P(None, axis) so the mesh splits rows, not features.
+    A negative row axis means the leaf carries no rows (sentinel/scalar
+    markers) and is fully replicated.
     """
     if row_axes is None:
         row_axes = jax.tree.map(lambda _: 0, data)
     return jax.tree.map(
-        lambda _, ax: P(*([None] * ax + [axis])), data, row_axes
+        lambda _, ax: P() if ax < 0 else P(*([None] * ax + [axis])),
+        data, row_axes,
     )
 
 
@@ -81,6 +84,8 @@ def shard_data(data, mesh: Mesh, axis: str = "data", row_axes=None):
 
     def put(x, ax, spec):
         x = jnp.asarray(x)
+        if ax < 0:  # row-less sentinel leaf: replicate as-is
+            return jax.device_put(x, NamedSharding(mesh, spec))
         if x.shape[ax] % size:
             raise ValueError(
                 f"rows {x.shape[ax]} not divisible by mesh axis {axis}={size}; "

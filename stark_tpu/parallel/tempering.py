@@ -290,7 +290,12 @@ def tempered_sample(
         "swap_accept_rate": np.asarray(swap_rate),
         "swap_accept_per_pair": np.asarray(rate_per_pair),
         "step_size_per_temp": np.asarray(step_sizes),
+        # 'betas' keeps the r2 semantics — the INPUT ladder, shape (K,) —
+        # so external consumers keying on it are unaffected by ladder
+        # adaptation (ADVICE r3).  The adapted, possibly per-chain final
+        # ladder is exposed separately as 'betas_adapted' (chains, K).
+        "betas": np.asarray(betas),
         "betas_init": np.asarray(betas),
-        "betas": np.asarray(betas_final),  # (chains, K); per-chain if adapted
+        "betas_adapted": np.asarray(betas_final),
     }
     return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(zs))

@@ -533,7 +533,15 @@ def sample_until_converged(
                 # device cost per gradient
                 "t_dispatch_s": round(t_dispatch, 3),
                 "t_diag_s": round(time.perf_counter() - t_blk - t_dispatch, 3),
+                # Normalized to GRADIENT EVALUATIONS on all paths: the
+                # ChEES/HMC count is leapfrog steps (1 grad eval each),
+                # the NUTS count is tree leaves (1 grad eval each).
+                # grad_eval_basis names the counting basis so the paths
+                # are never silently conflated (ADVICE r3).
                 "block_grad_evals": blk_grads,
+                "grad_eval_basis": (
+                    "tree_leaves" if cfg.kernel == "nuts" else "leapfrog"
+                ),
                 "wall_s": time.perf_counter() - t_start,
             }
             if (

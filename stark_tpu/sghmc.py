@@ -82,7 +82,12 @@ def sghmc_sample(
     """
     data = prepare_model_data(model, data)
     row_axes = model.data_row_axes(data)
-    n = jax.tree.leaves(data)[0].shape[jax.tree.leaves(row_axes)[0]]
+    # first leaf with a real row axis (negative = row-less sentinel leaf)
+    n = next(
+        x.shape[ax]
+        for x, ax in zip(jax.tree.leaves(data), jax.tree.leaves(row_axes))
+        if ax >= 0
+    )
     if batch_size > n:
         raise ValueError(f"batch_size={batch_size} > rows={n}")
     fm = flatten_model(model, lik_scale=n / batch_size)

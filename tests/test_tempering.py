@@ -113,7 +113,10 @@ def test_adaptive_ladder_revives_dead_swaps():
     assert dead_min < 0.02, f"ladder unexpectedly alive: {dead_min}"
     assert live_min > 0.1, f"adaptation failed to revive swaps: {live_min}"
     # cold rung stays pinned at beta=1; ladder is monotone after adaptation
-    betas = live.sample_stats["betas"]
+    # ('betas' itself keeps the input-ladder semantics; the adapted
+    # per-chain ladder lives under 'betas_adapted')
+    assert live.sample_stats["betas"].shape == (4,)
+    betas = live.sample_stats["betas_adapted"]
     np.testing.assert_allclose(betas[:, 0], 1.0, rtol=1e-6)
     assert np.all(np.diff(betas, axis=1) < 0)
     # the cold chain's posterior is unaffected by adaptation: theta_hat
