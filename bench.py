@@ -594,7 +594,10 @@ def main():
     picked = select_result(results)
     if picked is None:
         print(json.dumps({"metric": "bench failed: no result", "value": 0.0,
-                          "unit": "ess/sec/chip", "vs_baseline": 0.0}),
+                          "unit": "ess/sec/chip",
+                          "vs_baseline": None if fell_back else 0.0,
+                          "platform": platform,
+                          "accelerator_fallback": fell_back}),
               flush=True)
         return
     sampler_tag, ess_per_sec, rhat, converged = picked

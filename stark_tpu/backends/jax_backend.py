@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..guard import auto_dispatch
 from ..model import Model, flatten_model, prepare_model_data
 from ..sampler import (
     Posterior,
@@ -76,6 +77,15 @@ class JaxBackend:
     ) -> Posterior:
         fm = flatten_model(model)
         data = prepare_model_data(model, data)
+        # device-program guard (guard.py): validate an explicit dispatch
+        # bound, and auto-bound a monolithic run on accelerator platforms
+        # — whole-run device programs are the measured relay-fault class.
+        # The guard keys on the platform the run will actually execute on
+        # (a pinned CPU device on a TPU host has no program cap).
+        dispatch_steps = auto_dispatch(
+            cfg, self.dispatch_steps,
+            platform=None if self.device is None else self.device.platform,
+        )
 
         if cfg.kernel == "chees":
             # ensemble kernel: served through the same backend boundary but
@@ -90,7 +100,7 @@ class JaxBackend:
                 chains=chains,
                 seed=seed,
                 init_params=init_params,
-                dispatch_steps=self.dispatch_steps,
+                dispatch_steps=dispatch_steps,
                 jit_cache=self._cache.setdefault((model, cfg, "chees"), {}),
                 device=self.device,
             )
@@ -107,8 +117,10 @@ class JaxBackend:
             z0 = jax.device_put(z0, self.device)
             chain_keys = jax.device_put(chain_keys, self.device)
 
-        if self.dispatch_steps:
-            return self._run_segmented(model, fm, cfg, data, chain_keys, z0)
+        if dispatch_steps:
+            return self._run_segmented(
+                model, fm, cfg, data, chain_keys, z0, int(dispatch_steps)
+            )
 
         run = self._get_runner(model, fm, cfg)
         res = run(chain_keys, z0, data)
@@ -145,7 +157,8 @@ class JaxBackend:
             )),
         )
 
-    def _run_segmented(self, model, fm, cfg, data, chain_keys, z0):
+    def _run_segmented(self, model, fm, cfg, data, chain_keys, z0,
+                       dispatch_steps):
         """Warmup + sampling as bounded-length dispatches (see class doc),
         via the shared `sampler.drive_segmented_sampling` host driver."""
         seg_warmup = self._cached(
@@ -153,7 +166,7 @@ class JaxBackend:
         )
         return drive_segmented_sampling(
             fm, cfg, seg_warmup, self._get_block(model, fm, cfg),
-            chain_keys, z0, data, int(self.dispatch_steps),
+            chain_keys, z0, data, dispatch_steps,
         )
 
     def adaptive_parts(self, model, cfg: SamplerConfig, data):

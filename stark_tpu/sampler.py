@@ -236,28 +236,34 @@ def drive_segmented_warmup(cfg, v_init, v_seg, finalize, warm_keys, z0, data,
     schedule = build_warmup_schedule(cfg.num_warmup)
     aflags = np.asarray(schedule.adapt_mass)
     wflags = np.asarray(schedule.window_end)
-    # (num_warmup, chains, 2) step keys, sliced per segment on the host
-    wkeys = np.asarray(
+    # (num_warmup, chains, 2) step keys, computed and sliced ON DEVICE:
+    # chains-sharded keys must never materialize on one host (on a
+    # multi-process mesh they are not fully addressable), and slicing
+    # rides the replicated time axis so it is shard-local everywhere
+    wkeys = jnp.transpose(
         jax.vmap(lambda k: jax.random.split(k, max(cfg.num_warmup, 1)))(
             kinit[:, 1]
-        )
-    ).transpose(1, 0, 2)
-    warm_div = np.zeros((np.asarray(warm_keys).shape[0],), np.int64)
+        ),
+        (1, 0, 2),
+    )
+    warm_div = None  # accumulated on device (chains-sharded under a mesh)
     for s in range(0, cfg.num_warmup, seg):
         e = min(s + seg, cfg.num_warmup)
         state, da, welford, inv_mass, ndiv = jax.block_until_ready(
-            v_seg(jnp.asarray(wkeys[s:e]), jnp.asarray(aflags[s:e]),
+            v_seg(wkeys[s:e], jnp.asarray(aflags[s:e]),
                   jnp.asarray(wflags[s:e]), state, da, welford, inv_mass,
                   data)
         )
-        warm_div += np.asarray(ndiv)
+        warm_div = ndiv if warm_div is None else warm_div + ndiv
+    if warm_div is None:
+        warm_div = jnp.zeros((warm_keys.shape[0],), jnp.int32)
     return state, finalize(da), inv_mass, warm_div
 
 
 def make_segmented_warmup(fm: FlatModel, cfg: SamplerConfig):
     """Single-device segmented warmup: jit+vmap the warmup parts, return
     ``run(warm_keys, z0, data, seg) -> (state, step_size, inv_mass,
-    warm_div numpy (chains,))`` driven by ``drive_segmented_warmup``.
+    warm_div device (chains,))`` driven by ``drive_segmented_warmup``.
 
     Used by JaxBackend._run_segmented and the adaptive runner; the sharded
     backend builds shard_mapped parts and shares the same driver.
@@ -401,19 +407,23 @@ def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
     """
     if collect is None:
         collect = lambda t: jax.tree.map(np.asarray, t)  # noqa: E731
-    chains = np.asarray(z0).shape[0]
+    chains = z0.shape[0]
     keys = jax.vmap(lambda k: jax.random.split(k, 2))(chain_keys)
     warm_keys, sample_keys = keys[:, 0], keys[:, 1]
     state, step_size, inv_mass, warm_div = seg_warmup(warm_keys, z0, data, seg)
+    warm_div = np.asarray(collect(warm_div))
 
     total = cfg.num_samples * cfg.thin
-    skeys = np.asarray(
-        jax.vmap(lambda k: jax.random.split(k, max(total, 1)))(sample_keys)
+    # per-chain step keys stay ON DEVICE (chains-sharded on a mesh; not
+    # fully addressable on a multi-process mesh); sliced per block along
+    # the replicated sample axis
+    skeys = jax.vmap(lambda k: jax.random.split(k, max(total, 1)))(
+        sample_keys
     )  # (chains, >=1, 2)
     # empty seeds keep the num_samples=0 (warmup-only) case concatenable;
     # thinning happens PER BLOCK so host memory holds only kept draws
-    d = np.asarray(z0).shape[1]
-    zs_blocks = [np.zeros((chains, 0, d), np.asarray(z0).dtype)]
+    d = z0.shape[1]
+    zs_blocks = [np.zeros((chains, 0, d), np.dtype(z0.dtype))]
     acc_blocks = [np.zeros((chains, 0), np.float32)]
     div_blocks = [np.zeros((chains, 0), bool)]
     en_blocks = [np.zeros((chains, 0), np.float32)]
@@ -423,7 +433,7 @@ def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
         e = min(s + seg, total)
         v_block = get_block(e - s)
         # block_run splits its own per-step keys from one key per chain
-        bkeys = jnp.asarray(skeys[:, s, :])
+        bkeys = skeys[:, s, :]
         out = jax.block_until_ready(
             v_block(bkeys, state, step_size, inv_mass, data)
         )

@@ -80,6 +80,15 @@ def sghmc_sample(
     The returned Posterior holds the collected draws (num_samples*thin
     steps are run; roughly cycle_collect_frac of them are kept).
     """
+    # whole-run in-device program: warn when the worst-case row-gradient
+    # count is in the measured relay-fault class (guard.py); one
+    # gradient per step over batch_size rows per chain
+    from .guard import warn_whole_run
+
+    warn_whole_run(
+        "sghmc", num_warmup + num_samples * thin, num_leapfrog=1,
+        replicas=chains, rows=batch_size, context="sghmc_sample",
+    )
     data = prepare_model_data(model, data)
     row_axes = model.data_row_axes(data)
     # first leaf with a real row axis (negative = row-less sentinel leaf)

@@ -52,6 +52,16 @@ if kernel == "chees":
         chains=8, kernel="chees", num_warmup=200, num_samples=150,
         init_step_size=0.1, seed=0,
     )
+elif kernel == "nuts_dispatch":
+    # dispatch-bounded per-chain kernels over the multi-process mesh
+    # (VERDICT r3 missing #4): the segmented drivers keep chains-sharded
+    # keys/state on device; each device program is <= 40 transitions
+    post = stark_tpu.sample(
+        Logistic(num_features=4), local,
+        backend=ShardedBackend(mesh, dispatch_steps=40),
+        chains=2, kernel="nuts", max_tree_depth=5, num_warmup=150,
+        num_samples=150, seed=0,
+    )
 else:
     assert kernel == "nuts", f"worker has no branch for kernel={kernel!r}"
     post = stark_tpu.sample(
@@ -76,7 +86,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("kernel", ["nuts", "chees"])
+@pytest.mark.parametrize("kernel", ["nuts", "chees", "nuts_dispatch"])
 def test_two_process_sharded_sampling(tmp_path, kernel):
     script = tmp_path / "worker.py"
     script.write_text(_WORKER % {"port": _free_port()})

@@ -1,0 +1,163 @@
+"""Device-program risk guard (stark_tpu/guard.py).
+
+The guard pre-empts the measured relay-fault class — device programs
+past ~1 min of device time (BASELINE.md r2/r3 chip-access notes) — the
+way the VMEM guard pre-empts compile OOMs.  Platform is passed
+explicitly so the tests exercise accelerator behavior on the CPU host.
+"""
+
+import warnings
+
+import pytest
+
+from stark_tpu.guard import (
+    DeviceProgramRiskError,
+    auto_dispatch,
+    check_dispatch,
+    grads_per_transition,
+    warn_whole_run,
+)
+from stark_tpu.sampler import SamplerConfig
+
+
+def test_grads_per_transition():
+    assert grads_per_transition("nuts", max_tree_depth=6) == 64
+    assert grads_per_transition("hmc", num_leapfrog=12) == 12
+    # chees worst case is the warmup trajectory cap, not max_leapfrog
+    assert grads_per_transition("chees", max_leapfrog=1000) == 512
+    assert grads_per_transition("chees", max_leapfrog=100) == 100
+
+
+def test_check_dispatch_passes_judged_configs():
+    # every committed-good judged config sits under the cap
+    check_dispatch(SamplerConfig(kernel="chees"), 50, platform="tpu")
+    check_dispatch(SamplerConfig(kernel="chees"), 6, platform="tpu")
+    check_dispatch(
+        SamplerConfig(kernel="nuts", max_tree_depth=6), 50, platform="tpu"
+    )
+
+
+def test_check_dispatch_refuses_fault_class():
+    # depth-7 x 400-transition programs are the r3 fault signature; an
+    # explicit bound that worst-cases past the cap is refused
+    with pytest.raises(DeviceProgramRiskError, match="dispatch_steps <="):
+        check_dispatch(
+            SamplerConfig(kernel="nuts", max_tree_depth=7), 400,
+            platform="tpu",
+        )
+    # same config is fine on CPU (no program cap to fault)
+    check_dispatch(
+        SamplerConfig(kernel="nuts", max_tree_depth=7), 400, platform="cpu"
+    )
+
+
+def test_check_dispatch_env_override(monkeypatch):
+    monkeypatch.setenv("STARK_MAX_GRADS_PER_DISPATCH", "1000000")
+    check_dispatch(
+        SamplerConfig(kernel="nuts", max_tree_depth=7), 400, platform="tpu"
+    )
+
+
+def test_auto_dispatch_bounds_monolithic_on_accelerator():
+    cfg = SamplerConfig(kernel="nuts", max_tree_depth=10)
+    with pytest.warns(UserWarning, match="auto-bounded"):
+        steps = auto_dispatch(cfg, None, platform="tpu")
+    # bounded so that worst-case grads stay under the cap: 30000 // 1024
+    assert steps == 29
+    # shallow trees cap at the measured-good default dispatch
+    cfg6 = SamplerConfig(kernel="nuts", max_tree_depth=6)
+    with pytest.warns(UserWarning, match="auto-bounded"):
+        assert auto_dispatch(cfg6, None, platform="tpu") == 50
+
+
+def test_auto_dispatch_monolithic_stays_on_cpu():
+    cfg = SamplerConfig(kernel="nuts")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert auto_dispatch(cfg, None, platform="cpu") is None
+        assert auto_dispatch(cfg, 0, platform="cpu") == 0
+
+
+def test_auto_dispatch_opt_out(monkeypatch):
+    monkeypatch.setenv("STARK_ALLOW_MONOLITHIC", "1")
+    cfg = SamplerConfig(kernel="nuts")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert auto_dispatch(cfg, None, platform="tpu") is None
+
+
+def test_auto_dispatch_validates_explicit_bound():
+    cfg = SamplerConfig(kernel="nuts", max_tree_depth=10)
+    with pytest.raises(DeviceProgramRiskError):
+        auto_dispatch(cfg, 500, platform="tpu")
+    # a safe explicit bound passes through unchanged
+    assert auto_dispatch(cfg, 10, platform="tpu") == 10
+
+
+def test_warn_whole_run_fault_signatures():
+    # the exact r3 fault: depth-7 whole-run NUTS at N=1M, 8 chains
+    # (~4e11 worst-case row-grads, past the 2e11 cap)
+    with pytest.warns(UserWarning, match="row-grad"):
+        warn_whole_run(
+            "nuts", 400, platform="tpu", max_tree_depth=7, replicas=8,
+            rows=1_000_000,
+        )
+    # without a row count, the fallback trigger is the gradient cap
+    with pytest.warns(UserWarning, match="per-program cap"):
+        warn_whole_run(
+            "hmc", 1000, platform="tpu", num_leapfrog=16, replicas=8
+        )
+
+
+def test_warn_whole_run_good_configs_silent():
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        # the judged GMM ladder — depth-7 NUTS, 1100 transitions,
+        # 2 chains x 8 rungs, n=50k (~1.1e11 row-grads, measured 36-42 s
+        # on-chip) — stays silent: rows-awareness is what separates it
+        # from the same-depth faulted N=1M scan
+        warn_whole_run(
+            "nuts", 1100, platform="tpu", max_tree_depth=7, replicas=16,
+            rows=50_000,
+        )
+        # CPU never warns
+        warn_whole_run("nuts", 400, platform="cpu", max_tree_depth=9,
+                       replicas=8, rows=10_000_000)
+        warn_whole_run(
+            "hmc", 10000, platform="cpu", num_leapfrog=64, replicas=8
+        )
+
+
+def test_warn_whole_run_rowgrads_env_override(monkeypatch):
+    monkeypatch.setenv("STARK_MAX_ROWGRADS_PER_PROGRAM", "1e18")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        warn_whole_run(
+            "nuts", 400, platform="tpu", max_tree_depth=7, replicas=8,
+            rows=1_000_000,
+        )
+
+
+def test_auto_dispatch_explicit_zero_is_respected():
+    # BENCH_DISPATCH=0 semantics: an explicit 0 forces monolithic even on
+    # an accelerator (with a warning), it is never silently auto-bounded
+    cfg = SamplerConfig(kernel="nuts", max_tree_depth=6)
+    with pytest.warns(UserWarning, match="forces a monolithic"):
+        assert auto_dispatch(cfg, 0, platform="tpu") == 0
+
+
+def test_backend_applies_guard(monkeypatch):
+    """JaxBackend on an accelerator default would auto-bound; on the CPU
+    test platform the monolithic path must stay monolithic (no warning,
+    identical results to r3 behavior)."""
+    import stark_tpu
+    from stark_tpu.backends import JaxBackend
+    from stark_tpu.models.eight_schools import EightSchools, eight_schools_data
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        post = stark_tpu.sample(
+            EightSchools(), eight_schools_data(), chains=2, kernel="nuts",
+            num_warmup=100, num_samples=100, seed=0, backend=JaxBackend(),
+        )
+    assert post.num_samples == 100
