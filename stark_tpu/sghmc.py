@@ -228,4 +228,11 @@ def sghmc_sample(
         "num_divergent": np.asarray(n_div),
         "step_size": np.full((chains,), step_size),
     }
+    if cycles > 0:
+        # which warm-restart cycle each kept draw came from — the
+        # per-cycle mode-coverage evidence for multimodal posteriors
+        # (BNN config 5): draws from different cycles landing in
+        # different modes is the cyclical schedule doing its job, and is
+        # exactly what weight-space R-hat misreads as non-convergence
+        stats["cycle_id"] = np.asarray(keep) // max(total_sample // cycles, 1)
     return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(zs))

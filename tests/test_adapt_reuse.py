@@ -1,0 +1,134 @@
+"""Adaptation reuse across runs (runner.sample_until_converged adapt_path)
+— the Stan-style metric import that attacks the warmup share of wall
+(measured 37% of the r3 flagship; VERDICT r3 next #7)."""
+
+import json
+import os
+
+import numpy as np
+
+import stark_tpu
+from stark_tpu.models.eight_schools import EightSchools, eight_schools_data
+
+
+import pytest
+
+def _run(tmp_path, adapt_path, metrics, **kw):
+    return stark_tpu.sample_until_converged(
+        EightSchools(), eight_schools_data(), chains=8, kernel="chees",
+        block_size=100, min_blocks=1, max_blocks=6, ess_target=300.0,
+        init_step_size=0.1, adapt_path=adapt_path,
+        metrics_path=str(metrics), **kw,
+    )
+
+
+@pytest.mark.slow
+def test_adapt_export_then_import(tmp_path):
+    apath = str(tmp_path / "adapt.npz")
+    m1 = tmp_path / "m1.jsonl"
+    res1 = _run(tmp_path, apath, m1, seed=0)
+    assert res1.converged
+    assert os.path.exists(apath), "first run must export its adaptation"
+
+    # second run imports: warmup_done must carry adapt_imported=True and
+    # the result must still converge to the same posterior
+    m2 = tmp_path / "m2.jsonl"
+    res2 = _run(tmp_path, apath, m2, seed=7, map_init_steps=0)
+    recs = [json.loads(l) for l in open(m2)]
+    warm = [r for r in recs if r["event"] == "warmup_done"]
+    assert warm and warm[0].get("adapt_imported") is True
+    assert res2.converged
+    mu1 = float(np.mean(res1.draws["mu"]))
+    mu2 = float(np.mean(res2.draws["mu"]))
+    assert abs(mu1 - mu2) < 1.0, (mu1, mu2)
+    # the touch-up replaces the full warmup: far fewer warmup gradients
+    w1 = [json.loads(l) for l in open(m1) if '"warmup_done"' in l][0]
+    assert warm[0]["warmup_grad_evals"] < 0.6 * w1["warmup_grad_evals"], (
+        warm[0]["warmup_grad_evals"], w1["warmup_grad_evals"],
+    )
+
+
+@pytest.mark.slow
+def test_adapt_import_chain_count_mismatch(tmp_path):
+    apath = str(tmp_path / "adapt.npz")
+    res1 = _run(tmp_path, apath, tmp_path / "a.jsonl", seed=0)
+    assert res1.converged
+    # more chains than saved: tiled + jittered, still converges
+    res2 = stark_tpu.sample_until_converged(
+        EightSchools(), eight_schools_data(), chains=12, kernel="chees",
+        block_size=100, min_blocks=1, max_blocks=6, ess_target=300.0,
+        init_step_size=0.1, adapt_path=apath, map_init_steps=0, seed=3,
+    )
+    assert res2.converged
+
+
+@pytest.mark.slow
+def test_adapt_import_rejected_on_mismatch(tmp_path):
+    """A mismatched import (different model) is rejected, logged, and the
+    run falls back to a full warmup — never a crash or a silent reuse."""
+    from stark_tpu.models import Logistic
+    from stark_tpu.models.logistic import synth_logistic_data
+    import jax
+
+    apath = str(tmp_path / "adapt.npz")
+    res1 = _run(tmp_path, apath, tmp_path / "a.jsonl", seed=0)
+    assert os.path.exists(apath)
+
+    data, _ = synth_logistic_data(jax.random.PRNGKey(0), 512, 3)
+    mpath = tmp_path / "m.jsonl"
+    res2 = stark_tpu.sample_until_converged(
+        Logistic(num_features=3), data, chains=4, kernel="chees",
+        block_size=100, min_blocks=1, max_blocks=6, ess_target=200.0,
+        init_step_size=0.1, adapt_path=apath, seed=1,
+        metrics_path=str(mpath),
+    )
+    recs = [json.loads(l) for l in open(mpath)]
+    assert any(r["event"] == "adapt_import_rejected" for r in recs)
+    warm = [r for r in recs if r["event"] == "warmup_done"]
+    assert warm and "adapt_imported" not in warm[0]
+    assert res2.converged
+    # the rejected import is OVERWRITTEN by this run's export (it now
+    # matches this model) — later Logistic runs can import it
+    res3 = stark_tpu.sample_until_converged(
+        Logistic(num_features=3), data, chains=4, kernel="chees",
+        block_size=100, min_blocks=1, max_blocks=6, ess_target=200.0,
+        init_step_size=0.1, adapt_path=apath, map_init_steps=0, seed=2,
+        metrics_path=str(tmp_path / "m3.jsonl"),
+    )
+    recs3 = [json.loads(l) for l in open(tmp_path / "m3.jsonl")]
+    warm3 = [r for r in recs3 if r["event"] == "warmup_done"]
+    assert warm3 and warm3[0].get("adapt_imported") is True
+
+
+def test_load_adapt_state_validation(tmp_path):
+    """Fast-tier unit coverage of the shared import validation: missing
+    file (no reason), wrong-model/ndim/key mismatches (reasons), and the
+    accept path — no sampling involved."""
+    from stark_tpu.checkpoint import save_checkpoint
+    from stark_tpu.runner import load_adapt_state
+
+    p = str(tmp_path / "a.npz")
+    arrays, reason = load_adapt_state(
+        p, kernel="chees", model_name="M", ndim=3)
+    assert arrays is None and reason is None  # missing file: silent
+
+    save_checkpoint(p, {
+        "z": np.zeros((4, 3)), "log_eps": np.zeros(()),
+        "log_T": np.zeros(()), "inv_mass": np.ones(3),
+    }, {"kernel": "chees", "model": "M"})
+    arrays, reason = load_adapt_state(
+        p, kernel="chees", model_name="M", ndim=3)
+    assert arrays is not None and reason is None
+    # wrong ndim / model / kernel -> rejected with a reason
+    for kw in (dict(ndim=4), dict(model_name="Other"), dict(kernel="nuts")):
+        args = dict(kernel="chees", model_name="M", ndim=3)
+        args.update(kw)
+        arrays, reason = load_adapt_state(p, **args)
+        assert arrays is None and "mismatch" in reason
+    # a same-module WARMUP checkpoint (no log_eps) is rejected, not a crash
+    save_checkpoint(p, {
+        "z": np.zeros((4, 3)), "inv_mass": np.ones(3),
+    }, {"kernel": "chees", "model": "M", "phase": "warmup"})
+    arrays, reason = load_adapt_state(
+        p, kernel="chees", model_name="M", ndim=3)
+    assert arrays is None and "missing arrays" in reason

@@ -74,6 +74,7 @@ def test_cpu_extrapolation_legacy_record():
     )
 
 
+@pytest.mark.slow
 def test_runner_time_budget_and_progress_cb():
     """time_budget_s stops after the first over-budget block (returning the
     draws so far, flagged), and progress_cb sees every metrics record."""

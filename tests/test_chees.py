@@ -61,6 +61,7 @@ def test_chees_eight_schools_posterior():
     assert abs(float(s["tau"]["mean"]) - 3.6) < 1.2
 
 
+@pytest.mark.slow
 def test_chees_segmented_matches_monolithic():
     kw = dict(chains=8, num_warmup=200, num_samples=200, seed=3)
     a = chees_sample(CorrGauss(), **kw)
@@ -68,6 +69,7 @@ def test_chees_segmented_matches_monolithic():
     np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
 
 
+@pytest.mark.slow
 def test_chees_map_init_descends_and_keeps_chains_distinct():
     from stark_tpu.models import HierLogistic, synth_logistic_data
 
@@ -114,6 +116,7 @@ def test_chees_through_backend_boundary():
     assert post.min_ess() > 400
 
 
+@pytest.mark.slow
 def test_chees_runner_checkpoint_resume(tmp_path):
     """ChEES under the adaptive runner: blocks, checkpoint, resume."""
     ckpt = str(tmp_path / "c.npz")
@@ -148,6 +151,7 @@ def test_chees_kernel_mismatch_on_resume_rejected(tmp_path):
         )
 
 
+@pytest.mark.slow
 def test_chees_supervised_restart_resumes_from_checkpoint(tmp_path, monkeypatch):
     """The VERDICT done-criterion: supervised_sample(kernel='chees')
     restarts from checkpoint after an injected fault (proved by the
@@ -184,6 +188,7 @@ def test_chees_supervised_restart_resumes_from_checkpoint(tmp_path, monkeypatch)
     assert post.converged
 
 
+@pytest.mark.slow
 def test_chees_midwarmup_checkpoint_resume(tmp_path):
     """A fault mid-warmup resumes from the last finished warmup segment
     instead of restarting warmup from zero."""

@@ -14,6 +14,7 @@ import stark_tpu
 from stark_tpu import compare
 from stark_tpu.model import Model, ParamSpec
 from stark_tpu.models import EightSchools, eight_schools_data
+import pytest
 
 
 class NormalMean(Model):
@@ -77,6 +78,7 @@ class WrongScale(NormalMean):
         return jax.scipy.stats.norm.logpdf(data["y"], p["mu"], 3.0)
 
 
+@pytest.mark.slow
 def test_compare_ranks_true_model_first():
     rng = np.random.RandomState(2)
     y = rng.standard_normal(60)
@@ -97,6 +99,7 @@ def test_compare_ranks_true_model_first():
     assert table["wrong"]["elpd_diff"] > 2 * table["wrong"]["diff_se"]
 
 
+@pytest.mark.slow
 def test_eight_schools_pointwise_and_waic():
     post = stark_tpu.sample(
         EightSchools(), eight_schools_data(), chains=4, kernel="nuts",

@@ -7,6 +7,7 @@ import sys
 import numpy as np
 
 from stark_tpu.config import RunConfig, load_config, run_config
+import pytest
 
 
 def test_run_config_sample_entry(tmp_path):
@@ -38,6 +39,7 @@ execution:
     assert post.draws["mu"].shape[:2] == (2, 300)
 
 
+@pytest.mark.slow
 def test_run_config_all_entries_dispatch():
     """Every sampler entry builds and runs at tiny scale."""
     entries = [

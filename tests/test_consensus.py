@@ -21,6 +21,7 @@ def setup():
     return model, data, full
 
 
+@pytest.mark.slow
 def test_consensus_matches_full_posterior(setup):
     model, data, full = setup
     post = consensus_sample(
@@ -34,6 +35,7 @@ def test_consensus_matches_full_posterior(setup):
     np.testing.assert_allclose(b_c["sd"], b_f["sd"], rtol=0.5, atol=0.02)
 
 
+@pytest.mark.slow
 def test_consensus_on_mesh(setup):
     model, data, _ = setup
     mesh = make_mesh({"data": 4, "chains": 2})
@@ -44,6 +46,7 @@ def test_consensus_on_mesh(setup):
     assert post.draws["beta"].shape == (2, 200, 3)
 
 
+@pytest.mark.slow
 def test_consensus_uniform_combine(setup):
     model, data, _ = setup
     post = consensus_sample(
@@ -60,6 +63,7 @@ def test_consensus_bad_shards(setup):
                          num_warmup=10, num_samples=10)
 
 
+@pytest.mark.slow
 def test_consensus_chees_matches_full_posterior():
     """ChEES sub-posterior sampling through the consensus combine must
     recover the same posterior as full-data sampling (vmap layout)."""
@@ -84,6 +88,7 @@ def test_consensus_chees_matches_full_posterior():
     )
 
 
+@pytest.mark.slow
 def test_consensus_chees_mesh_layout():
     """Shards over the 8-device mesh, chees ensembles per device."""
     from stark_tpu.parallel.mesh import make_mesh
@@ -114,6 +119,7 @@ def test_consensus_chees_mesh_layout():
         )
 
 
+@pytest.mark.slow
 def test_consensus_chees_fused_model_parity():
     """The fused Pallas likelihood composes with shard-vmapped ChEES
     (custom_vmap batches chains inside each shard, lax.map over shards)

@@ -12,6 +12,7 @@ import numpy as np
 import stark_tpu
 from stark_tpu.backends import CpuBackend
 from stark_tpu.model import Model, ParamSpec
+import pytest
 
 
 class ConjugateNormal(Model):
@@ -32,6 +33,7 @@ def _true_posterior(y):
     return y.sum() / prec, 1.0 / prec
 
 
+@pytest.mark.slow
 def test_cpu_backend_matches_analytic_posterior():
     y = np.asarray(2.0 + np.random.default_rng(0).standard_normal(32), np.float32)
     data = {"y": jnp.asarray(y)}
@@ -47,6 +49,7 @@ def test_cpu_backend_matches_analytic_posterior():
     assert post.max_rhat() < 1.05
 
 
+@pytest.mark.slow
 def test_cpu_and_jax_backends_agree():
     """Same posterior, two independent NUTS implementations."""
     y = np.asarray(1.0 + 0.5 * np.random.default_rng(1).standard_normal(24), np.float32)
@@ -68,6 +71,7 @@ def test_cpu_and_jax_backends_agree():
     assert abs(s_cpu - s_jax) < 0.3 * np.sqrt(var_true)
 
 
+@pytest.mark.slow
 def test_cpu_backend_hmc_kernel():
     y = np.asarray(np.random.default_rng(2).standard_normal(16), np.float32)
     post = stark_tpu.sample(
@@ -78,6 +82,7 @@ def test_cpu_backend_hmc_kernel():
     assert np.all(np.isfinite(post.draws["mu"]))
 
 
+@pytest.mark.slow
 def test_cpu_backend_chees_kernel_matches_analytic_posterior():
     """kernel="chees" on the host reference: Halton-jittered fixed-length
     HMC — the ChEES sampling-phase transition family — must hit the same
@@ -97,6 +102,7 @@ def test_cpu_backend_chees_kernel_matches_analytic_posterior():
     assert post.max_rhat() < 1.05
 
 
+@pytest.mark.slow
 def test_chees_cpu_and_jax_backends_agree():
     """Same posterior through the SamplerBackend boundary: host-driven
     jittered-HMC reference vs the compiled ensemble ChEES sampler."""

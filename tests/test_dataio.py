@@ -64,6 +64,7 @@ def test_load_dataset_columns(tmp_path, matrix):
     np.testing.assert_array_equal(data["x"][:, 0], mat[:, 0])
 
 
+@pytest.mark.slow
 def test_end_to_end_sampling_from_file(tmp_path):
     """File -> load_dataset -> sample: the full ingest path."""
     import jax

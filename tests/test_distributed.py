@@ -87,6 +87,7 @@ def _free_port() -> int:
 
 
 @pytest.mark.parametrize("kernel", ["nuts", "chees", "nuts_dispatch"])
+@pytest.mark.slow
 def test_two_process_sharded_sampling(tmp_path, kernel):
     script = tmp_path / "worker.py"
     script.write_text(_WORKER % {"port": _free_port()})

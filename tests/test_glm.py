@@ -29,6 +29,7 @@ def test_linear_regression_recovers_truth():
     )
 
 
+@pytest.mark.slow
 def test_poisson_regression_recovers_truth():
     data, true = synth_poisson_data(jax.random.PRNGKey(1), 2048, 3)
     post = stark_tpu.sample(
@@ -68,6 +69,7 @@ def test_debug_nans_raises_in_model_code():
         )
 
 
+@pytest.mark.slow
 def test_fused_linreg_matches_plain():
     """FusedLinearRegression (gaussian kernel, zero offsets) matches the
     autodiff LinearRegression: potential+grad parity and posterior parity."""

@@ -88,6 +88,7 @@ def test_grouped_matches_autodiff_value_and_grads():
         )
 
 
+@pytest.mark.slow
 def test_grouped_chain_batched_matches_per_chain():
     _, _, grp, gdata = _models()
     fm = flatten_model(grp)
@@ -103,6 +104,7 @@ def test_grouped_chain_batched_matches_per_chain():
     )
 
 
+@pytest.mark.slow
 def test_grouped_same_posterior_as_offset_path():
     """End-to-end: short ChEES runs on grouped vs offset models land on
     the same posterior summaries (same data, different layouts)."""
@@ -139,6 +141,7 @@ def test_chain_vmem_guard():
         _check_chain_vmem(128, 8192, False)
 
 
+@pytest.mark.slow
 def test_lmm_grouped_matches_autodiff():
     """Grouped LMM kernel vs the plain autodiff LinearMixedModel on the
     same sorted rows — value and every parameter gradient, including the
@@ -181,6 +184,7 @@ def test_lmm_grouped_matches_autodiff():
         )
 
 
+@pytest.mark.slow
 def test_lmm_grouped_chain_batched_matches_per_chain():
     from stark_tpu.models import FusedLinearMixedModelGrouped, synth_lmm_data
 

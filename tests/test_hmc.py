@@ -4,6 +4,7 @@ import numpy as np
 
 from stark_tpu.kernels.base import init_state, kinetic_energy, leapfrog, sample_momentum
 from stark_tpu.kernels.hmc import hmc_step
+import pytest
 
 
 def std_normal_potential(z):
@@ -55,6 +56,7 @@ def test_hmc_std_normal_moments():
     assert np.all(np.abs(zs.var(0) - 1.0) < 0.2)
 
 
+@pytest.mark.slow
 def test_segmented_backend_matches_posterior():
     """Dispatch-bounded execution (JaxBackend(dispatch_steps=...)) is
     statistically equivalent to the monolithic dispatch, including with a

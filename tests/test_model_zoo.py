@@ -4,6 +4,7 @@ Stochastic Volatility — parameter recovery at small scale."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import stark_tpu
 from stark_tpu.models import (
@@ -20,6 +21,7 @@ from stark_tpu.models import (
 )
 
 
+@pytest.mark.slow
 def test_studentt_recovers_truth():
     data, true = synth_studentt_data(jax.random.PRNGKey(0), 2048, 4, nu=4.0)
     post = stark_tpu.sample(
@@ -35,6 +37,7 @@ def test_studentt_recovers_truth():
     assert float(np.median(post.draws["nu"])) < 15.0
 
 
+@pytest.mark.slow
 def test_negbinom_recovers_truth():
     data, true = synth_negbinom_data(jax.random.PRNGKey(1), 4096, 3, phi=2.0)
     post = stark_tpu.sample(
@@ -49,6 +52,7 @@ def test_negbinom_recovers_truth():
     assert 1.0 < float(np.asarray(post.draws["phi"]).mean()) < 4.0
 
 
+@pytest.mark.slow
 def test_horseshoe_shrinks_nulls_keeps_signals():
     data, true = synth_horseshoe_data(
         jax.random.PRNGKey(2), 1024, 32, num_nonzero=4, noise=0.5
@@ -71,6 +75,7 @@ def test_horseshoe_shrinks_nulls_keeps_signals():
     assert np.max(np.abs(beta_hat[4:])) < 0.1
 
 
+@pytest.mark.slow
 def test_ordered_logistic_recovers_truth():
     data, true = synth_ordinal_data(
         jax.random.PRNGKey(3), 4096, 3, num_categories=5
@@ -90,6 +95,7 @@ def test_ordered_logistic_recovers_truth():
     np.testing.assert_allclose(cuts, np.asarray(true["cutpoints"]), atol=0.3)
 
 
+@pytest.mark.slow
 def test_stochastic_volatility_runs_and_recovers_scale():
     data, true = synth_sv_data(
         jax.random.PRNGKey(4), 512, mu=-1.0, phi=0.95, sigma_h=0.25
@@ -138,6 +144,7 @@ def test_ar1_path_matches_sequential():
     )
 
 
+@pytest.mark.slow
 def test_irt_2pl_recovers_truth():
     from stark_tpu.models import IRT2PL, synth_irt_data
 
@@ -156,6 +163,7 @@ def test_irt_2pl_recovers_truth():
     assert np.all(np.asarray(post.draws["a"]) > 0)
 
 
+@pytest.mark.slow
 def test_cox_ph_recovers_truth():
     from stark_tpu.models import CoxPH, synth_survival_data
 
@@ -194,6 +202,7 @@ def test_cox_cumulative_logsumexp_matches_reference():
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_cox_breslow_ties_match_reference():
     """Discretized (tied) times: every tied event must share the FULL
     tied risk set, matching a naive O(N^2) Breslow reference."""
@@ -224,6 +233,7 @@ def test_cox_breslow_ties_match_reference():
     np.testing.assert_allclose(got, ref, rtol=5e-5)
 
 
+@pytest.mark.slow
 def test_cox_unsorted_input_handled_by_prepare_data():
     from stark_tpu.models import CoxPH, synth_survival_data
 
@@ -242,6 +252,7 @@ def test_cox_unsorted_input_handled_by_prepare_data():
     )
 
 
+@pytest.mark.slow
 def test_fused_lmm_matches_plain_posterior():
     """FusedLinearMixedModel (gaussian Pallas kernel) reaches the same
     posterior as the autodiff LMM under the ensemble sampler."""

@@ -9,6 +9,7 @@ exercised end-to-end.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import stark_tpu
 from stark_tpu.model import flatten_model
@@ -33,6 +34,7 @@ def test_lmm_potential_and_shapes():
     assert np.all(np.isfinite(np.asarray(grad)))
 
 
+@pytest.mark.slow
 def test_lmm_recovers_beta():
     model = LinearMixedModel(num_features=2, num_groups=30, num_random=2)
     data, true = synth_lmm_data(jax.random.PRNGKey(2), 1500, 2, 30, noise=0.3)
@@ -62,6 +64,7 @@ def test_gmm_potential_finite_and_simplex():
     assert np.all(np.diff(np.asarray(params["mu"])) > 0)  # ordered
 
 
+@pytest.mark.slow
 def test_gmm_recovers_means_hmc():
     k = 3
     model = GaussianMixture(num_components=k)
@@ -74,6 +77,7 @@ def test_gmm_recovers_means_hmc():
     np.testing.assert_allclose(mu_mean, np.sort(np.asarray(true["mu"])), atol=0.5)
 
 
+@pytest.mark.slow
 def test_bnn_sghmc_predictive_accuracy():
     model = BayesianMLP(num_features=4, hidden=8)
     data, _ = synth_bnn_data(jax.random.PRNGKey(6), 2000, 4, hidden=4)

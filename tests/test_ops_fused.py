@@ -8,6 +8,7 @@ import stark_tpu
 from stark_tpu.model import flatten_model
 from stark_tpu.models import Logistic, synth_logistic_data
 from stark_tpu.ops import logistic_loglik_value_and_grad
+import pytest
 
 
 def _autodiff_oracle(beta, x, y):
@@ -49,6 +50,7 @@ def test_offset_op_grads_match_autodiff():
     np.testing.assert_allclose(np.asarray(ga), np.asarray(gf), rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_fused_hier_sampling_vmapped():
     """Fused hierarchical model samples under vmap'd NUTS (the real path)."""
     from stark_tpu.models import FusedHierLogistic
@@ -63,6 +65,7 @@ def test_fused_hier_sampling_vmapped():
     assert post.max_rhat() < 1.3
 
 
+@pytest.mark.slow
 def test_fused_flat_model_sampling():
     """NUTS through the fused potential reproduces the autodiff posterior."""
     from stark_tpu.models import FusedLogistic
@@ -97,6 +100,7 @@ def test_fused_flat_model_sampling():
     )
 
 
+@pytest.mark.slow
 def test_fused_model_all_entry_points():
     """Every row-splitting entry point honors prepare_data + data_row_axes.
 
@@ -137,6 +141,7 @@ def test_fused_model_all_entry_points():
     )
 
 
+@pytest.mark.slow
 def test_chain_batched_vmap_matches_per_chain():
     """vmap over chains must hit the chain-batched kernel and agree with
     per-chain evaluation (both no-offset and offset variants, C not a
@@ -175,6 +180,7 @@ def test_chain_batched_vmap_matches_per_chain():
     np.testing.assert_allclose(np.asarray(go_b), np.asarray(go_s), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_chain_batched_model_sampling_matches_unbatched_model():
     """FusedLogistic sampled with vmapped chains == plain Logistic."""
     from stark_tpu.models import FusedLogistic

@@ -43,6 +43,7 @@ def _mesh():
     return make_mesh({"data": 2, "chains": 4})
 
 
+@pytest.mark.slow
 def test_adaptive_chees_on_mesh_matches_single_device(setup):
     """Same seed, same schedule: the mesh run's collective adaptation must
     reproduce the single-device ensemble statistics (psum of shard sums ==
@@ -63,6 +64,7 @@ def test_adaptive_chees_on_mesh_matches_single_device(setup):
         )
 
 
+@pytest.mark.slow
 def test_adaptive_nuts_on_mesh_converges(setup):
     """Per-chain kernels through the mesh adaptive path (shard_mapped
     segmented warmup + block runner)."""
@@ -77,6 +79,7 @@ def test_adaptive_nuts_on_mesh_converges(setup):
     assert post.draws_flat.shape[0] == 8
 
 
+@pytest.mark.slow
 def test_sharded_backend_dispatch_bounded_nuts(setup):
     """ShardedBackend.run with dispatch_steps: bounded device programs for
     the per-chain kernels (previously chees-only)."""
@@ -89,6 +92,7 @@ def test_sharded_backend_dispatch_bounded_nuts(setup):
     assert post.num_samples == 200
 
 
+@pytest.mark.slow
 def test_supervised_sharded_chees_kill_resume(tmp_path, monkeypatch, setup):
     """THE composition the flagship bench relies on: supervised ChEES over
     the mesh, killed mid-sampling, resumes from the block checkpoint on

@@ -15,6 +15,8 @@ from stark_tpu.model import Model, ParamSpec, flatten_model
 from stark_tpu.sghmc import sghmc_sample
 
 
+import pytest
+
 class NormalMean(Model):
     """y_i ~ N(mu, 1), mu ~ N(0, prior_sd): conjugate, posterior known."""
 
@@ -111,6 +113,7 @@ class ScaledNormal(Model):
         ) + jnp.sum(jax.scipy.stats.norm.logpdf(data["y2"], p["b"], 5.0))
 
 
+@pytest.mark.slow
 def test_preconditioning_equilibrates_scales():
     key = jax.random.PRNGKey(0)
     k1, k2 = jax.random.split(key)

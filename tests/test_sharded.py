@@ -46,6 +46,7 @@ def test_sharded_potential_matches_unsharded(logistic_setup):
     np.testing.assert_allclose(got, expected, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_sharded_backend_matches_jax_backend(logistic_setup):
     model, data = logistic_setup
     mesh = make_mesh({"data": 2, "chains": 4})
@@ -65,6 +66,7 @@ def test_sharded_backend_matches_jax_backend(logistic_setup):
     np.testing.assert_allclose(b_sh["sd"], b_pl["sd"], rtol=0.35, atol=0.01)
 
 
+@pytest.mark.slow
 def test_sharded_backend_no_data_model():
     from stark_tpu.models.eight_schools import EightSchools, eight_schools_data
 
@@ -151,6 +153,7 @@ def test_sharded_chees_transition_matches_unsharded(logistic_setup):
     )
 
 
+@pytest.mark.slow
 def test_sharded_chees_backend_matches_jax_backend(logistic_setup):
     """Full sharded ChEES run (data x chains mesh) reaches the same
     posterior as the single-device ensemble — distribution-level parity."""
@@ -175,6 +178,7 @@ def test_sharded_chees_backend_matches_jax_backend(logistic_setup):
         np.testing.assert_allclose(m_s, m_p, atol=4 * np.max(sd) / np.sqrt(300))
 
 
+@pytest.mark.slow
 def test_sharded_chees_dispatch_bounded(logistic_setup):
     """dispatch_steps segments the sharded chees run without changing the
     draw count or convergence."""

@@ -65,6 +65,7 @@ def test_checkpoint_health(tmp_path):
     assert not checkpoint_is_healthy(str(tmp_path / "missing.npz"))
 
 
+@pytest.mark.slow
 def test_supervised_clean_run(tmp_path):
     wd = str(tmp_path / "run")
     post = supervised_sample(StdNormal2(), workdir=wd, seed=0, **SAMPLE_KW)
@@ -75,6 +76,7 @@ def test_supervised_clean_run(tmp_path):
     assert not any(l["event"] == "restart" for l in lines)
 
 
+@pytest.mark.slow
 def test_supervised_restart_resumes_from_checkpoint(tmp_path, monkeypatch):
     """First attempt dies after checkpointing a block; the supervisor must
     resume from that checkpoint, and the restart must be JSONL-logged."""
@@ -110,6 +112,7 @@ def test_supervised_restart_resumes_from_checkpoint(tmp_path, monkeypatch):
     assert restarts[0]["resumed_from_checkpoint"] is False
 
 
+@pytest.mark.slow
 def test_supervised_discards_poisoned_checkpoint(tmp_path):
     """A checkpoint with non-finite state is quarantined, not resumed."""
     wd = str(tmp_path / "run")
@@ -133,6 +136,7 @@ def test_supervised_discards_poisoned_checkpoint(tmp_path):
     assert post.history[0]["block"] == 1
 
 
+@pytest.mark.slow
 def test_reseed_branches_the_resumed_stream(tmp_path):
     """Resuming with reseed= must not replay the checkpointed key's draws —
     otherwise a deterministic failure repeats on every supervised retry."""
@@ -154,6 +158,7 @@ def test_reseed_branches_the_resumed_stream(tmp_path):
     assert not np.array_equal(a.draws_flat[:, 100:], b.draws_flat[:, 100:])
 
 
+@pytest.mark.slow
 def test_cold_start_quarantines_stale_draw_store(tmp_path):
     """Draws persisted by a discarded run must not leak into the new run."""
     from stark_tpu.drawstore import DrawStore, read_draws
@@ -182,6 +187,7 @@ def test_cold_start_quarantines_stale_draw_store(tmp_path):
     assert not np.any(stored == 99.0)
 
 
+@pytest.mark.slow
 def test_resume_truncates_orphaned_store_rows(tmp_path):
     """Rows the async writer landed after the last completed checkpoint
     must be dropped on resume, or the re-run block double-counts."""

@@ -11,6 +11,7 @@ import numpy as np
 
 from stark_tpu.model import Model, ParamSpec
 from stark_tpu.parallel.tempering import geometric_ladder, tempered_sample
+import pytest
 
 
 class BimodalMean(Model):
@@ -88,6 +89,7 @@ class GaussLoc(Model):
         return jnp.sum(jax.scipy.stats.norm.logpdf(data["x"], p["theta"], 1.0))
 
 
+@pytest.mark.slow
 def test_adaptive_ladder_revives_dead_swaps():
     """ΔE-matched adaptation (VERDICT r2 #8): start from a ladder whose
     rung gaps are far too wide to ever swap and check warmup swap-rate

@@ -9,6 +9,7 @@ import numpy as np
 from stark_tpu.bijectors import Exp
 from stark_tpu.model import Model, ParamSpec
 from stark_tpu.validate import geweke_test, sbc
+import pytest
 
 _N = 20
 
@@ -114,6 +115,7 @@ def _fused_simulate(key, p):
     return {"x": _fx, "g": _fg, "y": y}
 
 
+@pytest.mark.slow
 def test_geweke_fused_hier_logistic():
     from stark_tpu.models import FusedHierLogistic
 
@@ -125,6 +127,7 @@ def test_geweke_fused_hier_logistic():
     assert res.max_abs_z() < 5.0, res.zscores
 
 
+@pytest.mark.slow
 def test_sbc_fused_hier_logistic():
     from stark_tpu.models import FusedHierLogistic
 
@@ -144,6 +147,7 @@ def test_sbc_fused_hier_logistic():
         assert np.ptp(r) > 90, (int(np.min(r)), int(np.max(r)))
 
 
+@pytest.mark.slow
 def test_sbc_cox_ph():
     """SBC on the Breslow partial likelihood with CONTINUOUS times.
 
