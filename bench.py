@@ -53,7 +53,6 @@ import json
 import math
 import os
 import shutil
-import subprocess
 import sys
 import time
 
@@ -199,41 +198,16 @@ def load_or_measure_cpu_denominator(d, groups, depth, n_cpu, num_warmup,
     return rec
 
 
-def _probe_accelerator() -> bool:
-    """True iff accelerator client init completes; probed in a SUBPROCESS
-    with a timeout, because a dead axon relay makes jax.devices() hang
-    forever (observed r2: relay died mid-round and every client froze) —
-    and a bench that hangs records nothing at all.
-    """
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return False
-    try:
-        subprocess.run(
-            [sys.executable, "-u", "-c", "import jax; jax.devices()"],
-            timeout=_env_int("BENCH_PROBE_TIMEOUT", 180),
-            check=True,
-            capture_output=True,
-        )
-        return True
-    except Exception as e:  # noqa: BLE001 — timeout/crash both mean "no"
-        print(f"[bench] accelerator probe failed ({type(e).__name__}); "
-              "falling back to CPU platform", file=sys.stderr)
-        return False
-
-
 def main():
     import jax
 
     t_bench = time.perf_counter()
-    fell_back = False
-    if not _probe_accelerator():
-        fell_back = os.environ.get("JAX_PLATFORMS", "") != "cpu"
-        # honored because the backend has not initialized yet in THIS
-        # process (same mechanism as conftest.py's platform override)
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:  # noqa: BLE001 — already initialized: keep going
-            pass
+    # shared probe + CPU fallback (stark_tpu.platform): a dead axon relay
+    # makes jax.devices() hang forever, and a bench that hangs records
+    # nothing at all
+    from stark_tpu.platform import ensure_live_platform
+
+    fell_back = ensure_live_platform(_env_int("BENCH_PROBE_TIMEOUT", 180))
     import numpy as np
 
     import stark_tpu

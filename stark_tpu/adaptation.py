@@ -30,13 +30,16 @@ class DualAveragingState(NamedTuple):
     count: Array
 
 
-def da_init(step_size: Array) -> DualAveragingState:
+def da_init(step_size: Array, mu: Array = None) -> DualAveragingState:
+    """mu defaults to Stan's log(10*step) exploration prior (cold
+    starts); pass mu=log(step) to anchor AT a known-good step, e.g. when
+    re-tuning an imported adaptation state (runner.py adapt_path)."""
     log_step = jnp.log(step_size)
     return DualAveragingState(
         log_step=log_step,
         log_avg_step=log_step,
         h_avg=jnp.zeros_like(log_step),
-        mu=jnp.log(10.0) + log_step,
+        mu=jnp.log(10.0) + log_step if mu is None else jnp.asarray(mu),
         count=jnp.zeros((), jnp.int32),
     )
 

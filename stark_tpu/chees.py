@@ -236,9 +236,16 @@ def make_chees_parts(
             log_T = jnp.where(jnp.isfinite(new_log_T), new_log_T, log_T)
             # keep T inside the regime warmup actually executes (warm_cap):
             # letting it ratchet past the executed length would let
-            # sampling run lengths no warmup step ever validated
-            log_T = jnp.clip(
-                log_T, log_eps, log_eps + jnp.log(float(warm_cap))
+            # sampling run lengths no warmup step ever validated.  idx < 0
+            # marks an adaptation-import touch-up (runner.py): log_T is
+            # fully frozen there — the clip's moving log_eps ceiling would
+            # otherwise let a transient DA dip permanently shrink the
+            # imported trajectory length with Adam frozen and unable to
+            # restore it
+            log_T = jnp.where(
+                idx >= 0,
+                jnp.clip(log_T, log_eps, log_eps + jnp.log(float(warm_cap))),
+                log_T,
             )
             wf = jax.tree.map(
                 lambda new, old: jnp.where(accum, new, old),

@@ -1,0 +1,58 @@
+"""Accelerator liveness probe + CPU fallback.
+
+A dead axon relay makes ``jax.devices()`` hang FOREVER in-process
+(observed r2/r3/r4: the relay dies on a device fault and every client
+freezes on init) — so any entry point that might run with a dead tunnel
+must probe in a SUBPROCESS with a timeout and, on failure, force the
+CPU platform BEFORE jax initializes in its own process.  One copy of
+the pattern, used by bench.py and the ``python -m stark_tpu`` CLI.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def probe_accelerator(timeout: int = None) -> bool:
+    """True iff accelerator client init completes (subprocess probe)."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return False
+    if timeout is None:
+        env = os.environ.get("BENCH_PROBE_TIMEOUT")
+        timeout = int(env) if env else 180
+    try:
+        subprocess.run(
+            [sys.executable, "-u", "-c", "import jax; jax.devices()"],
+            timeout=timeout,
+            check=True,
+            capture_output=True,
+        )
+        return True
+    except Exception as e:  # noqa: BLE001 — timeout/crash both mean "no"
+        print(
+            f"[platform] accelerator probe failed ({type(e).__name__}); "
+            "falling back to CPU platform",
+            file=sys.stderr,
+        )
+        return False
+
+
+def ensure_live_platform(timeout: int = None) -> bool:
+    """Probe, and force the CPU platform if the accelerator is dead.
+
+    Returns ``fell_back``: True when a non-CPU platform was requested
+    but the probe failed (the honest ``accelerator_fallback`` flag).
+    Must be called BEFORE jax initializes in this process.
+    """
+    if probe_accelerator(timeout):
+        return False
+    fell_back = os.environ.get("JAX_PLATFORMS", "") != "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — already initialized: too late
+        pass
+    return fell_back

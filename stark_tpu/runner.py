@@ -150,8 +150,9 @@ def sample_until_converged(
     positions) are saved there; a later run whose (kernel, model, ndim)
     match loads them, starts the ensemble AT the saved typical-set
     positions, and replaces the full warmup with a short touch-up
-    (``adapt_touchup_frac`` of ``num_warmup``, step/trajectory
-    adaptation on, mass frozen at the imported estimate).  Convergence
+    (``adapt_touchup_frac`` of ``num_warmup``; ONLY the step size
+    re-tunes, anchored at the imported value — trajectory length and
+    mass stay frozen at the imported estimates).  Convergence
     is still validated by the same R-hat/ESS gate on fresh draws, so a
     stale import costs extra blocks, never a false convergence claim.
     Set ``map_init_steps=0`` on reuse runs — MAP descent from imported
@@ -234,20 +235,22 @@ def sample_until_converged(
 
         def run_chees_touchup(carry, key_warm):
             """Short re-equilibration warmup for an imported adaptation
-            state (``adapt_path``): step-size DA and trajectory-length
-            Adam stay on, mass windows stay OFF (zero flags — the
-            imported inv_mass estimate is from a full previous warmup
-            and a short touch-up window would only degrade it), and the
-            schedule indices sit at the tail of the nominal schedule so
-            the trajectory adaptation is past its t_start gate."""
+            state (``adapt_path``): ONLY the step size re-tunes (DA,
+            anchored at the imported value).  Mass windows are OFF (zero
+            flags) and the trajectory-length Adam is OFF (indices below
+            its t_start gate): both estimates come from a full previous
+            warmup, and a short window would only degrade them —
+            measured: a fresh Adam re-adapting the imported log_T walked
+            trajectories from ~100 to ~288 leapfrogs in 80 touch-up
+            transitions (N=20k fallback replica), tripling every later
+            block's cost."""
             sched = parts.schedule
             n = max(20, int(cfg.num_warmup * adapt_touchup_frac))
             u = jnp.asarray(2.0 * halton(n), jnp.float32)
             wkeys = jax.random.split(key_warm, n)
             aoff = jnp.zeros((n,), np.asarray(sched.adapt_mass).dtype)
             woff = jnp.zeros((n,), np.asarray(sched.window_end).dtype)
-            start = max(cfg.num_warmup - n, 0)
-            idxs = jnp.arange(start, start + n)
+            idxs = jnp.full((n,), -1, jnp.int32)  # < t_start: log_T frozen
             n_div, n_leap = 0, 0
             for s in range(0, n, block_size):
                 e = min(s + block_size, n)
@@ -538,11 +541,13 @@ def sample_until_converged(
                 from .adaptation import da_init
 
                 pr = ap.put_rep
+                ls = jnp.asarray(warm_import["log_eps"])
+                # DA anchored AT the imported step (mu = log_eps, not
+                # Stan's log(10*eps) exploration prior — that prior is
+                # for cold starts and measurably pulled a tuned eps 2.7x
+                # up during an 80-transition touch-up)
                 carry = carry._replace(
-                    da=jax.tree.map(
-                        pr,
-                        da_init(jnp.exp(jnp.asarray(warm_import["log_eps"]))),
-                    ),
+                    da=jax.tree.map(pr, da_init(jnp.exp(ls), mu=ls)),
                     log_T=pr(jnp.asarray(warm_import["log_T"])),
                     inv_mass=pr(jnp.asarray(warm_import["inv_mass"])),
                 )

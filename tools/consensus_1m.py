@@ -28,6 +28,10 @@ def main() -> int:
     ap.add_argument("--shards", type=int, default=8)
     args = ap.parse_args()
 
+    from stark_tpu.platform import ensure_live_platform
+
+    ensure_live_platform()
+
     import jax
 
     from stark_tpu.benchmarks import bench_consensus_logistic
