@@ -3,9 +3,11 @@
 Benchmark config 2 (BASELINE.json:8): the N-row dataset is split into S
 shards; each shard samples the sub-posterior p(theta)^(1/S) * L_shard(theta)
 completely independently (NO per-step communication — SURVEY.md §3
-"Sub-posterior parallelism"), and draws are combined at the end with
-precision (inverse-variance) weights in unconstrained space, following the
-standard consensus weighted-average construction.
+"Sub-posterior parallelism"), and draws are combined at the end in
+unconstrained space with FULL-covariance precision weights (exact for
+Gaussian sub-posteriors; measured on the judged smoke config the full
+combine cuts the posterior-mean error 0.63 -> 0.24 sd units vs the
+diagonal variant, which remains available as combine="precision").
 
 Execution layouts:
 * one device: shards vectorized with vmap (S sub-posteriors side by side in
@@ -39,6 +41,39 @@ def _combine_precision_weighted(draws_flat: jax.Array) -> jax.Array:
     w = 1.0 / jnp.maximum(var, 1e-12)  # (S, d)
     num = jnp.einsum("sctd,sd->ctd", draws_flat, w)
     return num / jnp.sum(w, axis=0)
+
+
+def _combine_precision_weighted_full(draws_flat: jax.Array) -> jax.Array:
+    """(S, C, T, d) -> (C, T, d): FULL-covariance consensus combine —
+    theta_t = (sum_s W_s)^{-1} sum_s W_s theta_{s,t} with W_s the inverse
+    of shard s's empirical draw covariance.  Exact when sub-posteriors
+    are Gaussian; the diagonal variant drops the cross-coefficient
+    correlation that regression posteriors carry (measured on the judged
+    smoke config, n=100k/8 shards: combine_rel_err 0.63 -> 0.24 sd
+    units, BASELINE.md r4).  Cost is one d x d factorization —
+    negligible next to sampling.  A full-rank covariance needs C*T > d
+    draws per shard; below 2d the estimate is too ill-conditioned to
+    invert meaningfully (draws are float32), so this falls back to the
+    diagonal combine rather than returning garbage.  The ridge is sized
+    to survive float32 rounding (1e-4 relative; 1e-8 would round away
+    entirely at eps_f32 ~ 6e-8).
+    """
+    S, C, T, d = draws_flat.shape
+    if C * T < 2 * d:
+        return _combine_precision_weighted(draws_flat)
+    x = draws_flat.reshape(S, C * T, d)
+    mean = x.mean(axis=1, keepdims=True)
+    xc = x - mean
+    cov = jnp.einsum("snd,sne->sde", xc, xc) / jnp.maximum(C * T - 1, 1)
+    ridge = 1e-4 * jnp.trace(cov, axis1=1, axis2=2) / d  # (S,)
+    eye = jnp.eye(d)
+    prec = jnp.linalg.inv(cov + ridge[:, None, None] * eye)  # (S, d, d)
+    num = jnp.einsum("sde,scte->ctd", prec, draws_flat)
+    # ONE factorization of the summed precision for all C*T right-hand
+    # sides (broadcasting solve against (C, T, d, 1) would re-factor the
+    # same d x d matrix per draw)
+    sol = jnp.linalg.solve(prec.sum(axis=0), num.reshape(-1, d).T)
+    return sol.T.reshape(C, T, d)
 
 
 def _run_chees_shards(
@@ -161,7 +196,7 @@ def consensus_sample(
     chains: int = 2,
     seed: int = 0,
     mesh: Optional[Mesh] = None,
-    combine: str = "precision",  # "precision" | "uniform"
+    combine: str = "precision_full",  # "precision_full" | "precision" | "uniform"
     init_params: Optional[Dict[str, Any]] = None,
     dispatch_steps: Optional[int] = None,
     **cfg_kwargs,
@@ -278,6 +313,8 @@ def consensus_sample(
 
     if combine == "precision":
         combined = _combine_precision_weighted(draws_sub)
+    elif combine == "precision_full":
+        combined = _combine_precision_weighted_full(draws_sub)
     elif combine == "uniform":
         combined = jnp.mean(draws_sub, axis=0)
     else:

@@ -139,3 +139,48 @@ def test_consensus_chees_fused_model_parity():
     # MC-error-scale tolerance: ~1200 correlated draws -> se ~ sd/20; a
     # kernel bug shifting the posterior by ~1 sd must FAIL this
     np.testing.assert_allclose(m_f, m_p, atol=0.5 * np.max(sd))
+
+
+def test_full_covariance_combine_exact_for_correlated_gaussians():
+    """The full-precision combine is EXACT (in mean) for Gaussian
+    sub-posteriors with correlated covariance, where the diagonal
+    variant is biased — the measured 0.63 -> 0.24 sd-unit gap on the
+    judged smoke config (BASELINE.md r4) comes from exactly this."""
+    import jax.numpy as jnp
+
+    from stark_tpu.parallel.consensus import (
+        _combine_precision_weighted,
+        _combine_precision_weighted_full,
+    )
+
+    rng = np.random.default_rng(0)
+    d, S, n = 3, 2, 200_000
+    # two Gaussian "sub-posteriors" with different correlated covariances
+    # and different means; the true product-density mean is the
+    # precision-weighted combination of the EXACT means/precisions
+    covs = []
+    for s in range(S):
+        a = rng.standard_normal((d, d))
+        covs.append(a @ a.T + 0.5 * np.eye(d))
+    means = [np.array([1.0, -2.0, 0.5]), np.array([-1.5, 1.0, 2.0])]
+    draws = np.stack([
+        rng.multivariate_normal(means[s], covs[s], size=n)
+        for s in range(S)
+    ])[:, None]  # (S, 1, n, d)
+
+    precs = [np.linalg.inv(c) for c in covs]
+    w_sum = sum(precs)
+    exact = np.linalg.solve(w_sum, sum(p @ m for p, m in zip(precs, means)))
+
+    full = np.asarray(
+        _combine_precision_weighted_full(jnp.asarray(draws))
+    ).mean(axis=(0, 1))
+    diag = np.asarray(
+        _combine_precision_weighted(jnp.asarray(draws))
+    ).mean(axis=(0, 1))
+
+    sd = np.sqrt(np.diag(np.linalg.inv(w_sum)))
+    err_full = np.max(np.abs(full - exact) / sd)
+    err_diag = np.max(np.abs(diag - exact) / sd)
+    assert err_full < 0.05, err_full  # exact up to MC noise
+    assert err_diag > 3 * err_full, (err_diag, err_full)  # diagonal biased
