@@ -116,6 +116,9 @@ def test_runner_time_budget_and_progress_cb():
 
 
 _TINY_BENCH_ENV = {
+    # never litter the repo root with tiny-scale adaptation artifacts
+    # (the committed capture-scale artifact must stay pristine)
+    "BENCH_ADAPT_REUSE": "0",
     "JAX_PLATFORMS": "cpu",
     "PALLAS_AXON_POOL_IPS": "",
     "BENCH_N": "400",
