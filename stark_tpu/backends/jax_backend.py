@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..guard import auto_dispatch
+from ..guard import annotate_dispatch, resolve_dispatch
 from ..model import Model, flatten_model, prepare_model_data
 from ..sampler import (
     Posterior,
@@ -82,7 +82,7 @@ class JaxBackend:
         # — whole-run device programs are the measured relay-fault class.
         # The guard keys on the platform the run will actually execute on
         # (a pinned CPU device on a TPU host has no program cap).
-        dispatch_steps = auto_dispatch(
+        dispatch_steps, dispatch_auto = resolve_dispatch(
             cfg, self.dispatch_steps,
             platform=None if self.device is None else self.device.platform,
         )
@@ -93,7 +93,7 @@ class JaxBackend:
             # the per-chain vmapped runner does not apply)
             from ..chees import run_chees
 
-            return run_chees(
+            post = run_chees(
                 fm,
                 cfg,
                 data,
@@ -104,6 +104,8 @@ class JaxBackend:
                 jit_cache=self._cache.setdefault((model, cfg, "chees"), {}),
                 device=self.device,
             )
+            annotate_dispatch(post.sample_stats, dispatch_steps, dispatch_auto)
+            return post
 
         key = jax.random.PRNGKey(seed)
         key_init, key_run = jax.random.split(key)
@@ -118,9 +120,11 @@ class JaxBackend:
             chain_keys = jax.device_put(chain_keys, self.device)
 
         if dispatch_steps:
-            return self._run_segmented(
+            post = self._run_segmented(
                 model, fm, cfg, data, chain_keys, z0, int(dispatch_steps)
             )
+            annotate_dispatch(post.sample_stats, dispatch_steps, dispatch_auto)
+            return post
 
         run = self._get_runner(model, fm, cfg)
         res = run(chain_keys, z0, data)
@@ -137,6 +141,7 @@ class JaxBackend:
             "num_warmup_divergent": np.asarray(res.num_warmup_divergent),
             "num_divergent": np.asarray(res.num_divergent),
         }
+        annotate_dispatch(stats, 0, False)
         return Posterior(
             draws, stats, flat_model=fm, draws_flat=np.asarray(res.draws)
         )

@@ -20,7 +20,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..guard import auto_dispatch
+from ..guard import annotate_dispatch, resolve_dispatch
 from ..model import Model, flatten_model, prepare_model_data
 from ..parallel.mesh import (
     make_mesh,
@@ -116,14 +116,16 @@ class ShardedBackend:
                 data = shard_data(data, self.mesh, "data", row_axes=row_axes)
 
         if cfg.kernel == "chees":
-            return self._run_chees(
+            dispatch_steps, dispatch_auto = resolve_dispatch(
+                cfg, self.dispatch_steps, platform=self._platform()
+            )
+            post = self._run_chees(
                 model, fm, cfg, data, row_axes,
                 chains=chains, seed=seed, init_params=init_params,
-                multiproc=multiproc,
-                dispatch_steps=auto_dispatch(
-                    cfg, self.dispatch_steps, platform=self._platform()
-                ),
+                multiproc=multiproc, dispatch_steps=dispatch_steps,
             )
+            annotate_dispatch(post.sample_stats, dispatch_steps, dispatch_auto)
+            return post
 
         key = jax.random.PRNGKey(seed)
         key_init, key_run = jax.random.split(key)
@@ -140,7 +142,7 @@ class ShardedBackend:
         # device-program guard (guard.py): validate an explicit dispatch
         # bound; auto-bound a monolithic run on accelerator platforms
         # (platform taken from the mesh's devices, not the process default)
-        dispatch_steps = auto_dispatch(
+        dispatch_steps, dispatch_auto = resolve_dispatch(
             cfg, self.dispatch_steps, platform=self._platform()
         )
         if dispatch_steps:
@@ -155,10 +157,12 @@ class ShardedBackend:
             )
             from ..distributed import gather_draws
 
-            return drive_segmented_sampling(
+            post = drive_segmented_sampling(
                 fm, cfg, seg_warmup, get_block, chain_keys, z0, data,
                 int(dispatch_steps), collect=gather_draws,
             )
+            annotate_dispatch(post.sample_stats, dispatch_steps, dispatch_auto)
+            return post
 
         run = self._get_runner(model, fm, cfg, data, row_axes)
         if data is None:
@@ -184,6 +188,7 @@ class ShardedBackend:
             "num_warmup_divergent": np.asarray(res.num_warmup_divergent),
             "num_divergent": np.asarray(res.num_divergent),
         }
+        annotate_dispatch(stats, 0, False)
         return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(res.draws))
 
     def _platform(self) -> str:

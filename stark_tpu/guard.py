@@ -174,6 +174,28 @@ def auto_dispatch(cfg, dispatch_steps, platform=None):
     return steps
 
 
+def resolve_dispatch(cfg, requested, platform=None):
+    """``(effective_steps, auto)``: `auto_dispatch` plus the auto-chosen
+    flag `annotate_dispatch` records — ONE predicate shared by every
+    backend, so no Posterior-producing path re-derives it inline."""
+    steps = auto_dispatch(cfg, requested, platform)
+    return steps, requested is None and bool(steps)
+
+
+def annotate_dispatch(sample_stats: dict, dispatch_steps, auto: bool) -> None:
+    """Record the EFFECTIVE dispatch bound in a run's sample stats.
+
+    ``auto_dispatch``'s silent auto-bounding changes the RNG stream
+    relative to a monolithic run (same seed, different draws across
+    platforms / STARK_ALLOW_MONOLITHIC settings), so the choice must be
+    auditable in the results themselves, not just a transient warning
+    (ADVICE r4).  ``dispatch_steps`` falsy means monolithic (recorded as
+    0); ``auto`` marks a guard-chosen bound vs a caller-configured one.
+    """
+    sample_stats["dispatch_steps"] = int(dispatch_steps or 0)
+    sample_stats["dispatch_auto"] = bool(auto)
+
+
 def max_rowgrads_per_program() -> float:
     env = os.environ.get("STARK_MAX_ROWGRADS_PER_PROGRAM")
     return float(env) if env else DEFAULT_MAX_ROWGRADS_PER_PROGRAM

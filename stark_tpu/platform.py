@@ -98,8 +98,38 @@ def ensure_live_platform(timeout: int = None) -> bool:
     fell_back = os.environ.get("JAX_PLATFORMS", "") != "cpu"
     import jax
 
+    err = None
     try:
         jax.config.update("jax_platforms", "cpu")
-    except Exception:  # noqa: BLE001 — already initialized: too late
-        pass
+    except Exception as e:  # noqa: BLE001 — already initialized: too late
+        err = e
+    if fell_back:
+        # the fallback only works BEFORE jax picks its backend: if the
+        # config update failed, or a backend is already initialized in
+        # this process, the next jax call will still dial the dead relay
+        # and hang forever — fail loudly instead of returning as if the
+        # fallback took (ADVICE r4, platform.py)
+        try:
+            from jax._src import xla_bridge
+
+            initialized = bool(getattr(xla_bridge, "_backends", None))
+        except Exception:  # noqa: BLE001 — private API moved: can't tell
+            initialized = False
+        if initialized:
+            try:
+                if jax.default_backend() == "cpu":
+                    # idempotent re-entry: an earlier call (or the env)
+                    # already landed this process on CPU — the fallback
+                    # is in effect, nothing can hang
+                    return fell_back
+            except Exception:  # noqa: BLE001 — can't tell; fail loud below
+                pass
+        if err is not None or initialized:
+            raise RuntimeError(
+                "accelerator probe failed but jax is already initialized "
+                "in this process — the CPU fallback cannot take effect "
+                "and the next jax call would hang on the dead relay.  "
+                "Call ensure_live_platform() BEFORE any jax-importing "
+                "code (bench.py and the stark_tpu CLI do this at entry)."
+            ) from err
     return fell_back

@@ -161,3 +161,38 @@ def test_backend_applies_guard(monkeypatch):
             num_warmup=100, num_samples=100, seed=0, backend=JaxBackend(),
         )
     assert post.num_samples == 100
+
+
+def test_dispatch_recorded_in_sample_stats():
+    """ADVICE r4: the effective dispatch bound (and whether the guard
+    auto-chose it) is recorded in the result's sample stats, so the
+    RNG-stream-affecting choice is auditable, not just warned about."""
+    import stark_tpu
+    from stark_tpu.backends import JaxBackend
+    from stark_tpu.models.eight_schools import EightSchools, eight_schools_data
+
+    post = stark_tpu.sample(
+        EightSchools(), eight_schools_data(), chains=2, kernel="nuts",
+        num_warmup=50, num_samples=50, seed=0, backend=JaxBackend(),
+    )
+    # CPU platform: monolithic, nothing auto-chosen
+    assert post.sample_stats["dispatch_steps"] == 0
+    assert post.sample_stats["dispatch_auto"] is False
+
+    post = stark_tpu.sample(
+        EightSchools(), eight_schools_data(), chains=2, kernel="nuts",
+        num_warmup=50, num_samples=50, seed=0,
+        backend=JaxBackend(dispatch_steps=25),
+    )
+    assert post.sample_stats["dispatch_steps"] == 25
+    assert post.sample_stats["dispatch_auto"] is False
+
+
+def test_annotate_dispatch_auto_flag():
+    from stark_tpu.guard import annotate_dispatch
+
+    stats = {}
+    annotate_dispatch(stats, 50, True)
+    assert stats == {"dispatch_steps": 50, "dispatch_auto": True}
+    annotate_dispatch(stats, None, False)
+    assert stats == {"dispatch_steps": 0, "dispatch_auto": False}

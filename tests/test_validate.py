@@ -183,3 +183,26 @@ def test_sbc_cox_ph():
     )
     stats = res.chi2()
     assert max(stats.values()) < 25.0, stats
+
+
+def test_ensure_live_platform_refuses_late_call(monkeypatch):
+    """ADVICE r4 (platform.py): when the probe fails but jax has already
+    initialized a NON-CPU backend in this process, the CPU fallback cannot
+    take effect — ensure_live_platform must raise instead of returning as
+    if it worked (the next jax call would hang on the dead relay).  A
+    process already landed on CPU re-enters idempotently instead."""
+    import jax
+    import pytest
+
+    from stark_tpu import platform as plat
+
+    jax.devices()  # force backend init in this (CPU-forced) test process
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")  # a non-CPU platform was wanted
+    monkeypatch.setattr(plat, "probe_accelerator", lambda timeout=None: False)
+    # backend initialized but it IS cpu: the fallback is already in
+    # effect — idempotent re-entry, not a crash of a healthy process
+    assert plat.ensure_live_platform() is True
+    # backend initialized and NOT cpu: fail loud, never hang later
+    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    with pytest.raises(RuntimeError, match="already initialized"):
+        plat.ensure_live_platform()
