@@ -21,6 +21,28 @@ import numpy as np
 _META_KEY = "__stark_meta_json__"
 
 
+def rank_path(path):
+    """Per-process variant of a state-file path on multi-process runs.
+
+    Every process of a multi-process mesh runs the same checkpoint /
+    metrics / draw-store code on (after the collect allgather) identical
+    state — on a real pod each host writes to its own filesystem, but on
+    a shared filesystem (tests, single-host multi-process) the writes
+    would race on one file.  ``a/b.npz`` becomes ``a/b.p0.npz`` on
+    process 0, etc.; single-process runs and ``None`` pass through
+    untouched.  Idempotent, so supervisor and runner can both apply it.
+    """
+    import jax
+
+    if path is None or jax.process_count() == 1:
+        return path
+    tag = f".p{jax.process_index()}"
+    root, ext = os.path.splitext(path)
+    if root.endswith(tag):
+        return path
+    return root + tag + ext
+
+
 def save_checkpoint(path: str, arrays: Dict[str, np.ndarray], meta: Dict[str, Any]):
     """Atomically write arrays + meta as one .npz (write temp, rename)."""
     if _META_KEY in arrays:

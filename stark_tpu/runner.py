@@ -144,6 +144,7 @@ def sample_until_converged(
     progress_cb: Optional[Any] = None,
     time_budget_s: Optional[float] = None,
     adapt_path: Optional[str] = None,
+    adapt_export_path: Optional[str] = None,
     adapt_touchup_frac: float = 0.2,
     **cfg_kwargs,
 ) -> AdaptiveResult:
@@ -205,12 +206,35 @@ def sample_until_converged(
             f"{type(backend).__name__} does not support the adaptive "
             "runner (no adaptive_parts); use JaxBackend or ShardedBackend"
         )
+    # multi-process meshes: every process drives identical blocks on its
+    # shard of the chains and (after the collect allgather) holds
+    # identical host state, so each writes its own state files — shared
+    # filesystems must not race on one path (real pods write per-host
+    # anyway).  rank_path is identity in single-process runs.
+    from .checkpoint import rank_path
+
+    checkpoint_path = rank_path(checkpoint_path)
+    resume_from = rank_path(resume_from)
+    metrics_path = rank_path(metrics_path)
+    draw_store_path = rank_path(draw_store_path)
+    adapt_path = rank_path(adapt_path)
+    # export target may differ from the import candidate so a caller can
+    # import a pinned (committed) artifact while cold-start exports land
+    # in an untracked cache — the runner then structurally CANNOT dirty
+    # the pinned file, even if its own validation rejects what the
+    # caller's pre-check accepted (file changed between the two loads)
+    adapt_export_path = rank_path(adapt_export_path) or adapt_path
+
     # fingerprint the CALLER's data before `data` is rebound to the
     # prepared/sharded form below: the adaptation-artifact contract is
     # keyed on what the caller passed, so bench.py (which holds the same
     # raw pytree) computes the identical fingerprint when deciding
     # whether the import will be accepted
-    adapt_fp = data_fingerprint(data) if adapt_path else None
+    adapt_fp = (
+        data_fingerprint(data)
+        if (adapt_path or adapt_export_path)
+        else None
+    )
     ap = backend.adaptive_parts(model, cfg, data)
     fm, data, extra = ap.fm, ap.data, ap.extra
 
@@ -234,29 +258,33 @@ def sample_until_converged(
             of burning the whole (dominant) warmup budget again."""
             from .checkpoint import save_checkpoint
 
-            arrays = {
+            # ap.collect (gather_draws on a mesh) materializes the
+            # chain-sharded leaves on every host — np.asarray alone
+            # cannot read non-addressable shards on multi-process meshes
+            arrays = ap.collect({
                 # standard names so checkpoint_is_healthy's finite check
                 # covers position/grad/step/mass exactly like sample-phase
-                "z": np.asarray(carry.states.z),
-                "pe": np.asarray(carry.states.potential_energy),
-                "grad": np.asarray(carry.states.grad),
-                "step_size": np.exp(np.asarray(carry.da.log_step)),
-                "inv_mass": np.asarray(carry.inv_mass),
-                "da_log_step": np.asarray(carry.da.log_step),
-                "da_log_avg_step": np.asarray(carry.da.log_avg_step),
-                "da_h_avg": np.asarray(carry.da.h_avg),
-                "da_mu": np.asarray(carry.da.mu),
-                "da_count": np.asarray(carry.da.count),
-                "adam_m": np.asarray(carry.adam.m),
-                "adam_v": np.asarray(carry.adam.v),
-                "adam_t": np.asarray(carry.adam.t),
-                "log_T": np.asarray(carry.log_T),
-                "wf_count": np.asarray(carry.wf.count),
-                "wf_mean": np.asarray(carry.wf.mean),
-                "wf_m2": np.asarray(carry.wf.m2),
-                "key": np.asarray(key),
-                "key_warm": np.asarray(key_warm),
-            }
+                "z": carry.states.z,
+                "pe": carry.states.potential_energy,
+                "grad": carry.states.grad,
+                "inv_mass": carry.inv_mass,
+                "da_log_step": carry.da.log_step,
+                "da_log_avg_step": carry.da.log_avg_step,
+                "da_h_avg": carry.da.h_avg,
+                "da_mu": carry.da.mu,
+                "da_count": carry.da.count,
+                "adam_m": carry.adam.m,
+                "adam_v": carry.adam.v,
+                "adam_t": carry.adam.t,
+                "log_T": carry.log_T,
+                "wf_count": carry.wf.count,
+                "wf_mean": carry.wf.mean,
+                "wf_m2": carry.wf.m2,
+            })
+            arrays["step_size"] = np.exp(arrays["da_log_step"])
+            # PRNG keys are host-side driver state, never mesh-sharded
+            arrays["key"] = np.asarray(key)
+            arrays["key_warm"] = np.asarray(key_warm)
             if health_check:
                 # a poisoned adaptation carry must never land on disk
                 # (the load-side check in supervise covers old files)
@@ -364,7 +392,7 @@ def sample_until_converged(
                       "reason": "non-finite warmup state"})
                 return
             save_checkpoint(
-                adapt_path,
+                adapt_export_path,
                 {
                     "z": leaves[0],
                     "log_eps": leaves[1],
@@ -439,7 +467,9 @@ def sample_until_converged(
             "event": "warmup_done",
             "wall_s": time.perf_counter() - t_start,
             "num_divergent": int(n_div_total),
-            "step_size": np.asarray(step_size).tolist(),
+            # per-chain kernels carry chain-sharded step sizes: collect
+            # (allgather on a multi-process mesh) before reading
+            "step_size": np.asarray(ap.collect(step_size)).tolist(),
         }
         if warmup_grads is not None:
             rec["warmup_grad_evals"] = int(warmup_grads)
@@ -614,7 +644,7 @@ def sample_until_converged(
             state = run_carry.states
             step_size = jnp.exp(run_carry.log_eps)
             inv_mass = run_carry.inv_mass
-            if adapt_path and warm_import is None:
+            if adapt_export_path and warm_import is None:
                 # populate the reuse cache from a FULL warmup only.  A
                 # successful import leaves the artifact byte-identical: a
                 # judged capture must not dirty committed artifacts
@@ -622,7 +652,7 @@ def sample_until_converged(
                 # state with the touch-up's slightly re-tuned eps would
                 # trade provenance for noise.
                 save_adapt(run_carry)
-            elif adapt_path:
+            elif adapt_export_path:
                 emit({"event": "adapt_export_skipped", "reason": "imported"})
         else:
             if init_params is not None:
@@ -636,6 +666,7 @@ def sample_until_converged(
             state, step_size, inv_mass, n_div = seg_warmup(
                 warm_keys, z0, data, block_size
             )
+            n_div = ap.collect(n_div)  # per-chain counts are chain-sharded
         # chees: ensemble gradient evals spent before sampling — MAP
         # descent (one fused gradient per Adam step per chain) + warm
         # leapfrogs; per-chain kernels have no shared-budget equivalent
@@ -686,20 +717,24 @@ def sample_until_converged(
                 state = run_carry.states
                 step_size = jnp.exp(run_carry.log_eps)
                 inv_mass = run_carry.inv_mass
-                # n_leap is the SHARED per-transition trajectory length;
-                # the ensemble total is chains x that (chees.py convention)
+                # chain-sharded outputs cross to host via collect (an
+                # allgather on multi-process meshes); n_leap is the SHARED
+                # per-transition trajectory length (replicated), and the
+                # ensemble total is chains x that (chees.py convention)
+                zs, accept, divergent = ap.collect((zs, accept, divergent))
                 return (
                     np.asarray(zs).transpose(1, 0, 2), accept, divergent,
                     int(np.sum(np.asarray(n_leap))) * chains,
                 )
-            block_keys = jax.random.split(key_block, chains)
+            block_keys = ap.put_chains(jax.random.split(key_block, chains))
             out = jax.block_until_ready(
                 v_block(block_keys, state, step_size, inv_mass, data)
             )
             state, zs, accept, divergent, _energy, ngrad = out
-            return np.asarray(zs), accept, divergent, int(
-                np.sum(np.asarray(ngrad))
+            zs, accept, divergent, ngrad = ap.collect(
+                (zs, accept, divergent, ngrad)
             )
+            return np.asarray(zs), accept, divergent, int(np.sum(ngrad))
 
         while blocks_done < max_blocks:
             key, key_block = jax.random.split(key)
@@ -717,13 +752,13 @@ def sample_until_converged(
                 from .supervise import check_finite_state
 
                 check_finite_state(
-                    {
-                        "z": np.asarray(state.z),
-                        "pe": np.asarray(state.potential_energy),
-                        "grad": np.asarray(state.grad),
-                        "step_size": np.asarray(step_size),
-                        "inv_mass": np.asarray(inv_mass),
-                    }
+                    ap.collect({
+                        "z": state.z,
+                        "pe": state.potential_energy,
+                        "grad": state.grad,
+                        "step_size": step_size,
+                        "inv_mass": inv_mass,
+                    })
                 )
             blocks_done += 1
             draw_blocks.append(np.asarray(zs))  # (chains, block, d)
@@ -817,14 +852,14 @@ def sample_until_converged(
             if checkpoint_path:
                 from .checkpoint import save_checkpoint
 
-                arrays = {
-                    "z": np.asarray(state.z),
-                    "pe": np.asarray(state.potential_energy),
-                    "grad": np.asarray(state.grad),
-                    "step_size": np.asarray(step_size),
-                    "inv_mass": np.asarray(inv_mass),
-                    "key": np.asarray(key),
-                }
+                arrays = ap.collect({
+                    "z": state.z,
+                    "pe": state.potential_energy,
+                    "grad": state.grad,
+                    "step_size": step_size,
+                    "inv_mass": inv_mass,
+                })
+                arrays["key"] = np.asarray(key)  # host driver state
                 if is_chees:
                     arrays["log_eps"] = np.asarray(run_carry.log_eps)
                     arrays["log_T"] = np.asarray(run_carry.log_T)

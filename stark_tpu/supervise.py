@@ -88,6 +88,14 @@ def checkpoint_is_healthy(path: str) -> bool:
         return False
 
 
+def _ranks_agree(all_done) -> bool:
+    """True iff every rank reported a healthy checkpoint at the SAME
+    (phase, progress) — the resume-consistency rule for multi-process
+    supervision (see ``agree_resume`` inside `supervised_sample`)."""
+    a = np.asarray(all_done).reshape(-1, 2)
+    return bool((a[:, 0] >= 0).all() and (a == a[0]).all())
+
+
 def supervised_sample(
     model: Model,
     data: Any = None,
@@ -119,11 +127,17 @@ def supervised_sample(
     )
 
     os.makedirs(workdir, exist_ok=True)
-    ckpt_path = os.path.join(workdir, "chain.ckpt.npz")
-    metrics_path = kwargs.pop(
-        "metrics_path", os.path.join(workdir, "metrics.jsonl")
+    # per-process file names on multi-process meshes (idempotent — the
+    # runner applies the same mapping to whatever paths it receives, so
+    # supervisor-side health checks and runner-side writes agree)
+    from .checkpoint import rank_path
+
+    ckpt_path = rank_path(os.path.join(workdir, "chain.ckpt.npz"))
+    metrics_path = rank_path(
+        kwargs.pop("metrics_path", os.path.join(workdir, "metrics.jsonl"))
     )
     kwargs.setdefault("draw_store_path", os.path.join(workdir, "draws.stkr"))
+    kwargs["draw_store_path"] = rank_path(kwargs["draw_store_path"])
     kwargs.setdefault("health_check", True)
 
     store_path = kwargs.get("draw_store_path")
@@ -138,6 +152,50 @@ def supervised_sample(
             dst = f"{path}.bad{n}"
         os.replace(path, dst)
 
+    def agree_resume(resume: Optional[str]) -> Optional[str]:
+        """Cross-rank agreement on resume-vs-cold-start (multi-process).
+
+        Each rank reads only ITS per-rank checkpoint; a kill between two
+        ranks' checkpoint renames (atomic per file, not across ranks)
+        leaves blocks_done skewed by one, and skewed resumes would issue
+        different numbers of collective-bearing blocks — the pod then
+        hangs on an unmatched allgather.  Rule: resume ONLY when every
+        rank holds a healthy checkpoint with the SAME blocks_done;
+        otherwise all ranks cold-start in lockstep.  The skew window is
+        one checkpoint rename per block, so losing it costs (rarely) one
+        attempt's progress, never correctness.
+        """
+        import jax
+
+        if jax.process_count() == 1:
+            return resume
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        # (phase, progress): warmup checkpoints count warm_done segments,
+        # sample-phase ones count blocks_done — compare both so a
+        # warmup-2 file never falsely agrees with a blocks-2 one
+        done = (-1, -1)
+        if resume is not None:
+            try:
+                _, meta = load_checkpoint(resume)
+                warm = meta.get("phase") == "warmup"
+                done = (
+                    0 if warm else 1,
+                    int(meta["warm_done"] if warm
+                        else meta.get("blocks_done", 0)),
+                )
+            except Exception:  # noqa: BLE001 — unreadable: treat as cold
+                done = (-1, -1)
+        all_done = multihost_utils.process_allgather(np.array(done))
+        if _ranks_agree(all_done):
+            return resume
+        if resume is not None:
+            # healthy but unusable (a peer is cold or skewed): quarantine
+            # so the stale state can't mix into the cold restart
+            quarantine(resume)
+        return None
+
     attempt = 0
     while True:
         resume: Optional[str] = None
@@ -147,6 +205,7 @@ def supervised_sample(
             else:
                 # corrupt/poisoned checkpoint: quarantine it and cold-start
                 quarantine(ckpt_path)
+        resume = agree_resume(resume)
         if resume is None and store_path and os.path.exists(store_path):
             # cold start: draws persisted by a discarded run must not mix
             # into this run's store (a later resume reads the whole store)

@@ -238,3 +238,19 @@ def test_supervised_gives_up_after_max_restarts(tmp_path, monkeypatch):
         )
     lines = [json.loads(l) for l in open(os.path.join(wd, "metrics.jsonl"))]
     assert sum(1 for l in lines if l["event"] == "restart") == 3
+
+
+def test_ranks_agree_rule():
+    """Multi-process resume consistency (VERDICT r4 #3 follow-up): resume
+    only when every rank holds a healthy checkpoint at the same
+    (phase, progress); any cold, unreadable, or skewed rank cold-starts
+    the whole pod in lockstep."""
+    from stark_tpu.supervise import _ranks_agree
+
+    assert _ranks_agree([(1, 3), (1, 3)])          # same sample-phase block
+    assert _ranks_agree([(0, 2), (0, 2)])          # same warmup segment
+    assert not _ranks_agree([(1, 3), (1, 2)])      # one-block skew
+    assert not _ranks_agree([(0, 2), (1, 2)])      # warmup vs sample phase
+    assert not _ranks_agree([(-1, -1), (1, 3)])    # one rank cold
+    assert not _ranks_agree([(-1, -1), (-1, -1)])  # all cold
+    assert _ranks_agree([(1, 5)])                  # degenerate single rank
