@@ -78,16 +78,20 @@ def _cmd_bench_all(args) -> int:
         try:
             res = ALL_BENCHMARKS[name]()
             print(res.row(), file=sys.stderr)
-            converged = "yes" if res.max_rhat < 1.01 else "no"
+            # the headline column names its own metric and the pass
+            # column names its own gate (VERDICT r4 #4: the BNN's
+            # defensible metric is predictive accuracy + pred-ESS/s; its
+            # R-hat stays as a diagnostic with the mode-structure note)
+            passed = "yes" if res.passed() else "no"
             notes = "; ".join(
                 f"{k}={res.extra[k]:.3g}" if isinstance(res.extra[k], float)
                 else f"{k}={res.extra[k]}"
                 for k in _NOTE_KEYS if k in res.extra
             ) or "—"
             rows.append(
-                f"| {res.name} | {res.ess_per_sec:.2f} | {res.min_ess:.0f} | "
-                f"{res.wall_s:.1f} | {res.max_rhat:.3f} | {converged} | "
-                f"{notes} |"
+                f"| {res.name} | {res.ess_per_sec:.2f} {res.metric_name} | "
+                f"{res.min_ess:.0f} | {res.wall_s:.1f} | {res.max_rhat:.3f} | "
+                f"{passed} ({res.gate}) | {notes} |"
             )
         except Exception as e:  # noqa: BLE001 — record partial results
             print(f"{name}: FAILED {e!r}", file=sys.stderr)
@@ -105,7 +109,8 @@ def _cmd_bench_all(args) -> int:
             "i.e. wall to the final R-hat in the table; ESS/s = min-ESS/wall.",
             "The LATEST table in this file is the authoritative one.",
             "",
-            "| benchmark | ESS/s | min ESS | wall (s) | max R-hat | R-hat<1.01 | notes |",
+            "| benchmark | headline | min ESS | wall (s) | max R-hat "
+            "(diagnostic) | converged (gate) | notes |",
             "|---|---|---|---|---|---|---|",
             *rows,
             "",
