@@ -42,7 +42,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from .logistic_fused import _LOG_2PI, _default_lane_tile, _link_parts
+from .logistic_fused import (
+    _LOG_2PI,
+    _default_lane_tile,
+    _dot_precision,
+    _link_parts,
+)
 
 # Hard cap on the padded groups-per-tile: above this the one-hot slab and
 # the MXU extra work stop being negligible next to the X stream, and the
@@ -157,6 +162,7 @@ def _check_chain_vmem(cpad, lane_tile, interpret, k_loc=0, q=1):
 def _make_grouped_kernel(n, lane_tile, k_loc, link):
     def kernel(xt_ref, y_ref, gl_ref, beta_ref, alpha_ref,
                val_ref, gbeta_ref, galpha_ref):
+        prec = _dot_precision()  # STARK_FUSED_PRECISION (see logistic_fused)
         lane0 = pl.program_id(0) * lane_tile
         iota = jax.lax.broadcasted_iota(jnp.int32, (1, lane_tile), 1)
         mask = lane0 + iota < n  # (1, TILE)
@@ -171,20 +177,20 @@ def _make_grouped_kernel(n, lane_tile, k_loc, link):
         krows = jax.lax.broadcasted_iota(jnp.int32, (k_loc, lane_tile), 0)
         onehot = jnp.where(krows == gl, 1.0, 0.0)  # (K_LOC, TILE)
         logits = jax.lax.dot(
-            beta, xt, precision=jax.lax.Precision.HIGHEST,
+            beta, xt, precision=prec,
             preferred_element_type=jnp.float32,
         ) + jax.lax.dot(
-            alpha, onehot, precision=jax.lax.Precision.HIGHEST,
+            alpha, onehot, precision=prec,
             preferred_element_type=jnp.float32,
         )  # (C, TILE) — both MXU; offsets never touch HBM
         val_terms, resid = _link_parts(link, y, logits, mask)  # (C, TILE)
         val_ref[...] = jnp.sum(val_terms, axis=1)[None, :, None]
         gbeta_ref[...] = jax.lax.dot(
-            resid, xt.T, precision=jax.lax.Precision.HIGHEST,
+            resid, xt.T, precision=prec,
             preferred_element_type=jnp.float32,
         )[None]  # (1, C, D)
         galpha_ref[...] = jax.lax.dot(
-            resid, onehot.T, precision=jax.lax.Precision.HIGHEST,
+            resid, onehot.T, precision=prec,
             preferred_element_type=jnp.float32,
         )[None]  # (1, C, K_LOC) — the group-gradient partials
 
@@ -351,6 +357,7 @@ hier_logistic_loglik.defvjp(_hier_fwd, _hier_bwd)
 def _make_grouped_lmm_kernel(n, lane_tile, k_loc, q):
     def kernel(xt_ref, zt_ref, y_ref, gl_ref, beta_ref, ic_ref, u_ref,
                acc_ref, gbeta_ref, gu_ref):
+        prec = _dot_precision()  # STARK_FUSED_PRECISION (see logistic_fused)
         lane0 = pl.program_id(0) * lane_tile
         iota = jax.lax.broadcasted_iota(jnp.int32, (1, lane_tile), 1)
         mask = lane0 + iota < n
@@ -364,13 +371,13 @@ def _make_grouped_lmm_kernel(n, lane_tile, k_loc, q):
         krows = jax.lax.broadcasted_iota(jnp.int32, (k_loc, lane_tile), 0)
         onehot = jnp.where(krows == gl, 1.0, 0.0)  # (K_LOC, TILE)
         mu = ic + jax.lax.dot(
-            beta, xt, precision=jax.lax.Precision.HIGHEST,
+            beta, xt, precision=prec,
             preferred_element_type=jnp.float32,
         )  # (C, TILE)
         for j in range(q):  # static unroll: Q is 2-3
             uq = u[:, j * k_loc : (j + 1) * k_loc]  # (C, K_LOC)
             mu = mu + jax.lax.dot(
-                uq, onehot, precision=jax.lax.Precision.HIGHEST,
+                uq, onehot, precision=prec,
                 preferred_element_type=jnp.float32,
             ) * zt[j : j + 1, :]
         resid = jnp.where(mask, y - mu, 0.0)  # (C, TILE)
@@ -378,13 +385,13 @@ def _make_grouped_lmm_kernel(n, lane_tile, k_loc, q):
         sresid = jnp.sum(resid, axis=1)  # (C,) — the intercept gradient
         acc_ref[...] = jnp.stack([ssr, sresid], axis=-1)[None]  # (1, C, 2)
         gbeta_ref[...] = jax.lax.dot(
-            resid, xt.T, precision=jax.lax.Precision.HIGHEST,
+            resid, xt.T, precision=prec,
             preferred_element_type=jnp.float32,
         )[None]
         parts = [
             jax.lax.dot(
                 resid * zt[j : j + 1, :], onehot.T,
-                precision=jax.lax.Precision.HIGHEST,
+                precision=prec,
                 preferred_element_type=jnp.float32,
             )
             for j in range(q)

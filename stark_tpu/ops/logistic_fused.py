@@ -59,6 +59,34 @@ def _default_lane_tile(d: int) -> int:
     return max(128, min(_LANE_TILE, (_SLAB_BUDGET_ELEMS // max(d, 1)) // 128 * 128))
 
 
+def _dot_precision():
+    """MXU precision for the fused kernels' dots (STARK_FUSED_PRECISION).
+
+    f32 matmuls on the TPU MXU are EMULATED in bf16 passes: DEFAULT is
+    one pass (inputs truncated to bf16), HIGH three passes (~f32-accurate),
+    HIGHEST six.  The grouped hierarchical kernel runs four dots per tile
+    over a stream one-third the offset kernel's, so at HIGHEST it is
+    MXU-pass-bound, not HBM-bound (pass-count arithmetic + the measured
+    65 GB/s effective rate, BASELINE.md r5) — the knob exists so the
+    on-chip roofline can measure the precision/throughput trade and the
+    sampler can adopt the cheapest setting whose posterior matches.
+    Default stays HIGHEST: numerics never change silently.
+    """
+    import os
+
+    name = os.environ.get("STARK_FUSED_PRECISION", "highest").lower()
+    try:
+        return {
+            "highest": jax.lax.Precision.HIGHEST,
+            "high": jax.lax.Precision.HIGH,
+            "default": jax.lax.Precision.DEFAULT,
+        }[name]
+    except KeyError:
+        raise ValueError(
+            f"STARK_FUSED_PRECISION={name!r}: use highest|high|default"
+        ) from None
+
+
 def _link_parts(link, y, logits, mask):
     """Per-link elementwise math shared by both tile kernels.
 
@@ -134,14 +162,16 @@ def _make_batched_kernel(n, lane_tile, with_offset, link):
         xt = jnp.where(mask, xt_ref[...], 0.0)  # (D, TILE)
         y = jnp.where(mask, y_ref[...], 0.0)  # (1, TILE)
         beta = beta_ref[...]  # (C, D)
-        # explicit HIGHEST: never depend on the global matmul-precision
-        # default — bf16 input truncation here would silently give the
-        # batched path different numerics than the single-chain VPU path.
+        # explicit precision (HIGHEST unless STARK_FUSED_PRECISION says
+        # otherwise): never depend on the global matmul-precision default
+        # — bf16 input truncation here would silently give the batched
+        # path different numerics than the single-chain VPU path.
         # (The add of a non-constant offset AFTER a complete dot lowers
         # fine on Mosaic — verified on-chip; the header's accumulator
         # caveat applies to accumulating INTO the dot.)
+        prec = _dot_precision()
         logits = jax.lax.dot(
-            beta, xt, precision=jax.lax.Precision.HIGHEST,
+            beta, xt, precision=prec,
             preferred_element_type=jnp.float32,
         )  # (C, TILE) — MXU
         if off_ref is not None:
@@ -152,7 +182,7 @@ def _make_batched_kernel(n, lane_tile, with_offset, link):
             resid_ref[...] = resid
         # (C, TILE) x (TILE, D) -> (C, D) — second MXU pass, in-VMEM
         grad_ref[...] = jax.lax.dot(
-            resid, xt.T, precision=jax.lax.Precision.HIGHEST,
+            resid, xt.T, precision=prec,
             preferred_element_type=jnp.float32,
         )[None]
 

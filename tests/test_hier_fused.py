@@ -223,3 +223,28 @@ def test_grouped_fallback_on_degenerate_grouping():
     }
     v = grp.log_lik(params, gdata)
     assert np.isfinite(np.asarray(v))
+
+
+def test_fused_precision_knob(monkeypatch):
+    """STARK_FUSED_PRECISION selects the MXU dot precision (the on-chip
+    lever for the MXU-pass-bound grouped kernel, BASELINE.md r5); on CPU
+    the three settings are numerically identical (f32 dots are exact
+    there), and an invalid value fails loudly at kernel build."""
+    import pytest
+
+    from stark_tpu.ops.logistic_fused import _dot_precision
+    import jax
+
+    monkeypatch.delenv("STARK_FUSED_PRECISION", raising=False)
+    assert _dot_precision() == jax.lax.Precision.HIGHEST  # default
+    for name, want in (
+        ("highest", jax.lax.Precision.HIGHEST),
+        ("high", jax.lax.Precision.HIGH),
+        ("default", jax.lax.Precision.DEFAULT),
+        ("HIGH", jax.lax.Precision.HIGH),  # case-insensitive
+    ):
+        monkeypatch.setenv("STARK_FUSED_PRECISION", name)
+        assert _dot_precision() == want
+    monkeypatch.setenv("STARK_FUSED_PRECISION", "fast")
+    with pytest.raises(ValueError, match="highest|high|default"):
+        _dot_precision()

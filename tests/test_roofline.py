@@ -47,3 +47,15 @@ def test_committed_artifact_has_no_unflagged_impossible_rows():
             continue
         assert row["per_dispatch_gbs"] <= V5E_PEAK_GBS, row
         assert row["amortized_gbs"] <= V5E_PEAK_GBS, row
+
+
+def test_committed_artifact_grouped_rows_gated():
+    """Grouped-kernel rows (r5) obey the same memoization gate contract
+    when present in the committed artifact."""
+    with open(_RESULTS) as f:
+        results = json.load(f)
+    for row in results.get("grouped", []):
+        if row.get("invalid_memoized"):
+            continue
+        assert row["amortized_gbs"] <= V5E_PEAK_GBS, row
+        assert row["case"].startswith("grouped"), row

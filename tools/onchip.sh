@@ -19,6 +19,14 @@ set -ex
 
 # 1. kernel roofline (memoization-gated methodology; rows above spec peak
 #    are retried and otherwise tagged invalid) -> tools/roofline_results.json
+#    r5: now includes the GROUPED kernel (the flagship's own kernel) with
+#    attribution cases — grouped_full vs grouped_gather_hoist (alpha-window
+#    gather cost) vs grouped_prec_high/default (MXU-pass cost of f32
+#    emulation: HIGHEST=6 bf16 passes, HIGH=3, DEFAULT=1).  The pass-count
+#    arithmetic (BASELINE.md r5) predicts the grouped kernel is MXU-bound
+#    at HIGHEST; if grouped_prec_high cuts the eval materially, compare
+#    posteriors (step 3 with STARK_FUSED_PRECISION=high, same seed) and
+#    adopt the cheapest precision whose posterior parity holds.
 python tools/roofline.py
 
 # 2. five judged configs -> appends the measured table to BASELINE.md
