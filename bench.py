@@ -639,6 +639,81 @@ def main():
         return
     sampler_tag, ess_per_sec, rhat, converged = picked
 
+    # On a fallback capture the flagship uses under half the 900 s window
+    # (r4: 392 s) — spend the remainder on MORE judged configs so the one
+    # artifact carries several evidence lines, not one (VERDICT r4 #6).
+    # Cheap-at-judged-scale rows only (BASELINE.md r4 CPU-cost notes);
+    # each leg is gated on its measured-cost estimate so the final JSON
+    # line always lands inside the budget.  The consensus leg skips the
+    # combine-accuracy cross-check (its numbers are committed from r4 —
+    # re-measuring the combine would double the leg's wall for no new
+    # information).  BENCH_EXTRA_EVIDENCE=0 opts out (tiny-scale tests).
+    extra_evidence = []
+    if (
+        fell_back
+        and time_budget
+        and os.environ.get("BENCH_EXTRA_EVIDENCE", "1") == "1"
+    ):
+        from stark_tpu import benchmarks as bmarks
+
+        def _fin(v, nd):
+            # same strict-JSON rule as the flagship fields below: a stuck
+            # component's NaN must become null, never a bare NaN token
+            # that invalidates the whole artifact line
+            return round(v, nd) if math.isfinite(v) else None
+
+        def res_row(res):
+            row = {
+                "benchmark": res.name,
+                "value": _fin(res.ess_per_sec, 3) or 0.0,
+                "metric": res.metric_name,
+                "min_ess": _fin(res.min_ess, 1),
+                "wall_s": round(res.wall_s, 1),
+                "max_rhat": _fin(res.max_rhat, 4),
+                "converged": res.passed() and math.isfinite(res.ess_per_sec),
+                "gate": res.gate,
+            }
+            row.update({
+                k: (_fin(v, 4) if isinstance(v, float) else v)
+                for k, v in res.extra.items()
+            })
+            return row
+
+        legs = (
+            ("eight_schools", bmarks.bench_eight_schools, 25.0),
+            ("bnn_sghmc", bmarks.bench_bnn_sghmc, 130.0),
+            (
+                "consensus_logistic",
+                lambda: bmarks.bench_consensus_logistic(combine_check=False),
+                320.0,
+            ),
+        )
+        for leg_name, leg_fn, est in legs:
+            elapsed = time.perf_counter() - t_bench
+            if elapsed + est > time_budget * 0.95:
+                print(
+                    f"[bench] extra evidence {leg_name} skipped: est "
+                    f"{est:.0f}s past the {time_budget:.0f}s budget "
+                    f"(elapsed {elapsed:.0f}s)",
+                    file=sys.stderr,
+                )
+                continue
+            try:
+                t0x = time.perf_counter()
+                r = leg_fn()
+                extra_evidence.append(res_row(r))
+                print(
+                    f"[bench] extra evidence {leg_name}: "
+                    f"{r.ess_per_sec:.2f} {r.metric_name} "
+                    f"(leg wall {time.perf_counter() - t0x:.0f}s)",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 — evidence, not the metric
+                print(
+                    f"[bench] extra evidence {leg_name} failed: {e!r}",
+                    file=sys.stderr,
+                )
+
     vs_baseline = ess_per_sec / max(cpu_eps_at_n * executors, 1e-12)
     # strict JSON even when diagnostics go non-finite (stuck components
     # propagate NaN through min_ess/max_rhat): non-finite -> null / 0.0,
@@ -673,6 +748,10 @@ def main():
                 "accelerator_fallback": fell_back,
                 "time_budget_s": time_budget or None,
                 "budget_exhausted": budget_hit,
+                **(
+                    {"extra_evidence": extra_evidence}
+                    if extra_evidence else {}
+                ),
                 "wall_s": round(time.perf_counter() - t_bench, 1),
             }
         ),

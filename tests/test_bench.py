@@ -119,6 +119,8 @@ _TINY_BENCH_ENV = {
     # never litter the repo root with tiny-scale adaptation artifacts
     # (the committed capture-scale artifact must stay pristine)
     "BENCH_ADAPT_REUSE": "0",
+    # judged-scale extra-evidence legs don't belong in tiny-scale tests
+    "BENCH_EXTRA_EVIDENCE": "0",
     "JAX_PLATFORMS": "cpu",
     "PALLAS_AXON_POOL_IPS": "",
     "BENCH_N": "400",
