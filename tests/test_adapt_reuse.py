@@ -177,3 +177,26 @@ def test_load_adapt_state_dataset_fingerprint(tmp_path):
     # no caller fingerprint: legacy accept path still works
     ok, reason = load_adapt_state(p, kernel="chees", model_name="M", ndim=3)
     assert ok is not None and reason is None
+
+
+def test_data_fingerprint_edges():
+    """Fingerprint stability props: order-independent of dict insertion
+    (tree-canonical), sensitive to shape/dtype/content, tolerant of
+    non-buffer leaves."""
+    from stark_tpu.runner import data_fingerprint as fp
+
+    a = {"x": np.ones((4, 2)), "y": np.zeros(4)}
+    b = {"y": np.zeros(4), "x": np.ones((4, 2))}  # same tree, other order
+    assert fp(a) == fp(b)
+    assert fp(a) != fp({"x": np.ones((2, 4)), "y": np.zeros(4)})  # shape
+    assert fp(a) != fp({"x": np.ones((4, 2), np.float32), "y": np.zeros(4)})
+    assert fp(a) != fp({"x": np.ones((4, 2)), "y": np.zeros(4) + 1e-9})
+    # non-buffer leaf falls back to repr hashing, no crash
+    assert isinstance(fp({"x": np.ones(3), "meta": object()}), str)
+    # large leaf: the strided 64 KiB sample is deterministic (equal copies
+    # fingerprint equal) and still catches whole-array shifts; a SINGLE
+    # interior element between sample points can legitimately be missed —
+    # the guard targets wrong-dataset imports, not bit-flip detection
+    big = np.arange(1_000_000, dtype=np.float64)
+    assert fp({"x": big}) == fp({"x": big.copy()})
+    assert fp({"x": big}) != fp({"x": big + 1.0})

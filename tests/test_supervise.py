@@ -254,3 +254,22 @@ def test_ranks_agree_rule():
     assert not _ranks_agree([(-1, -1), (1, 3)])    # one rank cold
     assert not _ranks_agree([(-1, -1), (-1, -1)])  # all cold
     assert _ranks_agree([(1, 5)])                  # degenerate single rank
+
+
+def test_rank_path_single_process_identity_and_idempotence(monkeypatch):
+    """rank_path: identity single-process; per-rank suffix inserted once
+    (supervisor and runner both apply it) on multi-process runs."""
+    import jax
+
+    from stark_tpu.checkpoint import rank_path
+
+    assert rank_path(None) is None
+    assert rank_path("a/b.npz") == "a/b.npz"  # process_count() == 1
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    p = rank_path("a/b.npz")
+    assert p == "a/b.p1.npz"
+    assert rank_path(p) == p  # idempotent
+    assert rank_path("noext") == "noext.p1"
+    assert rank_path(None) is None

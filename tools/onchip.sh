@@ -24,10 +24,15 @@ set -ex
 #    gather cost) vs grouped_prec_high/default (MXU-pass cost of f32
 #    emulation: HIGHEST=6 bf16 passes, HIGH=3, DEFAULT=1).  The pass-count
 #    arithmetic (BASELINE.md r5) predicts the grouped kernel is MXU-bound
-#    at HIGHEST; if grouped_prec_high cuts the eval materially, compare
-#    posteriors (step 3 with STARK_FUSED_PRECISION=high, same seed) and
-#    adopt the cheapest precision whose posterior parity holds.
+#    at HIGHEST; if grouped_prec_high cuts the eval materially, run
+#    tools/precision_parity.py (below) and adopt the cheapest precision
+#    whose posterior parity holds.
 python tools/roofline.py
+
+# 1b. precision parity: same grouped config at highest vs high, same
+#     seed; adopt=high when max posterior-mean delta < 0.1 sd and both
+#     converge -> then re-run step 3 with STARK_FUSED_PRECISION=high
+python tools/precision_parity.py high
 
 # 2. five judged configs -> appends the measured table to BASELINE.md
 #    (r4: table now carries the BNN predictive_accuracy/pred-ESS and the

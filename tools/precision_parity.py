@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Posterior parity check for the fused-kernel dot-precision lever.
+
+BASELINE.md r5's pass-count analysis predicts the grouped hierarchical
+kernel is MXU-pass-bound at f32 HIGHEST (6 bf16 passes per dot), making
+``STARK_FUSED_PRECISION=high|default`` worth ~1.6x/2.6x flagship
+throughput — IF the posterior is unchanged.  This script is that check:
+it runs the same grouped-model ChEES config at ``highest`` and at a
+candidate precision (same seed, same data), then reports
+
+  * per-coordinate posterior-mean delta in posterior-sd units (max/mean)
+  * posterior-sd ratio (candidate / highest)
+  * both runs' convergence diagnostics
+
+Adoption rule (printed with the result): adopt the candidate when the
+max mean-delta is under 0.1 sd — an order of magnitude inside MC error
+at judged ESS — and both runs converge.  Runs on-chip after
+``tools/onchip.sh`` step 1; ``PARITY_N`` etc. shrink it for CPU smokes.
+
+Usage:  STARK candidate:  python tools/precision_parity.py high
+        (writes tools/precision_parity.json and prints a summary)
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+N = int(os.environ.get("PARITY_N", 200_000))
+D = int(os.environ.get("PARITY_D", 32))
+G = int(os.environ.get("PARITY_G", 1000))
+CHAINS = int(os.environ.get("PARITY_CHAINS", 32))
+WARMUP = int(os.environ.get("PARITY_WARMUP", 300))
+SAMPLES = int(os.environ.get("PARITY_SAMPLES", 300))
+
+
+def run_at(precision, model, data):
+    import numpy as np
+
+    import stark_tpu
+
+    os.environ["STARK_FUSED_PRECISION"] = precision
+    try:
+        post = stark_tpu.sample(
+            model, data, chains=CHAINS, kernel="chees",
+            num_warmup=WARMUP, num_samples=SAMPLES,
+            init_step_size=0.1, map_init_steps=200, seed=0,
+        )
+    finally:
+        os.environ.pop("STARK_FUSED_PRECISION", None)
+    flat = np.asarray(post.draws_flat, np.float64)
+    return {
+        "mean": flat.mean(axis=(0, 1)),
+        "sd": flat.std(axis=(0, 1)),
+        "max_rhat": float(post.max_rhat()),
+        "min_ess": float(post.min_ess()),
+    }
+
+
+def main():
+    candidate = sys.argv[1] if len(sys.argv) > 1 else "high"
+    import jax
+    import numpy as np
+
+    from stark_tpu.models import FusedHierLogisticGrouped, synth_logistic_data
+
+    print(
+        f"[parity] grouped model N={N} D={D} G={G} C={CHAINS}; "
+        f"highest vs {candidate}",
+        file=sys.stderr,
+    )
+    model = FusedHierLogisticGrouped(num_features=D, num_groups=G)
+    data, _ = synth_logistic_data(jax.random.PRNGKey(0), N, D, num_groups=G)
+
+    base = run_at("highest", model, data)
+    cand = run_at(candidate, model, data)
+
+    sd = np.maximum(base["sd"], 1e-12)
+    delta = np.abs(cand["mean"] - base["mean"]) / sd
+    sd_ratio = cand["sd"] / sd
+    out = {
+        "platform": jax.devices()[0].platform,
+        "n": N, "d": D, "g": G, "chains": CHAINS,
+        "candidate": candidate,
+        "max_mean_delta_sd": float(delta.max()),
+        "mean_mean_delta_sd": float(delta.mean()),
+        "sd_ratio_minmax": [float(sd_ratio.min()), float(sd_ratio.max())],
+        "highest": {k: base[k] for k in ("max_rhat", "min_ess")},
+        candidate: {k: cand[k] for k in ("max_rhat", "min_ess")},
+        "adopt": bool(
+            delta.max() < 0.1
+            and base["max_rhat"] < 1.01
+            and cand["max_rhat"] < 1.01
+        ),
+    }
+    # CPU smokes validate the harness, not the chip (f32 dots are exact
+    # on CPU, so delta is trivially 0): keep them off the on-chip
+    # artifact path, mirroring tools/roofline.py
+    name = (
+        "precision_parity.json"
+        if out["platform"] != "cpu"
+        else "precision_parity_smoke.json"
+    )
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+    print(
+        f"[parity] adopt={out['adopt']} (rule: max mean-delta "
+        f"{out['max_mean_delta_sd']:.4f} < 0.1 sd and both converged)",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()
