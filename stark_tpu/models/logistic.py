@@ -102,8 +102,12 @@ def _transpose_x(data):
     row axis on full-width TPU lanes (see ops/logistic_fused.py)."""
     if "xT" in data:
         return data
+    from ..ops.logistic_fused import _x_stream_dtype
+
     out = {k: v for k, v in data.items() if k != "x"}
-    out["xT"] = jnp.asarray(data["x"]).T
+    # storage dtype per STARK_FUSED_X_DTYPE (bf16 halves the X stream;
+    # kernels cast back to f32 in-register — see ops/logistic_fused.py)
+    out["xT"] = jnp.asarray(data["x"]).T.astype(_x_stream_dtype())
     return out
 
 

@@ -47,6 +47,8 @@ from .logistic_fused import (
     _default_lane_tile,
     _dot_precision,
     _link_parts,
+    _stream_arg,
+    _x_stream_dtype,
 )
 
 # Hard cap on the padded groups-per-tile: above this the one-hot slab and
@@ -123,8 +125,9 @@ def prepare_grouped(data, d_eff, transpose_keys=("x",)):
         for k, v in data.items()
         if k not in transpose_keys
     }
+    xdt = _x_stream_dtype()
     for k in transpose_keys:
-        out[k + "T"] = jnp.asarray(np.asarray(data[k])[order].T)
+        out[k + "T"] = jnp.asarray(np.asarray(data[k])[order].T).astype(xdt)
     out["gl"] = jnp.asarray(gl)
     out["first_gid"] = jnp.asarray(first_gid)
     # static window size and lane tile ride in SHAPES (never values)
@@ -166,7 +169,7 @@ def _make_grouped_kernel(n, lane_tile, k_loc, link):
         lane0 = pl.program_id(0) * lane_tile
         iota = jax.lax.broadcasted_iota(jnp.int32, (1, lane_tile), 1)
         mask = lane0 + iota < n  # (1, TILE)
-        xt = jnp.where(mask, xt_ref[...], 0.0)  # (D, TILE)
+        xt = jnp.where(mask, xt_ref[...].astype(jnp.float32), 0.0)  # (D, TILE)
         y = jnp.where(mask, y_ref[...], 0.0)  # (1, TILE)
         beta = beta_ref[...]  # (C, D)
         alpha = alpha_ref[0]  # (C, K_LOC) — this tile's group window
@@ -227,7 +230,7 @@ def _grouped_call(beta, alpha, xt, y, gl, first_gid, *, k_loc, lane_tile,
         return pl.BlockSpec((height, lane_tile), lambda i: (0, i))
 
     args = [
-        xt.astype(jnp.float32),
+        _stream_arg(xt),
         y.astype(jnp.float32)[None, :],
         gl.astype(jnp.int32)[None, :],
         beta.astype(jnp.float32),
@@ -361,8 +364,8 @@ def _make_grouped_lmm_kernel(n, lane_tile, k_loc, q):
         lane0 = pl.program_id(0) * lane_tile
         iota = jax.lax.broadcasted_iota(jnp.int32, (1, lane_tile), 1)
         mask = lane0 + iota < n
-        xt = jnp.where(mask, xt_ref[...], 0.0)  # (D, TILE)
-        zt = jnp.where(mask, zt_ref[...], 0.0)  # (Q, TILE)
+        xt = jnp.where(mask, xt_ref[...].astype(jnp.float32), 0.0)  # (D, TILE)
+        zt = jnp.where(mask, zt_ref[...].astype(jnp.float32), 0.0)  # (Q, TILE)
         y = jnp.where(mask, y_ref[...], 0.0)  # (1, TILE)
         gl = jnp.where(mask, gl_ref[...], 0)  # (1, TILE)
         beta = beta_ref[...]  # (C, D)
@@ -427,8 +430,8 @@ def _grouped_lmm_call(beta, u, intercept, xt, zt, y, gl, first_gid, *,
         return pl.BlockSpec((height, lane_tile), lambda i: (0, i))
 
     args = [
-        xt.astype(jnp.float32),
-        zt.astype(jnp.float32),
+        _stream_arg(xt),
+        _stream_arg(zt),
         y.astype(jnp.float32)[None, :],
         gl.astype(jnp.int32)[None, :],
         beta.astype(jnp.float32),

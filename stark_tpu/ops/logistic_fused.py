@@ -87,6 +87,48 @@ def _dot_precision():
         ) from None
 
 
+def _x_stream_dtype():
+    """HBM storage dtype for the streamed design matrix
+    (STARK_FUSED_X_DTYPE: f32 default | bf16).
+
+    The X stream is the dominant HBM traffic of every fused kernel
+    (~94% of the grouped kernel's bytes at the flagship shape); bf16
+    halves it — the stream-side lever that compounds with the MXU-side
+    `_dot_precision` lever once the kernel stops being pass-bound.
+    Opt-in because it changes the DATA, not just the arithmetic: X is
+    rounded to bf16 ONCE at prepare time, and the posterior is exactly
+    that of the rounded design matrix (kernels cast back to f32
+    in-register, so all accumulation stays f32).  Adopt via the same
+    parity gate as the precision knob (tools/precision_parity.py with
+    PARITY_X_DTYPE=bf16).  Adaptation-artifact fingerprints key on the
+    CALLER's raw data, so warm starts port across X dtypes — the
+    touch-up re-equilibrates and the convergence gate still validates.
+    """
+    import os
+
+    name = os.environ.get("STARK_FUSED_X_DTYPE", "f32").lower()
+    try:
+        return {
+            "f32": jnp.float32,
+            "float32": jnp.float32,
+            "bf16": jnp.bfloat16,
+            "bfloat16": jnp.bfloat16,
+        }[name]
+    except KeyError:
+        raise ValueError(
+            f"STARK_FUSED_X_DTYPE={name!r}: use f32|bf16"
+        ) from None
+
+
+def _stream_arg(xt):
+    """Pass a design-matrix slab to pallas in its storage dtype (bf16
+    streams halve HBM traffic; kernels cast back to f32 in-register);
+    anything else is normalized to f32."""
+    if xt.dtype == jnp.bfloat16:
+        return xt
+    return xt.astype(jnp.float32)
+
+
 def _link_parts(link, y, logits, mask):
     """Per-link elementwise math shared by both tile kernels.
 
@@ -122,7 +164,7 @@ def _make_kernel(n, lane_tile, with_offset, link):
         lane0 = pl.program_id(0) * lane_tile
         iota = jax.lax.broadcasted_iota(jnp.int32, (1, lane_tile), 1)
         mask = lane0 + iota < n  # (1, TILE) — False on ragged-tile overhang
-        xt = jnp.where(mask, xt_ref[...], 0.0)  # (D, TILE)
+        xt = jnp.where(mask, xt_ref[...].astype(jnp.float32), 0.0)  # (D, TILE)
         y = jnp.where(mask, y_ref[...], 0.0)  # (1, TILE)
         beta = beta_ref[...]  # (D, 1)
         logits = jnp.sum(xt * beta, axis=0, keepdims=True)  # (1, TILE)
@@ -159,7 +201,7 @@ def _make_batched_kernel(n, lane_tile, with_offset, link):
         lane0 = pl.program_id(0) * lane_tile
         iota = jax.lax.broadcasted_iota(jnp.int32, (1, lane_tile), 1)
         mask = lane0 + iota < n  # (1, TILE)
-        xt = jnp.where(mask, xt_ref[...], 0.0)  # (D, TILE)
+        xt = jnp.where(mask, xt_ref[...].astype(jnp.float32), 0.0)  # (D, TILE)
         y = jnp.where(mask, y_ref[...], 0.0)  # (1, TILE)
         beta = beta_ref[...]  # (C, D)
         # explicit precision (HIGHEST unless STARK_FUSED_PRECISION says
@@ -214,7 +256,7 @@ def _batched_call(beta, xt, y, offsets, *, lane_tile, interpret,
     def lane_spec(height=1):
         return pl.BlockSpec((height, lane_tile), lambda i: (0, i))
 
-    args = [xt.astype(jnp.float32), y.astype(jnp.float32)[None, :]]
+    args = [_stream_arg(xt), y.astype(jnp.float32)[None, :]]
     in_specs = [lane_spec(d), lane_spec()]
     if offsets is not None:
         args.append(offsets.astype(jnp.float32))
@@ -271,7 +313,7 @@ def _fused_call(beta, xt, y, offsets, *, lane_tile, interpret,
     def lane_spec(height=1):
         return pl.BlockSpec((height, lane_tile), lambda i: (0, i))
 
-    args = [xt.astype(jnp.float32), y.astype(jnp.float32)[None, :]]
+    args = [_stream_arg(xt), y.astype(jnp.float32)[None, :]]
     in_specs = [lane_spec(d), lane_spec()]
     if offsets is not None:
         args.append(offsets.astype(jnp.float32)[None, :])

@@ -248,3 +248,44 @@ def test_fused_precision_knob(monkeypatch):
     monkeypatch.setenv("STARK_FUSED_PRECISION", "fast")
     with pytest.raises(ValueError, match="highest|high|default"):
         _dot_precision()
+
+
+def test_grouped_x_bf16_stream_matches_rounded_oracle(monkeypatch):
+    """STARK_FUSED_X_DTYPE=bf16 (the stream-side lever, BASELINE.md r5):
+    prepare stores xT in bf16, the kernel casts back to f32 in-register,
+    and the computed posterior is exactly that of the ROUNDED design
+    matrix — value and gradients match the plain-autodiff oracle run on
+    the same bf16-rounded X to f32 tolerance."""
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "bf16")
+    ref, rdata, grp, gdata = _models()
+    assert gdata["xT"].dtype == jnp.bfloat16
+    rdata = dict(rdata)
+    rdata["x"] = rdata["x"].astype(jnp.bfloat16).astype(jnp.float32)
+    params = {
+        "beta": 0.1 * jnp.arange(8, dtype=jnp.float32),
+        "alpha0": jnp.float32(0.3),
+        "sigma_alpha": jnp.float32(0.7),
+        "alpha_raw": 0.05 * jnp.arange(50, dtype=jnp.float32) - 1.0,
+    }
+    v_ref = ref.log_lik(params, rdata)
+    v_grp = grp.log_lik(params, gdata)
+    np.testing.assert_allclose(v_ref, v_grp, rtol=2e-5)
+    g_ref = jax.grad(lambda p: ref.log_lik(p, rdata))(params)
+    g_grp = jax.grad(lambda p: grp.log_lik(p, gdata))(params)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(g_ref[k]), np.asarray(g_grp[k]), rtol=2e-4,
+            atol=1e-4, err_msg=k,
+        )
+
+
+def test_x_stream_dtype_knob(monkeypatch):
+    from stark_tpu.ops.logistic_fused import _x_stream_dtype
+
+    monkeypatch.delenv("STARK_FUSED_X_DTYPE", raising=False)
+    assert _x_stream_dtype() == jnp.float32  # default
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "bf16")
+    assert _x_stream_dtype() == jnp.bfloat16
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "fp8")
+    with pytest.raises(ValueError, match="f32|bf16"):
+        _x_stream_dtype()

@@ -33,6 +33,8 @@ python tools/roofline.py
 #     seed; adopt=high when max posterior-mean delta < 0.1 sd and both
 #     converge -> then re-run step 3 with STARK_FUSED_PRECISION=high
 python tools/precision_parity.py high
+#     then the combined candidate (precision=high + bf16 X stream):
+PARITY_X_DTYPE=bf16 python tools/precision_parity.py high
 
 # 2. five judged configs -> appends the measured table to BASELINE.md
 #    (r4: table now carries the BNN predictive_accuracy/pred-ESS and the

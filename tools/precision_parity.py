@@ -35,12 +35,16 @@ WARMUP = int(os.environ.get("PARITY_WARMUP", 300))
 SAMPLES = int(os.environ.get("PARITY_SAMPLES", 300))
 
 
-def run_at(precision, model, data):
+def run_at(precision, model, data, x_dtype=None):
     import numpy as np
 
     import stark_tpu
 
     os.environ["STARK_FUSED_PRECISION"] = precision
+    # force BOTH knobs unconditionally: an externally-exported
+    # STARK_FUSED_X_DTYPE must not leak into the f32/highest baseline
+    # (that would invert the comparison and mislabel the artifact)
+    os.environ["STARK_FUSED_X_DTYPE"] = x_dtype or "f32"
     try:
         post = stark_tpu.sample(
             model, data, chains=CHAINS, kernel="chees",
@@ -49,6 +53,7 @@ def run_at(precision, model, data):
         )
     finally:
         os.environ.pop("STARK_FUSED_PRECISION", None)
+        os.environ.pop("STARK_FUSED_X_DTYPE", None)
     flat = np.asarray(post.draws_flat, np.float64)
     return {
         "mean": flat.mean(axis=(0, 1)),
@@ -73,8 +78,13 @@ def main():
     model = FusedHierLogisticGrouped(num_features=D, num_groups=G)
     data, _ = synth_logistic_data(jax.random.PRNGKey(0), N, D, num_groups=G)
 
+    # PARITY_X_DTYPE=bf16 additionally streams the candidate's X in bf16
+    # (the stream-side lever; the baseline always runs f32/highest).
+    # NOTE: prepare_data runs inside sample(), so the dtype takes effect
+    # per-run — the two runs legitimately see different X roundings.
+    x_dtype = os.environ.pop("PARITY_X_DTYPE", None)
     base = run_at("highest", model, data)
-    cand = run_at(candidate, model, data)
+    cand = run_at(candidate, model, data, x_dtype=x_dtype)
 
     sd = np.maximum(base["sd"], 1e-12)
     delta = np.abs(cand["mean"] - base["mean"]) / sd
@@ -83,6 +93,7 @@ def main():
         "platform": jax.devices()[0].platform,
         "n": N, "d": D, "g": G, "chains": CHAINS,
         "candidate": candidate,
+        "candidate_x_dtype": x_dtype or "f32",
         "max_mean_delta_sd": float(delta.max()),
         "mean_mean_delta_sd": float(delta.mean()),
         "sd_ratio_minmax": [float(sd_ratio.min()), float(sd_ratio.max())],
@@ -97,8 +108,9 @@ def main():
     # CPU smokes validate the harness, not the chip (f32 dots are exact
     # on CPU, so delta is trivially 0): keep them off the on-chip
     # artifact path, mirroring tools/roofline.py
+    tag = "_bf16x" if x_dtype else ""
     name = (
-        "precision_parity.json"
+        f"precision_parity{tag}.json"
         if out["platform"] != "cpu"
         else "precision_parity_smoke.json"
     )

@@ -248,13 +248,13 @@ def main():
             + grid * C * (1 + D + k_loc) * 4
         )
 
-        def grouped_grad(beta, alpha):
-            return hf._grouped_call(
-                beta, alpha, xt, y, gl_j, fg_j, k_loc=k_loc,
-                lane_tile=lane_tile, interpret=INTERPRET,
-            )
+        def make_case(tag, vary_alpha, precision, xt_case, case_bytes):
+            def grouped_grad(beta, alpha):
+                return hf._grouped_call(
+                    beta, alpha, xt_case, y, gl_j, fg_j, k_loc=k_loc,
+                    lane_tile=lane_tile, interpret=INTERPRET,
+                )
 
-        def make_case(tag, vary_alpha, precision):
             def attempt(attempt_i):
                 prior = os.environ.get("STARK_FUSED_PRECISION")
                 os.environ["STARK_FUSED_PRECISION"] = precision
@@ -311,23 +311,34 @@ def main():
                     "lane_tile": lane_tile,
                     "k_loc": k_loc,
                     "precision": precision,
-                    "bytes": gbytes,
+                    "x_dtype": str(xt_case.dtype),
+                    "bytes": case_bytes,
                     "per_dispatch_s": t1,
                     "amortized_s": tk,
-                    "per_dispatch_gbs": gbytes / t1 / 1e9,
-                    "amortized_gbs": gbytes / tk / 1e9,
-                    "pct_of_spec_peak": 100.0 * gbytes / tk / 1e9 / V5E_PEAK_GBS,
+                    "per_dispatch_gbs": case_bytes / t1 / 1e9,
+                    "amortized_gbs": case_bytes / tk / 1e9,
+                    "pct_of_spec_peak": (
+                        100.0 * case_bytes / tk / 1e9 / V5E_PEAK_GBS
+                    ),
                 }
 
             return attempt
 
-        for tag, vary_alpha, precision in (
-            ("grouped_full", True, "highest"),
-            ("grouped_gather_hoist", False, "highest"),
-            ("grouped_prec_high", True, "high"),
-            ("grouped_prec_default", True, "default"),
+        # bf16 X stream: halves the dominant X bytes (the stream-side
+        # lever that compounds with the precision lever once the kernel
+        # stops being MXU-pass-bound)
+        xt_b16 = xt.astype(jnp.bfloat16)
+        gbytes_b16 = gbytes - xt.size * 2
+        for tag, vary_alpha, precision, xt_case, case_bytes in (
+            ("grouped_full", True, "highest", xt, gbytes),
+            ("grouped_gather_hoist", False, "highest", xt, gbytes),
+            ("grouped_prec_high", True, "high", xt, gbytes),
+            ("grouped_prec_default", True, "default", xt, gbytes),
+            ("grouped_x_bf16_prec_high", True, "high", xt_b16, gbytes_b16),
         ):
-            case = measure_gated(tag, make_case(tag, vary_alpha, precision))
+            case = measure_gated(
+                tag, make_case(tag, vary_alpha, precision, xt_case, case_bytes)
+            )
             grouped_cases.append(case)
             rate = invalid_or(
                 case,
@@ -335,7 +346,7 @@ def main():
                 f"{case['pct_of_spec_peak']:.0f}% of v5e spec peak)",
             )
             print(
-                f"[roofline] {tag}: {gbytes/1e6:.0f} MB/eval; amortized "
+                f"[roofline] {tag}: {case_bytes/1e6:.0f} MB/eval; amortized "
                 f"{case['amortized_s']*1e3:.2f} ms " + rate,
                 file=sys.stderr,
             )
