@@ -365,6 +365,122 @@ def main():
             ) * 1e3
     results["grouped"] = grouped_cases
 
+    # --- grouped LMM kernel (judged config 3's kernel) -------------------
+    # Same MXU-pass argument (4+Q HIGHEST dots per tile); these rows let
+    # the one on-chip session quantify the precision lever for config 3
+    # alongside the flagship kernel.  Dense grouping (~10 rows/group)
+    # shrinks the lane tile, so per-tile fixed costs matter more here.
+    LN = int(os.environ.get("ROOF_LMM_N", 100_000))
+    LD = int(os.environ.get("ROOF_LMM_D", 8))
+    LG = int(os.environ.get("ROOF_LMM_G", 10_000))
+    LQ = 2
+    LC = int(os.environ.get("ROOF_LMM_C", 16))
+    lmm_cases = []
+    g_l = np.sort(np.arange(LN) % LG).astype(np.int32)
+    lmm_layout = hf.grouped_layout(g_l, LD + LQ + 2)
+    if lmm_layout is None:
+        print("[roofline] grouped-LMM layout infeasible; skipped",
+              file=sys.stderr)
+    else:
+        lt_l, kloc_l, fg_l, gl_l = lmm_layout
+        grid_l = -(-LN // lt_l)
+        xt_l = jax.random.normal(jax.random.PRNGKey(5), (LD, LN), jnp.float32)
+        zt_l = jax.random.normal(jax.random.PRNGKey(6), (LQ, LN), jnp.float32)
+        y_l = jax.random.normal(jax.random.PRNGKey(7), (LN,), jnp.float32)
+        gl_lj, fg_lj = jnp.asarray(gl_l), jnp.asarray(fg_l)
+        lbytes = (
+            (LD + LQ + 2) * LN * 4                      # xt + zt + y + gl
+            + grid_l * LC * LQ * kloc_l * 4             # u windows in
+            + grid_l * LC * (2 + LD + LQ * kloc_l) * 4  # partials out
+        )
+
+        def make_lmm_case(tag, precision):
+            def lmm_grad(beta, u, ic):
+                return hf._grouped_lmm_call(
+                    beta, u, ic, xt_l, zt_l, y_l, gl_lj, fg_lj,
+                    k_loc=kloc_l, lane_tile=lt_l, interpret=INTERPRET,
+                )
+
+            def attempt(attempt_i):
+                prior = os.environ.get("STARK_FUSED_PRECISION")
+                os.environ["STARK_FUSED_PRECISION"] = precision
+                try:
+                    @jax.jit
+                    def loop(beta, u, ic):
+                        def body(i, bui):
+                            b, uu, i0 = bui
+                            ssr, sresid, gb, gu = lmm_grad(b, uu, i0)
+                            return (
+                                b + 1e-12 * gb,
+                                uu + 1e-12 * gu,
+                                i0 + 1e-12 * sresid,
+                            )
+
+                        return jax.lax.fori_loop(0, K, body, (beta, u, ic))
+
+                    @jax.jit
+                    def one(beta, u, ic):
+                        return lmm_grad(beta, u, ic)
+
+                    args = [
+                        (
+                            0.01 * jax.random.normal(
+                                jax.random.PRNGKey(900 + 1000 * attempt_i + i),
+                                (LC, LD), jnp.float32,
+                            ),
+                            0.01 * jax.random.normal(
+                                jax.random.PRNGKey(950 + 1000 * attempt_i + i),
+                                (LC, LG, LQ), jnp.float32,
+                            ),
+                            jnp.zeros((LC,), jnp.float32) + 0.01 * i,
+                        )
+                        for i in range(REPS + 1)
+                    ]
+                    t1 = timeit(
+                        lambda a: one(*a), args[0], args[1:], sync_each=True
+                    )
+                    tk = timeit(lambda a: loop(*a), args[0], args[1:]) / K
+                finally:
+                    if prior is None:
+                        os.environ.pop("STARK_FUSED_PRECISION", None)
+                    else:
+                        os.environ["STARK_FUSED_PRECISION"] = prior
+                return {
+                    "case": tag,
+                    "chains": LC,
+                    "lane_tile": lt_l,
+                    "k_loc": kloc_l,
+                    "precision": precision,
+                    "bytes": lbytes,
+                    "per_dispatch_s": t1,
+                    "amortized_s": tk,
+                    "per_dispatch_gbs": lbytes / t1 / 1e9,
+                    "amortized_gbs": lbytes / tk / 1e9,
+                    "pct_of_spec_peak": (
+                        100.0 * lbytes / tk / 1e9 / V5E_PEAK_GBS
+                    ),
+                }
+
+            return attempt
+
+        for tag, precision in (
+            ("lmm_grouped_full", "highest"),
+            ("lmm_grouped_prec_high", "high"),
+        ):
+            case = measure_gated(tag, make_lmm_case(tag, precision))
+            lmm_cases.append(case)
+            rate = invalid_or(
+                case,
+                f"({case['amortized_gbs']:.0f} GB/s effective = "
+                f"{case['pct_of_spec_peak']:.0f}% of v5e spec peak)",
+            )
+            print(
+                f"[roofline] {tag}: {lbytes/1e6:.0f} MB/eval; amortized "
+                f"{case['amortized_s']*1e3:.2f} ms " + rate,
+                file=sys.stderr,
+            )
+    results["grouped_lmm"] = lmm_cases
+
     # interpret/CPU smoke runs must never overwrite the committed on-chip
     # artifact (tests pin its sanity) — they validate the harness, not
     # the chip
