@@ -73,11 +73,28 @@ def grouped_layout(g_sorted: np.ndarray, d: int):
     lane_tile rides back to the kernel call in the data layout (shape-
     encoded), so prepare and call cannot disagree.
     """
+    import os
+
     g_sorted = np.asarray(g_sorted)
     if g_sorted.ndim != 1 or np.any(np.diff(g_sorted) < 0):
         raise ValueError("grouped_layout requires sorted 1-D group ids")
     n = g_sorted.shape[0]
     lane_tile = grouped_lane_tile(d)
+    # STARK_GROUPED_LANE_TILE caps the starting tile (128-multiple).  The
+    # default tile is chosen from D alone — it cannot see the CHAIN count,
+    # and a C=128 batch at tile 8192 trips the VMEM guard (~12.6 MB of
+    # (C, TILE) intermediates) where tile 4096 would fit.  The cap lets a
+    # large-C on-chip experiment halve the tile instead of being refused;
+    # the chosen tile still rides back shape-encoded, so prepare and call
+    # cannot disagree.
+    env_tile = os.environ.get("STARK_GROUPED_LANE_TILE")
+    if env_tile:
+        cap = int(env_tile)
+        if cap % 128 or cap < 256:
+            raise ValueError(
+                f"STARK_GROUPED_LANE_TILE={cap}: need a 128-multiple >= 256"
+            )
+        lane_tile = min(lane_tile, cap)
     # Floor at 256 ON PURPOSE: at tile 128 the window can never exceed
     # _K_LOC_MAX (span <= rows-per-tile), so every grouping would
     # "succeed" — including one-row-per-group degenerates where the

@@ -289,3 +289,20 @@ def test_x_stream_dtype_knob(monkeypatch):
     monkeypatch.setenv("STARK_FUSED_X_DTYPE", "fp8")
     with pytest.raises(ValueError, match="f32|bf16"):
         _x_stream_dtype()
+
+
+def test_grouped_lane_tile_env_cap(monkeypatch):
+    """STARK_GROUPED_LANE_TILE caps the starting tile so large chain
+    batches (C=128) can trade tile size for VMEM instead of being refused
+    by the guard; invalid values fail loudly."""
+    g = np.sort(np.random.RandomState(0).randint(0, 50, size=20_000))
+    lt_default, _, _, _ = grouped_layout(g, d=8)
+    monkeypatch.setenv("STARK_GROUPED_LANE_TILE", "1024")
+    lt_capped, k_loc, first_gid, gl = grouped_layout(g, d=8)
+    assert lt_capped == 1024 < lt_default
+    assert first_gid.shape[0] == -(-20_000 // 1024)
+    rec = first_gid[np.arange(20_000) // 1024] + gl
+    np.testing.assert_array_equal(rec, g)
+    monkeypatch.setenv("STARK_GROUPED_LANE_TILE", "1000")  # not 128-aligned
+    with pytest.raises(ValueError, match="128-multiple"):
+        grouped_layout(g, d=8)

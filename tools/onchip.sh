@@ -53,3 +53,13 @@ python bench.py
 
 # 4. config 2 at its pinned N=1M (consensus + combine-accuracy check)
 python tools/consensus_1m.py --out BASELINE.md
+
+# 5. EXPERIMENTS LAST (each could fault; judged numbers are already in):
+#    a. C=128 grouped flagship: tile 8192 trips the VMEM guard at C=128,
+#       so cap the tile — r3 measured C=64 at 19.2 ESS/s vs C=32 at 14.8
+#       (sublinear); C=128 at tile 4096 is the untested next step:
+#         STARK_GROUPED_LANE_TILE=4096 BENCH_CHEES_CHAINS=128 python bench.py
+#    b. guard fault-boundary probe (VERDICT r4 #7): ONE expendable config
+#       just over STARK_MAX_ROWGRADS_PER_PROGRAM (~2.5e11 row-grads), run
+#       dead last — it may wedge the relay; turns the 2-point calibration
+#       into a measured threshold either way.
