@@ -107,8 +107,15 @@ class ShardedBackend:
         row_axes = None
         if data is not None:
             data = prepare_model_data(model, data)
-            row_axes = model.data_row_axes(data)
+            row_axes = model.data_shard_row_axes(data)
             if multiproc:
+                # sequence-parallel models must verify the cross-process
+                # global order BEFORE the blocks are glued (per-host
+                # prepare_data only sorts locally — a violation would
+                # silently corrupt the stitched likelihood)
+                validate = getattr(model, "validate_process_blocks", None)
+                if validate is not None:
+                    validate(data)
                 # each process passed only ITS rows (distributed.local_row_range);
                 # glue them into one global row-sharded array over ICI/DCN
                 data = process_local_shard(data, self.mesh, "data", row_axes=row_axes)
@@ -359,10 +366,13 @@ class ShardedBackend:
         row_axes = None
         if data is not None:
             data = prepare_model_data(model, data)
-            row_axes = model.data_row_axes(data)
+            row_axes = model.data_shard_row_axes(data)
             if multiproc:
-                # each process passed only ITS rows (distributed.
-                # local_row_range) — same contract as `run`
+                # same cross-process order check as `run` (sequence-
+                # parallel models), then the same gluing contract
+                validate = getattr(model, "validate_process_blocks", None)
+                if validate is not None:
+                    validate(data)
                 data = process_local_shard(
                     data, self.mesh, "data", row_axes=row_axes
                 )

@@ -93,6 +93,19 @@ class Model:
         """
         return jax.tree.map(lambda _: 0, data)
 
+    def data_shard_row_axes(self, data: PyTree) -> PyTree:
+        """Row axes for CONTIGUOUS, ORDER-PRESERVING data-axis sharding
+        (the mesh "data" axis).  Defaults to ``data_row_axes``.
+
+        Sequential-likelihood models (CoxPH) override THIS — their
+        cross-shard ``log_lik_sharded`` stitches prefix state over the
+        axis, which is only valid when shards are contiguous row blocks
+        in the prepared global order — while leaving ``data_row_axes``
+        fail-fast, because minibatching and independent sub-posterior
+        splits (SG-HMC, consensus) remain statistically invalid for them.
+        """
+        return self.data_row_axes(data)
+
 
 def prepare_model_data(model: Model, data: PyTree) -> PyTree:
     """The single data choke point for every entry point: apply the model's
@@ -192,11 +205,31 @@ def flatten_model(
         unc = {k: spec[k].bijector.inverse(jnp.asarray(params[k])) for k in spec}
         return flatten(unc)
 
+    # cross-shard likelihood hook (sequence-parallel models): when the
+    # model implements log_lik_sharded(params, data, axis_name), the
+    # sharded path calls IT instead of log_lik — the model's own
+    # collectives stitch the sequential structure (prefix scans,
+    # boundary ties) across shards, and it returns this shard's PARTIAL
+    # of the globally-stitched log-lik.  The same outer psum as the
+    # ordinary per-shard path then reduces value and gradient — and
+    # crucially the function's OUTPUT stays shard-local, so the
+    # transposed in-likelihood collectives (which sum cotangent seeds
+    # over shards) aggregate exactly one seed per shard output; a
+    # replicated (internally psum'd) output would seed P cotangents and
+    # inflate the gradient by the axis size (measured: exactly 8x on the
+    # 8-shard mesh before this contract was fixed).
+    sharded_ll_fn = getattr(model, "log_lik_sharded", None)
+
+    def _local_ll(params, data):
+        if axis_name is not None and sharded_ll_fn is not None:
+            return sharded_ll_fn(params, data, axis_name)
+        return model.log_lik(params, data)
+
     def potential(flat: Array, data: PyTree = None) -> Array:
         params, fldj = constrain_with_fldj(flat)
         lp = prior_scale * model.log_prior(params) + fldj
         if data is not None:
-            ll = model.log_lik(params, data)
+            ll = _local_ll(params, data)
             if axis_name is not None:
                 ll = jax.lax.psum(ll, axis_name)
             lp = lp + lik_scale * ll
@@ -209,7 +242,7 @@ def flatten_model(
         # Sharded path: ONE fused psum carries [ll_value, ll_grad].
         def local_ll(z):
             params, _ = constrain_with_fldj(z)
-            return model.log_lik(params, data)
+            return _local_ll(params, data)
 
         ll, ll_grad = jax.value_and_grad(local_ll)(flat)
         packed = jax.lax.psum(jnp.concatenate([ll[None], ll_grad]), axis_name)

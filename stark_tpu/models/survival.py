@@ -5,8 +5,12 @@ still at risk at that time).  With rows pre-sorted by DESCENDING time,
 the risk-set denominator at row i is a prefix log-sum-exp over rows
 0..i — one `cumulative_logsumexp` pass, XLA-friendly static shapes, no
 per-event Python.  That prefix scan makes the likelihood sequential in
-the row ordering, so rows cannot be sharded over the data axis (same
-fail-fast contract as StochasticVolatility); chain parallelism applies.
+the row ordering — so minibatching and independent sub-posterior splits
+are fail-fast invalid — but mesh DATA-AXIS SHARDING is supported (r5):
+`log_lik_sharded` runs the prefix scan per contiguous shard and
+stitches carries/tie blocks across the axis with three O(P)
+collectives, the framework's sequence-parallel path (the MCMC analogue
+of ring/context parallelism).  Chain parallelism always applies.
 
 Capability-surface entry per SURVEY.md §3 "Model abstraction" (reference
 tree absent — built against the capability surface).
@@ -35,20 +39,23 @@ def _cumulative_logsumexp(x):
     return m + jnp.log(s)
 
 
-def _fill_from_right(vals, valid):
-    """For each i, the value at the NEAREST valid index j >= i.
-
-    Associative ("latest valid wins") prefix over the reversed sequence —
-    static shapes, no per-row scan serialization.
-    """
+def _fill_from_right_valid(vals, valid):
+    """For each i, (value at the NEAREST valid index j >= i, any-valid
+    flag).  Associative ("latest valid wins") prefix over the reversed
+    sequence — static shapes, no per-row scan serialization."""
 
     def op(a, b):  # b is the element closer to position i
         va, ha = a
         vb, hb = b
         return jnp.where(hb, vb, va), ha | hb
 
-    rv, _ = jax.lax.associative_scan(op, (vals[::-1], valid[::-1]))
-    return rv[::-1]
+    rv, rh = jax.lax.associative_scan(op, (vals[::-1], valid[::-1]))
+    return rv[::-1], rh[::-1]
+
+
+def _fill_from_right(vals, valid):
+    """For each i, the value at the NEAREST valid index j >= i."""
+    return _fill_from_right_valid(vals, valid)[0]
 
 
 class CoxPH(Model):
@@ -78,10 +85,47 @@ class CoxPH(Model):
     def data_row_axes(self, data):
         raise NotImplementedError(
             "CoxPH's risk-set prefix scan couples every row to all "
-            "longer-surviving rows: rows cannot be sharded or "
-            "minibatched. Use a single-shard backend (JaxBackend/"
-            "CpuBackend); chain parallelism still applies."
+            "longer-surviving rows: rows cannot be minibatched or split "
+            "into independent sub-posteriors (SG-HMC, consensus).  MESH "
+            "data-axis sharding IS supported — the cross-shard "
+            "log_lik_sharded stitches the prefix over the axis (use "
+            "ShardedBackend); chain parallelism always applies."
         )
+
+    def data_shard_row_axes(self, data):
+        # contiguous order-preserving mesh shards keep the global
+        # descending-time order; log_lik_sharded stitches the prefix
+        # across them (minibatch/sub-posterior splits stay fail-fast
+        # via data_row_axes above)
+        return jax.tree.map(lambda _: 0, data)
+
+    def validate_process_blocks(self, data):
+        """Multi-process precondition check (called by ShardedBackend):
+        each host's prepared block must be a contiguous slice of the
+        GLOBALLY descending-time-sorted dataset (pre-sort once, then
+        `distributed.local_row_range` per host).  `prepare_data` sorts
+        only the LOCAL rows, so a host fed unsorted global data gets a
+        locally-sorted block that silently breaks every cross-shard risk
+        set — fail loudly instead.  One 2-scalar allgather at setup.
+        """
+        if jax.process_count() == 1:
+            return
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        t = np.asarray(data["t"], np.float64)
+        ends = np.asarray(
+            multihost_utils.process_allgather(np.array([t[0], t[-1]]))
+        ).reshape(-1, 2)  # (P, 2): per-process (first, last) time
+        if np.any(ends[:-1, 1] < ends[1:, 0]):
+            raise ValueError(
+                "CoxPH multi-process blocks are not globally sorted by "
+                "descending time (a later host's first time exceeds an "
+                "earlier host's last): pre-sort the FULL dataset by "
+                "descending time and give each process its contiguous "
+                "local_row_range slice — per-host prepare_data sorting "
+                "cannot restore a global order."
+            )
 
     def log_prior(self, p):
         return jnp.sum(jstats.norm.logpdf(p["beta"], 0.0, self.prior_scale))
@@ -95,6 +139,75 @@ class CoxPH(Model):
             [t[1:] != t[:-1], jnp.ones((1,), bool)]
         )
         log_risk = _fill_from_right(prefix, is_block_end)
+        return jnp.sum(data["event"] * (eta - log_risk))
+
+    def log_lik_sharded(self, p, data, axis_name):
+        """Cross-shard Breslow partial likelihood — the framework's
+        sequence-parallel path (the MCMC analogue of ring/context
+        parallelism for a sequential likelihood).
+
+        Rows are globally sorted by descending time (`prepare_data`) and
+        mesh-sharded as contiguous blocks, so shard ``s`` holds global
+        rows [s·m, (s+1)·m).  Three O(P)-sized collectives stitch the
+        local prefix scans into the exact global quantities:
+
+          1. allgather of per-shard logsumexp totals → the exclusive
+             log-space carry added to every local prefix,
+          2. allgather of first local times → the cross-boundary
+             tie-block-end flag for each shard's last row,
+          3. allgather of (first local block-end fill, has-any-end) →
+             the right-fill carry for rows whose tie block ends in a
+             later shard (a tie run may span any number of shards).
+
+        Returns this shard's PARTIAL of the globally-stitched log-lik —
+        `flatten_model` psums value and gradient exactly as for ordinary
+        per-shard partials (keeping the output shard-local is what makes
+        the transposed in-likelihood collectives aggregate one cotangent
+        seed per shard; see the contract note in model.py).  Bit-equality
+        with the unsharded value is not expected (different logsumexp
+        association); agreement is to f32 roundoff
+        (tests/test_sharded.py).
+        """
+        eta = data["x"] @ p["beta"]  # (m,) this shard's contiguous rows
+        t = data["t"].astype(eta.dtype)
+        s = jax.lax.axis_index(axis_name)
+        num_shards = jax.lax.psum(1, axis_name)  # static axis size
+
+        # 1+2 packed into ONE gather (same one-fused-collective habit as
+        # flatten_model's psum): per-shard (prefix total, first time)
+        prefix_l = _cumulative_logsumexp(eta)
+        g1 = jax.lax.all_gather(
+            jnp.stack([prefix_l[-1], t[0]]), axis_name
+        )  # (P, 2)
+        totals, firsts = g1[:, 0], g1[:, 1]
+
+        # exclusive cross-shard carry (log-space) onto the local prefix
+        carry = jax.scipy.special.logsumexp(
+            jnp.where(jnp.arange(num_shards) < s, totals, -jnp.inf)
+        )
+        prefix_g = jnp.logaddexp(prefix_l, carry)
+
+        # tie-block ends, with the boundary flag taken from the NEXT
+        # shard's first time (the last global row is always an end)
+        nxt = firsts[jnp.minimum(s + 1, num_shards - 1)]
+        last_is_end = jnp.where(s + 1 < num_shards, t[-1] != nxt, True)
+        is_end = jnp.concatenate([t[1:] != t[:-1], last_is_end[None]])
+
+        # 3. fill-from-right of the global prefix at block ends; trailing
+        # rows of a block that closes in a LATER shard take that shard's
+        # first-end fill (nearest shard > s with any end — the global
+        # last row guarantees one exists).  One packed gather again.
+        fill, has_end = _fill_from_right_valid(prefix_g, is_end)
+        g2 = jax.lax.all_gather(
+            jnp.stack([fill[0], has_end[0].astype(eta.dtype)]), axis_name
+        )  # (P, 2)
+        fs, hs = g2[:, 0], g2[:, 1] > 0.5
+        later = jnp.arange(num_shards) > s
+        rfill, _ = _fill_from_right_valid(
+            jnp.where(later, fs, 0.0), later & hs
+        )
+        log_risk = jnp.where(has_end, fill, rfill[0])
+
         return jnp.sum(data["event"] * (eta - log_risk))
 
 

@@ -62,6 +62,37 @@ elif kernel == "nuts_dispatch":
         chains=2, kernel="nuts", max_tree_depth=5, num_warmup=150,
         num_samples=150, seed=0,
     )
+elif kernel == "coxph":
+    # sequence-parallel CoxPH across PROCESSES: rows globally sorted by
+    # descending time (synth_survival_data's contract), partitioned
+    # contiguously per host; the cross-shard prefix stitching must
+    # reproduce the generating betas, and a feed that breaks the global
+    # order must be REFUSED (validate_process_blocks), never silently
+    # wrong
+    from stark_tpu.models import CoxPH, synth_survival_data
+
+    sdata, true = synth_survival_data(jax.random.PRNGKey(0), 2048, 3)
+    lo, hi = dist.local_row_range(2048)
+    local_s = {k: np.asarray(v)[lo:hi] for k, v in sdata.items()}
+    post = stark_tpu.sample(
+        CoxPH(num_features=3), local_s, backend=ShardedBackend(mesh),
+        chains=2, kernel="nuts", max_tree_depth=6, num_warmup=150,
+        num_samples=150, seed=0,
+    )
+    # swap the hosts' blocks: each block is still locally descending, so
+    # only the cross-process check can catch the broken global order
+    swapped = {
+        k: np.asarray(v)[2048 - hi : 2048 - lo] for k, v in sdata.items()
+    }
+    try:
+        stark_tpu.sample(
+            CoxPH(num_features=3), swapped, backend=ShardedBackend(mesh),
+            chains=2, kernel="nuts", max_tree_depth=4, num_warmup=8,
+            num_samples=4, seed=1,
+        )
+        raise SystemExit("unsorted multi-process CoxPH was not refused")
+    except ValueError as e:
+        assert "descending" in str(e), e
 elif kernel == "adaptive":
     # the full flagship composition on a multi-process mesh (VERDICT r4
     # missing #3): convergence-gated blocks + per-rank checkpoints +
@@ -174,7 +205,9 @@ def _run_workers(script, kernel, extra_args=(), dev_per_proc=4, timeout=600):
     return results
 
 
-@pytest.mark.parametrize("kernel", ["nuts", "chees", "nuts_dispatch"])
+@pytest.mark.parametrize(
+    "kernel", ["nuts", "chees", "nuts_dispatch", "coxph"]
+)
 @pytest.mark.slow
 def test_two_process_sharded_sampling(tmp_path, kernel):
     script = tmp_path / "worker.py"
