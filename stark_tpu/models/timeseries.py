@@ -6,11 +6,13 @@ non-centered innovations with `jax.lax.associative_scan` — a log-depth
 parallel prefix that XLA maps onto the VPU, instead of a sequential
 T-step `scan` (the latent recurrence is the hot loop here, not a matmul).
 
-NOTE: the likelihood depends on the whole latent path, so this model does
-NOT shard over a data axis and must not be minibatched — `data_row_axes`
-raises so the sharded/consensus/SG-HMC entry points fail fast instead of
-slicing `y` out from under `latent_h` inside jit.  Use single-shard
-backends (JaxBackend / CpuBackend); chains still parallelize.
+Minibatching / sub-posterior splits are fail-fast invalid (a minibatch
+cannot know which time steps it holds), but mesh DATA-AXIS SHARDING is
+supported (r5): the latent path is a function of replicated params, so
+`log_lik_sharded` rebuilds it on every shard and aligns each contiguous
+``y`` time block with its path slice by shard index — sequence
+parallelism with zero in-likelihood collectives.  Chains always
+parallelize.
 """
 
 from __future__ import annotations
@@ -59,10 +61,20 @@ class StochasticVolatility(Model):
     def data_row_axes(self, data):
         raise NotImplementedError(
             "StochasticVolatility's likelihood couples every y_t through "
-            "the latent AR(1) path: rows cannot be sharded or minibatched. "
-            "Use a single-shard backend (JaxBackend/CpuBackend); chain "
-            "parallelism still applies."
+            "the latent AR(1) path: rows cannot be minibatched or split "
+            "into independent sub-posteriors (SG-HMC, consensus) — a "
+            "minibatch cannot know WHICH time steps it holds.  MESH "
+            "data-axis sharding IS supported (ShardedBackend): "
+            "log_lik_sharded aligns each contiguous y block with its "
+            "slice of the latent path.  Chain parallelism always applies."
         )
+
+    def data_shard_row_axes(self, data):
+        # contiguous mesh shards hold contiguous TIME blocks (row order
+        # is time order; there is no prepare_data reordering), and
+        # log_lik_sharded aligns each block with its latent-path slice.
+        # Minibatch/sub-posterior paths stay fail-fast via data_row_axes.
+        return jax.tree.map(lambda _: 0, data)
 
     def log_prior(self, p):
         lp = jnp.sum(jstats.norm.logpdf(p["eps"]))
@@ -82,6 +94,40 @@ class StochasticVolatility(Model):
     def log_lik(self, p, data):
         h = self.latent_h(p)
         return jnp.sum(jstats.norm.logpdf(data["y"], 0.0, jnp.exp(h / 2.0)))
+
+    def log_lik_sharded(self, p, data, axis_name):
+        """Sequence-parallel SV likelihood: the latent path is a function
+        of REPLICATED params, so every shard rebuilds the full T-length
+        path (the same log-depth prefix the unsharded model runs — O(T)
+        VPU work, no HBM traffic to split) and aligns its contiguous
+        ``y`` time block with the matching path slice by shard index.
+        Zero in-likelihood collectives; returns this shard's partial, and
+        the framework's fused psum reduces value + gradient as usual.
+
+        Multi-process precondition (inherent to rows-are-time-steps, the
+        same contract every sequence-parallel system has): host ``p``
+        must hold the contiguous time block ``local_row_range`` assigns
+        it — there is no time index in ``data`` to validate against.
+        """
+        h = self.latent_h(p)
+        m = data["y"].shape[0]  # this shard's (static) time-block length
+        num_shards = jax.lax.psum(1, axis_name)  # static axis size
+        if m * num_shards != self.num_steps:
+            # fail as loudly as the unsharded broadcast mismatch would:
+            # dynamic_slice CLAMPS out-of-range starts, which would
+            # silently evaluate several shards against the same tail
+            # slice of a too-short path
+            raise ValueError(
+                f"StochasticVolatility(num_steps={self.num_steps}) cannot "
+                f"shard a {m * num_shards}-step dataset ({num_shards} "
+                f"shards x {m} rows); the model and data lengths must "
+                "match exactly"
+            )
+        s = jax.lax.axis_index(axis_name)
+        h_loc = jax.lax.dynamic_slice_in_dim(h, s * m, m)
+        return jnp.sum(
+            jstats.norm.logpdf(data["y"], 0.0, jnp.exp(h_loc / 2.0))
+        )
 
 
 def synth_sv_data(key, num_steps, *, mu=-1.0, phi=0.95, sigma_h=0.25,

@@ -121,7 +121,7 @@ def test_sv_rejects_row_sharding_entry_points():
     from stark_tpu.sghmc import sghmc_sample
 
     data, _ = synth_sv_data(jax.random.PRNGKey(0), 128)
-    with pytest.raises(NotImplementedError, match="cannot be sharded"):
+    with pytest.raises(NotImplementedError, match="minibatched"):
         sghmc_sample(
             StochasticVolatility(num_steps=128), data, batch_size=32,
             chains=1, num_warmup=10, num_samples=10, seed=0,
