@@ -206,11 +206,43 @@ def consensus_sample(
     ``chains`` here is chains PER SHARD; the combined posterior keeps the
     chain axis (chain c of the consensus = combination of chain c of every
     shard), so standard R-hat/ESS diagnostics apply to the combined draws.
+
+    MULTI-PROCESS (r5): with ``jax.distributed`` initialized, each host
+    passes only ITS contiguous row block (``distributed.local_row_range``
+    — the same contract as `ShardedBackend`) and samples
+    ``num_shards / process_count`` sub-posteriors entirely locally —
+    consensus is embarrassingly parallel, so the hosts exchange NOTHING
+    during sampling; one draw allgather at the end materializes every
+    sub-posterior everywhere and the (deterministic) combine runs
+    identically on each host.  The per-chain kernels slice the SAME
+    global key streams a single-host run would use, so the multi-host
+    posterior is bit-comparable to the single-host one; the chees path
+    folds the process index into its keys (its internal splits are sized
+    by local shard count).  ``mesh`` is single-process-only: on a pod,
+    the per-host devices already serve the local shards.
     """
     cfg = SamplerConfig(**cfg_kwargs)
     fm = flatten_model(model, prior_scale=1.0 / num_shards)
     data = prepare_model_data(model, data)
     row_axes = model.data_row_axes(data)
+
+    multiproc = jax.process_count() > 1
+    if multiproc and mesh is not None:
+        raise ValueError(
+            "multi-process consensus runs each host's shards on that "
+            "host's own devices (zero cross-host communication until the "
+            "final draw allgather) — do not pass a cross-process mesh"
+        )
+    if multiproc and num_shards % jax.process_count():
+        raise ValueError(
+            f"num_shards={num_shards} must be a multiple of "
+            f"process_count={jax.process_count()} (each host samples an "
+            "equal block of shards)"
+        )
+    # shards THIS host samples; its local rows split into this many blocks
+    shards_here = (
+        num_shards // jax.process_count() if multiproc else num_shards
+    )
 
     # split each leaf's row axis into contiguous blocks and move the new
     # shard axis to the FRONT (vmap axis), preserving the model's per-shard
@@ -218,14 +250,14 @@ def consensus_sample(
     def to_shards(x, ax):
         x = jnp.asarray(x)
         if ax < 0:  # row-less sentinel leaf: replicate to every shard
-            return jnp.broadcast_to(x, (num_shards,) + x.shape)
+            return jnp.broadcast_to(x, (shards_here,) + x.shape)
         n = x.shape[ax]
-        if n % num_shards:
+        if n % shards_here:
             raise ValueError(
-                f"rows {n} not divisible by num_shards={num_shards}"
+                f"rows {n} not divisible by the {shards_here} local shards"
             )
         split = x.reshape(
-            x.shape[:ax] + (num_shards, n // num_shards) + x.shape[ax + 1 :]
+            x.shape[:ax] + (shards_here, n // shards_here) + x.shape[ax + 1 :]
         )
         return jnp.moveaxis(split, ax, 0)
 
@@ -260,11 +292,21 @@ def consensus_sample(
                     f"axis; axes {extra_devs} would duplicate work — use "
                     "a mesh with all non-'data' axes of size 1"
                 )
+        if multiproc:
+            # the chees driver's internal key splits are sized by its
+            # local shard count, so give each host a distinct fold of
+            # the run keys (the multi-host chees stream legitimately
+            # differs from the single-host one)
+            key_init = jax.random.fold_in(key_init, jax.process_index())
+            key_run = jax.random.fold_in(key_run, jax.process_index())
         draws_sub, stats_extra = _run_chees_shards(
-            fm, cfg, sharded, num_shards, chains, key_init, key_run, mesh,
+            fm, cfg, sharded, shards_here, chains, key_init, key_run, mesh,
             init_params, dispatch_steps,
         )
     else:
+        # per-chain kernels: derive the GLOBAL per-shard key/init streams
+        # and slice this host's block, so a multi-host run reproduces the
+        # single-host draws exactly
         if init_params is not None:
             z0 = jnp.broadcast_to(
                 fm.unconstrain(init_params), (num_shards, chains, fm.ndim)
@@ -278,6 +320,10 @@ def consensus_sample(
         keys = jax.random.split(key_run, num_shards * chains).reshape(
             num_shards, chains, 2
         )
+        if multiproc:
+            lo = jax.process_index() * shards_here
+            z0 = jax.lax.dynamic_slice_in_dim(z0, lo, shards_here)
+            keys = jax.lax.dynamic_slice_in_dim(keys, lo, shards_here)
 
         runner = make_chain_runner(fm, cfg)
         vchains = jax.vmap(runner, in_axes=(0, 0, None))  # chains within a shard
@@ -310,6 +356,19 @@ def consensus_sample(
             "num_divergent": np.asarray(res.num_divergent),
             "step_size": np.asarray(res.step_size),
         }
+
+    if multiproc:
+        # one draw allgather: every host materializes every sub-posterior
+        # (process blocks concatenate in rank order = global shard order),
+        # then the deterministic combine below runs identically everywhere
+        # — same gather helper as the sharded backend's draw collection
+        from ..distributed import gather_draws
+
+        gathered = gather_draws(
+            {"draws": np.asarray(draws_sub), **stats_extra}
+        )
+        draws_sub = gathered.pop("draws")
+        stats_extra = gathered
 
     if combine == "precision":
         combined = _combine_precision_weighted(draws_sub)

@@ -62,6 +62,19 @@ elif kernel == "nuts_dispatch":
         chains=2, kernel="nuts", max_tree_depth=5, num_warmup=150,
         num_samples=150, seed=0,
     )
+elif kernel == "consensus":
+    # multi-host consensus (r5): each host samples ITS half of the
+    # shards on its own devices with zero cross-host communication; one
+    # final draw allgather + identical deterministic combine.  The nuts
+    # path slices the GLOBAL key streams, so the combined posterior
+    # matches the single-host run (checked by the outer test).
+    from stark_tpu.parallel import consensus_sample
+
+    post = consensus_sample(
+        Logistic(num_features=4), local, num_shards=4, chains=2,
+        kernel="nuts", max_tree_depth=5, num_warmup=150, num_samples=150,
+        seed=0,
+    )
 elif kernel == "coxph":
     # sequence-parallel CoxPH across PROCESSES: rows globally sorted by
     # descending time (synth_survival_data's contract), partitioned
@@ -224,6 +237,35 @@ def test_two_process_sharded_sampling(tmp_path, kernel):
         results[0]["beta_mean"], results[0]["true"], atol=0.4
     )
     assert results[0]["max_rhat"] < 1.2
+
+
+@pytest.mark.slow
+def test_two_process_consensus_matches_single_host(tmp_path):
+    """Multi-host consensus (r5): hosts sample disjoint shard blocks with
+    zero cross-host comm and one final draw allgather; both hosts hold
+    the identical combined posterior, and it matches the single-host run
+    (the per-chain path slices the same global key streams)."""
+    import jax
+
+    from stark_tpu.models import Logistic, synth_logistic_data
+    from stark_tpu.parallel import consensus_sample
+
+    data, _ = synth_logistic_data(jax.random.PRNGKey(0), 2048, 4)
+    expected = consensus_sample(
+        Logistic(num_features=4), data, num_shards=4, chains=2,
+        kernel="nuts", max_tree_depth=5, num_warmup=150, num_samples=150,
+        seed=0,
+    )
+    exp_sum = float(np.asarray(expected.draws["beta"]).sum())
+
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER % {"port": _free_port()})
+    results = _run_workers(script, "consensus")
+    assert results[0]["checksum"] == pytest.approx(results[1]["checksum"])
+    assert results[0]["checksum"] == pytest.approx(exp_sum, rel=1e-5)
+    np.testing.assert_allclose(
+        results[0]["beta_mean"], results[0]["true"], atol=0.4
+    )
 
 
 @pytest.mark.slow
