@@ -888,10 +888,28 @@ def sample_until_converged(
 
             if converged:
                 break
-            if (
+            # budget stop must be agreed ACROSS RANKS on a multi-process
+            # mesh: convergence decisions derive from identical collected
+            # draws, but wall clocks skew per host — an unilateral break
+            # would leave the other ranks hanging on the next block's
+            # unmatched collectives.  Rule: stop when ANY rank is over
+            # budget (one tiny allgather per block, only when a budget is
+            # actually set).
+            over_budget = (
                 time_budget_s is not None
                 and time.perf_counter() - t_start > time_budget_s
-            ):
+            )
+            if time_budget_s is not None and jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                over_budget = bool(
+                    np.any(
+                        multihost_utils.process_allgather(
+                            np.array([over_budget], np.bool_)
+                        )
+                    )
+                )
+            if over_budget:
                 # stop AFTER the block is emitted and checkpointed, so the
                 # returned (and persisted) result accounts for every draw
                 budget_exhausted = True

@@ -163,6 +163,16 @@ elif kernel == "adaptive":
     warm = [r for r in recs if r["event"] == "warmup_done"]
     # the post-skew attempt ran a FRESH warmup (cold start), not a resume
     assert warm and "resumed_from_step" not in warm[-1]
+    # cross-rank BUDGET agreement: with a zero budget both ranks must
+    # agree to stop after exactly one block (the agreement allgather runs
+    # in lockstep — per-rank wall clocks alone could disagree and hang)
+    post4 = sample_until_converged(
+        Logistic(num_features=4), local, backend=ShardedBackend(mesh),
+        chains=8, kernel="chees", block_size=50, min_blocks=1,
+        max_blocks=10, rhat_target=0.0, ess_target=1e9, num_warmup=100,
+        time_budget_s=0.0, init_step_size=0.1, seed=2,
+    )
+    assert post4.budget_exhausted and post4.draws_flat.shape[1] == 50
 else:
     assert kernel == "nuts", f"worker has no branch for kernel={kernel!r}"
     post = stark_tpu.sample(
