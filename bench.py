@@ -46,7 +46,13 @@ Env knobs: BENCH_N (default 1000000), BENCH_CHAINS (8), BENCH_WARMUP (200),
 BENCH_SAMPLES (200), BENCH_GROUPED (1 = grouped hierarchical kernel),
 BENCH_CHEES_CHAINS (64 grouped / 32 offset-path), BENCH_CHEES_WARMUP (400),
 BENCH_CHEES_SAMPLES (500), BENCH_DISPATCH, BENCH_MAX_RESTARTS (3),
-BENCH_TIME_BUDGET (seconds; 0 = unlimited).
+BENCH_TIME_BUDGET (seconds; 0 = unlimited), BENCH_ADAPT_REUSE (1 =
+warm-start from a matching adaptation artifact), BENCH_EXTRA_EVIDENCE
+(1 = fill a fallback capture's remaining budget with extra judged-config
+rows).  Kernel levers (parity-gate before adopting — see
+tools/precision_parity.py): STARK_FUSED_PRECISION (highest|high|default
+MXU dot passes), STARK_FUSED_X_DTYPE (f32|bf16 design-matrix stream),
+STARK_GROUPED_LANE_TILE (cap for large chain batches).
 """
 
 import json
