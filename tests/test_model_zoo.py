@@ -278,3 +278,29 @@ def test_fused_lmm_matches_plain_posterior():
         m_p = np.asarray(post_p.draws[name]).mean((0, 1))
         sd = np.asarray(post_p.draws[name]).std((0, 1))
         np.testing.assert_allclose(m_f, m_p, atol=0.5 * np.max(sd) + 1e-3)
+
+
+def test_fill_from_right_matches_bruteforce():
+    """Property test for the associative fill-from-right primitive that
+    both the local and the cross-shard CoxPH tie stitching build on."""
+    from stark_tpu.models.survival import _fill_from_right_valid
+
+    rng = np.random.default_rng(0)
+    for trial in range(20):
+        n = int(rng.integers(1, 40))
+        vals = rng.standard_normal(n).astype(np.float32)
+        valid = rng.random(n) < rng.random()  # varying density incl. 0
+        got_v, got_h = _fill_from_right_valid(
+            jnp.asarray(vals), jnp.asarray(valid)
+        )
+        exp_v = np.empty(n, np.float32)
+        exp_h = np.empty(n, bool)
+        carry_v, carry_h = 0.0, False
+        for i in range(n - 1, -1, -1):
+            if valid[i]:
+                carry_v, carry_h = vals[i], True
+            exp_v[i], exp_h[i] = carry_v, carry_h
+        np.testing.assert_array_equal(np.asarray(got_h), exp_h)
+        np.testing.assert_allclose(
+            np.asarray(got_v)[exp_h], exp_v[exp_h], rtol=1e-6
+        )
