@@ -204,6 +204,79 @@ def load_or_measure_cpu_denominator(d, groups, depth, n_cpu, num_warmup,
     return rec
 
 
+def _print_phase_breakdown_from_trace(trace_path):
+    """Phase breakdown from the telemetry trace file; True on success.
+
+    The trace is the structured replacement for scraping ``[bench] chees
+    phases`` lines out of stdout: phase durations (compile / warmup /
+    sample blocks / checkpoint I/O), restarts, and last-seen chain health
+    all come from one parseable artifact
+    (``python tools/trace_report.py <trace>`` renders the full table).
+    """
+    try:
+        from stark_tpu.telemetry import read_trace, summarize_trace
+
+        s = summarize_trace(read_trace(trace_path, strict=False))
+        phases = s["phases"]
+        if not phases:
+            return False
+        parts = [
+            f"{name} {p['total_s']:.1f}s ({p['count']})"
+            for name, p in phases.items()
+        ]
+        h = s["health"]
+        health = ", ".join(
+            f"{k}={h[k]:.3g}" if isinstance(h[k], float) else f"{k}={h[k]}"
+            for k in ("max_rhat", "min_ess", "num_divergent")
+            if h.get(k) is not None
+        )
+        print(
+            f"[bench] chees phases (trace run {s['run']}): "
+            + ", ".join(parts)
+            + f"; restarts {s['restarts']}"
+            + (f"; {health}" if health else "")
+            + f"  [{trace_path}]",
+            file=sys.stderr,
+        )
+        return True
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return False
+
+
+def _print_phase_breakdown_from_metrics(metrics_path):
+    """Legacy fallback: coarse warmup-vs-blocks split from the runner's
+    metrics JSONL (no per-phase durations — the trace is the real
+    artifact)."""
+    try:
+        recs = [json.loads(l) for l in open(metrics_path)]
+        n_restarts = sum(1 for r in recs if r["event"] == "restart")
+        # wall_s restarts at each attempt's own t_start, so only
+        # compare records WITHIN the final attempt (after the last
+        # restart event); a resumed attempt has no warmup_done
+        last = max(
+            (i for i, r in enumerate(recs) if r["event"] == "restart"),
+            default=-1,
+        )
+        attempt = recs[last + 1 :]
+        warm = [r for r in attempt if r["event"] == "warmup_done"]
+        blocks = [r for r in attempt if r["event"] == "block"]
+        if blocks:
+            w = warm[-1]["wall_s"] if warm else 0.0
+            tag = (
+                f"warmup(+init/compile) {w:.1f}s, "
+                if warm
+                else "resumed (no warmup), "
+            )
+            print(
+                f"[bench] chees phases (final attempt): {tag}blocks "
+                f"{blocks[-1]['wall_s'] - w:.1f}s "
+                f"({len(blocks)} blocks), restarts {n_restarts}",
+                file=sys.stderr,
+            )
+    except Exception:  # noqa: BLE001 — diagnostics only
+        pass
+
+
 def main():
     import jax
 
@@ -453,6 +526,17 @@ def main():
             # fresh run per bench invocation; WITHIN the invocation any
             # fault restarts from the last healthy block checkpoint
             shutil.rmtree(workdir, ignore_errors=True)
+            # structured run telemetry (stark_tpu.telemetry): one trace
+            # file spans every supervised attempt — the durable phase/
+            # chain-health artifact the phase breakdown below reads,
+            # replacing stdout scraping.  BENCH_TRACE redirects it.
+            from stark_tpu import telemetry
+
+            trace_path = os.environ.get("BENCH_TRACE") or os.path.join(
+                workdir, "trace.jsonl"
+            )
+            os.makedirs(workdir, exist_ok=True)
+            run_trace = telemetry.RunTrace(trace_path)
             t0 = time.perf_counter()
 
             def on_progress(r):
@@ -537,25 +621,31 @@ def main():
                         f"with MAP (exports to {cache})",
                         file=sys.stderr,
                     )
-            post = supervised_sample(
-                fused, data, workdir=workdir, chains=cc,
-                kernel="chees", num_warmup=chees_warm,
-                map_init_steps=map_steps,
-                adapt_path=adapt_path,
-                # structural invariant: exports NEVER land on the import
-                # candidate, so the tracked bench_artifacts/ copy cannot
-                # be dirtied even if the runner re-validation disagrees
-                # with the pre-check above
-                adapt_export_path=cache if adapt_path else None,
-                init_step_size=0.1, block_size=block,
-                max_blocks=math.ceil(chees_samp / block),
-                min_blocks=math.ceil(chees_samp / block),
-                rhat_target=0.0,  # run the full draw budget, no early stop
-                max_restarts=_env_int("BENCH_MAX_RESTARTS", 3),
-                progress_cb=on_progress,
-                time_budget_s=remaining,
-                seed=1,
-            )
+            try:
+                post = supervised_sample(
+                    fused, data, workdir=workdir, chains=cc,
+                    trace=run_trace,
+                    kernel="chees", num_warmup=chees_warm,
+                    map_init_steps=map_steps,
+                    adapt_path=adapt_path,
+                    # structural invariant: exports NEVER land on the
+                    # import candidate, so the tracked bench_artifacts/
+                    # copy cannot be dirtied even if the runner
+                    # re-validation disagrees with the pre-check above
+                    adapt_export_path=cache if adapt_path else None,
+                    init_step_size=0.1, block_size=block,
+                    max_blocks=math.ceil(chees_samp / block),
+                    min_blocks=math.ceil(chees_samp / block),
+                    rhat_target=0.0,  # full draw budget, no early stop
+                    max_restarts=_env_int("BENCH_MAX_RESTARTS", 3),
+                    progress_cb=on_progress,
+                    time_budget_s=remaining,
+                    seed=1,
+                )
+            finally:
+                # the trace must close on the failure path too — the
+                # chees-leg except below otherwise leaks the handle
+                run_trace.close()
             wall = time.perf_counter() - t0
             budget_hit = getattr(post, "budget_exhausted", False)
             eps_chees = post.min_ess() / wall
@@ -568,40 +658,15 @@ def main():
                 f"max_rhat={rhat:.3f}",
                 file=sys.stderr,
             )
-            # phase breakdown from the runner's metrics JSONL, so the
-            # on-chip wall decomposes (compile+MAP+warmup vs draw blocks)
-            # instead of being one opaque number
-            try:
-                recs = [
-                    json.loads(l)
-                    for l in open(os.path.join(workdir, "metrics.jsonl"))
-                ]
-                n_restarts = sum(1 for r in recs if r["event"] == "restart")
-                # wall_s restarts at each attempt's own t_start, so only
-                # compare records WITHIN the final attempt (after the last
-                # restart event); a resumed attempt has no warmup_done
-                last = max(
-                    (i for i, r in enumerate(recs) if r["event"] == "restart"),
-                    default=-1,
+            # phase breakdown from the telemetry trace (the durable
+            # artifact), so the on-chip wall decomposes (compile+MAP vs
+            # warmup vs draw blocks vs checkpoint I/O) instead of being
+            # one opaque number.  Falls back to the runner's metrics
+            # JSONL for traces lost to e.g. a full disk.
+            if not _print_phase_breakdown_from_trace(trace_path):
+                _print_phase_breakdown_from_metrics(
+                    os.path.join(workdir, "metrics.jsonl")
                 )
-                attempt = recs[last + 1 :]
-                warm = [r for r in attempt if r["event"] == "warmup_done"]
-                blocks = [r for r in attempt if r["event"] == "block"]
-                if blocks:
-                    w = warm[-1]["wall_s"] if warm else 0.0
-                    tag = (
-                        f"warmup(+init/compile) {w:.1f}s, "
-                        if warm
-                        else "resumed (no warmup), "
-                    )
-                    print(
-                        f"[bench] chees phases (final attempt): {tag}blocks "
-                        f"{blocks[-1]['wall_s'] - w:.1f}s "
-                        f"({len(blocks)} blocks), restarts {n_restarts}",
-                        file=sys.stderr,
-                    )
-            except Exception:  # noqa: BLE001 — diagnostics only
-                pass
         except Exception as e:  # noqa: BLE001 — after supervised retries
             print(f"[bench] chees path failed after retries: {e!r}",
                   file=sys.stderr)

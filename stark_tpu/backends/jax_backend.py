@@ -22,6 +22,7 @@ import numpy as np
 
 from ..guard import annotate_dispatch, resolve_dispatch
 from ..model import Model, flatten_model, prepare_model_data
+from ..telemetry import get_trace
 from ..sampler import (
     Posterior,
     SamplerConfig,
@@ -31,6 +32,26 @@ from ..sampler import (
     make_chain_runner,
     make_segmented_warmup,
 )
+
+
+def _emit_chain_health(trace, stats: Dict[str, Any]) -> None:
+    """One end-of-run chain_health event from a Posterior stats dict —
+    the monolithic paths' health record (the block-bounded drivers emit
+    per-block health instead).  Tolerant of missing keys: kernels differ
+    in what they surface."""
+    fields: Dict[str, Any] = {}
+    acc = stats.get("accept_prob")
+    if acc is not None and np.asarray(acc).size:
+        fields["mean_accept"] = round(float(np.mean(np.asarray(acc))), 4)
+    for key, out in (("num_divergent", "num_divergent"),
+                     ("num_warmup_divergent", "num_warmup_divergent")):
+        v = stats.get(key)
+        if v is not None:
+            fields[out] = int(np.sum(np.asarray(v)))
+    ss = stats.get("step_size")
+    if ss is not None and np.asarray(ss).size:
+        fields["step_size"] = round(float(np.mean(np.asarray(ss))), 6)
+    trace.emit("chain_health", **fields)
 
 
 class JaxBackend:
@@ -75,49 +96,69 @@ class JaxBackend:
         seed: int,
         init_params: Optional[Dict[str, Any]] = None,
     ) -> Posterior:
-        fm = flatten_model(model)
-        data = prepare_model_data(model, data)
-        # device-program guard (guard.py): validate an explicit dispatch
-        # bound, and auto-bound a monolithic run on accelerator platforms
-        # — whole-run device programs are the measured relay-fault class.
-        # The guard keys on the platform the run will actually execute on
-        # (a pinned CPU device on a TPU host has no program cap).
-        dispatch_steps, dispatch_auto = resolve_dispatch(
-            cfg, self.dispatch_steps,
-            platform=None if self.device is None else self.device.platform,
-        )
-
+        trace = get_trace()
+        # model flattening + data prep are the run's setup cost: traced as
+        # a compile-stage phase so the per-run phase durations tile the
+        # wall (run_start -> run_end); a setup fault records its error
+        # class in the phase event like every other phase
+        with trace.phase("compile", stage="setup"):
+            fm = flatten_model(model)
+            data = prepare_model_data(model, data)
+            # device-program guard (guard.py): validate an explicit
+            # dispatch bound, and auto-bound a monolithic run on
+            # accelerator platforms — whole-run device programs are the
+            # measured relay-fault class.  The guard keys on the platform
+            # the run will actually execute on (a pinned CPU device on a
+            # TPU host has no program cap).
+            dispatch_steps, dispatch_auto = resolve_dispatch(
+                cfg, self.dispatch_steps,
+                platform=None if self.device is None else self.device.platform,
+            )
         if cfg.kernel == "chees":
             # ensemble kernel: served through the same backend boundary but
             # driven by the chees parts (its warmup adapts cross-chain, so
             # the per-chain vmapped runner does not apply)
             from ..chees import run_chees
 
-            post = run_chees(
-                fm,
-                cfg,
-                data,
-                chains=chains,
-                seed=seed,
-                init_params=init_params,
-                dispatch_steps=dispatch_steps,
-                jit_cache=self._cache.setdefault((model, cfg, "chees"), {}),
-                device=self.device,
-            )
+            # one phase for the whole ensemble drive: the chees host loop
+            # has its own internal segmentation, but its warmup/sample
+            # split is not surfaced here — the adaptive runner
+            # (sample_until_converged) is the finely-traced chees path
+            with trace.phase("sample_block", kernel="chees",
+                             includes_warmup=True, chains=chains):
+                post = run_chees(
+                    fm,
+                    cfg,
+                    data,
+                    chains=chains,
+                    seed=seed,
+                    init_params=init_params,
+                    dispatch_steps=dispatch_steps,
+                    jit_cache=self._cache.setdefault(
+                        (model, cfg, "chees"), {}
+                    ),
+                    device=self.device,
+                )
+            if trace.enabled:
+                _emit_chain_health(trace, post.sample_stats)
             annotate_dispatch(post.sample_stats, dispatch_steps, dispatch_auto)
             return post
 
-        key = jax.random.PRNGKey(seed)
-        key_init, key_run = jax.random.split(key)
-        if init_params is not None:
-            z0 = jnp.broadcast_to(fm.unconstrain(init_params), (chains, fm.ndim))
-        else:
-            z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
-        chain_keys = jax.random.split(key_run, chains)
+        # per-chain init keys/positions: first PRNG compiles of the run
+        with trace.phase("compile", stage="chain_init"):
+            key = jax.random.PRNGKey(seed)
+            key_init, key_run = jax.random.split(key)
+            if init_params is not None:
+                z0 = jnp.broadcast_to(
+                    fm.unconstrain(init_params), (chains, fm.ndim)
+                )
+            else:
+                z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
+            chain_keys = jax.random.split(key_run, chains)
 
-        if self.device is not None:
-            z0 = jax.device_put(z0, self.device)
-            chain_keys = jax.device_put(chain_keys, self.device)
+            if self.device is not None:
+                z0 = jax.device_put(z0, self.device)
+                chain_keys = jax.device_put(chain_keys, self.device)
 
         if dispatch_steps:
             post = self._run_segmented(
@@ -126,21 +167,35 @@ class JaxBackend:
             annotate_dispatch(post.sample_stats, dispatch_steps, dispatch_auto)
             return post
 
+        # monolithic dispatch: warmup+sampling fused in ONE device program,
+        # so the trace gets a single sample_block covering it (the cache
+        # miss flags where XLA compile time is hiding inside the phase)
+        cache_hit = (model, cfg) in self._cache
         run = self._get_runner(model, fm, cfg)
-        res = run(chain_keys, z0, data)
-        res = jax.block_until_ready(res)
+        with trace.phase(
+            "sample_block",
+            includes_warmup=True,
+            includes_compile=not cache_hit,
+            transitions=cfg.num_warmup + cfg.num_samples * cfg.thin,
+            chains=chains,
+        ):
+            res = run(chain_keys, z0, data)
+            res = jax.block_until_ready(res)
 
-        draws = _constrain_draws(fm, res.draws)
-        stats = {
-            "accept_prob": np.asarray(res.accept_prob),
-            "is_divergent": np.asarray(res.is_divergent),
-            "energy": np.asarray(res.energy),
-            "num_grad_evals": np.asarray(res.num_grad_evals),
-            "step_size": np.asarray(res.step_size),
-            "inv_mass_diag": np.asarray(res.inv_mass_diag),
-            "num_warmup_divergent": np.asarray(res.num_warmup_divergent),
-            "num_divergent": np.asarray(res.num_divergent),
-        }
+        with trace.phase("collect"):
+            draws = _constrain_draws(fm, res.draws)
+            stats = {
+                "accept_prob": np.asarray(res.accept_prob),
+                "is_divergent": np.asarray(res.is_divergent),
+                "energy": np.asarray(res.energy),
+                "num_grad_evals": np.asarray(res.num_grad_evals),
+                "step_size": np.asarray(res.step_size),
+                "inv_mass_diag": np.asarray(res.inv_mass_diag),
+                "num_warmup_divergent": np.asarray(res.num_warmup_divergent),
+                "num_divergent": np.asarray(res.num_divergent),
+            }
+        if trace.enabled:
+            _emit_chain_health(trace, stats)
         annotate_dispatch(stats, 0, False)
         return Posterior(
             draws, stats, flat_model=fm, draws_flat=np.asarray(res.draws)

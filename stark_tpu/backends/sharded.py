@@ -17,8 +17,9 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..compat import shard_map
 
 from ..guard import annotate_dispatch, resolve_dispatch
 from ..model import Model, flatten_model, prepare_model_data

@@ -7,6 +7,11 @@ primary metric being effective samples/sec/chip (BASELINE.json:2).
 
 Scales default to smoke-test sizes; ``bench.py`` at the repo root runs the
 flagship at full benchmark size on the real chip.
+
+Telemetry: under an ambient `telemetry` trace (the CLI's ``--trace PATH``),
+each benchmark's TIMED run emits the full event stream (run envelope, phase
+timings, chain health) — the compile pass is suppressed by ``_timed`` so
+the trace holds exactly one run per benchmark.
 """
 
 from __future__ import annotations
@@ -72,7 +77,14 @@ class BenchResult:
 
 
 def _timed(fn: Callable[[], Any]):
-    fn()  # compile pass — populates the backend's runner cache
+    from .telemetry import NULL_TRACE, use_trace
+
+    # compile pass — populates the backend's runner cache.  It runs with
+    # telemetry suppressed so a --trace file carries exactly ONE run (the
+    # timed one, whose phase durations tile the reported wall) instead of
+    # a compile-skewed duplicate.
+    with use_trace(NULL_TRACE):
+        fn()
     t0 = time.perf_counter()
     post = fn()
     wall = time.perf_counter() - t0
@@ -197,11 +209,16 @@ def bench_consensus_logistic(
     post, wall = _timed(run)
     extra = {"num_shards": num_shards, "sampler": sampler}
     if combine_check:
-        full = stark_tpu.sample(
-            model, data, chains=chains, kernel="chees",
-            num_warmup=num_warmup, num_samples=num_samples,
-            init_step_size=0.1, map_init_steps=200, seed=seed + 1,
-        )
+        from .telemetry import NULL_TRACE, use_trace
+
+        # correctness cross-check, not part of the consensus run: keep it
+        # out of the trace so the traced consensus run stays the last one
+        with use_trace(NULL_TRACE):
+            full = stark_tpu.sample(
+                model, data, chains=chains, kernel="chees",
+                num_warmup=num_warmup, num_samples=num_samples,
+                init_step_size=0.1, map_init_steps=200, seed=seed + 1,
+            )
         mc = np.asarray(post.draws["beta"]).mean(axis=(0, 1))
         mf = np.asarray(full.draws["beta"]).mean(axis=(0, 1))
         sf = np.asarray(full.draws["beta"]).std(axis=(0, 1))

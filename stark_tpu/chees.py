@@ -292,11 +292,26 @@ def make_chees_parts(
             inv_mass=carry.inv_mass,
         )
 
+    # telemetry opt-in (cfg.progress_every): jit-safe in-loop heartbeat
+    # inside the compiled sampling scan; None (default) leaves the
+    # compiled program identical to the untraced build
+    from .kernels.base import scan_progress
+
     def sample_segment(carry: CheesRunCarry, keys, us, data=None):
         potential_fn = fm.bind(data)
+        # built at trace time so the interval clamps to THIS segment's
+        # length (keys.shape is static per compiled variant): an interval
+        # longer than one dispatch still heartbeats once per segment
+        tick = scan_progress(
+            "chees_sample",
+            min(cfg.progress_every, keys.shape[0])
+            if cfg.progress_every and keys.shape[0]
+            else None,
+        )
 
         def body(c: CheesRunCarry, x):
-            key, u = x
+            # x gains a leading segment-local index under the heartbeat
+            (i, key, u) = x if tick is not None else (None,) + x
             # cap at warm_cap, not max_leapfrog: with the u in (0,2)
             # jitter a larger cap would let sampling run trajectory
             # lengths warmup never executed
@@ -305,6 +320,8 @@ def make_chees_parts(
                 num_steps(u, c.log_T, c.log_eps, warm_cap),
                 chains_axis=chains_axis,
             )
+            if tick is not None:
+                tick(i, jnp.mean(info.accept_prob))
             out = (
                 states.z,
                 info.accept_prob,
@@ -313,7 +330,12 @@ def make_chees_parts(
             )
             return CheesRunCarry(states, c.log_eps, c.log_T, c.inv_mass), out
 
-        return jax.lax.scan(body, carry, (keys, us))
+        xs = (
+            (jnp.arange(keys.shape[0]), keys, us)
+            if tick is not None
+            else (keys, us)
+        )
+        return jax.lax.scan(body, carry, xs)
 
     return CheesParts(
         init_carry=init_carry,

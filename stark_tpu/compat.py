@@ -1,0 +1,24 @@
+"""JAX version compatibility shims.
+
+One place for API drift between the jax versions this repo runs under, so
+call sites stay on the modern spelling.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, replication check named check_vma
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental home, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` with the modern keyword spelling on every version."""
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )

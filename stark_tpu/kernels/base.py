@@ -31,6 +31,37 @@ class HMCInfo(NamedTuple):
     num_grad_evals: Array
 
 
+def scan_progress(label: str, every):
+    """jit-safe in-loop progress for transition scans (telemetry opt-in).
+
+    Returns ``tick(i, accept_prob)`` — callable INSIDE a jitted
+    ``lax.scan`` body — that fires a ``jax.debug.callback`` into the
+    ambient `telemetry` trace every ``every`` transitions, or None when
+    disabled (``every`` falsy), in which case callers must skip the call
+    so the compiled program is bit-identical to the untraced one.
+
+    The callback is unordered (no sequencing constraint on the device
+    program) and the host side is rate-limited by the trace's heartbeat,
+    so a vmap-unrolled batch of callbacks cannot flood the trace file.
+    """
+    if not every:
+        return None
+    from .. import telemetry
+
+    def _host(step, accept):
+        telemetry.heartbeat(label, step, accept)
+
+    def tick(i, accept_prob):
+        jax.lax.cond(
+            (i + 1) % every == 0,
+            lambda a: jax.debug.callback(_host, i, a, ordered=False),
+            lambda a: None,
+            accept_prob,
+        )
+
+    return tick
+
+
 def value_and_grad_of(potential_fn: PotentialFn):
     """Use the potential's fused value_and_grad when it provides one
     (sharded models pack value+grad into a single psum — see model.Potential);

@@ -18,14 +18,16 @@ Execution layouts:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry
+from ..compat import shard_map
 from ..model import Model, flatten_model, prepare_model_data
 from ..sampler import Posterior, SamplerConfig, _constrain_draws, make_chain_runner
 
@@ -146,23 +148,34 @@ def _run_chees_shards(
 
     segments = lambda n: chees_segments(dispatch_steps, n)
 
-    carry = jax.block_until_ready(init_j(ikeys, z0, sharded))
+    # shard-tagged telemetry: the vmapped segments advance EVERY local
+    # shard per dispatch, so phase events carry the shard range; per-shard
+    # health is emitted by consensus_sample once end-of-run stats exist
+    trace = telemetry.get_trace().tagged(shards=num_shards)
+    with trace.phase("compile", stage="init+map"):
+        carry = jax.block_until_ready(init_j(ikeys, z0, sharded))
     wdiv = 0
     for lo, hi in segments(cfg.num_warmup):
-        carry, (nd, _) = jax.block_until_ready(
-            warm_j(
-                carry, wkeys[:, lo:hi], u_warm[lo:hi], idxs[lo:hi],
-                aflags[lo:hi], wflags[lo:hi], sharded,
+        with trace.phase("warmup_block", start=lo, end=hi) as ph:
+            carry, (nd, _) = jax.block_until_ready(
+                warm_j(
+                    carry, wkeys[:, lo:hi], u_warm[lo:hi], idxs[lo:hi],
+                    aflags[lo:hi], wflags[lo:hi], sharded,
+                )
             )
-        )
+            if trace.enabled:
+                ph.note(num_divergent=int(np.sum(np.asarray(nd))))
         wdiv += int(np.sum(np.asarray(nd)))
     run_carry = jax.vmap(parts.finalize)(carry)
 
     zs_parts, acc_parts, div_parts = [], [], []
     for lo, hi in segments(total):
-        run_carry, (zs, acc, div, _) = jax.block_until_ready(
-            samp_j(run_carry, rkeys[:, lo:hi], u_run[lo:hi], sharded)
-        )
+        with trace.phase("sample_block", start=lo, end=hi) as ph:
+            run_carry, (zs, acc, div, _) = jax.block_until_ready(
+                samp_j(run_carry, rkeys[:, lo:hi], u_run[lo:hi], sharded)
+            )
+            if trace.enabled:
+                ph.note(mean_accept=round(float(np.mean(np.asarray(acc))), 4))
         zs_parts.append(np.asarray(zs))
         acc_parts.append(np.asarray(acc))
         div_parts.append(np.asarray(div))
@@ -222,6 +235,19 @@ def consensus_sample(
     the per-host devices already serve the local shards.
     """
     cfg = SamplerConfig(**cfg_kwargs)
+    trace = telemetry.get_trace().tagged(component="consensus")
+    t_run0 = time.perf_counter()
+    if trace.enabled:
+        trace.emit(
+            "run_start",
+            entry="consensus",
+            model=type(model).__name__,
+            kernel=cfg.kernel,
+            num_shards=num_shards,
+            chains_per_shard=chains,
+            combine=combine,
+            **telemetry.device_info(),
+        )
     fm = flatten_model(model, prior_scale=1.0 / num_shards)
     data = prepare_model_data(model, data)
     row_axes = model.data_row_axes(data)
@@ -329,9 +355,15 @@ def consensus_sample(
         vchains = jax.vmap(runner, in_axes=(0, 0, None))  # chains within a shard
         vshards = jax.vmap(vchains, in_axes=(0, 0, 0))  # across shards
 
+        # the per-chain layout is one monolithic dispatch over all local
+        # shards: a single shard-tagged sample_block covers it
+        blk = trace.tagged(shards=shards_here).phase(
+            "sample_block", includes_warmup=True, includes_compile=True
+        )
         if mesh is None:
             run = jax.jit(vshards)
-            res = jax.block_until_ready(run(keys, z0, sharded))
+            with blk:
+                res = jax.block_until_ready(run(keys, z0, sharded))
         else:
             specs = jax.tree.map(lambda _: P("data"), sharded)
             fn = shard_map(
@@ -347,7 +379,8 @@ def consensus_sample(
                 lambda x: jax.device_put(x, NamedSharding(mesh, P("data"))),
                 sharded,
             )
-            res = jax.block_until_ready(jax.jit(fn)(keys, z0, sharded))
+            with blk:
+                res = jax.block_until_ready(jax.jit(fn)(keys, z0, sharded))
         draws_sub = res.draws  # (S, C, T, d)
         stats_extra = {
             "accept_prob": np.asarray(res.accept_prob).reshape(
@@ -370,19 +403,41 @@ def consensus_sample(
         draws_sub = gathered.pop("draws")
         stats_extra = gathered
 
-    if combine == "precision":
-        combined = _combine_precision_weighted(draws_sub)
-    elif combine == "precision_full":
-        combined = _combine_precision_weighted_full(draws_sub)
-    elif combine == "uniform":
-        combined = jnp.mean(draws_sub, axis=0)
-    else:
-        raise ValueError(f"unknown combine {combine!r}")
+    if trace.enabled:
+        # per-shard health, each event tagged with its GLOBAL shard id —
+        # how a dead or mis-stepped sub-posterior is singled out in the
+        # trace (step sizes/divergences are per shard by construction)
+        ss = np.asarray(stats_extra["step_size"])
+        nd = np.asarray(stats_extra["num_divergent"])
+        tl = stats_extra.get("traj_length")
+        for k in range(ss.shape[0]):
+            fields = {"step_size": round(float(np.mean(ss[k])), 6)}
+            if nd.ndim >= 1 and nd.shape[0] == ss.shape[0]:
+                fields["num_divergent"] = int(np.sum(nd[k]))
+            if tl is not None:
+                fields["traj_length"] = round(float(np.asarray(tl)[k]), 4)
+            trace.tagged(shard=k).emit("chain_health", **fields)
 
-    draws = _constrain_draws(fm, combined)
+    with trace.phase("collect", stage=f"combine:{combine}"):
+        if combine == "precision":
+            combined = _combine_precision_weighted(draws_sub)
+        elif combine == "precision_full":
+            combined = _combine_precision_weighted_full(draws_sub)
+        elif combine == "uniform":
+            combined = jnp.mean(draws_sub, axis=0)
+        else:
+            raise ValueError(f"unknown combine {combine!r}")
+
+        draws = _constrain_draws(fm, combined)
     stats = {
         **stats_extra,
         "num_shards": num_shards,
         "sub_draws_flat": np.asarray(draws_sub),
     }
+    if trace.enabled:
+        trace.emit(
+            "run_end",
+            dur_s=round(time.perf_counter() - t_run0, 4),
+            num_divergent=int(np.sum(np.asarray(stats_extra["num_divergent"]))),
+        )
     return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(combined))

@@ -113,7 +113,7 @@ def run_over_chains(mesh: Mesh, vrun, *args):
     P("chains") out_spec is applied as a pytree prefix).  Shared dispatch
     for the samplers that parallelize only over chains (SG-HMC, tempering).
     """
-    from jax import shard_map
+    from ..compat import shard_map
 
     if "chains" not in mesh.axis_names:
         raise ValueError("mesh must have a 'chains' axis")

@@ -24,6 +24,7 @@ with reparameterized HMC + parallel tempering"); reference tree absent
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Dict, NamedTuple, Optional
 
@@ -32,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from .. import telemetry
 from ..adaptation import da_init, da_update
 from ..kernels.base import HMCState
 from ..kernels.hmc import hmc_step
@@ -289,6 +291,20 @@ def tempered_sample(
             jnp.exp(da.log_avg_step),
         )
 
+    trace = telemetry.get_trace().tagged(component="tempering")
+    t_run0 = time.perf_counter()
+    if trace.enabled:
+        trace.emit(
+            "run_start",
+            entry="tempered",
+            model=type(model).__name__,
+            kernel=kernel,
+            chains=chains,
+            num_temps=num_temps,
+            swap_every=swap_every,
+            adapt_ladder=adapt_ladder,
+            **telemetry.device_info(),
+        )
     key = jax.random.PRNGKey(seed)
     key_init, key_run = jax.random.split(key)
     if init_params is not None:
@@ -304,15 +320,44 @@ def tempered_sample(
     chain_keys = jax.random.split(key_run, chains)
 
     vrun = jax.vmap(run_chain)
-    if mesh is None:
-        out = jax.block_until_ready(jax.jit(vrun)(chain_keys, z0))
-    else:
-        from .mesh import run_over_chains
+    # the whole K-replica ladder runs as ONE device program (a swap is a
+    # gather, not communication) — one sample_block phase covers it
+    with trace.phase(
+        "sample_block", includes_warmup=True, includes_compile=True,
+        transitions=num_warmup + num_samples, replicas=chains * num_temps,
+    ):
+        if mesh is None:
+            out = jax.block_until_ready(jax.jit(vrun)(chain_keys, z0))
+        else:
+            from .mesh import run_over_chains
 
-        out = run_over_chains(mesh, vrun, chain_keys, z0)
+            out = run_over_chains(mesh, vrun, chain_keys, z0)
 
     zs, n_div, swap_rate, rate_per_pair, betas_final, step_sizes = out
-    draws = _constrain_draws(fm, zs)
+    if trace.enabled:
+        # per-replica health (replica = temperature rung), tagged with the
+        # rung index: a frozen hot rung or a dead swap pair is visible per
+        # rung, not averaged away
+        bf = np.asarray(betas_final)
+        bf = bf if bf.ndim == 2 else np.broadcast_to(bf, (chains, num_temps))
+        ss_np = np.asarray(step_sizes)
+        rp = np.asarray(rate_per_pair)
+        for k in range(num_temps):
+            fields = {
+                "step_size": round(float(np.mean(ss_np[:, k])), 6),
+                "beta": round(float(np.mean(bf[:, k])), 5),
+            }
+            if k < num_temps - 1 and rp.size:
+                # swap rate of the (k, k+1) gap this rung COLDER-ends
+                fields["swap_accept_pair"] = round(float(np.mean(rp[:, k])), 4)
+            trace.tagged(replica=k).emit("chain_health", **fields)
+        trace.emit(
+            "chain_health",
+            num_divergent=int(np.sum(np.asarray(n_div))),
+            swap_accept_rate=round(float(np.mean(np.asarray(swap_rate))), 4),
+        )
+    with trace.phase("collect"):
+        draws = _constrain_draws(fm, zs)
     stats = {
         "num_divergent": np.asarray(n_div),
         "swap_accept_rate": np.asarray(swap_rate),
@@ -326,4 +371,10 @@ def tempered_sample(
         "betas_init": np.asarray(betas),
         "betas_adapted": np.asarray(betas_final),
     }
+    if trace.enabled:
+        trace.emit(
+            "run_end",
+            dur_s=round(time.perf_counter() - t_run0, 4),
+            num_divergent=int(np.sum(np.asarray(n_div))),
+        )
     return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(zs))

@@ -10,10 +10,17 @@ the pattern, used by bench.py and the ``python -m stark_tpu`` CLI.
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import subprocess
 import sys
+
+#: module logger (repo lint: no bare print() in library code — see
+#: tools/lint_no_print.py).  Diagnostics here are warnings: with no
+#: handler configured they still reach stderr via logging's last-resort
+#: handler, so the dead-relay fallback is never silent.
+log = logging.getLogger("stark_tpu.platform")
 
 #: ports the axon relay listens on (init goes via :8083, session via
 #: :8082).  When the relay is DEAD these refuse a TCP connect within
@@ -59,11 +66,9 @@ def probe_accelerator(timeout: int = None) -> bool:
             listening = True  # inconclusive: run the full probe
         if not listening:
             ports = ", ".join(map(str, _RELAY_PORTS))
-            print(
-                f"[platform] relay ports {ports} on {pool} refused — "
-                "accelerator dead, falling back to CPU platform without "
-                "the full probe",
-                file=sys.stderr,
+            log.warning(
+                "relay ports %s on %s refused — accelerator dead, falling "
+                "back to CPU platform without the full probe", ports, pool,
             )
             return False
     if timeout is None:
@@ -78,10 +83,9 @@ def probe_accelerator(timeout: int = None) -> bool:
         )
         return True
     except Exception as e:  # noqa: BLE001 — timeout/crash both mean "no"
-        print(
-            f"[platform] accelerator probe failed ({type(e).__name__}); "
-            "falling back to CPU platform",
-            file=sys.stderr,
+        log.warning(
+            "accelerator probe failed (%s); falling back to CPU platform",
+            type(e).__name__,
         )
         return False
 

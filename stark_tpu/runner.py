@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import diagnostics
+from . import diagnostics, telemetry
 from .kernels.base import HMCState
 from .model import Model
 from .sampler import Posterior, SamplerConfig, _constrain_draws
@@ -120,7 +120,18 @@ def load_adapt_state(path, *, kernel, model_name, ndim, data_fp=None):
         return None, repr(e)
 
 
-def sample_until_converged(
+def sample_until_converged(model: Model, data: Any = None, **kwargs):
+    """Run chains until converged — see `_sample_until_converged` for the
+    full parameter reference (this thin wrapper only pins the telemetry
+    trace as ambient for the WHOLE run, so in-loop ``progress_every``
+    heartbeats and backend-driver phase events reach a parameter-passed
+    trace, not just an ambiently installed one)."""
+    trace = telemetry.resolve_trace(kwargs.pop("trace", None))
+    with telemetry.use_trace(trace):
+        return _sample_until_converged(model, data, trace=trace, **kwargs)
+
+
+def _sample_until_converged(
     model: Model,
     data: Any = None,
     *,
@@ -146,6 +157,7 @@ def sample_until_converged(
     adapt_path: Optional[str] = None,
     adapt_export_path: Optional[str] = None,
     adapt_touchup_frac: float = 0.2,
+    trace: Optional[Any] = None,
     **cfg_kwargs,
 ) -> AdaptiveResult:
     """Run chains until R-hat < rhat_target AND min-ESS > ess_target.
@@ -195,6 +207,14 @@ def sample_until_converged(
     stale import costs extra blocks, never a false convergence claim.
     Set ``map_init_steps=0`` on reuse runs — MAP descent from imported
     typical-set positions is wasted work.
+
+    ``trace`` (default: the ambient `telemetry` trace, `NullTrace` when
+    none is installed): schema-versioned JSONL run telemetry — run
+    envelope, compile/warmup_block/sample_block phase timings, per-block
+    chain_health (acceptance, step size, divergences, R-hat/ESS), and
+    checkpoint durations.  Distinct from ``metrics_path`` (the runner's
+    convergence trail): the trace is the cross-run artifact
+    `tools/trace_report.py` and `bench.py` consume.
     """
     cfg = SamplerConfig(**cfg_kwargs)
     if backend is None:
@@ -235,7 +255,28 @@ def sample_until_converged(
         if (adapt_path or adapt_export_path)
         else None
     )
-    ap = backend.adaptive_parts(model, cfg, data)
+    # telemetry (telemetry.py): the runner is the primary trace emitter —
+    # run envelope, compile/warmup/sample phase boundaries, per-block
+    # chain health, checkpoint timings.  Default is the ambient trace
+    # (NullTrace unless a --trace flag / bench driver installed one).
+    trace = telemetry.resolve_trace(trace)
+    t_run0 = time.perf_counter()  # run_end dur covers setup/compile too
+    if trace.enabled:
+        trace.emit(
+            "run_start",
+            entry="sample_until_converged",
+            model=type(model).__name__,
+            kernel=cfg.kernel,
+            chains=chains,
+            block_size=block_size,
+            max_blocks=max_blocks,
+            rhat_target=rhat_target,
+            ess_target=ess_target,
+            resuming=bool(resume_from),
+            **telemetry.device_info(),
+        )
+    with trace.phase("compile", stage="build"):
+        ap = backend.adaptive_parts(model, cfg, data)
     fm, data, extra = ap.fm, ap.data, ap.extra
 
     is_chees = cfg.kernel == "chees"
@@ -256,6 +297,7 @@ def sample_until_converged(
             """Warmup-phase checkpoint: the full CheesWarmCarry, so a
             fault mid-warmup resumes at the last finished segment instead
             of burning the whole (dominant) warmup budget again."""
+            t_ckpt = time.perf_counter()
             from .checkpoint import save_checkpoint
 
             # ap.collect (gather_draws on a mesh) materializes the
@@ -303,6 +345,14 @@ def sample_until_converged(
                     "model": type(model).__name__,
                 },
             )
+            if trace.enabled:
+                trace.emit(
+                    "checkpoint",
+                    stage="warmup",
+                    warm_done=done,
+                    path=path,
+                    dur_s=round(time.perf_counter() - t_ckpt, 4),
+                )
 
         def run_chees_touchup(carry, key_warm):
             """Short re-equilibration warmup for an imported adaptation
@@ -325,12 +375,17 @@ def sample_until_converged(
             n_div, n_leap = 0, 0
             for s in range(0, n, block_size):
                 e = min(s + block_size, n)
-                carry, (nd, nl) = jax.block_until_ready(
-                    chees_warm_j(
-                        carry, wkeys[s:e], u[s:e], idxs[s:e],
-                        aoff[s:e], woff[s:e], *extra,
+                with trace.phase(
+                    "warmup_block", start=s, end=e, stage="touchup"
+                ) as ph:
+                    carry, (nd, nl) = jax.block_until_ready(
+                        chees_warm_j(
+                            carry, wkeys[s:e], u[s:e], idxs[s:e],
+                            aoff[s:e], woff[s:e], *extra,
+                        )
                     )
-                )
+                    if trace.enabled:
+                        ph.note(num_divergent=int(nd), leapfrogs=int(nl))
                 n_div += int(nd)
                 n_leap += int(nl)
             return carry, n_div, n_leap
@@ -418,12 +473,15 @@ def sample_until_converged(
             n_div, n_leap = nd0, nl0
             for s in range(start, cfg.num_warmup, block_size):
                 e = min(s + block_size, cfg.num_warmup)
-                carry, (nd, nl) = jax.block_until_ready(
-                    chees_warm_j(
-                        carry, wkeys[s:e], u_warm[s:e], idxs[s:e],
-                        aflags[s:e], wflags[s:e], *extra,
+                with trace.phase("warmup_block", start=s, end=e) as ph:
+                    carry, (nd, nl) = jax.block_until_ready(
+                        chees_warm_j(
+                            carry, wkeys[s:e], u_warm[s:e], idxs[s:e],
+                            aflags[s:e], wflags[s:e], *extra,
+                        )
                     )
-                )
+                    if trace.enabled:
+                        ph.note(num_divergent=int(nd), leapfrogs=int(nl))
                 n_div += int(nd)
                 n_leap += int(nl)
                 if checkpoint_path and e < cfg.num_warmup:
@@ -458,6 +516,14 @@ def sample_until_converged(
                 # pipe would otherwise surface as a sampler fault and burn
                 # the supervisor's restart budget on healthy state
                 pass
+        if trace.enabled and rec.get("event", "").startswith("adapt_"):
+            # adaptation decisions (import rejected / export skipped)
+            # mirror into the trace as auxiliary events
+            trace.emit(
+                "adapt",
+                kind=rec["event"],
+                **{k: v for k, v in rec.items() if k != "event"},
+            )
 
     def emit_warmup_done(n_div_total, step_size, warmup_grads=None,
                          resumed_from=None, adapt_imported=None):
@@ -478,6 +544,13 @@ def sample_until_converged(
         if adapt_imported:
             rec["adapt_imported"] = True
         emit(rec)
+        if trace.enabled:
+            trace.emit(
+                "chain_health",
+                status="warmup_done",
+                num_divergent=rec["num_divergent"],
+                step_size=round(float(np.mean(rec["step_size"])), 6),
+            )
 
     blocks_done = 0
     total_div = 0
@@ -618,7 +691,12 @@ def sample_until_converged(
                 z0 = ap.put_chains(
                     chees_init_positions(fm, key_init, chains, init_params)
                 )
-            carry = jax.block_until_ready(chees_init_j(key_init, z0, *extra))
+            # init dispatch = first compile + MAP descent (map_init_steps)
+            with trace.phase("compile", stage="init+map",
+                             map_init_steps=cfg.map_init_steps):
+                carry = jax.block_until_ready(
+                    chees_init_j(key_init, z0, *extra)
+                )
             if warm_import is not None:
                 from .adaptation import da_init
 
@@ -663,6 +741,8 @@ def sample_until_converged(
                 z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
             z0 = ap.put_chains(z0)
             warm_keys = ap.put_chains(jax.random.split(key_warm, chains))
+            # the segmented warmup driver reads the ambient trace, which
+            # the public wrapper pinned to THIS run's trace
             state, step_size, inv_mass, n_div = seg_warmup(
                 warm_keys, z0, data, block_size
             )
@@ -848,8 +928,35 @@ def sample_until_converged(
                     next_full_check = blocks_done + max(1, blocks_done // 4)
             history.append(rec)
             emit(rec)
+            if trace.enabled:
+                # one phase event (timing) + one health event (diagnostics)
+                # per block — the dur covers dispatch + host diagnostics
+                # (including the occasional full validation pass)
+                trace.emit(
+                    "sample_block",
+                    block=blocks_done,
+                    dur_s=round(time.perf_counter() - t_blk, 4),
+                    t_dispatch_s=rec["t_dispatch_s"],
+                    t_diag_s=rec["t_diag_s"],
+                    draws_per_chain=draws_per_chain,
+                    block_grad_evals=blk_grads,
+                )
+                trace.emit(
+                    "chain_health",
+                    block=blocks_done,
+                    max_rhat=rec["max_rhat"],
+                    min_ess=rec["min_ess"],
+                    num_stuck_components=n_stuck,
+                    num_divergent=total_div,
+                    mean_accept=rec["mean_accept"],
+                    step_size=round(
+                        float(np.mean(np.asarray(ap.collect(step_size)))), 6
+                    ),
+                    draws_per_chain=draws_per_chain,
+                )
 
             if checkpoint_path:
+                t_ckpt = time.perf_counter()
                 from .checkpoint import save_checkpoint
 
                 arrays = ap.collect({
@@ -885,6 +992,13 @@ def sample_until_converged(
                         "kernel": cfg.kernel,
                     },
                 )
+                if trace.enabled:
+                    trace.emit(
+                        "checkpoint",
+                        block=blocks_done,
+                        path=checkpoint_path,
+                        dur_s=round(time.perf_counter() - t_ckpt, 4),
+                    )
 
             if converged:
                 break
@@ -920,6 +1034,11 @@ def sample_until_converged(
                         "wall_s": time.perf_counter() - t_start,
                     }
                 )
+                if trace.enabled:
+                    trace.emit(
+                        "budget", time_budget_s=float(time_budget_s),
+                        blocks=blocks_done,
+                    )
                 break
     finally:
         if metrics_f:
@@ -929,10 +1048,11 @@ def sample_until_converged(
 
     # cat_draws from the final loop iteration (if any) is still current —
     # draw_blocks does not change between its construction and loop exit
-    all_draws = cat_draws if cat_draws is not None else np.concatenate(
-        draw_blocks, axis=1
-    )
-    draws = _constrain_draws(fm, all_draws)
+    with trace.phase("collect"):
+        all_draws = cat_draws if cat_draws is not None else np.concatenate(
+            draw_blocks, axis=1
+        )
+        draws = _constrain_draws(fm, all_draws)
     stats = {"num_divergent": np.asarray(total_div)}
     result = AdaptiveResult(
         draws,
@@ -944,4 +1064,13 @@ def sample_until_converged(
         wall_s=time.perf_counter() - t_start,
     )
     result.budget_exhausted = budget_exhausted
+    if trace.enabled:
+        trace.emit(
+            "run_end",
+            dur_s=round(time.perf_counter() - t_run0, 4),
+            converged=converged,
+            blocks=blocks_done,
+            num_divergent=total_div,
+            budget_exhausted=budget_exhausted,
+        )
     return result

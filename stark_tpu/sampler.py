@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import time
 from functools import partial
 from typing import Any, Callable, Dict, NamedTuple, Optional
 
@@ -19,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import diagnostics
+from . import diagnostics, telemetry
 from .adaptation import (
     build_warmup_schedule,
     da_init,
@@ -54,6 +55,11 @@ class SamplerConfig:
     init_traj_length: Optional[float] = None
     max_leapfrog: int = 1000
     map_init_steps: int = 0
+    # telemetry opt-in: emit a jit-safe in-loop heartbeat (device -> host
+    # via jax.debug.callback) every N transitions inside the compiled
+    # sampling scans.  None (default) leaves the compiled programs
+    # bit-identical to the untraced build — the hot path pays nothing.
+    progress_every: Optional[int] = None
 
 
 def _tree_select(flag, a, b):
@@ -229,31 +235,37 @@ def drive_segmented_warmup(cfg, v_init, v_seg, finalize, warm_keys, z0, data,
     mesh (``ShardedBackend``); the schedule slicing and key layout live
     here so the two execution paths cannot drift.
     """
-    kinit = jax.vmap(lambda k: jax.random.split(k, 2))(warm_keys)
-    state, da, welford, inv_mass = jax.block_until_ready(
-        v_init(kinit[:, 0], z0, data)
-    )
-    schedule = build_warmup_schedule(cfg.num_warmup)
-    aflags = np.asarray(schedule.adapt_mass)
-    wflags = np.asarray(schedule.window_end)
-    # (num_warmup, chains, 2) step keys, computed and sliced ON DEVICE:
-    # chains-sharded keys must never materialize on one host (on a
-    # multi-process mesh they are not fully addressable), and slicing
-    # rides the replicated time axis so it is shard-local everywhere
-    wkeys = jnp.transpose(
-        jax.vmap(lambda k: jax.random.split(k, max(cfg.num_warmup, 1)))(
-            kinit[:, 1]
-        ),
-        (1, 0, 2),
-    )
+    trace = telemetry.get_trace()
+    # warmup-carry init (find_reasonable_step_size) + the per-chain key
+    # streams are the first compiles/dispatches of the run: one
+    # compile-stage phase covers them so phase sums tile the wall
+    with trace.phase("compile", stage="warmup_init"):
+        kinit = jax.vmap(lambda k: jax.random.split(k, 2))(warm_keys)
+        state, da, welford, inv_mass = jax.block_until_ready(
+            v_init(kinit[:, 0], z0, data)
+        )
+        schedule = build_warmup_schedule(cfg.num_warmup)
+        aflags = np.asarray(schedule.adapt_mass)
+        wflags = np.asarray(schedule.window_end)
+        # (num_warmup, chains, 2) step keys, computed and sliced ON DEVICE:
+        # chains-sharded keys must never materialize on one host (on a
+        # multi-process mesh they are not fully addressable), and slicing
+        # rides the replicated time axis so it is shard-local everywhere
+        wkeys = jnp.transpose(
+            jax.vmap(lambda k: jax.random.split(k, max(cfg.num_warmup, 1)))(
+                kinit[:, 1]
+            ),
+            (1, 0, 2),
+        )
     warm_div = None  # accumulated on device (chains-sharded under a mesh)
     for s in range(0, cfg.num_warmup, seg):
         e = min(s + seg, cfg.num_warmup)
-        state, da, welford, inv_mass, ndiv = jax.block_until_ready(
-            v_seg(wkeys[s:e], jnp.asarray(aflags[s:e]),
-                  jnp.asarray(wflags[s:e]), state, da, welford, inv_mass,
-                  data)
-        )
+        with trace.phase("warmup_block", start=s, end=e):
+            state, da, welford, inv_mass, ndiv = jax.block_until_ready(
+                v_seg(wkeys[s:e], jnp.asarray(aflags[s:e]),
+                      jnp.asarray(wflags[s:e]), state, da, welford, inv_mass,
+                      data)
+            )
         warm_div = ndiv if warm_div is None else warm_div + ndiv
     if warm_div is None:
         warm_div = jnp.zeros((warm_keys.shape[0],), jnp.int32)
@@ -293,6 +305,17 @@ def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):
     """
     step_kernel = make_kernel(cfg)
     warmup = make_warmup_fn(fm, cfg)
+    from .kernels.base import scan_progress
+
+    # clamp to the scan length so an interval longer than the run still
+    # heartbeats at least once (step values are scan-local)
+    total_steps = cfg.num_samples * cfg.thin
+    tick = scan_progress(
+        "sample",
+        min(cfg.progress_every, total_steps)
+        if cfg.progress_every and total_steps
+        else None,
+    )
 
     def run(key, z0, data=None):
         potential_fn = fm.bind(data)
@@ -303,9 +326,15 @@ def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):
             key_warm, state, potential_fn, kernel
         )
 
-        def body(carry, key):
+        def body(carry, x):
+            # x is (index, key) only when the in-loop heartbeat is on, so
+            # the untraced compiled program is bit-identical to the
+            # pre-telemetry build (hot path pays nothing by construction)
+            i, key = x if tick is not None else (None, x)
             state, wf = carry
             state, info = kernel(key, state, step_size=step_size, inv_mass_diag=inv_mass)
+            if tick is not None:
+                tick(i, info.accept_prob)
             wf = welford_update(wf, state.z)
             out = (
                 state.z,
@@ -318,9 +347,10 @@ def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):
 
         total = cfg.num_samples * cfg.thin
         keys = jax.random.split(key_sample, total)
+        xs = (jnp.arange(total), keys) if tick is not None else keys
         wf0 = welford_init(z0.shape[0], z0.dtype)
         (state, wf), (zs, accept, divergent, energy, ngrad) = jax.lax.scan(
-            body, (state, wf0), keys
+            body, (state, wf0), xs
         )
         # divergence count must cover ALL transitions, including thinned-out ones
         num_divergent = jnp.sum(divergent.astype(jnp.int32))
@@ -362,6 +392,15 @@ def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int):
     (``make_segmented_warmup``).
     """
     step_kernel = make_kernel(cfg)
+    from .kernels.base import scan_progress
+
+    # clamp to the block length: an interval longer than one dispatch
+    # block would otherwise never fire (scan indices restart per block;
+    # heartbeat steps are block-local by design)
+    tick = scan_progress(
+        "sample_block",
+        min(cfg.progress_every, block_size) if cfg.progress_every else None,
+    )
 
     def block_run(key, state, step_size, inv_mass, data=None):
         potential_fn = fm.bind(data)
@@ -370,10 +409,14 @@ def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int):
         # lazily only if absent is not possible under jit, so the carried
         # state must include pe/grad (it does — HMCState is the carry).
 
-        def body(state, key):
+        def body(state, x):
+            # (index, key) only under the heartbeat — see make_chain_runner
+            i, key = x if tick is not None else (None, x)
             state, info = kernel(
                 key, state, step_size=step_size, inv_mass_diag=inv_mass
             )
+            if tick is not None:
+                tick(i, info.accept_prob)
             out = (
                 state.z,
                 info.accept_prob,
@@ -384,8 +427,9 @@ def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int):
             return state, out
 
         keys = jax.random.split(key, block_size)
+        xs = (jnp.arange(block_size), keys) if tick is not None else keys
         state, (zs, accept, divergent, energy, ngrad) = jax.lax.scan(
-            body, state, keys
+            body, state, xs
         )
         return state, zs, accept, divergent, energy, ngrad
 
@@ -429,17 +473,28 @@ def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
     en_blocks = [np.zeros((chains, 0), np.float32)]
     ng_blocks = [np.zeros((chains, 0), np.int32)]
     num_divergent = np.zeros((chains,), np.int64)
+    trace = telemetry.get_trace()
     for s in range(0, total, seg):
         e = min(s + seg, total)
         v_block = get_block(e - s)
         # block_run splits its own per-step keys from one key per chain
         bkeys = skeys[:, s, :]
-        out = jax.block_until_ready(
-            v_block(bkeys, state, step_size, inv_mass, data)
-        )
-        state = out[0]
-        zs, accept, divergent, energy, ngrad = collect(out[1:])
+        with trace.phase("sample_block", start=s, end=e) as ph:
+            out = jax.block_until_ready(
+                v_block(bkeys, state, step_size, inv_mass, data)
+            )
+            state = out[0]
+            zs, accept, divergent, energy, ngrad = collect(out[1:])
+            if trace.enabled:
+                ph.note(mean_accept=round(float(np.mean(accept)), 4))
         num_divergent += divergent.astype(np.int64).sum(axis=1)
+        if trace.enabled:
+            trace.emit(
+                "chain_health",
+                transitions=int(e),
+                mean_accept=round(float(np.mean(accept)), 4),
+                num_divergent=int(num_divergent.sum()),
+            )
         # global transition i is kept when (i+1) % thin == 0
         keep = np.arange(s, e)
         keep = (
@@ -453,19 +508,20 @@ def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
         en_blocks.append(energy[:, keep])
         ng_blocks.append(ngrad[:, keep])
 
-    zs = np.concatenate(zs_blocks, axis=1)  # (chains, num_samples, d)
-    step_size, inv_mass = collect((step_size, inv_mass))
-    draws = _constrain_draws(fm, zs)
-    stats = {
-        "accept_prob": np.concatenate(acc_blocks, axis=1),
-        "is_divergent": np.concatenate(div_blocks, axis=1),
-        "energy": np.concatenate(en_blocks, axis=1),
-        "num_grad_evals": np.concatenate(ng_blocks, axis=1),
-        "step_size": step_size,
-        "inv_mass_diag": inv_mass,
-        "num_warmup_divergent": warm_div,
-        "num_divergent": num_divergent,
-    }
+    with trace.phase("collect"):
+        zs = np.concatenate(zs_blocks, axis=1)  # (chains, num_samples, d)
+        step_size, inv_mass = collect((step_size, inv_mass))
+        draws = _constrain_draws(fm, zs)
+        stats = {
+            "accept_prob": np.concatenate(acc_blocks, axis=1),
+            "is_divergent": np.concatenate(div_blocks, axis=1),
+            "energy": np.concatenate(en_blocks, axis=1),
+            "num_grad_evals": np.concatenate(ng_blocks, axis=1),
+            "step_size": step_size,
+            "inv_mass_diag": inv_mass,
+            "num_warmup_divergent": warm_div,
+            "num_divergent": num_divergent,
+        }
     return Posterior(draws, stats, flat_model=fm, draws_flat=zs)
 
 
@@ -563,6 +619,7 @@ def sample(
     backend: Any = None,
     init_params: Optional[Dict[str, Array]] = None,
     debug_nans: bool = False,
+    trace: Optional[Any] = None,
     **cfg_kwargs,
 ) -> Posterior:
     """Run MCMC and return a Posterior.
@@ -577,14 +634,42 @@ def sample(
     instead of surfacing later as a silently frozen chain — the sanitizer
     mode of SURVEY.md §6 (pure-functional JAX has no data races to detect;
     numerics are the failure class that remains).
+
+    trace: a `telemetry.RunTrace` (default: the ambient trace installed by
+    ``telemetry.use_trace`` / the CLI ``--trace`` flag; `NullTrace` when
+    none is installed — zero cost).  The run emits ``run_start`` /
+    ``run_end`` envelope events here; backends emit the phase events
+    (``warmup_block``/``sample_block``/``chain_health``) between them.
     """
     cfg = SamplerConfig(**cfg_kwargs)
     if backend is None:
         from .backends.jax_backend import JaxBackend
 
         backend = JaxBackend()
+    trace = telemetry.resolve_trace(trace)
     ctx = jax.debug_nans(True) if debug_nans else contextlib.nullcontext()
-    with ctx:
-        return backend.run(
+    with ctx, telemetry.use_trace(trace):
+        if trace.enabled:
+            trace.emit(
+                "run_start",
+                entry="sample",
+                model=type(model).__name__,
+                kernel=cfg.kernel,
+                chains=chains,
+                num_warmup=cfg.num_warmup,
+                num_samples=cfg.num_samples,
+                seed=seed,
+                backend=type(backend).__name__,
+                **telemetry.device_info(),
+            )
+        t0 = time.perf_counter()
+        post = backend.run(
             model, data, cfg, chains=chains, seed=seed, init_params=init_params
         )
+        if trace.enabled:
+            trace.emit(
+                "run_end",
+                dur_s=round(time.perf_counter() - t0, 4),
+                num_divergent=int(post.num_divergent),
+            )
+        return post

@@ -33,6 +33,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from . import telemetry
 from .checkpoint import load_checkpoint
 from .model import Model
 
@@ -104,6 +105,7 @@ def supervised_sample(
     max_restarts: int = 3,
     seed: int = 0,
     reseed_on_restart: bool = True,
+    trace=None,
     **kwargs,
 ):
     """Run ``sample_until_converged`` under supervision.
@@ -114,9 +116,17 @@ def supervised_sample(
     as a ``{"event": "restart", ...}`` line in the metrics JSONL — the
     observable failure-detection record.
 
+    ``trace`` (default: the ambient `telemetry` trace): ONE RunTrace spans
+    every attempt — each attempt emits its own run envelope, and restarts
+    appear between them as ``chain_health`` events with
+    ``status="restart"`` plus the fault class, so a trace file reads as
+    the complete supervision story.
+
     Returns the AdaptiveResult of the first successful attempt.
     """
     from .runner import sample_until_converged
+
+    trace = telemetry.resolve_trace(trace)
 
     # a wall-clock budget is an absolute deadline across ALL attempts — a
     # crash at 80% of the budget leaves the retry only the remaining 20%,
@@ -219,17 +229,21 @@ def supervised_sample(
                 if deadline is not None
                 else None
             )
-            return sample_until_converged(
-                model,
-                data,
-                seed=seed + attempt if reseed_on_restart else seed,
-                checkpoint_path=ckpt_path,
-                resume_from=resume,
-                metrics_path=metrics_path,
-                reseed=attempt if (attempt and reseed_on_restart) else None,
-                time_budget_s=remaining,
-                **kwargs,
-            )
+            # ambient install: the runner and the drivers below it pick up
+            # this supervisor's trace even though only ``trace=`` was given
+            with telemetry.use_trace(trace):
+                return sample_until_converged(
+                    model,
+                    data,
+                    seed=seed + attempt if reseed_on_restart else seed,
+                    checkpoint_path=ckpt_path,
+                    resume_from=resume,
+                    metrics_path=metrics_path,
+                    reseed=attempt if (attempt and reseed_on_restart) else None,
+                    time_budget_s=remaining,
+                    trace=trace,
+                    **kwargs,
+                )
         except Exception as e:  # noqa: BLE001 — supervision boundary
             attempt += 1
             rec = {
@@ -242,5 +256,15 @@ def supervised_sample(
             if metrics_path:  # caller may disable metrics with None
                 with open(metrics_path, "a") as f:
                     f.write(json.dumps(rec) + "\n")
+            if trace.enabled:
+                # the failure-detection record, in the trace's vocabulary:
+                # a chain-health transition, not a new run
+                trace.emit(
+                    "chain_health",
+                    status="restart",
+                    attempt=attempt,
+                    error=f"{type(e).__name__}: {e}",
+                    resumed_from_checkpoint=resume is not None,
+                )
             if attempt > max_restarts:
                 raise

@@ -1,0 +1,526 @@
+"""Structured run telemetry: a schema-versioned JSONL event bus (RunTrace).
+
+Today's only window into a run is stdout — ad-hoc ``[bench]`` lines and the
+JSON tail bench.py scrapes.  This module makes the run itself the artifact:
+a `RunTrace` appends one JSON object per line to a trace file, each event
+stamped with the schema version, wall-clock offsets, and the emitting
+component's tags (shard/replica ids on the parallel paths), so a stalled
+700 s run or an R-hat-2 chain decomposes into *phases* after the fact —
+compile vs warmup vs draw blocks vs host diagnostics — with the chain-health
+trail (acceptance, step size, divergences) alongside.  `tools/trace_report.py`
+renders the summary table; `bench.py` consumes the same file for its phase
+breakdown instead of re-deriving it from stdout.
+
+Design rules:
+
+  * **Zero cost when off.**  The default trace is the `NullTrace` singleton:
+    every emit is a constant-time no-op, `phase()` returns a shared no-op
+    context manager, and nothing here imports jax at module load.  Hot
+    paths (the per-block runner loop) pay one attribute call per block.
+  * **Host-side only, block-bounded.**  Events are emitted from the host
+    driver after `jax.block_until_ready` readbacks — never from inside a
+    device program.  The one exception is the opt-in in-loop heartbeat
+    (`heartbeat`, fed by ``jax.debug.callback`` — see `kernels.base.
+    scan_progress`), which is rate-limited on the host so an unrolled
+    vmap of callbacks cannot flood the file.
+  * **Durable, append-only, crash-tolerant.**  Every line is flushed as
+    written (same contract as the runner's metrics JSONL): a SIGKILL at any
+    point leaves a parseable prefix.
+
+Canonical event types (``EVENT_TYPES``): ``run_start``, ``compile``,
+``warmup_block``, ``sample_block``, ``chain_health``, ``checkpoint``,
+``run_end``.  Auxiliary types (``progress``, ``adapt``, ``budget``) ride the
+same envelope; readers must ignore event types they don't know (that is the
+forward-compat rule that lets the schema grow without a version bump).
+
+Envelope fields present on EVERY event::
+
+    schema   int   — SCHEMA_VERSION of the writer
+    event    str   — event type
+    ts       float — absolute unix time of emission
+    wall_s   float — seconds since the trace (not the run) was opened
+    run      int   — 1-based run ordinal within this trace file (0 = before
+                     any run_start; a trace may hold several runs, e.g. a
+                     compile pass + a timed pass)
+
+Phase events (``compile``/``warmup_block``/``sample_block``/``checkpoint``)
+additionally carry ``dur_s`` — the measured wall-clock of that phase — and
+the per-run phase durations tile the run's wall (run_end.dur_s) to within
+the host-driver slack, which is what makes the trace a *timing* artifact
+and not just a log.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from contextvars import ContextVar
+from typing import Any, Dict, Iterator, List, Optional
+
+SCHEMA_VERSION = 1
+
+#: canonical event types — the documented core of the schema.  Readers must
+#: tolerate (skip or pass through) any OTHER event name: auxiliary events
+#: (progress/adapt/budget) and future additions share the envelope.
+EVENT_TYPES = frozenset(
+    {
+        "run_start",
+        "compile",
+        "warmup_block",
+        "sample_block",
+        "chain_health",
+        "checkpoint",
+        "run_end",
+    }
+)
+
+#: envelope keys every event must carry (validate_event)
+ENVELOPE_KEYS = ("schema", "event", "ts", "wall_s", "run")
+
+#: phase event types whose dur_s values tile the run wall.  ``collect`` is
+#: the auxiliary host post-processing phase (draw constraining, stat
+#: assembly) — not in the canonical set but timed like the others so phase
+#: sums account for the whole run
+PHASE_EVENTS = ("compile", "warmup_block", "sample_block", "checkpoint",
+                "collect")
+
+
+def _last_run_ordinal(path: str) -> int:
+    """Highest run ordinal already in ``path`` (0 for a new/empty file).
+
+    Run ordinals are monotone within a file, so only the tail needs
+    reading; torn or foreign trailing lines are skipped."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return 0
+    if not size:
+        return 0
+    try:
+        with open(path, "rb") as f:
+            f.seek(max(0, size - 65536))
+            tail = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return 0
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+            return int(rec.get("run", 0))
+        except (json.JSONDecodeError, TypeError, ValueError):
+            continue
+    return 0
+
+
+class _TraceState:
+    """Shared mutable core of a trace: file handle, clock zero, run counter.
+
+    One instance is shared by a `RunTrace` and every `tagged()` child view,
+    so tags are cheap (a new dict, same file/lock) and the run ordinal is
+    global to the file.
+    """
+
+    __slots__ = ("f", "t0", "run", "lock", "path", "last_progress_ts")
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self.f = open(path, "a")
+        self.t0 = time.perf_counter()
+        # append semantics: continue the file's run numbering, never
+        # collide with a previous session's ordinals (run is monotone, so
+        # the last parseable line carries the current maximum)
+        self.run = _last_run_ordinal(path)
+        # emits can arrive from jax.debug.callback threads: one lock
+        # serializes line writes so events never interleave mid-line
+        self.lock = threading.Lock()
+        self.last_progress_ts = 0.0
+
+
+class _Phase:
+    """Context manager for a timed phase: emits ONE event at exit with the
+    measured ``dur_s`` (plus any fields captured at enter or added via
+    ``note()`` while the phase runs)."""
+
+    __slots__ = ("_trace", "_event", "_fields", "_t0")
+
+    def __init__(self, trace: "RunTrace", event: str, fields: Dict[str, Any]):
+        self._trace = trace
+        self._event = event
+        self._fields = fields
+
+    def note(self, **fields) -> "_Phase":
+        """Attach fields discovered mid-phase (e.g. divergence counts read
+        back after the dispatch)."""
+        self._fields.update(fields)
+        return self
+
+    def __enter__(self) -> "_Phase":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dur = time.perf_counter() - self._t0
+        if exc_type is not None:
+            # a phase that died still leaves its timing + the error class
+            # in the trace — that is exactly the stall/fault evidence the
+            # layer exists for
+            self._fields.setdefault("error", exc_type.__name__)
+        self._trace.emit(self._event, dur_s=round(dur, 4), **self._fields)
+
+
+class RunTrace:
+    """Append-only JSONL event bus for one trace file.
+
+    ``emit`` never raises into the run: observability must not kill the
+    sampler (the same rule as the runner's ``progress_cb``) — write errors
+    disable the trace and the run continues.
+    """
+
+    enabled = True
+
+    def __init__(self, path: str, *, tags: Optional[Dict[str, Any]] = None,
+                 _state: Optional[_TraceState] = None):
+        self._state = _state if _state is not None else _TraceState(path)
+        self._tags = dict(tags) if tags else {}
+
+    @property
+    def path(self) -> str:
+        return self._state.path
+
+    def emit(self, event: str, **fields) -> Optional[Dict[str, Any]]:
+        """Write one event line; returns the record (None if disabled)."""
+        st = self._state
+        if st.f is None:
+            return None
+        rec = {
+            "schema": SCHEMA_VERSION,
+            "event": event,
+            "ts": time.time(),
+            "wall_s": round(time.perf_counter() - st.t0, 4),
+            "run": st.run + (1 if event == "run_start" else 0),
+        }
+        rec.update(self._tags)
+        rec.update(fields)
+        try:
+            with st.lock:
+                if event == "run_start":
+                    st.run += 1
+                    rec["run"] = st.run
+                st.f.write(json.dumps(rec) + "\n")
+                st.f.flush()
+        except (OSError, ValueError):  # closed/full disk: drop tracing,
+            st.f = None  # never the run
+            return None
+        return rec
+
+    def phase(self, event: str, **fields) -> _Phase:
+        """Timed phase: ``with trace.phase("sample_block", block=3): ...``
+        emits one event at exit carrying the measured ``dur_s``."""
+        return _Phase(self, event, dict(fields))
+
+    def tagged(self, **tags) -> "RunTrace":
+        """A view writing to the same file with extra constant tags — how
+        the parallel paths stamp shard/replica ids on their events."""
+        merged = {**self._tags, **tags}
+        return RunTrace(self._state.path, tags=merged, _state=self._state)
+
+    def heartbeat(self, min_interval_s: float = 0.5, **fields) -> None:
+        """Rate-limited auxiliary ``progress`` event for in-loop device
+        callbacks: at most one line per ``min_interval_s`` regardless of
+        how many chain-unrolled callbacks fire."""
+        st = self._state
+        now = time.perf_counter()
+        if now - st.last_progress_ts < min_interval_s:
+            return
+        st.last_progress_ts = now
+        self.emit("progress", **fields)
+
+    def close(self) -> None:
+        st = self._state
+        with st.lock:
+            if st.f is not None:
+                st.f.close()
+                st.f = None
+
+    def __enter__(self) -> "RunTrace":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class NullTrace:
+    """No-op trace: the default everywhere, so untraced hot paths pay one
+    method call per block and allocate nothing."""
+
+    enabled = False
+    path = None
+
+    def emit(self, event: str, **fields) -> None:
+        return None
+
+    def phase(self, event: str, **fields):
+        return _NULL_PHASE
+
+    def tagged(self, **tags) -> "NullTrace":
+        return self
+
+    def heartbeat(self, min_interval_s: float = 0.5, **fields) -> None:
+        return None
+
+    def close(self) -> None:
+        return None
+
+    def __enter__(self) -> "NullTrace":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+class _NullPhase:
+    """Shared no-op phase context (``note`` chains like the real one)."""
+
+    def note(self, **fields) -> "_NullPhase":
+        return self
+
+    def __enter__(self) -> "_NullPhase":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NULL_PHASE = _NullPhase()
+NULL_TRACE = NullTrace()
+
+# ambient trace: entry points (CLI --trace, bench.py) install a trace once;
+# the drivers below them pick it up without threading a parameter through
+# every backend signature.  ContextVar keeps nested/threaded runs isolated.
+# A module-level mirror (_CALLBACK_TRACE) carries the same trace to
+# jax.debug.callback host threads, which run OUTSIDE the installing
+# context — the heartbeat path reads the mirror, everything else the
+# ContextVar.
+_CURRENT: ContextVar[Any] = ContextVar("stark_tpu_trace", default=NULL_TRACE)
+_CALLBACK_TRACE: Any = NULL_TRACE
+
+
+def get_trace():
+    """The ambient trace (NULL_TRACE unless one was installed)."""
+    return _CURRENT.get()
+
+
+def set_trace(trace) -> None:
+    """Install ``trace`` as the ambient trace (None -> NULL_TRACE)."""
+    global _CALLBACK_TRACE
+    trace = trace if trace is not None else NULL_TRACE
+    _CURRENT.set(trace)
+    _CALLBACK_TRACE = trace
+
+
+@contextlib.contextmanager
+def use_trace(trace):
+    """Scoped ambient-trace install: ``with use_trace(RunTrace(p)): ...``"""
+    global _CALLBACK_TRACE
+    trace = trace if trace is not None else NULL_TRACE
+    token = _CURRENT.set(trace)
+    prev_cb = _CALLBACK_TRACE
+    _CALLBACK_TRACE = trace
+    try:
+        yield trace
+    finally:
+        _CURRENT.reset(token)
+        _CALLBACK_TRACE = prev_cb
+
+
+def resolve_trace(trace=None):
+    """Parameter-or-ambient resolution used by traced entry points."""
+    return trace if trace is not None else get_trace()
+
+
+def device_info() -> Dict[str, Any]:
+    """Platform/device fields for run_start events.  Imports jax lazily and
+    degrades to a stub if the backend is unreachable — tracing must never
+    be the thing that dials a dead accelerator tunnel."""
+    try:
+        import jax
+
+        devs = jax.local_devices()
+        return {
+            "platform": devs[0].platform if devs else "unknown",
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        }
+    except Exception:  # noqa: BLE001 — tracing stays best-effort
+        return {"platform": "unknown", "device_count": 0}
+
+
+def heartbeat(label, step, accept) -> None:
+    """Host target for in-loop ``jax.debug.callback`` progress (see
+    `kernels.base.scan_progress`): forwards to the installed trace's
+    rate-limited heartbeat.  Reads the callback mirror, not the
+    ContextVar — the runtime invokes debug callbacks from its own
+    threads, outside the installing context.  Must accept whatever the
+    callback thread hands it without raising."""
+    try:
+        _CALLBACK_TRACE.heartbeat(
+            label=str(label), step=int(step), accept=round(float(accept), 4)
+        )
+    except Exception:  # noqa: BLE001 — a progress tick must never fault a run
+        pass
+
+
+# ---------------------------------------------------------------------------
+# reading side: parse + validate + summarize (trace_report / bench.py)
+# ---------------------------------------------------------------------------
+
+
+class TraceError(ValueError):
+    """A trace line violates the envelope schema."""
+
+
+def validate_event(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """Check the envelope; returns ``rec``.  Unknown event *types* are legal
+    (forward compat); unknown schema *versions* are not — a reader must
+    never silently misinterpret a future writer."""
+    if not isinstance(rec, dict):
+        raise TraceError(f"event must be an object, got {type(rec).__name__}")
+    missing = [k for k in ENVELOPE_KEYS if k not in rec]
+    if missing:
+        raise TraceError(f"event missing envelope keys {missing}: {rec}")
+    if rec["schema"] != SCHEMA_VERSION:
+        raise TraceError(
+            f"trace schema {rec['schema']} != reader schema {SCHEMA_VERSION}"
+        )
+    if not isinstance(rec["event"], str):
+        raise TraceError(f"event type must be a string: {rec['event']!r}")
+    return rec
+
+
+def iter_trace(path: str, *, strict: bool = True) -> Iterator[Dict[str, Any]]:
+    """Yield validated events.  ``strict=False`` skips undecodable lines
+    (a live file's torn final line) instead of raising."""
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = validate_event(json.loads(line))
+            except (json.JSONDecodeError, TraceError):
+                if strict:
+                    raise TraceError(f"{path}:{lineno}: bad trace line {line!r}")
+                continue
+            yield rec
+
+
+def read_trace(path: str, *, strict: bool = True) -> List[Dict[str, Any]]:
+    return list(iter_trace(path, strict=strict))
+
+
+def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
+                    ) -> Dict[str, Any]:
+    """Aggregate one run's events into the phase/health summary that
+    `tools/trace_report.py` renders and `bench.py` logs.
+
+    ``run=None`` picks the LAST run in the trace (the timed pass when a
+    compile pass precedes it).  ``restarts`` counts the supervised-restart
+    chain LEADING TO the selected run: the supervisor stamps each restart
+    with the FAILED attempt's run ordinal, so the successful final run
+    never contains one — the count walks back through contiguous
+    predecessor runs that carry restart events (run N-1 restarted into
+    run N), which reconstructs the selected run's supervision story
+    without absorbing restarts from unrelated earlier sessions appended
+    to the same file.  Returns::
+
+        {"run": int, "meta": {...run_start fields...},
+         "wall_s": float | None,          # run_end dur, else event span
+         "phases": {name: {"count": n, "total_s": s}},
+         "health": {"mean_accept", "num_divergent", "max_rhat", "min_ess",
+                    "step_size", ...last-seen values...},
+         "restarts": int, "events": int}
+    """
+    restarts_by_run: Dict[int, int] = {}
+    for e in events:
+        if e.get("event") == "chain_health" and e.get("status") == "restart":
+            r = e.get("run", 0)
+            restarts_by_run[r] = restarts_by_run.get(r, 0) + 1
+    runs = sorted({e.get("run", 0) for e in events})
+    if not runs:
+        return {"run": 0, "meta": {}, "wall_s": None, "phases": {},
+                "health": {}, "restarts": 0, "events": 0}
+    run = runs[-1] if run is None else run
+    evs = [e for e in events if e.get("run", 0) == run]
+    # restart chain: the selected run's own restarts (it may itself be a
+    # failed attempt) plus those of contiguous failed predecessors
+    restarts_total = restarts_by_run.get(run, 0)
+    r = run - 1
+    while r in restarts_by_run:
+        restarts_total += restarts_by_run[r]
+        r -= 1
+
+    meta: Dict[str, Any] = {}
+    phases: Dict[str, Dict[str, float]] = {}
+    health: Dict[str, Any] = {}
+    wall = None
+    div_latest = None
+    accepts: List[float] = []
+    for e in evs:
+        ev = e["event"]
+        if ev == "run_start":
+            meta = {
+                k: v for k, v in e.items()
+                if k not in ENVELOPE_KEYS
+            }
+        elif ev == "run_end":
+            wall = e.get("dur_s", wall)
+        if "dur_s" in e and ev in PHASE_EVENTS:
+            p = phases.setdefault(ev, {"count": 0, "total_s": 0.0})
+            p["count"] += 1
+            p["total_s"] += float(e["dur_s"])
+        if ev == "chain_health":
+            for k in ("max_rhat", "min_ess", "step_size", "min_ess_per_grad",
+                      "num_stuck_components", "draws_per_chain"):
+                if e.get(k) is not None:
+                    health[k] = e[k]
+            if e.get("mean_accept") is not None:
+                accepts.append(float(e["mean_accept"]))
+            if e.get("num_divergent") is not None:
+                div_latest = e["num_divergent"]
+        # blocks may carry accept/divergence inline (monolithic runs)
+        elif ev in ("sample_block", "warmup_block"):
+            if e.get("mean_accept") is not None:
+                accepts.append(float(e["mean_accept"]))
+            if e.get("num_divergent") is not None:
+                div_latest = (
+                    e["num_divergent"]
+                    if ev == "sample_block"
+                    else div_latest
+                )
+    if accepts:
+        health["mean_accept"] = sum(accepts) / len(accepts)
+    if div_latest is not None:
+        health["num_divergent"] = div_latest
+    if wall is None and evs:
+        wall = evs[-1]["wall_s"] - evs[0]["wall_s"]
+    return {
+        "run": run,
+        "meta": meta,
+        "wall_s": wall,
+        "phases": {
+            k: {"count": int(v["count"]), "total_s": round(v["total_s"], 4)}
+            for k, v in phases.items()
+        },
+        "health": health,
+        "restarts": restarts_total,
+        "events": len(evs),
+    }

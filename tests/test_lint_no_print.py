@@ -1,0 +1,44 @@
+"""Repo lint: library code must not grow bare print() calls.
+
+Diagnostics from inside stark_tpu/ go through module loggers or the
+telemetry trace (ISSUE: observability); the CLI entry points that OWN a
+stdout machine interface (__main__.py, config.py) are the only exceptions.
+The lint is AST-based so strings/comments mentioning print don't trip it.
+"""
+
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "lint_no_print",
+    os.path.join(os.path.dirname(__file__), "..", "tools", "lint_no_print.py"),
+)
+lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(lint)
+
+_PKG = os.path.join(os.path.dirname(__file__), "..", "stark_tpu")
+
+
+def test_library_code_has_no_bare_print():
+    violations = lint.lint_package(_PKG)
+    assert violations == [], (
+        "bare print() in library code — use logging or the telemetry "
+        "trace:\n" + "\n".join(violations)
+    )
+
+
+def test_finder_detects_prints_but_not_strings():
+    src = (
+        "def f():\n"
+        "    x = 'print(not me)'\n"
+        "    # print(nor me)\n"
+        "    print('caught', 1)\n"
+        "    obj.print('method calls are fine')\n"
+    )
+    hits = lint.find_prints(src, "<test>")
+    assert len(hits) == 1 and hits[0][0] == 4
+
+
+def test_cli_entry_points_are_allowed():
+    assert "__main__.py" in lint.ALLOWED_FILES
+    assert "config.py" in lint.ALLOWED_FILES

@@ -7,10 +7,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 import stark_tpu
+from stark_tpu.compat import shard_map
 from stark_tpu.backends.jax_backend import JaxBackend
 from stark_tpu.backends.sharded import ShardedBackend
 from stark_tpu.model import flatten_model

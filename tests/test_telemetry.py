@@ -1,0 +1,414 @@
+"""Telemetry layer: schema round-trip, NullTrace no-op, traced runs.
+
+The trace is a durable artifact other tooling parses (trace_report,
+bench.py), so the contract under test is the SCHEMA: envelope fields on
+every event, version rejection on mismatch, run ordinals, phase durations
+that tile the run wall, and the canonical run_start -> sample_block ->
+run_end ordering on a real eight_schools run.
+"""
+
+import io
+import json
+import os
+import time
+from contextlib import redirect_stdout
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import stark_tpu
+from stark_tpu import telemetry
+from stark_tpu.model import Model, ParamSpec
+from stark_tpu.telemetry import (
+    EVENT_TYPES,
+    NULL_TRACE,
+    SCHEMA_VERSION,
+    NullTrace,
+    RunTrace,
+    TraceError,
+    read_trace,
+    summarize_trace,
+    use_trace,
+    validate_event,
+)
+
+
+class StdNormal2(Model):
+    def param_spec(self):
+        return {"x": ParamSpec((2,))}
+
+    def log_prior(self, p):
+        return -0.5 * jnp.sum(p["x"] ** 2)
+
+    def log_lik(self, p, data):
+        return jnp.zeros(())
+
+
+# ---------------------------------------------------------------------------
+# schema round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_emit_jsonl_roundtrip(tmp_path):
+    p = tmp_path / "t.jsonl"
+    with RunTrace(str(p)) as tr:
+        tr.emit("run_start", model="M", kernel="nuts", chains=4)
+        tr.emit("chain_health", mean_accept=0.8, num_divergent=3)
+        tr.emit("run_end", dur_s=1.25)
+    events = read_trace(str(p))
+    assert [e["event"] for e in events] == [
+        "run_start", "chain_health", "run_end"
+    ]
+    for e in events:
+        assert e["schema"] == SCHEMA_VERSION
+        assert e["run"] == 1
+        assert isinstance(e["ts"], float) and isinstance(e["wall_s"], float)
+    assert events[0]["model"] == "M" and events[0]["chains"] == 4
+    assert events[1]["mean_accept"] == 0.8
+    assert events[2]["dur_s"] == 1.25
+    # every canonical event type is representable and survives round-trip
+    assert {"run_start", "chain_health", "run_end"} <= EVENT_TYPES
+
+
+def test_run_ordinals_and_tags(tmp_path):
+    p = tmp_path / "t.jsonl"
+    with RunTrace(str(p)) as tr:
+        tr.emit("run_start")
+        tr.emit("run_end", dur_s=0.1)
+        shard = tr.tagged(shard=3, component="consensus")
+        shard.emit("run_start")
+        shard.emit("chain_health", step_size=0.5)
+    events = read_trace(str(p))
+    assert [e["run"] for e in events] == [1, 1, 2, 2]
+    assert events[3]["shard"] == 3 and events[3]["component"] == "consensus"
+    # tagged views share the file and run counter; tags never leak back
+    assert "shard" not in events[0]
+
+
+def test_validate_event_rejects_bad_envelope():
+    good = {"schema": SCHEMA_VERSION, "event": "run_start", "ts": 1.0,
+            "wall_s": 0.0, "run": 1}
+    assert validate_event(dict(good)) == good
+    with pytest.raises(TraceError):
+        validate_event({k: v for k, v in good.items() if k != "ts"})
+    with pytest.raises(TraceError):
+        validate_event({**good, "schema": SCHEMA_VERSION + 1})
+    # unknown event TYPES are forward-compatible, never an error
+    validate_event({**good, "event": "a_future_event"})
+
+
+def test_read_trace_strict_and_lenient(tmp_path):
+    p = tmp_path / "t.jsonl"
+    with RunTrace(str(p)) as tr:
+        tr.emit("run_start")
+    with open(p, "a") as f:
+        f.write('{"torn line...')  # live file killed mid-write
+    with pytest.raises(TraceError):
+        read_trace(str(p))
+    events = read_trace(str(p), strict=False)
+    assert len(events) == 1 and events[0]["event"] == "run_start"
+
+
+def test_phase_emits_duration_and_error_class(tmp_path):
+    p = tmp_path / "t.jsonl"
+    tr = RunTrace(str(p))
+    with tr.phase("sample_block", block=1) as ph:
+        time.sleep(0.01)
+        ph.note(mean_accept=0.9)
+    with pytest.raises(RuntimeError):
+        with tr.phase("warmup_block"):
+            raise RuntimeError("fault mid-phase")
+    tr.close()
+    blk, warm = read_trace(str(p))
+    assert blk["event"] == "sample_block" and blk["dur_s"] >= 0.01
+    assert blk["block"] == 1 and blk["mean_accept"] == 0.9
+    # the failed phase still records its timing + the fault class: that is
+    # the stalled-run evidence the layer exists for
+    assert warm["event"] == "warmup_block" and warm["error"] == "RuntimeError"
+    assert warm["dur_s"] >= 0.0
+
+
+def test_heartbeat_is_rate_limited(tmp_path):
+    p = tmp_path / "t.jsonl"
+    with RunTrace(str(p)) as tr:
+        for i in range(50):
+            tr.heartbeat(min_interval_s=10.0, label="sample", step=i)
+    events = read_trace(str(p))
+    assert len(events) == 1  # 49 of 50 dropped by the limiter
+    assert events[0]["event"] == "progress" and events[0]["step"] == 0
+
+
+def test_emit_survives_closed_file(tmp_path):
+    tr = RunTrace(str(tmp_path / "t.jsonl"))
+    tr.emit("run_start")
+    tr.close()
+    # observability must never kill the run: emits after close are dropped
+    assert tr.emit("run_end") is None
+    with tr.phase("sample_block"):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# NullTrace: the no-op default
+# ---------------------------------------------------------------------------
+
+
+def test_nulltrace_is_default_and_noop(tmp_path):
+    assert isinstance(telemetry.get_trace(), NullTrace)
+    assert not NULL_TRACE.enabled
+    assert NULL_TRACE.emit("run_start", anything=1) is None
+    assert NULL_TRACE.tagged(shard=0) is NULL_TRACE
+    ph = NULL_TRACE.phase("sample_block")
+    with ph as inner:
+        assert inner.note(x=1) is inner
+    NULL_TRACE.heartbeat(label="x", step=0)
+    NULL_TRACE.close()
+    # the shared no-op phase is a singleton: no per-block allocation
+    assert NULL_TRACE.phase("a") is NULL_TRACE.phase("b")
+
+
+def test_use_trace_scopes_and_restores(tmp_path):
+    tr = RunTrace(str(tmp_path / "t.jsonl"))
+    assert telemetry.get_trace() is NULL_TRACE
+    with use_trace(tr) as got:
+        assert got is tr and telemetry.get_trace() is tr
+        with use_trace(None):
+            assert telemetry.get_trace() is NULL_TRACE
+        assert telemetry.get_trace() is tr
+    assert telemetry.get_trace() is NULL_TRACE
+    tr.close()
+
+
+def test_nulltrace_runs_pay_nothing(tmp_path):
+    """An untraced run must not write anywhere or change results: same
+    seeds with and without an (enabled) trace give identical draws."""
+    post_plain = stark_tpu.sample(
+        StdNormal2(), chains=2, kernel="hmc", num_leapfrog=4,
+        num_warmup=20, num_samples=20, seed=0,
+    )
+    p = tmp_path / "t.jsonl"
+    with use_trace(RunTrace(str(p))) as tr:
+        post_traced = stark_tpu.sample(
+            StdNormal2(), chains=2, kernel="hmc", num_leapfrog=4,
+            num_warmup=20, num_samples=20, seed=0,
+        )
+        tr.close()
+    np.testing.assert_array_equal(post_plain.draws_flat, post_traced.draws_flat)
+    assert len(read_trace(str(p))) >= 3  # and the traced run DID record
+
+
+# ---------------------------------------------------------------------------
+# traced runs: the canonical event stream
+# ---------------------------------------------------------------------------
+
+
+def _run_eight_schools(trace):
+    from stark_tpu.backends import JaxBackend
+    from stark_tpu.models import EightSchools, eight_schools_data
+
+    backend = JaxBackend()  # shared so the traced pass hits the jit cache
+    kwargs = dict(
+        chains=2, kernel="nuts", max_tree_depth=5, num_warmup=50,
+        num_samples=50, seed=0, backend=backend,
+    )
+    with use_trace(NULL_TRACE):
+        stark_tpu.sample(EightSchools(), eight_schools_data(), **kwargs)
+    with use_trace(trace):
+        stark_tpu.sample(EightSchools(), eight_schools_data(), **kwargs)
+
+
+def test_eight_schools_trace_smoke(tmp_path):
+    """The acceptance-shaped smoke: an eight_schools run under a trace
+    produces run_start -> sample_block -> run_end IN ORDER, carries
+    acceptance + divergence counts, and its phase durations tile the
+    run wall (compile-cached pass, same contract as --trace on the CLI
+    bench path)."""
+    p = tmp_path / "t.jsonl"
+    tr = RunTrace(str(p))
+    _run_eight_schools(tr)
+    tr.close()
+    events = read_trace(str(p))
+    names = [e["event"] for e in events]
+    # ordered core: run_start before sample_block before run_end
+    assert names.index("run_start") < names.index("sample_block") < names.index("run_end")
+    health = [e for e in events if e["event"] == "chain_health"]
+    assert health and "mean_accept" in health[-1]
+    assert "num_divergent" in health[-1]
+
+    s = summarize_trace(events)
+    assert s["meta"]["model"] == "EightSchools"
+    phase_sum = sum(v["total_s"] for v in s["phases"].values())
+    assert s["wall_s"] > 0
+    # summed phase durations within 10% of the run wall (the compile-
+    # cached pass — cold passes hide XLA compile outside any dispatch)
+    assert abs(phase_sum - s["wall_s"]) / s["wall_s"] < 0.10
+
+
+def test_adaptive_runner_trace_events(tmp_path):
+    """sample_until_converged emits the full vocabulary: compile,
+    warmup_block(s), per-block sample_block + chain_health (R-hat/ESS/
+    step size), checkpoint timings, run_end."""
+    p = tmp_path / "t.jsonl"
+    ckpt = tmp_path / "c.npz"
+    tr = RunTrace(str(p))
+    post = stark_tpu.sample_until_converged(
+        StdNormal2(), chains=2, block_size=20, max_blocks=3, min_blocks=1,
+        rhat_target=1.5, ess_target=5.0, num_warmup=60, kernel="nuts",
+        max_tree_depth=4, seed=0, checkpoint_path=str(ckpt), trace=tr,
+    )
+    tr.close()
+    events = read_trace(str(p))
+    names = [e["event"] for e in events]
+    assert names[0] == "run_start" and names[-1] == "run_end"
+    for required in ("compile", "warmup_block", "sample_block",
+                     "chain_health", "checkpoint"):
+        assert required in names, f"missing {required}: {names}"
+    # block-level health carries the live convergence signal
+    block_health = [e for e in events
+                    if e["event"] == "chain_health" and "max_rhat" in e]
+    assert block_health
+    h = block_health[-1]
+    assert h["min_ess"] > 0 and h["step_size"] > 0
+    assert h["num_divergent"] >= 0 and "mean_accept" in h
+    end = events[-1]
+    assert end["converged"] == post.converged
+    assert end["blocks"] == len(post.history)
+
+
+def test_trace_report_renders_phase_and_health_table(tmp_path):
+    """tools/trace_report.py renders a per-phase table including
+    acceptance rate and divergence counts from a real trace."""
+    import importlib.util
+
+    p = tmp_path / "t.jsonl"
+    tr = RunTrace(str(p))
+    _run_eight_schools(tr)
+    tr.close()
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "trace_report.py"),
+    )
+    trace_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_report)
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = trace_report.main([str(p)])
+    out = buf.getvalue()
+    assert rc == 0
+    assert "phase" in out and "sample_block" in out
+    assert "acceptance rate" in out and "divergences" in out
+
+    # --json mode emits the machine-readable summary
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = trace_report.main([str(p), "--json"])
+    assert rc == 0
+    summary = json.loads(buf.getvalue())
+    assert summary["phases"] and "mean_accept" in summary["health"]
+
+
+def test_in_loop_heartbeat_progress_events(tmp_path):
+    """progress_every wires a jit-safe jax.debug.callback heartbeat into
+    the compiled sampling scan; events land in the trace from the
+    callback thread."""
+    p = tmp_path / "t.jsonl"
+    with use_trace(RunTrace(str(p))) as tr:
+        stark_tpu.sample(
+            StdNormal2(), chains=2, kernel="hmc", num_leapfrog=4,
+            num_warmup=10, num_samples=60, seed=0, progress_every=25,
+        )
+        import jax
+
+        jax.effects_barrier()
+        tr.close()
+    events = read_trace(str(p), strict=False)
+    progress = [e for e in events if e["event"] == "progress"]
+    assert progress, "no progress heartbeat reached the trace"
+    assert progress[0]["label"] == "sample"
+    assert 0.0 <= progress[0]["accept"] <= 1.0
+
+
+def test_summarize_trace_counts_restarts(tmp_path):
+    p = tmp_path / "t.jsonl"
+    with RunTrace(str(p)) as tr:
+        tr.emit("run_start")
+        tr.emit("chain_health", status="restart", attempt=1,
+                error="ChainHealthError: boom")
+        tr.emit("chain_health", status="restart", attempt=2,
+                error="XlaRuntimeError: tunnel")
+        tr.emit("run_end", dur_s=2.0)
+    s = summarize_trace(read_trace(str(p)))
+    assert s["restarts"] == 2
+
+
+def test_restarts_counted_across_runs(tmp_path):
+    """The supervisor stamps a restart with the FAILED attempt's run
+    ordinal; the summary of the (later, successful) run must still count
+    it — restart totals are a whole-trace property."""
+    p = tmp_path / "t.jsonl"
+    with RunTrace(str(p)) as tr:
+        tr.emit("run_start")  # attempt 1 (faults)
+        tr.emit("chain_health", status="restart", attempt=1,
+                error="ChainHealthError: boom")
+        tr.emit("run_start")  # attempt 2 (succeeds)
+        tr.emit("run_end", dur_s=1.0)
+    s = summarize_trace(read_trace(str(p)))
+    assert s["run"] == 2 and s["restarts"] == 1
+
+
+def test_restarts_not_absorbed_from_earlier_sessions(tmp_path):
+    """A clean run appended after an earlier session's restarts must not
+    inherit them: the chain-walk stops at a predecessor run with no
+    restart event (the earlier session's successful final run)."""
+    p = tmp_path / "t.jsonl"
+    with RunTrace(str(p)) as tr:  # session 1: one restart, then success
+        tr.emit("run_start")
+        tr.emit("chain_health", status="restart", attempt=1, error="boom")
+        tr.emit("run_start")
+        tr.emit("run_end", dur_s=1.0)
+    with RunTrace(str(p)) as tr:  # session 2: clean
+        tr.emit("run_start")
+        tr.emit("run_end", dur_s=2.0)
+    events = read_trace(str(p))
+    assert summarize_trace(events)["restarts"] == 0  # run 3, clean story
+    assert summarize_trace(events, run=2)["restarts"] == 1
+
+
+def test_chees_progress_heartbeat(tmp_path):
+    """progress_every reaches the ChEES ensemble sampling scan too (the
+    flagship path)."""
+    from stark_tpu.models import Logistic, synth_logistic_data
+    import jax
+
+    data, _ = synth_logistic_data(jax.random.PRNGKey(0), 200, 3)
+    p = tmp_path / "t.jsonl"
+    with use_trace(RunTrace(str(p))) as tr:
+        stark_tpu.sample(
+            Logistic(num_features=3), data, chains=4, kernel="chees",
+            num_warmup=20, num_samples=60, init_step_size=0.1,
+            progress_every=25, seed=0,
+        )
+        jax.effects_barrier()
+        tr.close()
+    progress = [e for e in read_trace(str(p), strict=False)
+                if e["event"] == "progress"]
+    assert progress and progress[0]["label"] == "chees_sample"
+
+
+def test_reopened_trace_continues_run_ordinals(tmp_path):
+    """Appending a second session to the same --trace PATH must continue
+    the run numbering, never collide with the first session's runs."""
+    p = tmp_path / "t.jsonl"
+    with RunTrace(str(p)) as tr:
+        tr.emit("run_start")
+        tr.emit("run_end", dur_s=0.5)
+    with RunTrace(str(p)) as tr:  # new process/session, same file
+        tr.emit("run_start")
+        tr.emit("run_end", dur_s=0.7)
+    events = read_trace(str(p))
+    assert [e["run"] for e in events] == [1, 1, 2, 2]
+    assert summarize_trace(events)["wall_s"] == 0.7  # last run, unmerged

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Repo lint: no bare ``print()`` in stark_tpu/ library code.
+
+Library diagnostics must go through ``logging`` (module logger) or the
+telemetry trace — stdout/stderr prints from deep inside a sampler are
+exactly the unstructured output the telemetry layer replaced.  CLI entry
+points keep their machine interfaces: ``__main__.py`` (stdout JSON/tables)
+and ``config.py`` (its ``__main__`` convenience block) are allowed.
+
+AST-based, so strings/comments mentioning print don't trip it.  Run
+directly (``python tools/lint_no_print.py``) or via the test suite
+(``tests/test_lint_no_print.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+#: files (relative to the package root) where print() is an interface
+ALLOWED_FILES = frozenset({"__main__.py", "config.py"})
+
+
+def find_prints(source: str, filename: str) -> List[Tuple[int, str]]:
+    """(lineno, context) of every bare print() call in ``source``."""
+    tree = ast.parse(source, filename=filename)
+    hits = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            hits.append((node.lineno, ast.unparse(node)[:80]))
+    return hits
+
+
+def lint_package(pkg_dir: str) -> List[str]:
+    """Violation strings ("path:line: call") for the whole package."""
+    violations = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(root, name), pkg_dir)
+            if rel in ALLOWED_FILES:
+                continue
+            path = os.path.join(root, name)
+            with open(path) as f:
+                source = f.read()
+            for lineno, ctx in find_prints(source, path):
+                violations.append(f"{path}:{lineno}: {ctx}")
+    return violations
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(repo, "stark_tpu")
+    violations = lint_package(pkg)
+    for v in violations:
+        print(v, file=sys.stderr)
+    if violations:
+        print(
+            f"{len(violations)} bare print() call(s) in library code — "
+            "use the module logger (logging.getLogger) or the telemetry "
+            "trace instead (see tools/lint_no_print.py docstring)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

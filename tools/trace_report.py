@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Render a phase-timing + chain-health summary from a telemetry trace.
+
+    python tools/trace_report.py /tmp/t.jsonl            # last run in file
+    python tools/trace_report.py /tmp/t.jsonl --run 1    # a specific run
+    python tools/trace_report.py /tmp/t.jsonl --all      # every run
+    python tools/trace_report.py /tmp/t.jsonl --json     # machine-readable
+
+Traces are written by ``--trace PATH`` on the ``python -m stark_tpu``
+subcommands, by ``bench.py`` (under the supervised workdir), or by any code
+that installs a `stark_tpu.telemetry.RunTrace`.  Stdlib-only on the read
+path apart from the schema helpers it shares with the writer
+(`stark_tpu.telemetry`) — no jax import, so it runs anywhere the trace
+file lands, including hosts with a dead accelerator tunnel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# repo-root invocation without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stark_tpu.telemetry import (  # noqa: E402
+    PHASE_EVENTS,
+    read_trace,
+    summarize_trace,
+)
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, bool):
+        return "yes" if v else "no"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _table(rows, header) -> str:
+    """Plain aligned text table (no deps)."""
+    cols = [header] + [[_fmt(c) for c in r] for r in rows]
+    widths = [max(len(r[i]) for r in cols) for i in range(len(header))]
+    lines = []
+    for j, r in enumerate(cols):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if j == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def render_run(events, run) -> str:
+    s = summarize_trace(events, run=run)
+    out = []
+    meta = s["meta"]
+    desc = " ".join(
+        f"{k}={meta[k]}"
+        for k in ("entry", "model", "kernel", "chains", "num_shards",
+                  "num_temps", "platform", "device_count")
+        if k in meta
+    )
+    out.append(f"run {s['run']}: {desc or '(no run_start event)'}")
+    wall = s["wall_s"] or 0.0
+    phase_sum = sum(p["total_s"] for p in s["phases"].values())
+    out.append(
+        f"wall {wall:.2f}s, {s['events']} events, "
+        f"phases cover {phase_sum:.2f}s"
+        + (f" ({100.0 * phase_sum / wall:.0f}%)" if wall else "")
+        + (f", {s['restarts']} restart(s)" if s["restarts"] else "")
+    )
+    out.append("")
+
+    # phase table in canonical order, then any others the writer added
+    order = {name: i for i, name in enumerate(PHASE_EVENTS)}
+    rows = [
+        (
+            name,
+            p["count"],
+            round(p["total_s"], 3),
+            f"{100.0 * p['total_s'] / wall:.1f}%" if wall else "—",
+        )
+        for name, p in sorted(
+            s["phases"].items(), key=lambda kv: order.get(kv[0], 99)
+        )
+    ]
+    out.append(_table(rows, ("phase", "events", "total_s", "share")))
+    out.append("")
+
+    h = s["health"]
+    if h:
+        keys = (
+            ("mean_accept", "acceptance rate"),
+            ("num_divergent", "divergences"),
+            ("max_rhat", "max R-hat"),
+            ("min_ess", "min ESS"),
+            ("num_stuck_components", "stuck components"),
+            ("step_size", "step size"),
+            ("draws_per_chain", "draws/chain"),
+        )
+        rows = [(label, h[k]) for k, label in keys if k in h]
+        out.append(_table(rows, ("chain health", "value")))
+    else:
+        out.append("(no chain_health events)")
+
+    # per-shard / per-replica tagged health, when the parallel paths ran
+    for tag in ("shard", "replica"):
+        tagged = [
+            e for e in events
+            if e.get("run") == s["run"] and e["event"] == "chain_health"
+            and tag in e
+        ]
+        if not tagged:
+            continue
+        cols = [
+            k for k in ("step_size", "traj_length", "beta",
+                        "swap_accept_pair", "num_divergent")
+            if any(k in e for e in tagged)
+        ]
+        rows = [
+            tuple([e[tag]] + [e.get(k) for k in cols]) for e in tagged
+        ]
+        out.append("")
+        out.append(_table(rows, tuple([tag] + cols)))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="JSONL trace file")
+    ap.add_argument("--run", type=int, default=None,
+                    help="run ordinal to report (default: last)")
+    ap.add_argument("--all", action="store_true", help="report every run")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary dict(s) as JSON instead")
+    args = ap.parse_args(argv)
+
+    # tolerate a torn final line: the trace may still be live
+    events = read_trace(args.trace, strict=False)
+    if not events:
+        print(f"{args.trace}: no parseable events", file=sys.stderr)
+        return 1
+    runs = sorted({e.get("run", 0) for e in events})
+    picked = runs if args.all else [args.run if args.run is not None else runs[-1]]
+    if args.json:
+        out = [summarize_trace(events, run=r) for r in picked]
+        print(json.dumps(out[0] if len(out) == 1 else out, indent=1))
+        return 0
+    chunks = [render_run(events, r) for r in picked]
+    print(("\n\n" + "=" * 60 + "\n\n").join(chunks))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
