@@ -153,6 +153,23 @@ def _cmd_bench_all(args) -> int:
     return 0
 
 
+def _cmd_chaos(args) -> int:
+    """Run the fault-injection scenario matrix (stark_tpu.chaos)."""
+    from .chaos import SCENARIOS, run_drill
+
+    if args.list_scenarios:
+        print("scenarios:", ", ".join(SCENARIOS))
+        return 0
+    with _traced(args):
+        results = run_drill(args.scenario or None, args.workdir)
+    print(json.dumps({
+        "passed": sum(1 for r in results if r["ok"]),
+        "failed": sum(1 for r in results if not r["ok"]),
+        "scenarios": results,
+    }))
+    return 0 if all(r["ok"] for r in results) else 1
+
+
 def _cmd_list(args) -> int:
     from .benchmarks import ALL_BENCHMARKS
     from .config import _model_registry, _synth_registry
@@ -201,6 +218,25 @@ def main(argv=None) -> int:
     p_all.add_argument("--update-baseline", metavar="PATH", default=None)
     p_all.add_argument("--trace", **trace_kw)
     p_all.set_defaults(fn=_cmd_bench_all)
+
+    p_chaos = sub.add_parser(
+        "chaos-drill",
+        help="run the fault-injection scenario matrix (supervision drills)",
+    )
+    p_chaos.add_argument(
+        "--scenario", action="append", metavar="NAME", default=None,
+        help="run only this scenario (repeatable; default: full matrix)",
+    )
+    p_chaos.add_argument(
+        "--workdir", metavar="DIR", default=None,
+        help="keep drill artifacts under DIR (default: fresh temp dir)",
+    )
+    p_chaos.add_argument(
+        "--list-scenarios", action="store_true",
+        help="list scenario names and exit",
+    )
+    p_chaos.add_argument("--trace", **trace_kw)
+    p_chaos.set_defaults(fn=_cmd_chaos)
 
     p_list = sub.add_parser("list", help="list benchmarks/models/datasets")
     p_list.set_defaults(fn=_cmd_list)

@@ -1,0 +1,349 @@
+"""Chaos drill: the scripted fault-injection scenario matrix.
+
+Each scenario arms `faults` failpoints, runs a real (small) supervised or
+consensus job, and ASSERTS the recovery contract the supervision layer
+promises — not just "it didn't crash" but the precise behavior: which
+checkpoint the restart resumed from, which fault class the restart record
+carries, that a quarantined file exists, that a degraded consensus names
+its lost shards, that with everything disarmed the sampler is bit-identical
+to an uninjected run.
+
+Run it via the CLI (``python -m stark_tpu chaos-drill``), the standalone
+tool (``python tools/chaos_drill.py``), or pytest (``tests/test_chaos.py``
+wires the fast scenarios into tier-1 under the ``chaos`` marker).
+
+Scenario matrix (`SCENARIOS`):
+
+  crash_before_rename    crash straddles the checkpoint rename (old side):
+                         restart resumes the PREVIOUS checkpoint
+  crash_after_rename     crash on the new side: restart resumes the JUST-
+                         renamed checkpoint (no progress lost)
+  nan_poison             poisoned carried state → ChainHealthError before
+                         checkpointing → reseeded restart, finite result
+  corrupt_checkpoint     corrupted bytes on disk → quarantine (with reason)
+                         → cold start
+  stall_watchdog         a hung block dispatch → watchdog abort → restart,
+                         no human intervention
+  shard_death_recovered  a consensus shard dies once → per-shard restart
+                         recovers it (not degraded)
+  shard_death_degraded   a shard dies past its restart budget → dropped,
+                         combine reweights over survivors, degraded=True
+  clean_identity         failpoints disarmed: two runs are bit-identical
+                         (the harness is a no-op when off)
+
+The drill models are tiny on purpose: the contracts under test are
+supervision mechanics, not posterior quality — every scenario finishes in
+seconds on one CPU.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import faults
+from .model import Model, ParamSpec
+
+log = logging.getLogger("stark_tpu.chaos")
+
+__all__ = ["SCENARIOS", "run_drill", "main"]
+
+
+class _StdNormal(Model):
+    """2-d standard normal: the smallest state that exercises the full
+    runner/checkpoint/supervise machinery."""
+
+    def param_spec(self):
+        return {"x": ParamSpec((2,))}
+
+    def log_prior(self, p):
+        return -0.5 * jnp.sum(p["x"] ** 2)
+
+    def log_lik(self, p, data):
+        return jnp.zeros(())
+
+
+class _GaussMean(Model):
+    """y ~ N(mu, 1): a rowful likelihood so consensus has rows to shard."""
+
+    def param_spec(self):
+        return {"mu": ParamSpec(())}
+
+    def log_prior(self, p):
+        return -0.5 * p["mu"] ** 2
+
+    def log_lik(self, p, data):
+        return -0.5 * jnp.sum((data["y"] - p["mu"]) ** 2)
+
+
+#: supervised-run settings: converge at min_blocks on a loose gate — the
+#: drill asserts recovery mechanics, not posterior quality
+_SUP_KW = dict(
+    chains=2,
+    block_size=25,
+    max_blocks=8,
+    min_blocks=2,
+    rhat_target=10.0,
+    ess_target=1.0,
+    num_warmup=40,
+    kernel="hmc",
+    num_leapfrog=8,
+)
+
+SCENARIOS: Dict[str, Callable[[str], Dict[str, Any]]] = {}
+
+
+def _scenario(name: str):
+    def deco(fn):
+        SCENARIOS[name] = fn
+        return fn
+
+    return deco
+
+
+def _metrics(workdir: str) -> List[Dict[str, Any]]:
+    with open(os.path.join(workdir, "metrics.jsonl")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _restarts(lines) -> List[Dict[str, Any]]:
+    return [l for l in lines if l.get("event") == "restart"]
+
+
+def _first_block_after_restart(lines) -> Optional[int]:
+    """The block ordinal of the first block record AFTER the first restart
+    — 1 means the retry cold-started, blocks_done+1 means it resumed."""
+    seen_restart = False
+    for l in lines:
+        if l.get("event") == "restart":
+            seen_restart = True
+        elif seen_restart and l.get("event") == "block":
+            return int(l["block"])
+    return None
+
+
+@_scenario("crash_before_rename")
+def crash_before_rename(workdir: str) -> Dict[str, Any]:
+    """Crash between temp-write and rename of block 2's checkpoint: the
+    on-disk checkpoint is still block 1's, so the restart re-runs block 2."""
+    from .supervise import supervised_sample
+
+    faults.configure("ckpt.before_rename=crash*1@1")
+    res = supervised_sample(_StdNormal(), workdir=workdir, seed=0, **_SUP_KW)
+    lines = _metrics(workdir)
+    rs = _restarts(lines)
+    assert res.converged, "run did not converge after restart"
+    assert len(rs) == 1 and rs[0]["fault"] == "transient", rs
+    first = _first_block_after_restart(lines)
+    assert first == 2, f"expected resume at block 2 (got block {first})"
+    return {"restarts": 1, "resumed_block": first}
+
+
+@_scenario("crash_after_rename")
+def crash_after_rename(workdir: str) -> Dict[str, Any]:
+    """Crash right after block 2's checkpoint rename: the new checkpoint is
+    durable, so the restart resumes AT block 2 and continues with block 3."""
+    from .supervise import supervised_sample
+
+    faults.configure("ckpt.after_rename=crash*1@1")
+    res = supervised_sample(_StdNormal(), workdir=workdir, seed=0, **_SUP_KW)
+    lines = _metrics(workdir)
+    rs = _restarts(lines)
+    assert res.converged, "run did not converge after restart"
+    assert len(rs) == 1 and rs[0]["fault"] == "transient", rs
+    first = _first_block_after_restart(lines)
+    assert first == 3, f"expected resume past block 2 (got block {first})"
+    return {"restarts": 1, "resumed_block": first}
+
+
+@_scenario("nan_poison")
+def nan_poison(workdir: str) -> Dict[str, Any]:
+    """Poisoned carried state: caught by the health check BEFORE the
+    checkpoint (nothing poisoned lands on disk), restarted with a fresh
+    seed, and classified poisoned_state in the restart record."""
+    from .supervise import supervised_sample
+
+    faults.configure("runner.carried_nan=nan*1")
+    res = supervised_sample(_StdNormal(), workdir=workdir, seed=0, **_SUP_KW)
+    lines = _metrics(workdir)
+    rs = _restarts(lines)
+    assert res.converged
+    assert len(rs) == 1 and rs[0]["fault"] == "poisoned_state", rs
+    assert np.isfinite(res.draws_flat).all(), "poison leaked into the result"
+    bad = glob.glob(os.path.join(workdir, "chain.ckpt.npz.bad*"))
+    assert not bad, f"poisoned state reached disk: {bad}"
+    return {"restarts": 1, "fault": rs[0]["fault"]}
+
+
+@_scenario("corrupt_checkpoint")
+def corrupt_checkpoint(workdir: str) -> Dict[str, Any]:
+    """Corrupt bytes land in block 1's checkpoint; block 2 crashes; the
+    supervisor must quarantine the corrupt file (reason logged+traced) and
+    cold-start — never resume garbage."""
+    from .supervise import supervised_sample
+
+    faults.configure("ckpt.corrupt=corrupt*1; runner.block.pre=crash*1@1")
+    res = supervised_sample(_StdNormal(), workdir=workdir, seed=0, **_SUP_KW)
+    lines = _metrics(workdir)
+    rs = _restarts(lines)
+    assert res.converged
+    assert len(rs) == 1 and rs[0]["fault"] == "transient", rs
+    bad = glob.glob(os.path.join(workdir, "chain.ckpt.npz.bad*"))
+    assert bad, "corrupt checkpoint was not quarantined"
+    first = _first_block_after_restart(lines)
+    assert first == 1, f"expected cold start (got block {first})"
+    assert np.isfinite(res.draws_flat).all()
+    return {"restarts": 1, "quarantined": os.path.basename(bad[0])}
+
+
+@_scenario("stall_watchdog")
+def stall_watchdog(workdir: str) -> Dict[str, Any]:
+    """Block 2's dispatch hangs: the watchdog aborts it at the deadline and
+    the supervisor restarts from block 1's checkpoint — no human, no Ctrl-C."""
+    from .supervise import supervised_sample
+
+    faults.configure("runner.block.pre=stall(60)*1@1")
+    t0 = time.monotonic()
+    res = supervised_sample(
+        _StdNormal(), workdir=workdir, seed=0, stall_timeout_s=3.0, **_SUP_KW
+    )
+    wall = time.monotonic() - t0
+    lines = _metrics(workdir)
+    rs = _restarts(lines)
+    assert res.converged
+    assert len(rs) == 1 and rs[0]["fault"] == "stall", rs
+    assert wall < 45.0, f"watchdog did not break the 60s stall (wall {wall:.0f}s)"
+    return {"restarts": 1, "wall_s": round(wall, 1)}
+
+
+_CONSENSUS_KW = dict(
+    num_shards=4,
+    chains=2,
+    num_warmup=30,
+    num_samples=40,
+    kernel="hmc",
+    num_leapfrog=8,
+    seed=0,
+)
+
+
+def _consensus_data(n: int = 512):
+    rng = np.random.default_rng(0)
+    return {"y": jnp.asarray(rng.normal(0.3, 1.0, n), jnp.float32)}
+
+
+@_scenario("shard_death_recovered")
+def shard_death_recovered(workdir: str) -> Dict[str, Any]:
+    """Shard 2 dies once: the per-shard restart re-samples it with a fresh
+    stream and the consensus comes back whole (NOT degraded)."""
+    from .parallel.consensus import consensus_sample
+
+    faults.configure("consensus.shard_death=kill(2)*1")
+    post = consensus_sample(
+        _GaussMean(), _consensus_data(), shard_restarts=1, **_CONSENSUS_KW
+    )
+    assert post.sample_stats["degraded"] is False
+    assert post.sample_stats["lost_shards"].size == 0
+    assert np.isfinite(post.draws_flat).all()
+    assert len(faults.fired()) == 1
+    return {"degraded": False}
+
+
+@_scenario("shard_death_degraded")
+def shard_death_degraded(workdir: str) -> Dict[str, Any]:
+    """Shard 1 dies on every attempt: after exhausting its restart budget
+    it is dropped, the combine reweights over the 3 survivors, and the
+    result says so (degraded=True, lost_shards=[1])."""
+    from .parallel.consensus import consensus_sample
+
+    faults.configure("consensus.shard_death=kill(1)*9")
+    post = consensus_sample(
+        _GaussMean(), _consensus_data(), shard_restarts=1, **_CONSENSUS_KW
+    )
+    assert post.sample_stats["degraded"] is True
+    assert post.sample_stats["lost_shards"].tolist() == [1]
+    assert np.isfinite(post.draws_flat).all(), "dead shard leaked into combine"
+    return {"degraded": True, "lost_shards": [1]}
+
+
+@_scenario("clean_identity")
+def clean_identity(workdir: str) -> Dict[str, Any]:
+    """Failpoints disarmed: the harness must be invisible — two identical
+    runs produce bit-identical draws and no site records a hit."""
+    from .runner import sample_until_converged
+
+    faults.reset()
+    assert not faults.active()
+    kw = dict(_SUP_KW, seed=0)
+    a = sample_until_converged(
+        _StdNormal(), checkpoint_path=os.path.join(workdir, "a.ckpt.npz"), **kw
+    )
+    b = sample_until_converged(
+        _StdNormal(), checkpoint_path=os.path.join(workdir, "b.ckpt.npz"), **kw
+    )
+    np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+    assert faults.fired() == []
+    return {"bit_identical": True}
+
+
+def run_drill(
+    names: Optional[List[str]] = None,
+    workdir: Optional[str] = None,
+) -> List[Dict[str, Any]]:
+    """Run the scenario matrix; returns one record per scenario.
+
+    Every scenario gets a FRESH subdirectory (a reused ``workdir`` keeps
+    only the last drill's artifacts — stale checkpoints/metrics from a
+    previous invocation would make every resume/restart assertion lie)
+    and a clean failpoint table (armed inside, disarmed after — a drill
+    leaves no live failpoints behind, whatever happens).
+    """
+    names = list(SCENARIOS) if not names else list(names)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        raise ValueError(f"unknown scenario(s) {unknown}; have {list(SCENARIOS)}")
+    root = workdir or tempfile.mkdtemp(prefix="stark-chaos-")
+    results: List[Dict[str, Any]] = []
+    for name in names:
+        sub = os.path.join(root, name)
+        if os.path.isdir(sub):
+            shutil.rmtree(sub)
+        os.makedirs(sub)
+        t0 = time.monotonic()
+        rec: Dict[str, Any] = {"scenario": name, "ok": True}
+        try:
+            faults.reset()
+            rec.update(SCENARIOS[name](sub) or {})
+        except Exception as e:  # noqa: BLE001 — the drill reports, never dies
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"
+        finally:
+            faults.reset()
+        rec["wall_s"] = round(time.monotonic() - t0, 2)
+        log.info(
+            "chaos %s: %s (%.1fs)%s", name,
+            "PASS" if rec["ok"] else "FAIL", rec["wall_s"],
+            "" if rec["ok"] else f" — {rec['error']}",
+        )
+        results.append(rec)
+    return results
+
+
+def main(names: Optional[List[str]] = None,
+         workdir: Optional[str] = None) -> int:
+    """Drill entry point shared by the CLI subcommand and tools wrapper;
+    returns a process exit code (0 = full matrix green)."""
+    results = run_drill(names, workdir)
+    failed = [r["scenario"] for r in results if not r["ok"]]
+    if failed:
+        log.error("chaos drill FAILED: %s", ", ".join(failed))
+    return 1 if failed else 0

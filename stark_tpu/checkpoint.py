@@ -18,7 +18,25 @@ from typing import Any, Dict, Tuple
 
 import numpy as np
 
+from .faults import corrupt_file, fail_point
+
 _META_KEY = "__stark_meta_json__"
+
+
+def _fsync_dir(directory: str) -> None:
+    """fsync the directory entry so a rename survives power loss (the file
+    fsync alone pins the bytes, not the name).  Best-effort: some
+    filesystems refuse directory fds."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def rank_path(path):
@@ -44,9 +62,21 @@ def rank_path(path):
 
 
 def save_checkpoint(path: str, arrays: Dict[str, np.ndarray], meta: Dict[str, Any]):
-    """Atomically write arrays + meta as one .npz (write temp, rename)."""
+    """Atomically write arrays + meta as one .npz (write temp, fsync,
+    rename, fsync dir).
+
+    The fsync pair is what makes "atomic" hold across a crash that
+    straddles the rename: without it the rename can land while the temp
+    file's pages are still dirty, leaving the named checkpoint truncated
+    (resume would then cold-start off a quarantined file).
+
+    Failpoint sites (`faults`): ``ckpt.slow`` (latency), ``ckpt.
+    before_rename`` / ``ckpt.after_rename`` (crash straddling the rename),
+    ``ckpt.corrupt`` (byte corruption of the renamed file).
+    """
     if _META_KEY in arrays:
         raise ValueError(f"array name {_META_KEY!r} is reserved")
+    fail_point("ckpt.slow")
     directory = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(directory, exist_ok=True)
     payload = {k: np.asarray(v) for k, v in arrays.items()}
@@ -57,7 +87,13 @@ def save_checkpoint(path: str, arrays: Dict[str, np.ndarray], meta: Dict[str, An
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        fail_point("ckpt.before_rename")
         os.replace(tmp, path)
+        fail_point("ckpt.after_rename")
+        _fsync_dir(directory)
+        corrupt_file("ckpt.corrupt", path)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)

@@ -14,6 +14,7 @@ from typing import Tuple
 import numpy as np
 
 from ._native_build import load_native
+from .faults import fail_point
 
 _HEADER_BYTES = 4 + 4 + 8 + 8  # magic, version, chains, dim
 
@@ -51,6 +52,10 @@ class DrawStore:
         """block: strictly (chains, n_draws, dim) float32 — the layout the
         samplers produce.  Stored draw-major (transposed here, host copy) so
         on-disk reads concatenate along the draw axis."""
+        # failpoint: crash/slow-I/O in the draw-persistence path (the
+        # async writer hides real latency; injection happens host-side,
+        # before the handoff, so it is deterministic)
+        fail_point("drawstore.append")
         if block.ndim != 3 or block.shape[0] != self.chains or block.shape[2] != self.dim:
             raise ValueError(
                 f"expected (chains={self.chains}, n, dim={self.dim}),"

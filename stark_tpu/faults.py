@@ -1,0 +1,330 @@
+"""Deterministic failpoint harness (gofail-style) for supervision drills.
+
+The supervision layer (`supervise.supervised_sample`, the runner's block
+loop, checkpointing, the parallel drivers) claims to survive a taxonomy of
+faults — crash around the checkpoint rename, poisoned carried state,
+corrupt checkpoint bytes, slow I/O, preemption, shard death, stalls.  None
+of those shapes occur on demand, so this module makes them injectable:
+*named sites* compiled into the hot paths that are **zero-cost no-ops when
+disabled** (one module-global ``is None`` check) and, when armed, fire a
+scripted action with gofail-style trigger counts, so every drill scenario
+is reproducible bit-for-bit.
+
+Activation — either source, same grammar::
+
+    STARK_FAILPOINTS="ckpt.before_rename=crash*1@1; runner.block.pre=sleep(0.2)"
+    faults.configure("runner.carried_nan=nan*1")
+    faults.enable("consensus.shard_death", "kill(1)*3")
+
+Spec grammar (per site): ``action[(arg)][*count][@skip]``
+
+  * ``action`` — what fires (table below)
+  * ``arg``    — action parameter (seconds for sleep/stall, shard id for kill)
+  * ``*count`` — fire at most ``count`` times, then the site goes dormant
+                 (default: unlimited)
+  * ``@skip``  — ignore the first ``skip`` hits (e.g. crash on the SECOND
+                 checkpoint write: ``crash*1@1``)
+
+Actions:
+
+  ``crash``    raise `InjectedFault` at the site (a transient device fault)
+  ``preempt``  raise `InjectedPreemption` (simulated preemption — same
+               recovery path as crash, distinct class for assertions)
+  ``sleep``    ``time.sleep(arg)`` — slow-I/O / latency injection
+  ``stall``    ``time.sleep(arg)`` with a long default (600 s) — a hang the
+               watchdog must break (the sleep is interruptible by
+               ``_thread.interrupt_main``, unlike a real device hang)
+  ``nan``      data directive: `poison` fills the site's float arrays with
+               NaN (poisoned carried state)
+  ``corrupt``  data directive: `corrupt_file` overwrites bytes of the
+               site's file (torn write / bitrot)
+  ``kill``     data directive: `kill_shards` NaN-fills sub-posterior draws
+               of shard ``arg`` (shard death)
+
+Control-flow sites call `fail_point(site)`; data sites call the matching
+helper (`poison` / `corrupt_file` / `kill_shards`), which routes through
+`fail_point` first — so EVERY site also accepts crash/preempt/sleep.  Each
+firing is logged, recorded in `fired()` (drill assertions), and emitted to
+the ambient telemetry trace as a ``fault`` event.
+
+Not thread-safe by design: sites fire from the host driver thread; the
+counters are plain ints so the disabled path stays a single global read.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger("stark_tpu.faults")
+
+ENV_VAR = "STARK_FAILPOINTS"
+
+#: action kinds that raise/delay inside fail_point itself
+_CONTROL_KINDS = ("crash", "preempt", "sleep", "stall")
+#: action kinds applied by a data helper at the site
+_DATA_KINDS = ("nan", "corrupt", "kill")
+
+_SPEC_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)"
+    r"(?:\((?P<arg>[^)]*)\))?"
+    r"(?:\*(?P<count>\d+))?"
+    r"(?:@(?P<skip>\d+))?$"
+)
+
+
+class InjectedFault(RuntimeError):
+    """A failpoint-injected fault (classified transient by supervision)."""
+
+    def __init__(self, site: str, message: Optional[str] = None):
+        super().__init__(message or f"injected fault at failpoint {site!r}")
+        self.site = site
+
+
+class InjectedPreemption(InjectedFault):
+    """A failpoint-injected simulated preemption."""
+
+    def __init__(self, site: str):
+        super().__init__(site, f"injected preemption at failpoint {site!r}")
+
+
+class _Action:
+    __slots__ = ("kind", "arg", "count", "skip", "hits", "fired")
+
+    def __init__(self, kind: str, arg: Optional[str], count: Optional[int],
+                 skip: int):
+        self.kind = kind
+        self.arg = arg
+        self.count = count  # None = unlimited
+        self.skip = skip
+        self.hits = 0
+        self.fired = 0
+
+    def take(self) -> bool:
+        """Count one hit at the site; True iff the action fires this hit."""
+        self.hits += 1
+        if self.hits <= self.skip:
+            return False
+        if self.count is not None and self.fired >= self.count:
+            return False
+        self.fired += 1
+        return True
+
+    def arg_float(self, default: float) -> float:
+        return float(self.arg) if self.arg not in (None, "") else default
+
+    def arg_int(self, default: int = 0) -> int:
+        return int(self.arg) if self.arg not in (None, "") else default
+
+    def describe(self) -> str:
+        s = self.kind
+        if self.arg not in (None, ""):
+            s += f"({self.arg})"
+        if self.count is not None:
+            s += f"*{self.count}"
+        if self.skip:
+            s += f"@{self.skip}"
+        return s
+
+
+#: armed sites; None = harness fully disabled (the zero-cost fast path)
+_SITES: Optional[Dict[str, _Action]] = None
+#: record of fired actions, for drill assertions
+_FIRED: List[Dict[str, Any]] = []
+
+
+def parse_action(spec: str) -> _Action:
+    m = _SPEC_RE.match(spec.strip())
+    if not m:
+        raise ValueError(f"bad failpoint action spec {spec!r}")
+    kind = m.group("kind")
+    if kind not in _CONTROL_KINDS + _DATA_KINDS:
+        raise ValueError(
+            f"unknown failpoint action {kind!r} (have "
+            f"{sorted(_CONTROL_KINDS + _DATA_KINDS)})"
+        )
+    count = m.group("count")
+    return _Action(
+        kind,
+        m.group("arg"),
+        int(count) if count is not None else None,
+        int(m.group("skip") or 0),
+    )
+
+
+def parse_config(text: str) -> Dict[str, _Action]:
+    """``"site=spec; site2=spec2"`` -> {site: action} (``;`` or ``,``)."""
+    sites: Dict[str, _Action] = {}
+    for part in re.split(r"[;,]", text):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad failpoint entry {part!r} (want site=action)")
+        site, spec = part.split("=", 1)
+        sites[site.strip()] = parse_action(spec)
+    return sites
+
+
+def configure(text: Optional[str]) -> None:
+    """Replace the armed-site table from a config string (None/"" = disable)."""
+    global _SITES
+    _FIRED.clear()
+    if not text:
+        _SITES = None
+        return
+    sites = parse_config(text)
+    _SITES = sites or None
+    if _SITES:
+        log.warning(
+            "failpoints ARMED: %s",
+            ", ".join(f"{k}={v.describe()}" for k, v in _SITES.items()),
+        )
+
+
+def enable(site: str, spec: str) -> None:
+    """Arm one site (keeps others)."""
+    global _SITES
+    if _SITES is None:
+        _SITES = {}
+    _SITES[site] = parse_action(spec)
+
+
+def disable(site: str) -> None:
+    global _SITES
+    if _SITES and site in _SITES:
+        del _SITES[site]
+        if not _SITES:
+            _SITES = None
+
+
+def reset() -> None:
+    """Disarm everything and clear the fired record."""
+    global _SITES
+    _SITES = None
+    _FIRED.clear()
+
+
+def active() -> bool:
+    return _SITES is not None
+
+
+def fired() -> List[Dict[str, Any]]:
+    """Copy of the fired-action record (site, kind, hit ordinal)."""
+    return list(_FIRED)
+
+
+def _on_fire(site: str, act: _Action) -> None:
+    _FIRED.append({"site": site, "action": act.kind, "hit": act.hits})
+    log.warning("failpoint fired: %s=%s (hit %d)", site, act.describe(), act.hits)
+    try:
+        from . import telemetry
+
+        tr = telemetry.get_trace()
+        if tr.enabled:
+            tr.emit("fault", site=site, action=act.kind, hit=act.hits)
+    except Exception:  # noqa: BLE001 — injection must not add failure modes
+        pass
+
+
+def fail_point(site: str) -> Optional[_Action]:
+    """The one call compiled into a site.
+
+    Disabled: a single global read, returns None.  Armed: applies the
+    site's action — raises for crash/preempt, sleeps for sleep/stall, and
+    RETURNS the action for data directives (nan/corrupt/kill) so the
+    site-specific helper can apply it.
+    """
+    if _SITES is None:
+        return None
+    act = _SITES.get(site)
+    if act is None or not act.take():
+        return None
+    _on_fire(site, act)
+    if act.kind == "crash":
+        raise InjectedFault(site)
+    if act.kind == "preempt":
+        raise InjectedPreemption(site)
+    if act.kind == "sleep":
+        time.sleep(act.arg_float(0.1))
+        return None
+    if act.kind == "stall":
+        # long interruptible sleep: only the watchdog's interrupt_main (or
+        # a real Ctrl-C) breaks it — the cooperative stand-in for a hung
+        # device program
+        time.sleep(act.arg_float(600.0))
+        return None
+    return act
+
+
+def poison(site: str, tree: Any) -> Any:
+    """NaN-fill every float leaf of ``tree`` when ``site`` directs ``nan``.
+
+    Returns ``tree`` unchanged otherwise (including when disabled).
+    """
+    act = fail_point(site)
+    if act is None or act.kind != "nan":
+        return tree
+    import jax
+    import jax.numpy as jnp
+
+    def bad(x):
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return jnp.full_like(x, jnp.nan)
+        return x
+
+    return jax.tree.map(bad, tree)
+
+
+def corrupt_file(site: str, path: str) -> bool:
+    """Overwrite bytes in the middle of ``path`` when directed ``corrupt``.
+
+    Deterministic garbage at a deterministic offset; True iff applied.
+    """
+    act = fail_point(site)
+    if act is None or act.kind != "corrupt":
+        return False
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(max(0, size // 3))
+            f.write(b"\xde\xad\xbe\xef" * 16)
+    except OSError as e:
+        log.warning("failpoint %s: could not corrupt %s: %s", site, path, e)
+        return False
+    return True
+
+
+def kill_shards(site: str, draws, shard_ids=None):
+    """NaN-fill one shard's sub-posterior draws when directed ``kill``.
+
+    ``draws`` is the (S, ...) stacked sub-posterior array; ``shard_ids``
+    maps rows to GLOBAL shard ids (default ``arange(S)``) so the directive
+    ``kill(k)`` targets the same shard on retries over a survivor subset.
+    Returns a (possibly modified) numpy array.
+    """
+    import numpy as np
+
+    draws = np.asarray(draws)
+    act = fail_point(site)
+    if act is None or act.kind != "kill":
+        return draws
+    target = act.arg_int(0)
+    ids = np.arange(draws.shape[0]) if shard_ids is None else np.asarray(shard_ids)
+    rows = np.nonzero(ids == target)[0]
+    if rows.size == 0:
+        # target shard not in this subset: the directive fizzles (but the
+        # trigger count was consumed — a fired shot is a fired shot)
+        return draws
+    draws = draws.copy()
+    draws[rows] = np.nan
+    return draws
+
+
+# arm from the environment at import: any process that imports the package
+# (including chaos-drill subprocesses) honors STARK_FAILPOINTS
+configure(os.environ.get(ENV_VAR))

@@ -18,6 +18,7 @@ Execution layouts:
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Dict, Optional
 
@@ -26,10 +27,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .. import telemetry
+from .. import faults, telemetry
 from ..compat import shard_map
 from ..model import Model, flatten_model, prepare_model_data
 from ..sampler import Posterior, SamplerConfig, _constrain_draws, make_chain_runner
+
+log = logging.getLogger("stark_tpu.consensus")
 
 
 def _combine_precision_weighted(draws_flat: jax.Array) -> jax.Array:
@@ -201,6 +204,20 @@ def _run_chees_shards(
     return draws_sub, stats
 
 
+def _dead_shard_mask(draws_sub) -> np.ndarray:
+    """(S,) bool: a shard whose sub-posterior draws contain ANY non-finite
+    value is dead — a died/poisoned device program writes NaN, never a
+    partially-sane posterior.  Device-resident draws are scanned on
+    device (one (S,)-bool readback); only host arrays scan on host — the
+    healthy path never materializes the draws."""
+    S = draws_sub.shape[0]
+    if isinstance(draws_sub, jax.Array):
+        return np.asarray(
+            ~jnp.all(jnp.isfinite(draws_sub.reshape(S, -1)), axis=1)
+        )
+    return ~np.isfinite(np.asarray(draws_sub).reshape(S, -1)).all(axis=1)
+
+
 def consensus_sample(
     model: Model,
     data,
@@ -212,6 +229,8 @@ def consensus_sample(
     combine: str = "precision_full",  # "precision_full" | "precision" | "uniform"
     init_params: Optional[Dict[str, Any]] = None,
     dispatch_steps: Optional[int] = None,
+    shard_restarts: int = 1,
+    on_shard_failure: str = "degrade",  # "degrade" | "raise"
     **cfg_kwargs,
 ) -> Posterior:
     """Run consensus MC and return the combined Posterior.
@@ -219,6 +238,22 @@ def consensus_sample(
     ``chains`` here is chains PER SHARD; the combined posterior keeps the
     chain axis (chain c of the consensus = combination of chain c of every
     shard), so standard R-hat/ESS diagnostics apply to the combined draws.
+
+    SHARD DEATH (degraded-mode consensus): a shard whose draws come back
+    non-finite is dead.  Dead shards are re-sampled with a folded RNG
+    stream up to ``shard_restarts`` times (single-process, mesh-less runs
+    only — a mesh/multi-host subset re-dispatch would re-shard the
+    collective layout); a shard that exhausts its restarts is DROPPED: the
+    combination reweights over the surviving sub-posteriors and the result
+    carries ``sample_stats["degraded"]=True`` plus ``"lost_shards"`` (the
+    global shard ids), mirrored as ``chain_health`` ``status=
+    "shard_dropped"`` trace events and ``degraded`` on ``run_end``.  A
+    degraded consensus is an approximation of the full posterior MISSING
+    the lost shards' likelihood factors — usable for serving, flagged for
+    the caller to decide.  ``on_shard_failure="raise"`` turns exhaustion
+    into an error instead; every shard dead always raises.  Per-shard
+    ``sample_stats`` (step sizes etc.) describe the first attempt; the
+    draws are the authoritative post-retry state.
 
     MULTI-PROCESS (r5): with ``jax.distributed`` initialized, each host
     passes only ITS contiguous row block (``distributed.local_row_range``
@@ -329,6 +364,21 @@ def consensus_sample(
             fm, cfg, sharded, shards_here, chains, key_init, key_run, mesh,
             init_params, dispatch_steps,
         )
+
+        def rerun_shards(idx, fold):
+            # re-sample ONLY the dead shards: slice their data blocks out
+            # of the pre-placement tree and fold the attempt into the keys
+            # so the retry walks a fresh stream
+            sub = jax.tree.map(
+                lambda x: jnp.asarray(np.asarray(x)[idx]), sharded
+            )
+            d, _ = _run_chees_shards(
+                fm, cfg, sub, len(idx), chains,
+                jax.random.fold_in(key_init, fold),
+                jax.random.fold_in(key_run, fold),
+                None, init_params, dispatch_steps,
+            )
+            return d
     else:
         # per-chain kernels: derive the GLOBAL per-shard key/init streams
         # and slice this host's block, so a multi-host run reproduces the
@@ -390,6 +440,17 @@ def consensus_sample(
             "step_size": np.asarray(res.step_size),
         }
 
+        def rerun_shards(idx, fold):
+            jidx = jnp.asarray(idx)
+            fkeys = jax.vmap(
+                jax.vmap(lambda k: jax.random.fold_in(k, fold))
+            )(keys[jidx])
+            sub = jax.tree.map(lambda x: x[jidx], sharded)
+            out = jax.block_until_ready(
+                jax.jit(vshards)(fkeys, z0[jidx], sub)
+            )
+            return out.draws
+
     if multiproc:
         # one draw allgather: every host materializes every sub-posterior
         # (process blocks concatenate in rank order = global shard order),
@@ -402,6 +463,63 @@ def consensus_sample(
         )
         draws_sub = gathered.pop("draws")
         stats_extra = gathered
+
+    # ---- shard-death detection → per-shard retry → degraded mode ----
+    # failpoint: deterministic shard death (NaN-fills the targeted
+    # shard's draws, exactly the signature of a died device program).
+    # Only an ARMED harness pays the host materialization; the healthy
+    # path keeps the draws wherever they already live.
+    if faults.active():
+        draws_sub = faults.kill_shards(
+            "consensus.shard_death", np.asarray(draws_sub)
+        )
+    dead = _dead_shard_mask(draws_sub)
+    can_retry = not multiproc and mesh is None
+    shard_attempt = 0
+    while dead.any() and can_retry and shard_attempt < shard_restarts:
+        shard_attempt += 1
+        idx = np.nonzero(dead)[0]
+        log.warning(
+            "consensus: %d dead shard(s) %s — restart %d/%d",
+            idx.size, idx.tolist(), shard_attempt, shard_restarts,
+        )
+        if trace.enabled:
+            trace.emit(
+                "chain_health", status="shard_restart",
+                shards=idx.tolist(), attempt=shard_attempt,
+            )
+        new = faults.kill_shards(
+            "consensus.shard_death", np.asarray(rerun_shards(idx, shard_attempt)),
+            shard_ids=idx,
+        )
+        if not isinstance(draws_sub, np.ndarray) or not draws_sub.flags.writeable:
+            draws_sub = np.array(draws_sub)  # first mutation: host copy
+        draws_sub[idx] = new
+        dead = _dead_shard_mask(draws_sub)
+    lost = np.nonzero(dead)[0]
+    degraded = bool(lost.size)
+    if degraded:
+        if lost.size == draws_sub.shape[0]:
+            raise RuntimeError(
+                f"consensus: all {lost.size} shards dead after "
+                f"{shard_attempt} restart(s) — nothing to combine"
+            )
+        if on_shard_failure == "raise":
+            raise RuntimeError(
+                f"consensus: shards {lost.tolist()} dead after exhausting "
+                f"{shard_restarts} restart(s)"
+            )
+        log.warning(
+            "consensus DEGRADED: dropping dead shard(s) %s, combining the "
+            "%d survivors (their likelihood factors are missing from the "
+            "result)", lost.tolist(), draws_sub.shape[0] - lost.size,
+        )
+        if trace.enabled:
+            for k in lost.tolist():
+                trace.tagged(shard=int(k)).emit(
+                    "chain_health", status="shard_dropped",
+                    shard_restarts=shard_restarts,
+                )
 
     if trace.enabled:
         # per-shard health, each event tagged with its GLOBAL shard id —
@@ -419,12 +537,16 @@ def consensus_sample(
             trace.tagged(shard=k).emit("chain_health", **fields)
 
     with trace.phase("collect", stage=f"combine:{combine}"):
+        # degraded mode: the combine reweights over the SURVIVING shards
+        # only (the precision weights are per-shard estimates, so dropping
+        # a row is exact — no renormalization beyond the weight sums)
+        alive = jnp.asarray(draws_sub[~dead] if degraded else draws_sub)
         if combine == "precision":
-            combined = _combine_precision_weighted(draws_sub)
+            combined = _combine_precision_weighted(alive)
         elif combine == "precision_full":
-            combined = _combine_precision_weighted_full(draws_sub)
+            combined = _combine_precision_weighted_full(alive)
         elif combine == "uniform":
-            combined = jnp.mean(draws_sub, axis=0)
+            combined = jnp.mean(alive, axis=0)
         else:
             raise ValueError(f"unknown combine {combine!r}")
 
@@ -433,11 +555,15 @@ def consensus_sample(
         **stats_extra,
         "num_shards": num_shards,
         "sub_draws_flat": np.asarray(draws_sub),
+        "degraded": degraded,
+        "lost_shards": np.asarray(lost, np.int64),
     }
     if trace.enabled:
         trace.emit(
             "run_end",
             dur_s=round(time.perf_counter() - t_run0, 4),
             num_divergent=int(np.sum(np.asarray(stats_extra["num_divergent"]))),
+            degraded=degraded,
+            lost_shards=lost.tolist(),
         )
     return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(combined))

@@ -322,6 +322,12 @@ def tempered_sample(
     vrun = jax.vmap(run_chain)
     # the whole K-replica ladder runs as ONE device program (a swap is a
     # gather, not communication) — one sample_block phase covers it
+    # failpoint: fault the ladder dispatch (crash/preempt/sleep) — the
+    # whole-run program has no retry below the caller, so this is the
+    # site that drills caller-level supervision of tempered runs
+    from ..faults import fail_point
+
+    fail_point("tempering.dispatch")
     with trace.phase(
         "sample_block", includes_warmup=True, includes_compile=True,
         transitions=num_warmup + num_samples, replicas=chains * num_temps,

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import diagnostics, telemetry
+from . import diagnostics, faults, telemetry
 from .kernels.base import HMCState
 from .model import Model
 from .sampler import Posterior, SamplerConfig, _constrain_draws
@@ -386,6 +386,7 @@ def _sample_until_converged(
                     )
                     if trace.enabled:
                         ph.note(num_divergent=int(nd), leapfrogs=int(nl))
+                telemetry.notify_progress()  # watchdog liveness beat
                 n_div += int(nd)
                 n_leap += int(nl)
             return carry, n_div, n_leap
@@ -482,6 +483,7 @@ def _sample_until_converged(
                     )
                     if trace.enabled:
                         ph.note(num_divergent=int(nd), leapfrogs=int(nl))
+                telemetry.notify_progress()  # watchdog liveness beat
                 n_div += int(nd)
                 n_leap += int(nl)
                 if checkpoint_path and e < cfg.num_warmup:
@@ -505,9 +507,14 @@ def _sample_until_converged(
     metrics_f = open(metrics_path, "a") if metrics_path else None
 
     def emit(rec):
+        # every record is a progress beat (watchdog liveness) and is
+        # flushed AND fsynced line-by-line: the metrics trail documents
+        # crashes, so it must survive the crash it documents
+        telemetry.notify_progress()
         if metrics_f:
             metrics_f.write(json.dumps(rec) + "\n")
             metrics_f.flush()
+            os.fsync(metrics_f.fileno())
         if progress_cb is not None:
             try:
                 progress_cb(rec)
@@ -817,6 +824,10 @@ def _sample_until_converged(
             return np.asarray(zs), accept, divergent, int(np.sum(ngrad))
 
         while blocks_done < max_blocks:
+            # failpoint: crash/preempt/sleep/stall before dispatching a
+            # block — @skip counts hits, so ``stall(600)*1@1`` stalls
+            # exactly once, at block 2 of the first attempt
+            faults.fail_point("runner.block.pre")
             key, key_block = jax.random.split(key)
             t_blk = time.perf_counter()
             if profile_dir and blocks_done == 0:
@@ -825,6 +836,12 @@ def _sample_until_converged(
             else:
                 zs, accept, divergent, blk_grads = advance_block(key_block)
             t_dispatch = time.perf_counter() - t_blk
+            # failpoint: NaN-poison the carried state — injected BEFORE
+            # the health check, exactly where a real numerical fault would
+            # surface (health_check=True catches it pre-checkpoint; with
+            # the check off it lands on disk and exercises the quarantine
+            # path instead)
+            state = faults.poison("runner.carried_nan", state)
             if health_check:
                 # poisoned state must never reach the checkpoint; the
                 # supervisor (supervise.supervised_sample) restarts from

@@ -266,6 +266,7 @@ def drive_segmented_warmup(cfg, v_init, v_seg, finalize, warm_keys, z0, data,
                       jnp.asarray(wflags[s:e]), state, da, welford, inv_mass,
                       data)
             )
+        telemetry.notify_progress()  # watchdog liveness beat per segment
         warm_div = ndiv if warm_div is None else warm_div + ndiv
     if warm_div is None:
         warm_div = jnp.zeros((warm_keys.shape[0],), jnp.int32)

@@ -7,17 +7,35 @@ this module supervises a run — detecting failures and restarting from the
 last *healthy* checkpoint, or from scratch when no healthy checkpoint
 exists.
 
-Failure classes handled:
+Fault taxonomy (`classify_fault` — every restart record and trace event
+carries the class):
 
-  * process/device faults — any exception out of the run (XLA error, TPU
-    tunnel fault, preemption surfacing as a crash on the next attempt's
-    ``resume_from``) → restart from the latest valid checkpoint.
-  * numerical divergence of the sampler state — non-finite positions or
-    step sizes detected by the runner's per-block health check BEFORE the
-    state is checkpointed (a poisoned state never lands on disk) →
-    ``ChainHealthError`` → restart with a fresh seed.
-  * checkpoint corruption — a checkpoint that fails to load or contains
-    non-finite state is discarded and the run cold-starts.
+  * ``transient``          — process/device faults: any exception out of
+    the run (XLA error, TPU tunnel fault, preemption surfacing as a crash)
+    → restart from the latest valid checkpoint, with exponential backoff.
+  * ``poisoned_state``     — non-finite sampler state detected by the
+    runner's per-block health check BEFORE checkpointing (a poisoned state
+    never lands on disk) → `ChainHealthError` → immediate restart with a
+    fresh seed (no backoff: the fault is numerical, not environmental).
+  * ``corrupt_checkpoint`` — a checkpoint that fails to load or contains
+    non-finite state is quarantined (with the REASON logged and traced)
+    and the run cold-starts.
+  * ``stall``              — no progress beat within ``stall_timeout_s``:
+    the `watchdog.Watchdog` aborts the attempt (`StallError`) and the
+    supervisor restarts from the last checkpoint.
+  * ``restart_budget_exhausted`` — the restart-rate window overflowed; the
+    final fault is re-raised to the caller.
+
+Restart discipline: failures are recorded in a sliding `RestartBudget`
+(``max_restarts`` within ``restart_window_s``; an infinite window — the
+default — reproduces the old lifetime counter), and each restart waits
+``backoff_base_s * 2^(attempt-1)`` seconds with deterministic jitter,
+capped at ``backoff_cap_s`` (base 0 — the default — keeps restarts
+immediate, matching historical behavior; production configs set a base).
+
+Every fault shape above is injectable on demand via `faults` (see the
+``chaos-drill`` CLI subcommand / `chaos.run_drill` for the scripted
+scenario matrix).
 
 Elastic re-sharding (changing the device mesh mid-run) is a documented
 non-goal for v1 — restart-from-checkpoint onto the new topology covers the
@@ -27,22 +45,39 @@ preemption story without it (DESIGN.md §6).
 from __future__ import annotations
 
 import json
+import logging
 import os
+import random
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from . import telemetry
 from .checkpoint import load_checkpoint
+from .faults import fail_point
 from .model import Model
+from .watchdog import StallError, Watchdog
+
+log = logging.getLogger("stark_tpu.supervise")
 
 __all__ = [
     "ChainHealthError",
+    "RestartBudget",
+    "agree_resume",
+    "backoff_delay",
     "check_finite_state",
+    "checkpoint_health",
     "checkpoint_is_healthy",
+    "classify_fault",
     "supervised_sample",
 ]
+
+#: fault-class names (the taxonomy every restart record/trace event uses)
+FAULT_TRANSIENT = "transient"
+FAULT_POISONED = "poisoned_state"
+FAULT_CORRUPT = "corrupt_checkpoint"
+FAULT_STALL = "stall"
 
 
 class ChainHealthError(RuntimeError):
@@ -79,22 +114,168 @@ def check_finite_state(arrays: Dict[str, Any]) -> None:
             )
 
 
-def checkpoint_is_healthy(path: str) -> bool:
-    """True iff the checkpoint loads and its state arrays are finite."""
+def checkpoint_health(path: str) -> Tuple[bool, Optional[str]]:
+    """(healthy, reason) for a checkpoint file.
+
+    ``reason`` (None when healthy) is "<fault class>: <detail>" — the
+    WHY a checkpoint is about to be quarantined, so discards are never
+    silent (they are logged and traced by the supervisor).
+    """
     try:
         arrays, _ = load_checkpoint(path)
+    except Exception as e:  # noqa: BLE001 — unreadable file = corrupt
+        return False, f"{FAULT_CORRUPT}: {type(e).__name__}: {e}"
+    try:
         check_finite_state(arrays)
-        return True
-    except Exception:
-        return False
+    except ChainHealthError as e:
+        return False, f"{FAULT_POISONED}: {e}"
+    return True, None
+
+
+def checkpoint_is_healthy(path: str) -> bool:
+    """True iff the checkpoint loads and its state arrays are finite."""
+    return checkpoint_health(path)[0]
+
+
+def classify_fault(exc: BaseException) -> str:
+    """Map an exception out of an attempt to its fault class."""
+    if isinstance(exc, ChainHealthError):
+        return FAULT_POISONED
+    if isinstance(exc, StallError):
+        return FAULT_STALL
+    return FAULT_TRANSIENT
+
+
+def backoff_delay(
+    fault: str,
+    attempt: int,
+    *,
+    base_s: float,
+    cap_s: float = 60.0,
+    seed: int = 0,
+) -> float:
+    """Exponential backoff with deterministic jitter for restart ``attempt``.
+
+    ``base_s * 2^(attempt-1)`` scaled by a jitter in [0.5, 1.5) derived
+    from (seed, attempt) — deterministic per run so drills reproduce,
+    decorrelated across seeds so a fleet of supervised runs restarting
+    off the same shared-filesystem hiccup doesn't thundering-herd — and
+    the RESULT capped at ``cap_s`` (the cap is the contract an operator
+    sizes budgets around, so jitter stays inside it).  Poisoned state
+    skips backoff entirely: the fault is numerical, the fix is the
+    reseed, and waiting buys nothing.
+    """
+    if base_s <= 0 or fault == FAULT_POISONED:
+        return 0.0
+    jitter = 0.5 + random.Random(f"{seed}:{attempt}").random()
+    return min(cap_s, base_s * 2.0 ** max(attempt - 1, 0) * jitter)
+
+
+class RestartBudget:
+    """Sliding-window restart-rate limit (replaces the bare counter).
+
+    Allows at most ``max_restarts`` failures inside any ``window_s``-second
+    window; ``window_s=None`` (default) never forgets — exactly the old
+    lifetime ``max_restarts`` semantics.  A finite window is the crash-loop
+    detector for long runs: three preemptions across a day is routine,
+    three faults in two minutes is a broken build.
+    """
+
+    def __init__(self, max_restarts: int, window_s: Optional[float] = None):
+        self.max_restarts = int(max_restarts)
+        self.window_s = window_s
+        self._times: List[float] = []
+
+    def record_failure(self, now: Optional[float] = None) -> None:
+        self._times.append(time.monotonic() if now is None else now)
+
+    def in_window(self, now: Optional[float] = None) -> int:
+        now = time.monotonic() if now is None else now
+        if self.window_s is not None:
+            self._times = [t for t in self._times if now - t <= self.window_s]
+        return len(self._times)
+
+    def exhausted(self, now: Optional[float] = None) -> bool:
+        """True when the CURRENT window holds more failures than allowed
+        restarts (the n-th failure is terminal once n > max_restarts)."""
+        return self.in_window(now) > self.max_restarts
 
 
 def _ranks_agree(all_done) -> bool:
     """True iff every rank reported a healthy checkpoint at the SAME
     (phase, progress) — the resume-consistency rule for multi-process
-    supervision (see ``agree_resume`` inside `supervised_sample`)."""
+    supervision (see `agree_resume`)."""
     a = np.asarray(all_done).reshape(-1, 2)
     return bool((a[:, 0] >= 0).all() and (a == a[0]).all())
+
+
+def agree_resume(
+    resume: Optional[str],
+    *,
+    quarantine: Callable[[str], None],
+    trace=None,
+) -> Optional[str]:
+    """Cross-rank agreement on resume-vs-cold-start (multi-process).
+
+    Each rank reads only ITS per-rank checkpoint; a kill between two
+    ranks' checkpoint renames (atomic per file, not across ranks)
+    leaves blocks_done skewed by one, and skewed resumes would issue
+    different numbers of collective-bearing blocks — the pod then
+    hangs on an unmatched allgather.  Rule: resume ONLY when every
+    rank holds a healthy checkpoint with the SAME blocks_done;
+    otherwise all ranks cold-start in lockstep.  The skew window is
+    one checkpoint rename per block, so losing it costs (rarely) one
+    attempt's progress, never correctness.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return resume
+    from jax.experimental import multihost_utils
+
+    trace = telemetry.resolve_trace(trace)
+    # (phase, progress): warmup checkpoints count warm_done segments,
+    # sample-phase ones count blocks_done — compare both so a
+    # warmup-2 file never falsely agrees with a blocks-2 one
+    done = (-1, -1)
+    if resume is not None:
+        try:
+            _, meta = load_checkpoint(resume)
+            warm = meta.get("phase") == "warmup"
+            done = (
+                0 if warm else 1,
+                int(meta["warm_done"] if warm
+                    else meta.get("blocks_done", 0)),
+            )
+        except Exception:  # noqa: BLE001 — unreadable: treat as cold
+            done = (-1, -1)
+    all_done = multihost_utils.process_allgather(np.array(done))
+    if _ranks_agree(all_done):
+        return resume
+    if resume is not None:
+        # healthy but unusable (a peer is cold or skewed): quarantine
+        # so the stale state can't mix into the cold restart
+        log.warning(
+            "quarantining %s: ranks disagree on resume point %s "
+            "(cold-starting in lockstep)", resume, np.asarray(all_done).tolist(),
+        )
+        if trace.enabled:
+            trace.emit(
+                "chain_health", status="quarantine", path=resume,
+                reason="rank resume-point skew",
+            )
+        quarantine(resume)
+    return None
+
+
+def _append_record(path: str, rec: Dict[str, Any]) -> None:
+    """Append one JSONL record, flushed AND fsynced — a restart record
+    documents a crash, so it must survive the crash (and the host dying
+    right after) that it documents."""
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
 
 
 def supervised_sample(
@@ -103,6 +284,10 @@ def supervised_sample(
     *,
     workdir: str,
     max_restarts: int = 3,
+    restart_window_s: Optional[float] = None,
+    backoff_base_s: float = 0.0,
+    backoff_cap_s: float = 60.0,
+    stall_timeout_s: Optional[float] = None,
     seed: int = 0,
     reseed_on_restart: bool = True,
     trace=None,
@@ -112,9 +297,20 @@ def supervised_sample(
 
     Checkpoints, draw store, and metrics all live under ``workdir``; on any
     failure the run restarts from the last healthy checkpoint (or from
-    scratch if none), up to ``max_restarts`` times.  Each restart is logged
-    as a ``{"event": "restart", ...}`` line in the metrics JSONL — the
-    observable failure-detection record.
+    scratch if none).  Each restart is logged as a ``{"event": "restart",
+    "fault": <class>, ...}`` line in the metrics JSONL — the observable
+    failure-detection record — and restarts are bounded by a
+    `RestartBudget` (``max_restarts`` failures within ``restart_window_s``;
+    the default infinite window is the historical lifetime counter) with
+    `backoff_delay` pauses between attempts.
+
+    ``stall_timeout_s`` arms a `watchdog.Watchdog` around every attempt: an
+    attempt that stops emitting progress beats (draw blocks, warmup
+    segments, in-scan heartbeats) for that long is aborted (`StallError`)
+    and restarted like any other fault.  Pick it LARGER than the worst
+    single dispatch including compile — beats only flow between
+    dispatches.  A genuine Ctrl-C is never converted: only an interrupt
+    the watchdog itself fired counts as a stall.
 
     ``trace`` (default: the ambient `telemetry` trace): ONE RunTrace spans
     every attempt — each attempt emits its own run envelope, and restarts
@@ -151,6 +347,7 @@ def supervised_sample(
     kwargs.setdefault("health_check", True)
 
     store_path = kwargs.get("draw_store_path")
+    budget = RestartBudget(max_restarts, restart_window_s)
 
     def quarantine(path: str) -> None:
         # numbered suffixes: a second quarantine in the same workdir must
@@ -162,64 +359,86 @@ def supervised_sample(
             dst = f"{path}.bad{n}"
         os.replace(path, dst)
 
-    def agree_resume(resume: Optional[str]) -> Optional[str]:
-        """Cross-rank agreement on resume-vs-cold-start (multi-process).
-
-        Each rank reads only ITS per-rank checkpoint; a kill between two
-        ranks' checkpoint renames (atomic per file, not across ranks)
-        leaves blocks_done skewed by one, and skewed resumes would issue
-        different numbers of collective-bearing blocks — the pod then
-        hangs on an unmatched allgather.  Rule: resume ONLY when every
-        rank holds a healthy checkpoint with the SAME blocks_done;
-        otherwise all ranks cold-start in lockstep.  The skew window is
-        one checkpoint rename per block, so losing it costs (rarely) one
-        attempt's progress, never correctness.
-        """
-        import jax
-
-        if jax.process_count() == 1:
-            return resume
-        import numpy as np
-        from jax.experimental import multihost_utils
-
-        # (phase, progress): warmup checkpoints count warm_done segments,
-        # sample-phase ones count blocks_done — compare both so a
-        # warmup-2 file never falsely agrees with a blocks-2 one
-        done = (-1, -1)
-        if resume is not None:
-            try:
-                _, meta = load_checkpoint(resume)
-                warm = meta.get("phase") == "warmup"
-                done = (
-                    0 if warm else 1,
-                    int(meta["warm_done"] if warm
-                        else meta.get("blocks_done", 0)),
-                )
-            except Exception:  # noqa: BLE001 — unreadable: treat as cold
-                done = (-1, -1)
-        all_done = multihost_utils.process_allgather(np.array(done))
-        if _ranks_agree(all_done):
-            return resume
-        if resume is not None:
-            # healthy but unusable (a peer is cold or skewed): quarantine
-            # so the stale state can't mix into the cold restart
-            quarantine(resume)
-        return None
-
     attempt = 0
+
+    def on_failure(e: BaseException, fault: str, resumed: bool) -> None:
+        """Record one failed attempt; re-raise when the budget is gone,
+        otherwise back off and let the loop retry."""
+        nonlocal attempt
+        attempt += 1
+        budget.record_failure()
+        exhausted = budget.exhausted()
+        delay = (
+            0.0 if exhausted
+            else backoff_delay(
+                fault, attempt,
+                base_s=backoff_base_s, cap_s=backoff_cap_s, seed=seed,
+            )
+        )
+        rec = {
+            "event": "restart",
+            "attempt": attempt,
+            "fault": fault,
+            "error": f"{type(e).__name__}: {e}",
+            "resumed_from_checkpoint": resumed,
+            "backoff_s": round(delay, 3),
+            "ts": time.time(),
+        }
+        log.warning(
+            "attempt %d failed (%s): %s — %s", attempt, fault, e,
+            "restart budget exhausted" if exhausted
+            else f"restarting in {delay:.2f}s",
+        )
+        if metrics_path:  # caller may disable metrics with None
+            _append_record(metrics_path, rec)
+        if trace.enabled:
+            # the failure-detection record, in the trace's vocabulary:
+            # a chain-health transition, not a new run
+            trace.emit(
+                "chain_health",
+                status="restart",
+                attempt=attempt,
+                fault=fault,
+                error=f"{type(e).__name__}: {e}",
+                resumed_from_checkpoint=resumed,
+                backoff_s=round(delay, 3),
+            )
+        if exhausted:
+            if trace.enabled:
+                trace.emit(
+                    "chain_health",
+                    status="restart_budget_exhausted",
+                    restarts_in_window=budget.in_window(),
+                    window_s=restart_window_s,
+                )
+            raise e
+        if delay > 0:
+            time.sleep(delay)
+
     while True:
+        fail_point("supervise.attempt")
         resume: Optional[str] = None
         if os.path.exists(ckpt_path):
-            if checkpoint_is_healthy(ckpt_path):
+            healthy, reason = checkpoint_health(ckpt_path)
+            if healthy:
                 resume = ckpt_path
             else:
-                # corrupt/poisoned checkpoint: quarantine it and cold-start
+                # corrupt/poisoned checkpoint: quarantine it (keeping the
+                # forensic copy) and cold-start — NEVER silently: the
+                # reason lands in the log and the trace
+                log.warning("quarantining %s: %s", ckpt_path, reason)
+                if trace.enabled:
+                    trace.emit(
+                        "chain_health", status="quarantine",
+                        path=ckpt_path, reason=reason,
+                    )
                 quarantine(ckpt_path)
-        resume = agree_resume(resume)
+        resume = agree_resume(resume, quarantine=quarantine, trace=trace)
         if resume is None and store_path and os.path.exists(store_path):
             # cold start: draws persisted by a discarded run must not mix
             # into this run's store (a later resume reads the whole store)
             quarantine(store_path)
+        wd: Optional[Watchdog] = None
         try:
             remaining = (
                 # floor at 1s: with the deadline already blown the attempt
@@ -232,39 +451,37 @@ def supervised_sample(
             # ambient install: the runner and the drivers below it pick up
             # this supervisor's trace even though only ``trace=`` was given
             with telemetry.use_trace(trace):
-                return sample_until_converged(
-                    model,
-                    data,
-                    seed=seed + attempt if reseed_on_restart else seed,
-                    checkpoint_path=ckpt_path,
-                    resume_from=resume,
-                    metrics_path=metrics_path,
-                    reseed=attempt if (attempt and reseed_on_restart) else None,
-                    time_budget_s=remaining,
-                    trace=trace,
-                    **kwargs,
+                if stall_timeout_s is not None:
+                    wd = Watchdog(
+                        stall_timeout_s, trace=trace, label="supervise"
+                    ).start()
+                try:
+                    return sample_until_converged(
+                        model,
+                        data,
+                        seed=seed + attempt if reseed_on_restart else seed,
+                        checkpoint_path=ckpt_path,
+                        resume_from=resume,
+                        metrics_path=metrics_path,
+                        reseed=attempt if (attempt and reseed_on_restart) else None,
+                        time_budget_s=remaining,
+                        trace=trace,
+                        **kwargs,
+                    )
+                finally:
+                    if wd is not None:
+                        wd.stop()
+        except KeyboardInterrupt:
+            # ONLY a watchdog-fired interrupt is a stall; a user Ctrl-C
+            # (no stall flag) propagates untouched — supervision must
+            # never eat a genuine interrupt
+            if wd is not None and wd.consume_stall():
+                e = StallError(
+                    f"no progress beat within {stall_timeout_s}s "
+                    "(watchdog aborted the attempt)"
                 )
-        except Exception as e:  # noqa: BLE001 — supervision boundary
-            attempt += 1
-            rec = {
-                "event": "restart",
-                "attempt": attempt,
-                "error": f"{type(e).__name__}: {e}",
-                "resumed_from_checkpoint": resume is not None,
-                "ts": time.time(),
-            }
-            if metrics_path:  # caller may disable metrics with None
-                with open(metrics_path, "a") as f:
-                    f.write(json.dumps(rec) + "\n")
-            if trace.enabled:
-                # the failure-detection record, in the trace's vocabulary:
-                # a chain-health transition, not a new run
-                trace.emit(
-                    "chain_health",
-                    status="restart",
-                    attempt=attempt,
-                    error=f"{type(e).__name__}: {e}",
-                    resumed_from_checkpoint=resume is not None,
-                )
-            if attempt > max_restarts:
+                on_failure(e, FAULT_STALL, resume is not None)
+            else:
                 raise
+        except Exception as e:  # noqa: BLE001 — supervision boundary
+            on_failure(e, classify_fault(e), resume is not None)

@@ -311,6 +311,39 @@ NULL_TRACE = NullTrace()
 _CURRENT: ContextVar[Any] = ContextVar("stark_tpu_trace", default=NULL_TRACE)
 _CALLBACK_TRACE: Any = NULL_TRACE
 
+# progress listeners: the liveness side-channel the watchdog subscribes to.
+# Distinct from the trace (beats flow even with tracing off) and zero-cost
+# when nobody listens — one empty-list truth test per beat site.
+_PROGRESS_LISTENERS: List[Any] = []
+
+
+def add_progress_listener(fn) -> None:
+    """Register ``fn()`` to be called on every progress beat (see
+    `notify_progress`).  Used by `watchdog.Watchdog`; listeners must be
+    cheap and must not raise (exceptions are swallowed)."""
+    if fn not in _PROGRESS_LISTENERS:
+        _PROGRESS_LISTENERS.append(fn)
+
+
+def remove_progress_listener(fn) -> None:
+    try:
+        _PROGRESS_LISTENERS.remove(fn)
+    except ValueError:
+        pass
+
+
+def notify_progress() -> None:
+    """One progress beat: the run advanced by an observable unit (a draw
+    block, a warmup segment, a checkpoint write, an in-scan heartbeat).
+    Called from the host drivers; free when no listener is registered."""
+    if not _PROGRESS_LISTENERS:
+        return
+    for fn in list(_PROGRESS_LISTENERS):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — liveness must not fault the run
+            pass
+
 
 def get_trace():
     """The ambient trace (NULL_TRACE unless one was installed)."""
@@ -371,6 +404,7 @@ def heartbeat(label, step, accept) -> None:
     ContextVar — the runtime invokes debug callbacks from its own
     threads, outside the installing context.  Must accept whatever the
     callback thread hands it without raising."""
+    notify_progress()  # in-scan liveness beats flow even with tracing off
     try:
         _CALLBACK_TRACE.heartbeat(
             label=str(label), step=int(step), accept=round(float(accept), 4)

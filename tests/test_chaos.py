@@ -1,0 +1,61 @@
+"""Chaos drill scenarios (stark_tpu/chaos.py) wired into tier-1.
+
+Each scenario is a REAL (tiny) supervised or consensus run with armed
+failpoints, asserting the recovery contract — these are the repo's
+fault-injection acceptance tests, so they run in the default tier under
+the ``chaos`` marker (deselect with ``-m 'not chaos'`` for a quick loop).
+"""
+
+import pytest
+
+from stark_tpu import faults
+from stark_tpu.chaos import SCENARIOS, run_drill
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# scenarios measured >= ~8s on the 1-core host (pytest.ini policy): they
+# ride the slow tier; the full matrix always runs via `chaos-drill`
+_SLOW = {"stall_watchdog", "shard_death_recovered"}
+
+
+# every scenario is its own test so a matrix regression names the exact
+# broken contract instead of "the drill failed"
+@pytest.mark.parametrize(
+    "name",
+    [
+        pytest.param(n, marks=pytest.mark.slow) if n in _SLOW
+        else n
+        for n in SCENARIOS
+    ],
+)
+def test_scenario(name, tmp_path):
+    SCENARIOS[name](str(tmp_path))
+
+
+def test_run_drill_reports_instead_of_dying(tmp_path, monkeypatch):
+    """A failing scenario becomes a FAIL record (the drill reports the
+    whole matrix), and the drill never leaves failpoints armed."""
+
+    def boom(workdir):
+        faults.enable("leftover.site", "crash")
+        raise AssertionError("scripted failure")
+
+    monkeypatch.setitem(SCENARIOS, "exploding", boom)
+    results = run_drill(["exploding"], str(tmp_path))
+    assert len(results) == 1
+    assert results[0]["ok"] is False
+    assert "scripted failure" in results[0]["error"]
+    assert not faults.active()
+
+
+def test_run_drill_rejects_unknown_scenario(tmp_path):
+    with pytest.raises(ValueError, match="unknown scenario"):
+        run_drill(["no_such_drill"], str(tmp_path))

@@ -1,0 +1,122 @@
+"""Failpoint harness (stark_tpu/faults.py): grammar, trigger counts,
+data directives, and the zero-cost disabled contract."""
+
+import numpy as np
+import pytest
+
+from stark_tpu import faults
+from stark_tpu.faults import (
+    InjectedFault,
+    InjectedPreemption,
+    fail_point,
+    parse_action,
+    parse_config,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def test_disabled_is_noop():
+    assert not faults.active()
+    assert fail_point("anything.at.all") is None
+    assert faults.fired() == []
+
+
+def test_parse_action_grammar():
+    a = parse_action("crash")
+    assert (a.kind, a.arg, a.count, a.skip) == ("crash", None, None, 0)
+    a = parse_action("sleep(0.25)*2@3")
+    assert (a.kind, a.arg, a.count, a.skip) == ("sleep", "0.25", 2, 3)
+    a = parse_action("kill(1)")
+    assert a.arg_int() == 1
+    with pytest.raises(ValueError, match="unknown failpoint action"):
+        parse_action("explode")
+    with pytest.raises(ValueError, match="bad failpoint action"):
+        parse_action("crash(((")
+
+
+def test_parse_config_multi_site():
+    sites = parse_config("a.b=crash*1; c.d=nan@2, e.f=sleep(0.1)")
+    assert set(sites) == {"a.b", "c.d", "e.f"}
+    with pytest.raises(ValueError, match="site=action"):
+        parse_config("justasite")
+
+
+def test_crash_and_preempt_raise():
+    faults.configure("s.crash=crash; s.pre=preempt")
+    with pytest.raises(InjectedFault):
+        fail_point("s.crash")
+    with pytest.raises(InjectedPreemption):
+        fail_point("s.pre")
+    # preemption is a fault subclass: one supervision path handles both
+    assert issubclass(InjectedPreemption, InjectedFault)
+
+
+def test_trigger_count_and_skip():
+    faults.configure("s=crash*2@1")
+    fail_point("s")  # hit 1: skipped
+    for _ in range(2):  # hits 2-3: fire
+        with pytest.raises(InjectedFault):
+            fail_point("s")
+    assert fail_point("s") is None  # exhausted: dormant again
+    assert [f["hit"] for f in faults.fired()] == [2, 3]
+
+
+def test_enable_disable_roundtrip():
+    faults.enable("x", "crash*1")
+    assert faults.active()
+    faults.disable("x")
+    assert not faults.active()
+
+
+def test_poison_directive_nan_fills_floats():
+    faults.configure("p=nan*1")
+    tree = {"z": np.ones((2, 3), np.float32), "n": np.arange(3)}
+    out = faults.poison("p", tree)
+    assert np.isnan(out["z"]).all()
+    np.testing.assert_array_equal(out["n"], np.arange(3))  # ints untouched
+    # count exhausted: second call is identity
+    tree2 = faults.poison("p", tree)
+    assert not np.isnan(np.asarray(tree2["z"])).any()
+
+
+def test_poison_ignores_mismatched_action():
+    faults.configure("p=sleep(0)")
+    tree = {"z": np.ones(2, np.float32)}
+    assert not np.isnan(np.asarray(faults.poison("p", tree)["z"])).any()
+
+
+def test_corrupt_file_directive(tmp_path):
+    p = str(tmp_path / "f.bin")
+    with open(p, "wb") as f:
+        f.write(b"\x00" * 4096)
+    assert not faults.corrupt_file("c", p)  # disabled: untouched
+    faults.configure("c=corrupt*1")
+    assert faults.corrupt_file("c", p)
+    with open(p, "rb") as f:
+        assert b"\xde\xad\xbe\xef" in f.read()
+
+
+def test_kill_shards_targets_global_ids():
+    faults.configure("k=kill(2)*2")
+    draws = np.zeros((4, 2, 3, 1), np.float32)
+    out = faults.kill_shards("k", draws)
+    assert np.isnan(out[2]).all() and np.isfinite(out[[0, 1, 3]]).all()
+    # retry over a survivor subset: global id 2 maps through shard_ids
+    sub = np.zeros((2, 2, 3, 1), np.float32)
+    out2 = faults.kill_shards("k", sub, shard_ids=np.array([1, 2]))
+    assert np.isfinite(out2[0]).all() and np.isnan(out2[1]).all()
+
+
+def test_env_var_configures(monkeypatch):
+    # configure() is what the import-time hook calls with the env value
+    faults.configure("env.site=crash*1")
+    with pytest.raises(InjectedFault):
+        fail_point("env.site")
+    faults.configure(None)
+    assert not faults.active()

@@ -1,0 +1,59 @@
+"""Repo lint: supervision boundaries must never eat Ctrl-C/SystemExit.
+
+``except BaseException`` / bare ``except:`` / explicit KeyboardInterrupt
+or SystemExit handlers in stark_tpu/ must re-raise — a retry loop that
+swallows them turns the operator's Ctrl-C into "restart attempt N+1".
+AST-based, sibling of tools/lint_no_print.py.
+"""
+
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "lint_supervision",
+    os.path.join(
+        os.path.dirname(__file__), "..", "tools", "lint_supervision.py"
+    ),
+)
+lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(lint)
+
+_PKG = os.path.join(os.path.dirname(__file__), "..", "stark_tpu")
+
+
+def test_package_has_no_interrupt_swallowing_handlers():
+    violations = lint.lint_package(_PKG)
+    assert violations == [], (
+        "handler(s) can swallow Ctrl-C/SystemExit — catch Exception at "
+        "supervision boundaries or re-raise:\n" + "\n".join(violations)
+    )
+
+
+def test_detects_swallowing_handlers():
+    src = (
+        "try:\n    x()\nexcept:\n    pass\n"
+        "try:\n    y()\nexcept BaseException:\n    log()\n"
+        "try:\n    z()\nexcept KeyboardInterrupt:\n    retry()\n"
+    )
+    hits = lint.find_violations(src, "<test>")
+    assert [h[0] for h in hits] == [3, 7, 11]
+
+
+def test_reraise_is_allowed():
+    src = (
+        "try:\n    x()\nexcept BaseException:\n    cleanup()\n    raise\n"
+        "try:\n    y()\nexcept KeyboardInterrupt:\n"
+        "    if cond():\n        handle()\n    else:\n        raise\n"
+    )
+    assert lint.find_violations(src, "<test>") == []
+
+
+def test_except_exception_is_never_flagged():
+    src = "try:\n    x()\nexcept Exception:\n    pass\n"
+    assert lint.find_violations(src, "<test>") == []
+
+
+def test_tuple_catch_containing_baseexception_is_flagged():
+    src = "try:\n    x()\nexcept (ValueError, SystemExit):\n    pass\n"
+    hits = lint.find_violations(src, "<test>")
+    assert len(hits) == 1 and "SystemExit" in hits[0][1]

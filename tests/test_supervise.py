@@ -13,8 +13,13 @@ from stark_tpu.checkpoint import save_checkpoint
 from stark_tpu.model import Model, ParamSpec
 from stark_tpu.supervise import (
     ChainHealthError,
+    RestartBudget,
+    agree_resume,
+    backoff_delay,
     check_finite_state,
+    checkpoint_health,
     checkpoint_is_healthy,
+    classify_fault,
     supervised_sample,
 )
 
@@ -238,6 +243,199 @@ def test_supervised_gives_up_after_max_restarts(tmp_path, monkeypatch):
         )
     lines = [json.loads(l) for l in open(os.path.join(wd, "metrics.jsonl"))]
     assert sum(1 for l in lines if l["event"] == "restart") == 3
+
+
+def test_classify_fault_taxonomy():
+    from stark_tpu.faults import InjectedFault, InjectedPreemption
+    from stark_tpu.watchdog import StallError
+
+    assert classify_fault(ChainHealthError("nan")) == "poisoned_state"
+    assert classify_fault(StallError("hung")) == "stall"
+    assert classify_fault(RuntimeError("xla")) == "transient"
+    assert classify_fault(InjectedFault("site")) == "transient"
+    assert classify_fault(InjectedPreemption("site")) == "transient"
+
+
+def test_checkpoint_health_reports_reason(tmp_path):
+    p = str(tmp_path / "c.npz")
+    save_checkpoint(p, {"z": np.zeros((2, 2)), "pe": np.zeros(2)}, {})
+    assert checkpoint_health(p) == (True, None)
+    save_checkpoint(p, {"z": np.full((2, 2), np.nan), "pe": np.zeros(2)}, {})
+    ok, reason = checkpoint_health(p)
+    assert not ok and reason.startswith("poisoned_state:") and "'z'" in reason
+    with open(p, "wb") as f:
+        f.write(b"garbage")
+    ok, reason = checkpoint_health(p)
+    assert not ok and reason.startswith("corrupt_checkpoint:")
+
+
+def test_restart_budget_lifetime_and_window():
+    # window=None: the historical lifetime counter
+    b = RestartBudget(2)
+    for t in (0.0, 1.0):
+        b.record_failure(t)
+        assert not b.exhausted(t)
+    b.record_failure(2.0)
+    assert b.exhausted(2.0)
+    # sliding window: three failures in 10s trip a max of 2 ...
+    w = RestartBudget(2, window_s=10.0)
+    for t in (0.0, 1.0, 2.0):
+        w.record_failure(t)
+    assert w.exhausted(2.0)
+    # ... but the same three spread over hours never do (rate, not count)
+    w2 = RestartBudget(2, window_s=10.0)
+    for t in (0.0, 3600.0, 7200.0):
+        w2.record_failure(t)
+        assert not w2.exhausted(t)
+
+
+def test_backoff_delay_policy():
+    # base 0 (the default) keeps restarts immediate
+    assert backoff_delay("transient", 1, base_s=0.0) == 0.0
+    # poisoned state restarts immediately regardless of base
+    assert backoff_delay("poisoned_state", 3, base_s=5.0) == 0.0
+    # exponential growth with deterministic jitter in [0.5, 1.5)
+    d1 = backoff_delay("transient", 1, base_s=1.0, seed=7)
+    d2 = backoff_delay("transient", 2, base_s=1.0, seed=7)
+    assert d1 == backoff_delay("transient", 1, base_s=1.0, seed=7)
+    assert 0.5 <= d1 < 1.5 and 1.0 <= d2 < 3.0
+    # the cap bounds the DELIVERED delay, jitter included
+    for a in range(1, 40):
+        assert backoff_delay("transient", a, base_s=1.0, cap_s=4.0, seed=a) <= 4.0
+
+
+def test_supervised_restart_window_bounds_rate(tmp_path, monkeypatch):
+    """Fast repeated failures overflow the window and raise; the restart
+    records carry the fault class and backoff."""
+    wd = str(tmp_path / "run")
+
+    def always_fails(model, data=None, **kw):
+        raise RuntimeError("crash loop")
+
+    monkeypatch.setattr(stark_tpu.runner, "sample_until_converged", always_fails)
+    with pytest.raises(RuntimeError, match="crash loop"):
+        supervised_sample(
+            StdNormal2(), workdir=wd, seed=0, max_restarts=1,
+            restart_window_s=3600.0, backoff_base_s=0.01, **SAMPLE_KW
+        )
+    lines = [json.loads(l) for l in open(os.path.join(wd, "metrics.jsonl"))]
+    rs = [l for l in lines if l["event"] == "restart"]
+    assert len(rs) == 2  # failure 2 overflows max_restarts=1 in-window
+    assert all(r["fault"] == "transient" for r in rs)
+    assert rs[0]["backoff_s"] > 0  # jittered exponential before retry
+    assert rs[-1]["backoff_s"] == 0  # no pointless sleep before giving up
+
+
+def test_supervised_quarantine_reason_logged_and_traced(tmp_path, monkeypatch):
+    """A discarded checkpoint must say WHY — in the log and as a
+    chain_health quarantine trace event — never silently."""
+    from stark_tpu.telemetry import RunTrace, read_trace
+
+    wd = str(tmp_path / "run")
+    os.makedirs(wd)
+    ckpt = os.path.join(wd, "chain.ckpt.npz")
+    save_checkpoint(
+        ckpt, {"z": np.full((2, 2), np.nan), "pe": np.zeros(2)}, {}
+    )
+    monkeypatch.setattr(
+        stark_tpu.runner, "sample_until_converged",
+        lambda model, data=None, **kw: "sentinel",
+    )
+    tpath = str(tmp_path / "trace.jsonl")
+    with RunTrace(tpath) as trace:
+        out = supervised_sample(
+            StdNormal2(), workdir=wd, seed=0, trace=trace, **SAMPLE_KW
+        )
+    assert out == "sentinel"
+    assert os.path.exists(ckpt + ".bad")
+    quar = [
+        e for e in read_trace(tpath)
+        if e["event"] == "chain_health" and e.get("status") == "quarantine"
+    ]
+    assert len(quar) == 1 and quar[0]["reason"].startswith("poisoned_state:")
+
+
+class _FakeAllgather:
+    """Stand-in for multihost_utils.process_allgather: stacks this rank's
+    report with a scripted peer report."""
+
+    def __init__(self, peer):
+        self.peer = peer
+        self.saw = None
+
+    def __call__(self, x):
+        self.saw = tuple(int(v) for v in np.asarray(x))
+        return np.stack([np.asarray(x), np.asarray(self.peer)])
+
+
+def _fake_multiprocess(monkeypatch, peer):
+    import jax
+    from jax.experimental import multihost_utils
+
+    fake = _FakeAllgather(peer)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake)
+    return fake
+
+
+def test_agree_resume_single_process_passthrough(tmp_path):
+    p = str(tmp_path / "c.npz")
+    assert agree_resume(p, quarantine=lambda _: 1 / 0) == p
+    assert agree_resume(None, quarantine=lambda _: 1 / 0) is None
+
+
+def test_agree_resume_all_ranks_agree(tmp_path, monkeypatch):
+    ckpt = str(tmp_path / "c.npz")
+    save_checkpoint(ckpt, {"z": np.zeros(2)}, {"blocks_done": 3})
+    fake = _fake_multiprocess(monkeypatch, peer=(1, 3))
+    quarantined = []
+    assert agree_resume(ckpt, quarantine=quarantined.append) == ckpt
+    assert fake.saw == (1, 3)  # sample phase, 3 blocks
+    assert quarantined == []
+
+
+def test_agree_resume_skew_quarantines(tmp_path, monkeypatch):
+    """A one-block skew (peer checkpointed block 2, we hold block 3) must
+    cold-start BOTH ranks and quarantine our healthy-but-unusable file."""
+    ckpt = str(tmp_path / "c.npz")
+    save_checkpoint(ckpt, {"z": np.zeros(2)}, {"blocks_done": 3})
+    _fake_multiprocess(monkeypatch, peer=(1, 2))
+    quarantined = []
+    assert agree_resume(ckpt, quarantine=quarantined.append) is None
+    assert quarantined == [ckpt]
+
+
+def test_agree_resume_peer_cold_quarantines(tmp_path, monkeypatch):
+    ckpt = str(tmp_path / "c.npz")
+    save_checkpoint(ckpt, {"z": np.zeros(2)}, {"blocks_done": 1})
+    _fake_multiprocess(monkeypatch, peer=(-1, -1))
+    quarantined = []
+    assert agree_resume(ckpt, quarantine=quarantined.append) is None
+    assert quarantined == [ckpt]
+
+
+def test_agree_resume_self_cold_no_quarantine(monkeypatch):
+    """A rank with nothing to resume reports cold and cold-starts without
+    quarantining anything (there is no file to protect)."""
+    fake = _fake_multiprocess(monkeypatch, peer=(1, 2))
+    quarantined = []
+    assert agree_resume(None, quarantine=quarantined.append) is None
+    assert fake.saw == (-1, -1)
+    assert quarantined == []
+
+
+def test_agree_resume_warmup_phase_distinct(tmp_path, monkeypatch):
+    """A warmup-2 checkpoint must never falsely agree with a blocks-2 one:
+    the phase rides in the report."""
+    ckpt = str(tmp_path / "c.npz")
+    save_checkpoint(
+        ckpt, {"z": np.zeros(2)}, {"phase": "warmup", "warm_done": 2}
+    )
+    fake = _fake_multiprocess(monkeypatch, peer=(1, 2))
+    quarantined = []
+    assert agree_resume(ckpt, quarantine=quarantined.append) is None
+    assert fake.saw == (0, 2)  # warmup phase tag
+    assert quarantined == [ckpt]
 
 
 def test_ranks_agree_rule():

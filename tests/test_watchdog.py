@@ -1,0 +1,107 @@
+"""Watchdog deadman (stark_tpu/watchdog.py): beats hold it off, silence
+fires it, and the interrupt handshake never eats a genuine Ctrl-C."""
+
+import threading
+import time
+
+import pytest
+
+from stark_tpu import telemetry
+from stark_tpu.watchdog import StallError, Watchdog, watched
+
+
+def test_beats_prevent_firing():
+    fired = threading.Event()
+    wd = Watchdog(0.3, poll_s=0.05, on_stall=fired.set)
+    wd.start()
+    try:
+        for _ in range(10):
+            time.sleep(0.05)
+            wd.beat()
+        assert not fired.is_set()
+        assert not wd.consume_stall()
+    finally:
+        wd.stop()
+
+
+def test_silence_fires_and_sets_stall_flag():
+    fired = threading.Event()
+    wd = Watchdog(0.15, poll_s=0.05, on_stall=fired.set)
+    wd.start()
+    try:
+        assert fired.wait(2.0), "watchdog never fired on silence"
+        assert wd.consume_stall()
+        assert not wd.consume_stall()  # flag is consumed, not sticky
+        assert wd.stall_count >= 1
+    finally:
+        wd.stop()
+
+
+def test_progress_listener_feeds_the_watchdog():
+    """telemetry.notify_progress — the beat every runner block emits —
+    must reach a started watchdog with no extra wiring."""
+    fired = threading.Event()
+    wd = Watchdog(0.3, poll_s=0.05, on_stall=fired.set)
+    wd.start()
+    try:
+        for _ in range(10):
+            time.sleep(0.05)
+            telemetry.notify_progress()
+        assert not fired.is_set()
+    finally:
+        wd.stop()
+    # after stop() the listener is unregistered
+    assert wd.beat not in telemetry._PROGRESS_LISTENERS
+
+
+def test_default_on_stall_interrupts_main_thread():
+    """The default abort is interrupt_main: a stalled main thread sees
+    KeyboardInterrupt, which supervision converts via consume_stall."""
+    wd = Watchdog(0.2, poll_s=0.05)
+    wd.start()
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            time.sleep(5.0)  # the "stall": no beats flow
+        assert wd.consume_stall()
+    finally:
+        wd.stop()
+
+
+def test_stall_on_worker_thread_interrupts_that_thread():
+    """A watchdog started from a worker thread must abort THAT thread —
+    never shoot the process main loop with a SIGINT it can't handle."""
+    out = {}
+
+    def worker():
+        wd = Watchdog(0.2, poll_s=0.05)
+        wd.start()
+        try:
+            deadline = time.monotonic() + 8.0
+            while time.monotonic() < deadline:  # Python-level stall
+                pass
+            out["result"] = "never interrupted"
+        except KeyboardInterrupt:
+            out["result"] = "interrupted"
+            out["stalled"] = wd.consume_stall()
+        finally:
+            wd.stop()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=12.0)
+    assert out.get("result") == "interrupted"
+    assert out.get("stalled") is True
+
+
+def test_watched_contextmanager_none_deadline():
+    with watched(None) as wd:
+        assert wd is None
+    with watched(0.5, poll_s=0.05) as wd:
+        assert isinstance(wd, Watchdog)
+        wd.beat()
+    assert wd.beat not in telemetry._PROGRESS_LISTENERS
+
+
+def test_bad_deadline_rejected():
+    with pytest.raises(ValueError):
+        Watchdog(0.0)

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Repo lint: supervision boundaries must never eat Ctrl-C or SystemExit.
+
+A retry loop that catches ``BaseException`` (or uses a bare ``except:``)
+swallows KeyboardInterrupt and SystemExit — the operator's Ctrl-C becomes
+"restart attempt N+1" and the run is unkillable, which is exactly the
+failure mode the watchdog/supervision hardening exists to avoid.  The rule
+for ``stark_tpu/``:
+
+  * bare ``except:``, ``except BaseException``, and explicit
+    ``except KeyboardInterrupt`` / ``except SystemExit`` handlers are
+    allowed ONLY if the handler re-raises (a bare ``raise`` anywhere in
+    its body) — cleanup-and-propagate is fine, catch-and-continue is not.
+  * ``except Exception`` is the correct supervision-boundary catch and is
+    never flagged.
+
+AST-based, like its sibling ``tools/lint_no_print.py``; run directly or
+via ``tests/test_lint_supervision.py`` (tier-1).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+#: exception names whose explicit capture requires a re-raise
+_GUARDED = frozenset({"BaseException", "KeyboardInterrupt", "SystemExit"})
+
+
+def _names(node) -> List[str]:
+    """Exception class names an ExceptHandler's type expression mentions."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    if isinstance(node, ast.Tuple):
+        out: List[str] = []
+        for elt in node.elts:
+            out.extend(_names(elt))
+        return out
+    return []
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """True iff the handler body contains a bare ``raise`` (re-raise)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise) and node.exc is None:
+            return True
+    return False
+
+
+def find_violations(source: str, filename: str) -> List[Tuple[int, str]]:
+    """(lineno, description) for every swallowing guarded handler."""
+    tree = ast.parse(source, filename=filename)
+    hits: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            what = "bare except:"
+        else:
+            guarded = sorted(set(_names(node.type)) & _GUARDED)
+            if not guarded:
+                continue
+            what = f"except {', '.join(guarded)}"
+        if not _reraises(node):
+            hits.append((node.lineno, f"{what} without re-raise"))
+    return hits
+
+
+def lint_package(pkg_dir: str) -> List[str]:
+    violations: List[str] = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            with open(path) as f:
+                source = f.read()
+            for lineno, desc in find_violations(source, path):
+                violations.append(f"{path}:{lineno}: {desc}")
+    return violations
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = lint_package(os.path.join(repo, "stark_tpu"))
+    for v in violations:
+        print(v, file=sys.stderr)
+    if violations:
+        print(
+            f"{len(violations)} handler(s) can swallow Ctrl-C/SystemExit — "
+            "catch Exception at supervision boundaries, or re-raise "
+            "(see tools/lint_supervision.py docstring)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
