@@ -205,13 +205,16 @@ def load_or_measure_cpu_denominator(d, groups, depth, n_cpu, num_warmup,
 
 
 def _print_phase_breakdown_from_trace(trace_path):
-    """Phase breakdown from the telemetry trace file; True on success.
+    """Phase breakdown from the telemetry trace file; returns the trace
+    summary dict on success (None on any failure — callers fall back to
+    the metrics JSONL and carry no overlap fields).
 
     The trace is the structured replacement for scraping ``[bench] chees
     phases`` lines out of stdout: phase durations (compile / warmup /
-    sample blocks / checkpoint I/O), restarts, and last-seen chain health
-    all come from one parseable artifact
-    (``python tools/trace_report.py <trace>`` renders the full table).
+    sample blocks / checkpoint I/O), restarts, last-seen chain health,
+    and the block-pipeline overlap (device-idle fraction) all come from
+    one parseable artifact (``python tools/trace_report.py <trace>``
+    renders the full table).
     """
     try:
         from stark_tpu.telemetry import read_trace, summarize_trace
@@ -219,11 +222,22 @@ def _print_phase_breakdown_from_trace(trace_path):
         s = summarize_trace(read_trace(trace_path, strict=False))
         phases = s["phases"]
         if not phases:
-            return False
+            return None
         parts = [
             f"{name} {p['total_s']:.1f}s ({p['count']})"
             for name, p in phases.items()
         ]
+        # block-pipeline overlap: host work hidden behind device compute
+        # and the device-idle fraction — the observable for the async
+        # sample loop (runner.py); t_diag_s no longer adds serially to
+        # the block wall when the fraction is ~0
+        ov = s.get("overlap") or {}
+        if ov.get("device_idle_frac") is not None:
+            parts.append(
+                f"host hidden {ov.get('t_host_hidden_s', 0.0):.1f}s, "
+                f"device idle {ov.get('device_idle_s', 0.0):.1f}s "
+                f"({100.0 * ov['device_idle_frac']:.1f}%)"
+            )
         h = s["health"]
         health = ", ".join(
             f"{k}={h[k]:.3g}" if isinstance(h[k], float) else f"{k}={h[k]}"
@@ -238,9 +252,9 @@ def _print_phase_breakdown_from_trace(trace_path):
             + f"  [{trace_path}]",
             file=sys.stderr,
         )
-        return True
+        return s
     except Exception:  # noqa: BLE001 — diagnostics only
-        return False
+        return None
 
 
 def _print_phase_breakdown_from_metrics(metrics_path):
@@ -476,6 +490,7 @@ def main():
     # autodiff NUTS leg at this scale would not)
     try_chees = os.environ.get("BENCH_CHEES", "auto")
     chees_converged = False
+    chees_overlap = {}  # block-pipeline overlap from the supervised trace
     if try_chees == "1" or (
         try_chees == "auto" and (platform != "cpu" or fell_back)
     ):
@@ -662,11 +677,16 @@ def main():
             # artifact), so the on-chip wall decomposes (compile+MAP vs
             # warmup vs draw blocks vs checkpoint I/O) instead of being
             # one opaque number.  Falls back to the runner's metrics
-            # JSONL for traces lost to e.g. a full disk.
-            if not _print_phase_breakdown_from_trace(trace_path):
+            # JSONL for traces lost to e.g. a full disk.  The summary
+            # also carries the block-pipeline overlap (device-idle
+            # fraction) into the final artifact line below.
+            trace_summary = _print_phase_breakdown_from_trace(trace_path)
+            if trace_summary is None:
                 _print_phase_breakdown_from_metrics(
                     os.path.join(workdir, "metrics.jsonl")
                 )
+            else:
+                chees_overlap = trace_summary.get("overlap") or {}
         except Exception as e:  # noqa: BLE001 — after supervised retries
             print(f"[bench] chees path failed after retries: {e!r}",
                   file=sys.stderr)
@@ -819,6 +839,19 @@ def main():
                 "accelerator_fallback": fell_back,
                 "time_budget_s": time_budget or None,
                 "budget_exhausted": budget_hit,
+                # async block pipeline (runner.py): fraction of the draw-
+                # block wall the device sat idle waiting on host work —
+                # ~0 means t_diag_s is fully hidden behind device compute
+                **(
+                    {
+                        "device_idle_frac": chees_overlap["device_idle_frac"],
+                        "host_hidden_s": chees_overlap.get(
+                            "t_host_hidden_s", 0.0
+                        ),
+                    }
+                    if chees_overlap.get("device_idle_frac") is not None
+                    else {}
+                ),
                 **(
                     {"extra_evidence": extra_evidence}
                     if extra_evidence else {}

@@ -28,6 +28,10 @@ Scenario matrix (`SCENARIOS`):
                          recovers it (not degraded)
   shard_death_degraded   a shard dies past its restart budget → dropped,
                          combine reweights over survivors, degraded=True
+  inflight_block_replay  crashes while the async block pipeline has a
+                         block in flight + with orphaned DrawStore rows:
+                         resume truncates the orphans and the replay is
+                         bit-identical to an uninjected run
   clean_identity         failpoints disarmed: two runs are bit-identical
                          (the harness is a no-op when off)
 
@@ -273,6 +277,61 @@ def shard_death_degraded(workdir: str) -> Dict[str, Any]:
     assert post.sample_stats["lost_shards"].tolist() == [1]
     assert np.isfinite(post.draws_flat).all(), "dead shard leaked into combine"
     return {"degraded": True, "lost_shards": [1]}
+
+
+@_scenario("inflight_block_replay")
+def inflight_block_replay(workdir: str) -> Dict[str, Any]:
+    """Crashes around the async block pipeline's in-flight window.
+
+    Two injected faults: (1) ``runner.block.post`` crashes right after
+    block 2 is fully accounted (metrics + checkpoint durable) — with the
+    pipeline on, block 3 is IN FLIGHT on the device at that moment and
+    must be discarded and replayed; (2) on the retry, ``ckpt.before_rename``
+    crashes block 3's checkpoint AFTER its draws were appended+flushed to
+    the DrawStore — the store then holds one more block than the durable
+    checkpoint accounts, and resume reconciliation (`truncate_draws`) must
+    drop the orphaned rows.  With ``reseed_on_restart=False`` the whole
+    story must be bit-identical to an uninjected run: any surviving orphan
+    row or skipped replay block would show up as a draw mismatch."""
+    from .drawstore import read_draws
+    from .supervise import supervised_sample
+
+    # fixed block budget (no convergence stop): the injected run and the
+    # clean reference must execute the same number of blocks
+    kw = dict(_SUP_KW, rhat_target=0.0, max_blocks=3, min_blocks=3,
+              reseed_on_restart=False)
+    ref = supervised_sample(
+        _StdNormal(), workdir=os.path.join(workdir, "clean"), seed=0, **kw
+    )
+    faults.reset()
+    # block.post hit 1 (block 1) skipped, hit 2 (block 2, block 3 in
+    # flight) crashes; before_rename hits 1-2 (blocks 1-2, attempt 1)
+    # skipped, hit 3 (block 3's checkpoint on attempt 2) crashes after the
+    # store flush — manufacturing the orphaned rows
+    faults.configure(
+        "runner.block.post=crash*1@1; ckpt.before_rename=crash*1@2"
+    )
+    res = supervised_sample(_StdNormal(), workdir=workdir, seed=0, **kw)
+    lines = _metrics(workdir)
+    rs = _restarts(lines)
+    assert len(rs) == 2 and all(r["fault"] == "transient" for r in rs), rs
+    assert len(faults.fired()) == 2, faults.fired()
+    # both retries resumed block 2's checkpoint: the first block record
+    # after each restart is the replayed block 3
+    first = _first_block_after_restart(lines)
+    assert first == 3, f"expected replay of block 3 (got block {first})"
+    # deterministic replay end-to-end: orphan rows dropped, in-flight
+    # block discarded and re-run — bit-identical draws and store
+    np.testing.assert_array_equal(res.draws_flat, ref.draws_flat)
+    draws, _, _ = read_draws(os.path.join(workdir, "draws.stkr"))
+    assert draws.shape[0] == res.num_samples, (
+        f"store holds {draws.shape[0]} rows for {res.num_samples} draws"
+    )
+    np.testing.assert_array_equal(
+        np.transpose(np.asarray(draws), (1, 0, 2)), res.draws_flat
+    )
+    return {"restarts": 2, "resumed_block": first,
+            "bit_identical": True}
 
 
 @_scenario("clean_identity")

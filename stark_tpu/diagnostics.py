@@ -180,6 +180,76 @@ class ChainSuffStats:
         )
 
 
+class DrawHistory:
+    """Full draw history in ONE growing preallocated host buffer.
+
+    The adaptive runner used to keep a Python list of per-block arrays and
+    ``np.concatenate`` them for every diagnostics pass — the worst-k ESS
+    subset alone re-copied the whole accumulated history every block
+    (O(blocks²) copy traffic).  This buffer appends each block exactly once
+    (amortized O(1) per element via capacity doubling) and serves:
+
+      * ``view()``  — a zero-copy (chains, n, d) window for full-history
+        passes (split-R-hat validation, final collection, checkpoints);
+      * ``take(cols)`` — ONE fancy-index copy of the selected components
+        (the per-block worst-k ESS subset), O(n·k) instead of a per-block
+        list concatenate + allocation.
+    """
+
+    def __init__(self, chains: int, ndim: int, dtype=None):
+        """``dtype=None`` adopts the first appended block's dtype (the
+        device draw dtype — float32 by default, float64 under x64)."""
+        self.chains = int(chains)
+        self.ndim = int(ndim)
+        self._buf = None if dtype is None else np.empty(
+            (self.chains, 0, self.ndim), dtype
+        )
+        self._n = 0
+
+    @property
+    def rows(self) -> int:
+        """Draws accumulated per chain."""
+        return self._n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def append(self, block: np.ndarray) -> None:
+        """Append a (chains, block_draws, d) block (one write; the buffer
+        doubles when full, so growth never re-copies per block)."""
+        block = np.asarray(block)
+        if (
+            block.ndim != 3
+            or block.shape[0] != self.chains
+            or block.shape[2] != self.ndim
+        ):
+            raise ValueError(
+                f"expected (chains={self.chains}, n, d={self.ndim}), "
+                f"got {block.shape}"
+            )
+        if self._buf is None:
+            self._buf = np.empty((self.chains, 0, self.ndim), block.dtype)
+        need = self._n + block.shape[1]
+        if need > self._buf.shape[1]:
+            cap = max(need, 2 * self._buf.shape[1], 64)
+            grown = np.empty((self.chains, cap, self.ndim), self._buf.dtype)
+            grown[:, : self._n] = self._buf[:, : self._n]
+            self._buf = grown
+        self._buf[:, self._n : need] = block
+        self._n = need
+
+    def view(self) -> np.ndarray:
+        """(chains, n, d) view of the accumulated draws — NO copy; valid
+        until the next ``append`` (growth may reallocate the buffer)."""
+        if self._buf is None:
+            return np.empty((self.chains, 0, self.ndim), np.float32)
+        return self._buf[:, : self._n]
+
+    def take(self, cols) -> np.ndarray:
+        """(chains, n, len(cols)) copy of the selected components."""
+        return self.view()[:, :, cols]
+
+
 def rank_normalize(x: np.ndarray) -> np.ndarray:
     """Pooled fractional ranks -> normal scores (Vehtari et al. 2021 eq. 14).
 

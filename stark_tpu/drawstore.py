@@ -48,20 +48,26 @@ class DrawStore:
         self.chains = chains
         self.dim = dim
 
-    def append(self, block: np.ndarray) -> None:
-        """block: strictly (chains, n_draws, dim) float32 — the layout the
-        samplers produce.  Stored draw-major (transposed here, host copy) so
-        on-disk reads concatenate along the draw axis."""
+    def append(self, block: np.ndarray, *, draw_major: bool = False) -> None:
+        """Append one block.  ``draw_major=False`` (default): block is
+        (chains, n_draws, dim) — the per-chain samplers' layout — and is
+        transposed (host copy) to the draw-major on-disk order.
+        ``draw_major=True``: block is already (n_draws, chains, dim) — the
+        ensemble samplers' device output — and is handed to the writer
+        as-is, skipping the transpose round-trip and its
+        ``ascontiguousarray`` copy entirely."""
         # failpoint: crash/slow-I/O in the draw-persistence path (the
         # async writer hides real latency; injection happens host-side,
         # before the handoff, so it is deterministic)
         fail_point("drawstore.append")
-        if block.ndim != 3 or block.shape[0] != self.chains or block.shape[2] != self.dim:
+        c_ax = 1 if draw_major else 0
+        if block.ndim != 3 or block.shape[c_ax] != self.chains or block.shape[2] != self.dim:
             raise ValueError(
-                f"expected (chains={self.chains}, n, dim={self.dim}),"
-                f" got {block.shape}"
+                f"expected (chains={self.chains}, n, dim={self.dim})"
+                f"{' draw-major' if draw_major else ''}, got {block.shape}"
             )
-        block = np.transpose(block, (1, 0, 2))
+        if not draw_major:
+            block = np.transpose(block, (1, 0, 2))
         block = np.ascontiguousarray(block, np.float32)
         rc = self._lib.ds_append(
             self._handle,

@@ -15,6 +15,7 @@ import os
 import socket
 import subprocess
 import sys
+from typing import Optional
 
 #: module logger (repo lint: no bare print() in library code — see
 #: tools/lint_no_print.py).  Diagnostics here are warnings: with no
@@ -41,6 +42,54 @@ def _relay_listening(host: str, connect_timeout: float = 2.0) -> bool:
         except ConnectionRefusedError:
             continue
     return False
+
+
+#: env knob for `enable_compilation_cache`: a path overrides the default
+#: cache location, "0"/"" disables enabling it from library code (an
+#: already-configured JAX_COMPILATION_CACHE_DIR always wins)
+CACHE_ENV = "STARK_COMPILE_CACHE"
+
+
+def enable_compilation_cache(cache_dir: str) -> Optional[str]:
+    """Point jax's persistent compilation cache at ``cache_dir`` (created
+    if missing); returns the directory in effect, or None when disabled.
+
+    Supervised restarts re-jit every compiled segment from scratch (each
+    attempt builds a fresh backend), and repeated bench legs re-pay the
+    whole init+compile phase (~56 s measured on the flagship) — the
+    persistent cache turns both into disk hits.  Resolution order:
+
+      * ``JAX_COMPILATION_CACHE_DIR`` already set in the environment (the
+        bench entry point sets a repo-level cache) → respected, untouched;
+      * ``STARK_COMPILE_CACHE=0`` (or empty) → disabled, no-op;
+      * ``STARK_COMPILE_CACHE=<path>`` → that path wins;
+      * otherwise → ``cache_dir`` (callers key it under their workdir so
+        concurrent runs on a shared filesystem don't contend on one dir).
+
+    Best-effort: a jax too old for the config knob, or an unwritable
+    directory, degrades to no caching — never to a failed run.
+    """
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return os.environ["JAX_COMPILATION_CACHE_DIR"]
+    override = os.environ.get(CACHE_ENV)
+    if override is not None:
+        if override in ("", "0"):
+            return None
+        cache_dir = override
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception as e:  # noqa: BLE001 — caching is an optimization
+        log.warning("compilation cache unavailable (%s): %s",
+                    type(e).__name__, e)
+        return None
+    # jax's default min-compile-time threshold (~1 s) is kept: the
+    # restart win comes from the big warmup-segment/draw-block programs,
+    # and serializing every sub-second helper compile would tax fresh
+    # workdirs (each supervised run starts one) for no later hit
+    return cache_dir
 
 
 def probe_accelerator(timeout: int = None) -> bool:

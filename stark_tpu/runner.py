@@ -8,6 +8,21 @@ record, and optionally checkpoints the full chain state.  Stop when
 converged (or budget exhausted) — the convergence-based stopping the
 reference exposes via its R-hat/ESS diagnostics (SURVEY.md §2 layer C).
 
+The block loop is a SOFTWARE PIPELINE by default: block k+1 is enqueued on
+the device (jax dispatch is asynchronous) before the host materializes
+block k's outputs, so device→host transfer, streaming diagnostics, draw
+persistence, and checkpointing for block k all run while the device
+computes block k+1 — the serial loop left the device idle for every
+block's ``t_diag_s``.  PRNG keys are split on the host in dispatch order,
+so the pipelined and serial (``STARK_SYNC_BLOCKS=1`` / ``sync_blocks=``)
+loops produce bit-identical draws, metrics, and checkpoints; block k's
+health check still gates block k's checkpoint, and a crash with block k+1
+in flight discards it — resume reconciliation (`drawstore.truncate_draws`)
+already accounts for the at-most-one-block skew between the draw store and
+the checkpoint.  The trace's ``sample_block`` events carry the overlap
+accounting (``t_wait_s`` / ``t_host_hidden_s`` / ``device_idle_s``) that
+`tools/trace_report.py` and bench.py surface as a device-idle fraction.
+
 Auxiliary subsystems wired here (SURVEY.md §6):
   * metrics JSONL   — one line per block (max_rhat, min_ess, wall, divs)
   * checkpoint      — `checkpoint.save_checkpoint` every block; resume via
@@ -158,6 +173,7 @@ def _sample_until_converged(
     adapt_export_path: Optional[str] = None,
     adapt_touchup_frac: float = 0.2,
     trace: Optional[Any] = None,
+    sync_blocks: Optional[bool] = None,
     **cfg_kwargs,
 ) -> AdaptiveResult:
     """Run chains until R-hat < rhat_target AND min-ESS > ess_target.
@@ -215,6 +231,17 @@ def _sample_until_converged(
     checkpoint durations.  Distinct from ``metrics_path`` (the runner's
     convergence trail): the trace is the cross-run artifact
     `tools/trace_report.py` and `bench.py` consume.
+
+    ``sync_blocks`` (default: the ``STARK_SYNC_BLOCKS=1`` env escape
+    hatch, else False on single-process runs; multi-process meshes
+    always run serial — their collect is an allgather whose dispatch
+    would be stream-ordered behind the prefetched block): True disables
+    the asynchronous block pipeline and runs the historical
+    strictly-serial loop — one block dispatched, awaited, and
+    host-processed at a time.  Draws, metrics history, and
+    checkpoints are bit-identical in both modes (only timing fields and
+    the overlap trace fields differ); the serial mode exists for
+    debugging and as the equivalence oracle in tests.
     """
     cfg = SamplerConfig(**cfg_kwargs)
     if backend is None:
@@ -769,102 +796,174 @@ def _sample_until_converged(
         )
 
     suff = diagnostics.ChainSuffStats(chains, fm.ndim)
+    # full draw history in ONE growing preallocated host buffer: each block
+    # is written exactly once, the per-block worst-k ESS subset is a single
+    # fancy index, and full-history passes (stop validation, no-store
+    # checkpoints, final collection) read a zero-copy view — the old
+    # per-block ``np.concatenate`` over the block list was O(blocks²)
+    # copy traffic in the hot loop
+    draws_hist = diagnostics.DrawHistory(chains, fm.ndim)
     for blk in draw_blocks:
         suff.update(blk)  # resume: rebuild streaming stats from stored draws
+        draws_hist.append(blk)
+    del draw_blocks
     next_full_check = 0  # earliest block allowed to run full validation
+    # chees Halton stream position: advanced at DISPATCH time (the
+    # pipeline enqueues ahead of the host-side suff.count), anchored at
+    # the resumed draw count so every mode walks the same sequence
+    halton_start = int(suff.count[0])
+
+    if sync_blocks is None:
+        # multi-process meshes run serial: collect is a process_allgather
+        # (distributed.gather_draws) — a dispatched computation that is
+        # stream-ordered AFTER an already-enqueued block k+1, so a
+        # prefetch there wouldn't overlap anything; it would delay block
+        # k's health check and checkpoint durability by a whole block
+        sync_blocks = (
+            os.environ.get("STARK_SYNC_BLOCKS", "") == "1"
+            or jax.process_count() > 1
+        )
+    # overlap accounting across blocks: host-side seconds of the previous
+    # cycle (diagnostics + persistence + checkpoint) and the running
+    # device-seconds-per-block estimate (exact whenever the host waited)
+    pipe = {"t_host_prev": 0.0, "dev_est": None}
 
     draw_store = None
     converged = False
-    cat_draws = None  # (re)built per block; None when stale or never built
     try:
         if draw_store_path:
             from .drawstore import DrawStore
 
             draw_store = DrawStore(draw_store_path, chains, fm.ndim)
 
-        def advance_block(key_block):
-            """One draw block; returns (zs (chains, block, d), accept,
-            divergent, grad_evals) and refreshes state/step_size/inv_mass."""
-            nonlocal state, step_size, inv_mass
+        def dispatch_block(key_block, key_snap):
+            """ENQUEUE one draw block on the device without waiting, and
+            refresh the carried device state so the next dispatch chains
+            off it.  Returns the pending-block record `process_block`
+            materializes later: the ``state``/``step_size``/``inv_mass``
+            (and chees adaptation) refs inside it are what block k's
+            health check gates and block k's checkpoint persists, and
+            ``key`` is the host RNG key as of THIS split — stored in the
+            checkpoint regardless of how far ahead the pipeline has
+            already split for later blocks."""
+            nonlocal state, step_size, inv_mass, halton_start
             if is_chees:
                 nonlocal run_carry
                 # Halton jitter continues the global sampling sequence
-                # (draws already taken = suff.count), so a resumed or
-                # blocked run walks the SAME low-discrepancy stream
+                # (draws already dispatched = halton_start), so a resumed,
+                # blocked, or pipelined run walks the SAME stream
                 us = jnp.asarray(
-                    2.0 * halton(block_size, start=int(suff.count[0])),
-                    jnp.float32,
+                    2.0 * halton(block_size, start=halton_start), jnp.float32
                 )
+                halton_start += block_size
                 bkeys = jax.random.split(key_block, block_size)
-                run_carry, (zs, accept, divergent, n_leap) = (
-                    jax.block_until_ready(
-                        chees_samp_j(run_carry, bkeys, us, *extra)
-                    )
+                run_carry, (zs, accept, divergent, n_leap) = chees_samp_j(
+                    run_carry, bkeys, us, *extra
                 )
-                state = run_carry.states
+                # failpoint: NaN-poison the carried state — injected where
+                # a real numerical fault would surface (health_check=True
+                # catches it before block k's checkpoint; with the check
+                # off it lands on disk and exercises the quarantine path)
+                st = faults.poison("runner.carried_nan", run_carry.states)
+                state = st
                 step_size = jnp.exp(run_carry.log_eps)
                 inv_mass = run_carry.inv_mass
+                return {
+                    "key": key_snap,
+                    "state": st,
+                    "step_size": step_size,
+                    "inv_mass": inv_mass,
+                    "log_eps": run_carry.log_eps,
+                    "log_T": run_carry.log_T,
+                    "outs": {"zs": zs, "accept": accept,
+                             "divergent": divergent, "n_leap": n_leap},
+                }
+            block_keys = ap.put_chains(jax.random.split(key_block, chains))
+            out = v_block(block_keys, state, step_size, inv_mass, data)
+            new_state, zs, accept, divergent, _energy, ngrad = out
+            # per-chain kernels CARRY the (possibly poisoned) state into
+            # the next dispatch — same rebinding as the serial loop
+            new_state = faults.poison("runner.carried_nan", new_state)
+            state = new_state
+            return {
+                "key": key_snap,
+                "state": new_state,
+                "step_size": step_size,
+                "inv_mass": inv_mass,
+                "outs": {"zs": zs, "accept": accept,
+                         "divergent": divergent, "ngrad": ngrad},
+            }
+
+        def process_block(pend, next_in_flight):
+            """Host side of ONE finished block: materialize its outputs
+            (blocks only until the DEVICE finishes block k — block k+1 may
+            already be running), health-gate, update diagnostics, emit
+            metrics/trace, checkpoint.  Returns True when the run stops
+            (converged or over budget); an in-flight speculative block is
+            then discarded by the caller."""
+            nonlocal blocks_done, total_div, converged, next_full_check
+            nonlocal budget_exhausted
+            # failpoint: crash/preempt/sleep/stall before the host consumes
+            # a completed block — @skip counts hits, so ``stall(600)*1@1``
+            # stalls exactly once, at block 2 of the first attempt.  With
+            # the pipeline on, block k+1 may already be in flight here; a
+            # crash discards it and the supervisor replays from block
+            # k-1's checkpoint.
+            faults.fail_point("runner.block.pre")
+            t_blk = time.perf_counter()
+            outs = pend["outs"]
+            if is_chees:
                 # chain-sharded outputs cross to host via collect (an
                 # allgather on multi-process meshes); n_leap is the SHARED
                 # per-transition trajectory length (replicated), and the
                 # ensemble total is chains x that (chees.py convention)
-                zs, accept, divergent = ap.collect((zs, accept, divergent))
-                return (
-                    np.asarray(zs).transpose(1, 0, 2), accept, divergent,
-                    int(np.sum(np.asarray(n_leap))) * chains,
+                zs_dm, accept, divergent = ap.collect(
+                    (outs["zs"], outs["accept"], outs["divergent"])
                 )
-            block_keys = ap.put_chains(jax.random.split(key_block, chains))
-            out = jax.block_until_ready(
-                v_block(block_keys, state, step_size, inv_mass, data)
-            )
-            state, zs, accept, divergent, _energy, ngrad = out
-            zs, accept, divergent, ngrad = ap.collect(
-                (zs, accept, divergent, ngrad)
-            )
-            return np.asarray(zs), accept, divergent, int(np.sum(ngrad))
-
-        while blocks_done < max_blocks:
-            # failpoint: crash/preempt/sleep/stall before dispatching a
-            # block — @skip counts hits, so ``stall(600)*1@1`` stalls
-            # exactly once, at block 2 of the first attempt
-            faults.fail_point("runner.block.pre")
-            key, key_block = jax.random.split(key)
-            t_blk = time.perf_counter()
-            if profile_dir and blocks_done == 0:
-                with jax.profiler.trace(profile_dir):
-                    zs, accept, divergent, blk_grads = advance_block(key_block)
+                # the device block is draw-major (block, chains, d): keep
+                # it for the draw store and give host diagnostics a free
+                # transposed VIEW — no transpose copies on this path
+                zs_dm = np.asarray(zs_dm)
+                zs = zs_dm.transpose(1, 0, 2)
+                blk_grads = int(np.sum(np.asarray(outs["n_leap"]))) * chains
             else:
-                zs, accept, divergent, blk_grads = advance_block(key_block)
-            t_dispatch = time.perf_counter() - t_blk
-            # failpoint: NaN-poison the carried state — injected BEFORE
-            # the health check, exactly where a real numerical fault would
-            # surface (health_check=True catches it pre-checkpoint; with
-            # the check off it lands on disk and exercises the quarantine
-            # path instead)
-            state = faults.poison("runner.carried_nan", state)
+                zs, accept, divergent, ngrad = ap.collect(
+                    (outs["zs"], outs["accept"], outs["divergent"],
+                     outs["ngrad"])
+                )
+                zs, zs_dm = np.asarray(zs), None
+                blk_grads = int(np.sum(np.asarray(ngrad)))
+            t_wait = time.perf_counter() - t_blk
             if health_check:
                 # poisoned state must never reach the checkpoint; the
                 # supervisor (supervise.supervised_sample) restarts from
-                # the last healthy one
+                # the last healthy one.  The refs in ``pend`` are block
+                # k's carried state, so block k's health still gates
+                # block k's checkpoint even with k+1 in flight.
                 from .supervise import check_finite_state
 
                 check_finite_state(
                     ap.collect({
-                        "z": state.z,
-                        "pe": state.potential_energy,
-                        "grad": state.grad,
-                        "step_size": step_size,
-                        "inv_mass": inv_mass,
+                        "z": pend["state"].z,
+                        "pe": pend["state"].potential_energy,
+                        "grad": pend["state"].grad,
+                        "step_size": pend["step_size"],
+                        "inv_mass": pend["inv_mass"],
                     })
                 )
             blocks_done += 1
-            draw_blocks.append(np.asarray(zs))  # (chains, block, d)
+            draws_hist.append(zs)
             if draw_store is not None:
-                draw_store.append(draw_blocks[-1])  # async; doesn't stall the loop
+                # async writer; doesn't stall the loop.  The chees block
+                # is already draw-major — append it without the
+                # transpose-back + ascontiguousarray copy
+                if zs_dm is not None:
+                    draw_store.append(zs_dm, draw_major=True)
+                else:
+                    draw_store.append(zs)
             total_div += int(np.sum(np.asarray(divergent)))
 
-            cat_draws = None  # full-history concatenation, built at most once per block
-            suff.update(draw_blocks[-1])
+            suff.update(zs)
             srhat = suff.rhat()
             # NaN streaming R-hat = frozen component; surface it explicitly
             # (nanmax would report a healthy-looking max while never
@@ -878,7 +977,9 @@ def _sample_until_converged(
             # NaN R-hat counts as worst — it flags a suspicious component
             k = min(diag_components, fm.ndim)
             worst = np.argsort(np.where(np.isnan(srhat), -np.inf, -srhat))[:k]
-            subset = np.concatenate([b[:, :, worst] for b in draw_blocks], axis=1)
+            # one fancy index off the preallocated history buffer — the old
+            # per-block concatenate over the block list was O(blocks²)
+            subset = draws_hist.take(worst)
             ess_vals = diagnostics.ess(subset)
             finite_ess = ess_vals[np.isfinite(ess_vals)]
             # NaN ESS values (stuck components) are excluded from the
@@ -898,11 +999,12 @@ def _sample_until_converged(
                 "num_stuck_components": n_stuck,
                 "num_divergent": total_div,
                 "mean_accept": float(np.mean(np.asarray(accept))),
-                # wall attribution (VERDICT r2 weak #6): dispatch+execute+
-                # fetch vs host-side diagnostics; grad_evals divides out to
-                # device cost per gradient
-                "t_dispatch_s": round(t_dispatch, 3),
-                "t_diag_s": round(time.perf_counter() - t_blk - t_dispatch, 3),
+                # wall attribution (VERDICT r2 weak #6): device-attributed
+                # time (enqueue + host wait for the device — near-zero wait
+                # when the pipeline hides host work) vs host diagnostics;
+                # grad_evals divides out to device cost per gradient
+                "t_dispatch_s": round(pend["t_enq"] + t_wait, 3),
+                "t_diag_s": round(time.perf_counter() - t_blk - t_wait, 3),
                 # Normalized to GRADIENT EVALUATIONS on all paths: the
                 # ChEES/HMC count is leapfrog steps (1 grad eval each),
                 # the NUTS count is tree leaves (1 grad eval each).
@@ -922,21 +1024,22 @@ def _sample_until_converged(
                 and blocks_done >= next_full_check
             ):
                 # candidate stop: validate with the full split-form pass
-                cat_draws = np.concatenate(draw_blocks, axis=1)
-                full_rhat = float(np.max(diagnostics.split_rhat(cat_draws)))
-                full_ess = float(np.min(diagnostics.ess(cat_draws)))
+                # (zero-copy view of the history buffer)
+                full_draws = draws_hist.view()
+                full_rhat = float(np.max(diagnostics.split_rhat(full_draws)))
+                full_ess = float(np.min(diagnostics.ess(full_draws)))
                 rec["full_max_rhat"] = full_rhat
                 rec["full_min_ess"] = full_ess
                 # recorded for the metrics trail, not gated: the robust
                 # rank form flags heavy-tail/scale disagreement the
                 # classic gate can miss
                 rec["full_max_rank_rhat"] = float(
-                    np.max(diagnostics.rank_rhat(cat_draws))
+                    np.max(diagnostics.rank_rhat(full_draws))
                 )
                 # the full pass is host diagnostics too — re-stamp so the
                 # attribution covers the expensive validation blocks
                 rec["t_diag_s"] = round(
-                    time.perf_counter() - t_blk - t_dispatch, 3
+                    time.perf_counter() - t_blk - t_wait, 3
                 )
                 rec["wall_s"] = time.perf_counter() - t_start
                 if full_rhat < rhat_target and full_ess > ess_target:
@@ -945,55 +1048,32 @@ def _sample_until_converged(
                     next_full_check = blocks_done + max(1, blocks_done // 4)
             history.append(rec)
             emit(rec)
-            if trace.enabled:
-                # one phase event (timing) + one health event (diagnostics)
-                # per block — the dur covers dispatch + host diagnostics
-                # (including the occasional full validation pass)
-                trace.emit(
-                    "sample_block",
-                    block=blocks_done,
-                    dur_s=round(time.perf_counter() - t_blk, 4),
-                    t_dispatch_s=rec["t_dispatch_s"],
-                    t_diag_s=rec["t_diag_s"],
-                    draws_per_chain=draws_per_chain,
-                    block_grad_evals=blk_grads,
-                )
-                trace.emit(
-                    "chain_health",
-                    block=blocks_done,
-                    max_rhat=rec["max_rhat"],
-                    min_ess=rec["min_ess"],
-                    num_stuck_components=n_stuck,
-                    num_divergent=total_div,
-                    mean_accept=rec["mean_accept"],
-                    step_size=round(
-                        float(np.mean(np.asarray(ap.collect(step_size)))), 6
-                    ),
-                    draws_per_chain=draws_per_chain,
-                )
 
+            t_ckpt_dur = 0.0
             if checkpoint_path:
                 t_ckpt = time.perf_counter()
                 from .checkpoint import save_checkpoint
 
                 arrays = ap.collect({
-                    "z": state.z,
-                    "pe": state.potential_energy,
-                    "grad": state.grad,
-                    "step_size": step_size,
-                    "inv_mass": inv_mass,
+                    "z": pend["state"].z,
+                    "pe": pend["state"].potential_energy,
+                    "grad": pend["state"].grad,
+                    "step_size": pend["step_size"],
+                    "inv_mass": pend["inv_mass"],
                 })
-                arrays["key"] = np.asarray(key)  # host driver state
+                # host driver state AS OF this block's dispatch: the
+                # pipeline may have split further keys for in-flight
+                # blocks, but a resume from THIS checkpoint must replay
+                # block k+1 from the serial stream position
+                arrays["key"] = np.asarray(pend["key"])
                 if is_chees:
-                    arrays["log_eps"] = np.asarray(run_carry.log_eps)
-                    arrays["log_T"] = np.asarray(run_carry.log_T)
+                    arrays["log_eps"] = np.asarray(pend["log_eps"])
+                    arrays["log_T"] = np.asarray(pend["log_T"])
                 if draw_store is None:
                     # no draw store -> draws ride in the checkpoint; with a
                     # store the draws are already persisted incrementally
                     # (avoids O(blocks^2) checkpoint I/O)
-                    if cat_draws is None:
-                        cat_draws = np.concatenate(draw_blocks, axis=1)
-                    arrays["draws"] = cat_draws
+                    arrays["draws"] = draws_hist.view()
                 else:
                     draw_store.flush()  # store on disk before state advances
                 save_checkpoint(
@@ -1009,16 +1089,97 @@ def _sample_until_converged(
                         "kernel": cfg.kernel,
                     },
                 )
+                t_ckpt_dur = time.perf_counter() - t_ckpt
                 if trace.enabled:
                     trace.emit(
                         "checkpoint",
                         block=blocks_done,
                         path=checkpoint_path,
-                        dur_s=round(time.perf_counter() - t_ckpt, 4),
+                        dur_s=round(t_ckpt_dur, 4),
                     )
+            if trace.enabled:
+                # one phase event (timing) + one health event (diagnostics)
+                # per block, emitted once the block's ENTIRE host cycle
+                # (diagnostics + persistence + checkpoint) is done.
+                # ``dur_s`` excludes the checkpoint time — the checkpoint
+                # phase has its own event and the per-run phase durations
+                # must still tile the wall without double counting.
+                # Overlap accounting: ``t_host_hidden_s`` is this block's
+                # host-cycle time that ran while the next block computed
+                # on device; ``device_idle_s`` is the device idle the host
+                # caused before this block ran — exact in sync mode (the
+                # whole previous host cycle), estimated in pipelined mode
+                # from the latest device-seconds-per-block observation
+                # (0 whenever the host had to wait, i.e. the device never
+                # starved).  Both are bounded by the host-cycle totals, so
+                # the summarized idle fraction (idle over sample_block +
+                # checkpoint phase time) stays in [0, 1].
+                host_cycle = time.perf_counter() - t_blk - t_wait
+                if sync_blocks:
+                    hidden, idle = 0.0, pipe["t_host_prev"]
+                else:
+                    hidden = host_cycle if next_in_flight else 0.0
+                    idle = (
+                        0.0
+                        if t_wait > 1e-4 or pipe["dev_est"] is None
+                        else max(0.0, pipe["t_host_prev"] - pipe["dev_est"])
+                    )
+                trace.emit(
+                    "sample_block",
+                    block=blocks_done,
+                    # dur covers this block's own host timeline: enqueue
+                    # (jit tracing/compile on the first call lands there)
+                    # + wait + host diagnostics — checkpoint excluded
+                    # (own phase event), so per-run phases still tile the
+                    # wall
+                    dur_s=round(
+                        pend["t_enq"]
+                        + time.perf_counter() - t_blk - t_ckpt_dur,
+                        4,
+                    ),
+                    t_dispatch_s=rec["t_dispatch_s"],
+                    t_diag_s=rec["t_diag_s"],
+                    t_wait_s=round(t_wait, 4),
+                    t_host_hidden_s=round(hidden, 4),
+                    device_idle_s=round(idle, 4),
+                    pipelined=not sync_blocks,
+                    draws_per_chain=draws_per_chain,
+                    block_grad_evals=blk_grads,
+                )
+                trace.emit(
+                    "chain_health",
+                    block=blocks_done,
+                    max_rhat=rec["max_rhat"],
+                    min_ess=rec["min_ess"],
+                    num_stuck_components=n_stuck,
+                    num_divergent=total_div,
+                    mean_accept=rec["mean_accept"],
+                    step_size=round(
+                        float(
+                            np.mean(np.asarray(ap.collect(pend["step_size"])))
+                        ),
+                        6,
+                    ),
+                    draws_per_chain=draws_per_chain,
+                )
+            # failpoint: crash/preempt after the block is fully accounted
+            # (metrics + checkpoint durable) — with the pipeline on, the
+            # next block is in flight HERE, so this site drills the
+            # orphaned-in-flight-block recovery story
+            faults.fail_point("runner.block.post")
+
+            # overlap bookkeeping: device-seconds estimate is exact when
+            # the host waited (device busy for the whole previous host
+            # cycle plus the wait); host cycle time feeds the next
+            # block's idle attribution
+            if t_wait > 1e-4 or pipe["dev_est"] is None:
+                pipe["dev_est"] = (
+                    t_wait if sync_blocks else pipe["t_host_prev"] + t_wait
+                )
+            pipe["t_host_prev"] = time.perf_counter() - t_blk - t_wait
 
             if converged:
-                break
+                return True
             # budget stop must be agreed ACROSS RANKS on a multi-process
             # mesh: convergence decisions derive from identical collected
             # draws, but wall clocks skew per host — an unilateral break
@@ -1056,6 +1217,46 @@ def _sample_until_converged(
                         "budget", time_budget_s=float(time_budget_s),
                         blocks=blocks_done,
                     )
+                return True
+            return False
+
+        pending = None
+        blocks_dispatched = blocks_done
+        profile_next = bool(profile_dir) and blocks_done == 0
+
+        def dispatch_next():
+            """Split the next block's key on the HOST (identical stream in
+            serial and pipelined order) and enqueue the block."""
+            nonlocal key, blocks_dispatched, profile_next
+            key, key_block = jax.random.split(key)
+            t_enq = time.perf_counter()
+            if profile_next:
+                # the profiler wants one block's device timeline by
+                # itself: run the first block synchronously under the
+                # trace, then pipeline from the next block on
+                profile_next = False
+                with jax.profiler.trace(profile_dir):
+                    pend = dispatch_block(key_block, key)
+                    jax.block_until_ready(pend["outs"])
+            else:
+                pend = dispatch_block(key_block, key)
+            pend["t_enq"] = time.perf_counter() - t_enq
+            blocks_dispatched += 1
+            return pend
+
+        while blocks_done < max_blocks:
+            if pending is None:
+                pending = dispatch_next()
+            current, pending = pending, None
+            if not sync_blocks and blocks_dispatched < max_blocks:
+                # the overlap: block k+1 starts on the device while the
+                # host processes block k below
+                pending = dispatch_next()
+            if process_block(current, next_in_flight=pending is not None):
+                # converged or budget stop: a speculative in-flight block
+                # is simply discarded — the serial path never ran it, and
+                # neither its draws nor its key split are observable in
+                # any persisted artifact
                 break
     finally:
         if metrics_f:
@@ -1063,12 +1264,10 @@ def _sample_until_converged(
         if draw_store is not None:
             draw_store.close()
 
-    # cat_draws from the final loop iteration (if any) is still current —
-    # draw_blocks does not change between its construction and loop exit
     with trace.phase("collect"):
-        all_draws = cat_draws if cat_draws is not None else np.concatenate(
-            draw_blocks, axis=1
-        )
+        # one final contiguous copy out of the history buffer (the buffer
+        # over-allocates by up to 2x; the result should not pin that)
+        all_draws = np.ascontiguousarray(draws_hist.view())
         draws = _constrain_draws(fm, all_draws)
     stats = {"num_divergent": np.asarray(total_div)}
     result = AdaptiveResult(

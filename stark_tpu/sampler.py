@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 import time
 from functools import partial
 from typing import Any, Callable, Dict, NamedTuple, Optional
@@ -447,6 +448,13 @@ def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
     data)`` are backend-compiled (jit or shard_map + jit); ``collect``
     materializes a device pytree on the host (allgather on pods).
 
+    Draw blocks run as a two-deep software pipeline (the same discipline
+    as the adaptive runner): segment i+1 is ENQUEUED before segment i's
+    outputs are materialized, so the host-side transfer/thinning/append
+    work overlaps device compute.  Per-segment keys are pre-split, so the
+    pipelined and serial (``STARK_SYNC_BLOCKS=1``) orders are
+    bit-identical.
+
     At most two compiled block variants run per call (the full segment and
     one remainder length).
     """
@@ -475,17 +483,38 @@ def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
     ng_blocks = [np.zeros((chains, 0), np.int32)]
     num_divergent = np.zeros((chains,), np.int64)
     trace = telemetry.get_trace()
-    for s in range(0, total, seg):
-        e = min(s + seg, total)
-        v_block = get_block(e - s)
+    # multi-process meshes stay serial: their collect is an allgather —
+    # a dispatched computation stream-ordered after the prefetched block,
+    # so prefetching only delays this block's materialization (see the
+    # adaptive runner's identical gate)
+    sync_blocks = (
+        os.environ.get("STARK_SYNC_BLOCKS", "") == "1"
+        or jax.process_count() > 1
+    )
+    spans = [(s, min(s + seg, total)) for s in range(0, total, seg)]
+
+    def dispatch(span):
+        """Enqueue one segment (async) and chain the carried state."""
+        nonlocal state
+        s, e = span
         # block_run splits its own per-step keys from one key per chain
-        bkeys = skeys[:, s, :]
-        with trace.phase("sample_block", start=s, end=e) as ph:
-            out = jax.block_until_ready(
-                v_block(bkeys, state, step_size, inv_mass, data)
-            )
-            state = out[0]
-            zs, accept, divergent, energy, ngrad = collect(out[1:])
+        out = get_block(e - s)(skeys[:, s, :], state, step_size, inv_mass,
+                               data)
+        state = out[0]
+        return out[1:]
+
+    pend = None
+    for i, (s, e) in enumerate(spans):
+        if pend is None:
+            pend = dispatch((s, e))
+        outs, pend = pend, None
+        if not sync_blocks and i + 1 < len(spans):
+            # overlap: the next segment computes while the host thins and
+            # appends this one
+            pend = dispatch(spans[i + 1])
+        with trace.phase("sample_block", start=s, end=e,
+                         pipelined=not sync_blocks) as ph:
+            zs, accept, divergent, energy, ngrad = collect(outs)
             if trace.enabled:
                 ph.note(mean_accept=round(float(np.mean(accept)), 4))
         num_divergent += divergent.astype(np.int64).sum(axis=1)

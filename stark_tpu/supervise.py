@@ -318,6 +318,15 @@ def supervised_sample(
     ``status="restart"`` plus the fault class, so a trace file reads as
     the complete supervision story.
 
+    The runner's asynchronous block pipeline composes with supervision
+    unchanged: a fault with block k+1 in flight discards that block (its
+    draws never reached the host), the restart resumes block k's
+    checkpoint, and the runner's resume reconciliation truncates any draw
+    store rows the checkpoint doesn't account for — so the replayed block
+    k+1 is bit-identical to what the serial loop would have produced.
+    Restart attempts also reuse the workdir-keyed persistent compilation
+    cache enabled here, so they skip the re-jit of every segment.
+
     Returns the AdaptiveResult of the first successful attempt.
     """
     from .runner import sample_until_converged
@@ -333,6 +342,15 @@ def supervised_sample(
     )
 
     os.makedirs(workdir, exist_ok=True)
+    # persistent XLA compilation cache, keyed under the workdir: every
+    # restart attempt builds a fresh backend and would otherwise re-pay
+    # the full jit of warmup segments + draw blocks (the dominant share
+    # of the measured ~56 s init+compile phase).  An env-configured
+    # JAX_COMPILATION_CACHE_DIR (bench.py sets a repo-level one) wins;
+    # STARK_COMPILE_CACHE=0 disables (see platform.enable_compilation_cache).
+    from .platform import enable_compilation_cache
+
+    enable_compilation_cache(os.path.join(workdir, ".jax_cache"))
     # per-process file names on multi-process meshes (idempotent — the
     # runner applies the same mapping to whatever paths it receives, so
     # supervisor-side health checks and runner-side writes agree)

@@ -481,7 +481,17 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
          "phases": {name: {"count": n, "total_s": s}},
          "health": {"mean_accept", "num_divergent", "max_rhat", "min_ess",
                     "step_size", ...last-seen values...},
+         "overlap": {"t_host_hidden_s", "device_idle_s", "t_wait_s",
+                     "device_idle_frac"} | {},   # block-pipeline totals,
+                                                 # when the writer emitted
+                                                 # the overlap fields
          "restarts": int, "events": int}
+
+    ``overlap`` aggregates the runner's pipelined ``sample_block``
+    accounting: total host work hidden behind device compute, total
+    estimated device idle, total host wait, and the idle fraction
+    (device_idle_s / total sample_block time — 0.0 when the device never
+    starved).
     """
     restarts_by_run: Dict[int, int] = {}
     for e in events:
@@ -505,11 +515,18 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     meta: Dict[str, Any] = {}
     phases: Dict[str, Dict[str, float]] = {}
     health: Dict[str, Any] = {}
+    overlap: Dict[str, float] = {}
+    saw_overlap = False
     wall = None
     div_latest = None
     accepts: List[float] = []
     for e in evs:
         ev = e["event"]
+        if ev == "sample_block":
+            for k in ("t_host_hidden_s", "device_idle_s", "t_wait_s"):
+                if e.get(k) is not None:
+                    saw_overlap = True
+                    overlap[k] = overlap.get(k, 0.0) + float(e[k])
         if ev == "run_start":
             meta = {
                 k: v for k, v in e.items()
@@ -546,6 +563,24 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
         health["num_divergent"] = div_latest
     if wall is None and evs:
         wall = evs[-1]["wall_s"] - evs[0]["wall_s"]
+    if saw_overlap:
+        # idle fraction over the whole BLOCK-LOOP time: sample_block durs
+        # exclude checkpoint time (each checkpoint has its own phase
+        # event, so phase durations tile the wall without double
+        # counting), but the per-block idle attribution covers the full
+        # host cycle INCLUDING checkpoints — the denominator must too, or
+        # checkpoint-heavy serial runs would report fractions above 1
+        loop_total = (
+            phases.get("sample_block", {}).get("total_s", 0.0)
+            + phases.get("checkpoint", {}).get("total_s", 0.0)
+        )
+        overlap = {k: round(v, 4) for k, v in overlap.items()}
+        overlap["device_idle_frac"] = round(
+            min(overlap.get("device_idle_s", 0.0) / loop_total, 1.0)
+            if loop_total > 0
+            else 0.0,
+            4,
+        )
     return {
         "run": run,
         "meta": meta,
@@ -555,6 +590,7 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
             for k, v in phases.items()
         },
         "health": health,
+        "overlap": overlap if saw_overlap else {},
         "restarts": restarts_total,
         "events": len(evs),
     }

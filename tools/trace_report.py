@@ -90,6 +90,27 @@ def render_run(events, run) -> str:
     out.append(_table(rows, ("phase", "events", "total_s", "share")))
     out.append("")
 
+    # block-pipeline overlap accounting (runner's async sample loop):
+    # host work hidden behind in-flight device blocks, and the estimated
+    # device idle fraction — the number the pipeline exists to drive to 0
+    ov = s.get("overlap") or {}
+    if ov:
+        rows = [
+            ("host work hidden (s)", ov.get("t_host_hidden_s")),
+            ("host wait on device (s)", ov.get("t_wait_s")),
+            ("device idle (s)", ov.get("device_idle_s")),
+            (
+                "device idle fraction",
+                f"{100.0 * ov['device_idle_frac']:.1f}%"
+                if ov.get("device_idle_frac") is not None
+                else None,
+            ),
+        ]
+        out.append(_table(
+            [r for r in rows if r[1] is not None], ("block overlap", "value")
+        ))
+        out.append("")
+
     h = s["health"]
     if h:
         keys = (
