@@ -491,6 +491,7 @@ def main():
     try_chees = os.environ.get("BENCH_CHEES", "auto")
     chees_converged = False
     chees_overlap = {}  # block-pipeline overlap from the supervised trace
+    chees_diag = {}  # streaming-gate transfer + overshoot, same trace
     if try_chees == "1" or (
         try_chees == "auto" and (platform != "cpu" or fell_back)
     ):
@@ -687,6 +688,7 @@ def main():
                 )
             else:
                 chees_overlap = trace_summary.get("overlap") or {}
+                chees_diag = trace_summary.get("diag") or {}
         except Exception as e:  # noqa: BLE001 — after supervised retries
             print(f"[bench] chees path failed after retries: {e!r}",
                   file=sys.stderr)
@@ -756,7 +758,11 @@ def main():
         def res_row(res):
             row = {
                 "benchmark": res.name,
-                "value": _fin(res.ess_per_sec, 3) or 0.0,
+                # null (not 0.0) for a non-finite rate: a stuck leg must
+                # stay distinguishable from a measured-(~)zero one —
+                # ``converged`` carries the finiteness, the value column
+                # must not erase it (ADVICE r5)
+                "value": _fin(res.ess_per_sec, 3),
                 "metric": res.metric_name,
                 "min_ess": _fin(res.min_ess, 1),
                 "wall_s": round(res.wall_s, 1),
@@ -850,6 +856,20 @@ def main():
                         ),
                     }
                     if chees_overlap.get("device_idle_frac") is not None
+                    else {}
+                ),
+                # streaming diagnostics + adaptive blocks (runner.py):
+                # per-block bytes the convergence gate pulled to host
+                # (constant O(chains*d*L) with streaming on) and the
+                # estimated draws spent past the ESS target
+                **(
+                    {"diag_bytes_to_host": chees_diag["bytes_last"]}
+                    if chees_diag.get("bytes_last") is not None
+                    else {}
+                ),
+                **(
+                    {"overshoot_draws": chees_diag["overshoot_draws"]}
+                    if chees_diag.get("overshoot_draws") is not None
                     else {}
                 ),
                 **(

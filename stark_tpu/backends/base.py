@@ -30,8 +30,17 @@ class AdaptiveParts(NamedTuple):
       extra        () or (data,) — trailing args for every segment call
       chees        CheesParts (schedule/finalize) when kernel == "chees"
       init_j/warm_j/samp_j   compiled chees segment callables
+      samp_diag    samp_diag(donate=False) -> compiled chees segment with
+                   the streaming-diagnostics carry (carry, diag, keys, us,
+                   data) -> (carry, diag, outs); ``donate=True`` donates
+                   the diag buffers (safe only when the caller never reads
+                   a block's diag after dispatching the next one — the
+                   runner's serial mode)
       seg_warmup   run(warm_keys, z0, data, seg) for per-chain kernels
-      get_block    get_block(block_size) -> compiled v_block(keys, state,
+      get_block    get_block(block_size, diag_lags=None, donate_diag=False)
+                   -> compiled v_block(keys, state, step_size, inv_mass,
+                   data); with ``diag_lags`` the block threads a per-chain
+                   StreamDiagState batch: v_block(keys, state, diag,
                    step_size, inv_mass, data)
       put_chains   place a host (chains, ...) array on the chains layout
       put_rep      place a host replicated array (adaptation state)
@@ -48,6 +57,7 @@ class AdaptiveParts(NamedTuple):
     init_j: Any = None
     warm_j: Any = None
     samp_j: Any = None
+    samp_diag: Any = None
     seg_warmup: Any = None
     get_block: Any = None
 

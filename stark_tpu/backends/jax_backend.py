@@ -208,14 +208,34 @@ class JaxBackend:
         return self._cache[key]
 
     def _get_block(self, model, fm, cfg):
-        """get_block(length) -> jitted vmapped block runner (cached)."""
-        return lambda length: self._cached(
-            model, cfg, ("block", length),
-            lambda: jax.jit(jax.vmap(
-                make_block_runner(fm, cfg, length),
-                in_axes=(0, 0, 0, 0, None),
-            )),
-        )
+        """get_block(length, diag_lags=None, donate_diag=False) -> jitted
+        vmapped block runner (cached).  ``diag_lags`` threads the streaming-
+        diagnostics carry (extra chains-batched StreamDiagState arg after
+        ``state``); ``donate_diag`` donates those buffers so the serial
+        loop updates the O(chains*d*L) accumulators in place."""
+
+        def get(length, diag_lags=None, donate_diag=False):
+            if diag_lags is None:
+                return self._cached(
+                    model, cfg, ("block", length),
+                    lambda: jax.jit(jax.vmap(
+                        make_block_runner(fm, cfg, length),
+                        in_axes=(0, 0, 0, 0, None),
+                    )),
+                )
+            return self._cached(
+                model, cfg, ("block", length, diag_lags, donate_diag),
+                lambda: jax.jit(
+                    jax.vmap(
+                        make_block_runner(fm, cfg, length,
+                                          diag_lags=diag_lags),
+                        in_axes=(0, 0, 0, 0, 0, None),
+                    ),
+                    donate_argnums=(2,) if donate_diag else (),
+                ),
+            )
+
+        return get
 
     def _run_segmented(self, model, fm, cfg, data, chain_keys, z0,
                        dispatch_steps):
@@ -263,7 +283,7 @@ class JaxBackend:
                 model, cfg, "chees_parts", lambda: make_chees_parts(fm, cfg)
             )
 
-            def jit_part(tag, fn):
+            def jit_part(tag, fn, donate=()):
                 # bind data=None explicitly when absent so every backend's
                 # segment callables share the (*args, *extra) convention
                 wrapped = fn if data is not None else (
@@ -271,8 +291,17 @@ class JaxBackend:
                 )
                 # data-ness is part of the key: the wrapper's arity differs
                 return self._cached(
-                    model, cfg, ("chees_j", tag, data is None),
-                    lambda: jax.jit(wrapped),
+                    model, cfg, ("chees_j", tag, data is None, donate),
+                    lambda: jax.jit(wrapped, donate_argnums=donate),
+                )
+
+            def samp_diag(donate=False):
+                # streaming-diagnostics segment; donate=True donates the
+                # diag carry (arg 1) — jit wrappers are lazy, so building
+                # a variant costs nothing until it is dispatched
+                return jit_part(
+                    "samp_diag", parts.sample_segment_diag,
+                    donate=(1,) if donate else (),
                 )
 
             return bundle._replace(
@@ -280,6 +309,7 @@ class JaxBackend:
                 init_j=jit_part("init", parts.init_carry),
                 warm_j=jit_part("warm", parts.warm_segment),
                 samp_j=jit_part("samp", parts.sample_segment),
+                samp_diag=samp_diag,
             )
         seg_warmup = self._cached(
             model, cfg, "seg_warmup", lambda: make_segmented_warmup(fm, cfg)

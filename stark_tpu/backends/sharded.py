@@ -221,22 +221,26 @@ class ShardedBackend:
 
         return to_global
 
-    def _smap(self, fn, in_specs, out_specs, data, data_specs):
+    def _smap(self, fn, in_specs, out_specs, data, data_specs, donate=()):
         """shard_map + jit over the backend mesh; a ``None`` dataset is
         bound here so every compiled segment shares the (*args, *extra)
-        calling convention with the single-device backend."""
+        calling convention with the single-device backend.  ``donate``
+        forwards to the outer jit's ``donate_argnums`` (buffer donation of
+        carried state, e.g. the streaming-diagnostics accumulators)."""
         if data is None:
             return jax.jit(
                 shard_map(
                     lambda *a: fn(*a, None), mesh=self.mesh, in_specs=in_specs,
                     out_specs=out_specs, check_vma=False,
-                )
+                ),
+                donate_argnums=donate,
             )
         return jax.jit(
             shard_map(
                 fn, mesh=self.mesh, in_specs=in_specs + (data_specs,),
                 out_specs=out_specs, check_vma=False,
-            )
+            ),
+            donate_argnums=donate,
         )
 
     def _data_specs(self, data, row_axes):
@@ -247,8 +251,14 @@ class ShardedBackend:
         )
 
     def _chees_smapped(self, model, fm, cfg, data, row_axes):
-        """(parts, init_j, warm_j, samp_j): the chees segment callables
-        shard_mapped over the mesh, cached per (model, cfg, data layout)."""
+        """(parts, init_j, warm_j, samp_j, samp_diag): the chees segment
+        callables shard_mapped over the mesh, cached per (model, cfg, data
+        layout).  ``samp_diag(donate=False)`` is the streaming-diagnostics
+        variant — the per-chain StreamDiagState batch is chain-sharded
+        like the ensemble state (every accumulator leaf carries a leading
+        chains axis), so no cross-device reduction runs per transition;
+        ``collect`` (an allgather on pods) materializes the O(chains*d*L)
+        summary on the hosts once per block."""
         from ..adaptation import DualAveragingState, WelfordState
         from ..chees import (
             AdamState,
@@ -281,6 +291,20 @@ class ShardedBackend:
             None if data is None else jax.tree.structure(data),
         )
         if cache_key not in self._cache:
+
+            def samp_diag(donate=False):
+                # every StreamDiagState leaf is chain-sharded, so the one
+                # prefix spec S covers the whole diag pytree; donation is
+                # an outer-jit property, keyed separately
+                dkey = cache_key + ("samp_diag", donate)
+                if dkey not in self._cache:
+                    self._cache[dkey] = self._smap(
+                        parts.sample_segment_diag, (run_spec, S, R, R),
+                        (run_spec, S, out_spec), data, data_specs,
+                        donate=(1,) if donate else (),
+                    )
+                return self._cache[dkey]
+
             self._cache[cache_key] = (
                 self._smap(parts.init_carry, (R, S), warm_spec, data, data_specs),
                 self._smap(
@@ -291,6 +315,7 @@ class ShardedBackend:
                     parts.sample_segment, (run_spec, R, R),
                     (run_spec, out_spec), data, data_specs,
                 ),
+                samp_diag,
             )
         return (parts,) + self._cache[cache_key]
 
@@ -306,11 +331,12 @@ class ShardedBackend:
         )
         if cache_key not in self._cache:
 
-            def smap_seg(fn, in_specs, out_specs):
+            def smap_seg(fn, in_specs, out_specs, donate=()):
                 # the segmented drivers pass data as a trailing arg even
                 # when it is None (the single-device vmapped parts need
                 # it); tolerate-and-drop it in the dataless mesh case
-                inner = self._smap(fn, in_specs, out_specs, data, data_specs)
+                inner = self._smap(fn, in_specs, out_specs, data, data_specs,
+                                   donate=donate)
                 if data is None:
                     return lambda *a: inner(*a[:-1])
                 return inner
@@ -329,18 +355,34 @@ class ShardedBackend:
                     cfg, v_init, v_seg, finalize, warm_keys, z0, data_arg, seg
                 )
 
-            blocks: Dict[int, Any] = {}
+            blocks: Dict[Any, Any] = {}
 
-            def get_block(length):
-                if length not in blocks:
-                    blocks[length] = smap_seg(
-                        jax.vmap(
-                            make_block_runner(fm, cfg, length),
-                            in_axes=(0, 0, 0, 0, None),
-                        ),
-                        (S, S, S, S), S,
-                    )
-                return blocks[length]
+            def get_block(length, diag_lags=None, donate_diag=False):
+                key = (length, diag_lags, donate_diag)
+                if key not in blocks:
+                    if diag_lags is None:
+                        blocks[key] = smap_seg(
+                            jax.vmap(
+                                make_block_runner(fm, cfg, length),
+                                in_axes=(0, 0, 0, 0, None),
+                            ),
+                            (S, S, S, S), S,
+                        )
+                    else:
+                        # the chains-batched StreamDiagState rides the
+                        # chains axis like the HMC state; one prefix spec
+                        # covers every accumulator leaf
+                        blocks[key] = smap_seg(
+                            jax.vmap(
+                                make_block_runner(
+                                    fm, cfg, length, diag_lags=diag_lags
+                                ),
+                                in_axes=(0, 0, 0, 0, 0, None),
+                            ),
+                            (S, S, S, S, S), S,
+                            donate=(2,) if donate_diag else (),
+                        )
+                return blocks[key]
 
             self._cache[cache_key] = (seg_warmup, get_block)
         return self._cache[cache_key]
@@ -398,11 +440,12 @@ class ShardedBackend:
             collect=gather_draws,
         )
         if cfg.kernel == "chees":
-            parts, init_j, warm_j, samp_j = self._chees_smapped(
+            parts, init_j, warm_j, samp_j, samp_diag = self._chees_smapped(
                 model, fm, cfg, data, row_axes
             )
             return bundle._replace(
-                chees=parts, init_j=init_j, warm_j=warm_j, samp_j=samp_j
+                chees=parts, init_j=init_j, warm_j=warm_j, samp_j=samp_j,
+                samp_diag=samp_diag,
             )
         seg_warmup, get_block = self._segmented_parts(
             model, fm, cfg, data, row_axes
@@ -423,7 +466,7 @@ class ShardedBackend:
         from ..chees import drive_chees_segments
         from ..distributed import gather_draws
 
-        parts, init_j, warm_j, samp_j = self._chees_smapped(
+        parts, init_j, warm_j, samp_j, _ = self._chees_smapped(
             model, fm, cfg, data, row_axes
         )
 

@@ -131,6 +131,10 @@ class CheesParts(NamedTuple):
     sample_segment: Callable  # (carry, keys, us, data) -> (carry, outs)
     warm_cap: int
     schedule: Any  # WarmupSchedule for cfg.num_warmup
+    # streaming-diagnostics variant (STARK_STREAM_DIAG): threads a
+    # per-chain StreamDiagState batch through the scan —
+    # (carry, diag, keys, us, data) -> (carry, diag, outs)
+    sample_segment_diag: Optional[Callable] = None
 
 
 def make_chees_parts(
@@ -297,7 +301,16 @@ def make_chees_parts(
     # compiled program identical to the untraced build
     from .kernels.base import scan_progress
 
-    def sample_segment(carry: CheesRunCarry, keys, us, data=None):
+    def _sample_scan(carry: CheesRunCarry, diag, keys, us, data):
+        """The ONE sampling scan body serving both segment variants —
+        ``diag=None`` (resolved at trace time) compiles the historical
+        plain segment; a `kernels.base.StreamDiagState` batch (leading
+        chains axis — the local shard under ``chains_axis``) is updated
+        from every accepted ensemble position otherwise.  One body so the
+        transitions cannot drift between the variants: the accumulator
+        only CONSUMES states.z, so draws match bit-for-bit either way."""
+        from .kernels.base import stream_diag_update
+
         potential_fn = fm.bind(data)
         # built at trace time so the interval clamps to THIS segment's
         # length (keys.shape is static per compiled variant): an interval
@@ -309,7 +322,8 @@ def make_chees_parts(
             else None,
         )
 
-        def body(c: CheesRunCarry, x):
+        def body(cd, x):
+            c, dg = cd
             # x gains a leading segment-local index under the heartbeat
             (i, key, u) = x if tick is not None else (None,) + x
             # cap at warm_cap, not max_leapfrog: with the u in (0,2)
@@ -322,20 +336,35 @@ def make_chees_parts(
             )
             if tick is not None:
                 tick(i, jnp.mean(info.accept_prob))
+            if dg is not None:
+                dg = jax.vmap(stream_diag_update)(dg, states.z)
             out = (
                 states.z,
                 info.accept_prob,
                 info.is_divergent,
                 info.num_leapfrog,
             )
-            return CheesRunCarry(states, c.log_eps, c.log_T, c.inv_mass), out
+            return (
+                (CheesRunCarry(states, c.log_eps, c.log_T, c.inv_mass), dg),
+                out,
+            )
 
         xs = (
             (jnp.arange(keys.shape[0]), keys, us)
             if tick is not None
             else (keys, us)
         )
-        return jax.lax.scan(body, carry, xs)
+        return jax.lax.scan(body, (carry, diag), xs)
+
+    def sample_segment(carry: CheesRunCarry, keys, us, data=None):
+        (carry, _), outs = _sample_scan(carry, None, keys, us, data)
+        return carry, outs
+
+    def sample_segment_diag(carry: CheesRunCarry, diag, keys, us, data=None):
+        """`sample_segment` + the on-device streaming-diagnostics carry
+        (see `_sample_scan`)."""
+        (carry, diag), outs = _sample_scan(carry, diag, keys, us, data)
+        return carry, diag, outs
 
     return CheesParts(
         init_carry=init_carry,
@@ -344,6 +373,7 @@ def make_chees_parts(
         sample_segment=sample_segment,
         warm_cap=warm_cap,
         schedule=sched,
+        sample_segment_diag=sample_segment_diag,
     )
 
 

@@ -180,6 +180,137 @@ class ChainSuffStats:
         )
 
 
+def stream_diag_from_draws(draws, lags: int, chains=None, ndim=None,
+                           dtype=np.float32):
+    """Host (numpy) rebuild of the on-device streaming accumulator
+    (`kernels.base.StreamDiagState`) from a (chains, n, d) draw history.
+
+    Two jobs: (1) the resume path reconstructs the device carry from the
+    stored draws, (2) tests hold the device scan and this reference to the
+    same math.  Returns a dict with the device state's field names, every
+    leaf batched over a leading chains axis (the layout the vmapped /
+    chain-sharded update carries); sums accumulate in the device dtype so
+    the rebuilt state tracks an uninterrupted device run to roundoff.
+    """
+    draws = np.asarray(draws)
+    if draws.ndim != 3:
+        raise ValueError(f"expected (chains, n, d) draws, got {draws.shape}")
+    c, n, d = draws.shape
+    chains = c if chains is None else int(chains)
+    ndim = d if ndim is None else int(ndim)
+    if n and (c != chains or d != ndim):
+        raise ValueError(
+            f"draws {draws.shape} != (chains={chains}, n, d={ndim})"
+        )
+    out = {
+        "n": np.full((chains,), n, np.int32),
+        "anchor": np.zeros((chains, ndim), dtype),
+        "s1": np.zeros((chains, ndim), dtype),
+        "s2": np.zeros((chains, ndim), dtype),
+        "cross": np.zeros((chains, lags, ndim), dtype),
+        "ring": np.zeros((chains, lags, ndim), dtype),
+        "head": np.zeros((chains, lags, ndim), dtype),
+    }
+    if n == 0:
+        return out
+    anchor = draws[:, 0].astype(dtype)
+    y = (draws.astype(dtype) - anchor[:, None, :]).astype(dtype)
+    out["anchor"] = anchor
+    out["s1"] = y.sum(axis=1, dtype=dtype)
+    out["s2"] = (y * y).sum(axis=1, dtype=dtype)
+    k = min(lags, n)
+    for li in range(min(lags, n - 1)):
+        lag = li + 1
+        out["cross"][:, li] = (y[:, lag:] * y[:, :-lag]).sum(
+            axis=1, dtype=dtype
+        )
+    # ring: last k draws, most recent first; head: first k draws in order
+    out["ring"][:, :k] = y[:, n - k:][:, ::-1]
+    out["head"][:, :k] = y[:, :k]
+    return out
+
+
+def ess_from_suffstats(n, anchor, s1, s2, cross, ring, head) -> np.ndarray:
+    """Geyer initial-positive-sequence ESS LOWER BOUND from the streaming
+    accumulators (`kernels.base.StreamDiagState`, leaves batched over a
+    leading chains axis) — the adaptive runner's O(chains*d*L) convergence
+    signal, replacing the full-history FFT pass in the hot loop.
+
+    Bias direction: the accumulator truncates the autocovariance at lag L.
+    When the Geyer initial-positive pair sequence terminates WITHIN the
+    tracked lags, the estimate matches the (non-split) full estimator on
+    those lags; when it is still positive at the last tracked pair — the
+    chain mixes slower than L lags can resolve — the tail is extended with
+    a geometric bound fitted to the last two monotone pairs (rate clipped
+    below 1), which over- rather than under-estimates tau, so the returned
+    ESS errs LOW and the gate waits instead of stopping early.  Every
+    candidate stop is still validated by the full split-form pass
+    (runner.py), so this estimator only decides *when to look*.
+
+    Returns (d,) float64; NaN for frozen components (no defined ESS, so a
+    stuck parameter fails an ``ess > target`` gate — same convention as
+    ``ess``).
+    """
+    n = np.asarray(n)
+    count = int(n.max()) if n.size else 0
+    if n.size and count != int(n.min()):
+        raise ValueError(f"ragged per-chain counts: {n}")
+    anchor = np.asarray(anchor, np.float64)
+    s1 = np.asarray(s1, np.float64)
+    s2 = np.asarray(s2, np.float64)
+    cross = np.asarray(cross, np.float64)
+    ring = np.asarray(ring, np.float64)
+    head = np.asarray(head, np.float64)
+    c, lags, d = cross.shape
+    if count < 4:
+        return np.full((d,), np.nan)
+    # per-chain centered moments -> per-chain autocovariance at lags 0..L
+    mean_c = s1 / count  # centered chain mean, (c, d)
+    gamma0 = (s2 - count * mean_c**2) / count
+    l_eff = min(lags, count - 1)
+    ls = np.arange(1, l_eff + 1)[None, :, None]  # (1, L_eff, 1)
+    # sums over the lagged/leading windows from the boundary buffers:
+    #   sum_{t=l+1..n} y_{t-l} = s1 - (last l draws)   (ring, newest first)
+    #   sum_{t=l+1..n} y_t     = s1 - (first l draws)  (head, in order)
+    s_head = s1[:, None, :] - np.cumsum(ring[:, :l_eff], axis=1)
+    s_tail = s1[:, None, :] - np.cumsum(head[:, :l_eff], axis=1)
+    gamma = (
+        cross[:, :l_eff]
+        - mean_c[:, None, :] * (s_head + s_tail)
+        + (count - ls) * mean_c[:, None, :] ** 2
+    ) / count  # (c, L_eff, d)
+    # cross-chain combine — the non-split analogue of _ess_chunk
+    chain_var = gamma0 * count / (count - 1.0)
+    mean_var = chain_var.mean(axis=0)  # (d,)
+    var_plus = mean_var * (count - 1.0) / count
+    if c > 1:
+        var_plus = var_plus + (anchor + mean_c).var(axis=0, ddof=1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        rho = 1.0 - (mean_var[None] - gamma.mean(axis=0)) / var_plus[None]
+    rho = np.concatenate([np.ones((1, d)), rho], axis=0)  # lag 0
+    max_pairs = (l_eff + 1) // 2
+    pair = rho[0 : 2 * max_pairs : 2] + rho[1 : 2 * max_pairs : 2]
+    valid = np.cumprod(pair >= 0.0, axis=0).astype(bool)
+    mono = np.minimum.accumulate(np.where(valid, pair, np.inf), axis=0)
+    tau = -1.0 + 2.0 * np.sum(np.where(valid, mono, 0.0), axis=0)
+    # unterminated sequence: conservative geometric tail extension
+    if max_pairs >= 2:
+        unterminated = valid.all(axis=0)
+        g_last, g_prev = mono[-1], mono[-2]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            r = np.where(g_prev > 0, g_last / g_prev, 0.0)
+        r = np.clip(r, 0.0, 0.995)
+        tail = np.where(unterminated, g_last * r / (1.0 - r), 0.0)
+        tau = tau + 2.0 * np.where(np.isfinite(tail), tail, 0.0)
+    tau = np.maximum(tau, 1.0 / np.log10(c * count + 10.0))
+    out = c * count / tau
+    # frozen components: zero within-chain variance everywhere (exact —
+    # centered sums make a constant chain's moments identically zero)
+    const = np.all(gamma0 <= 0.0, axis=0)
+    out[const | ~np.isfinite(var_plus) | (var_plus <= 0.0)] = np.nan
+    return out
+
+
 class DrawHistory:
     """Full draw history in ONE growing preallocated host buffer.
 

@@ -4,6 +4,13 @@ Every kernel is a pure function ``(key, state, params...) -> (state, info)``
 composable under ``jax.lax.scan`` (SURVEY.md §8 step 2).  State lives on a
 flat unconstrained vector; kinetic energy uses a diagonal inverse mass matrix
 (vector) throughout — dense mass is a documented non-goal for v1.
+
+Also home to the ON-DEVICE streaming-diagnostics accumulator
+(`StreamDiagState` / `stream_diag_update`): Welford moments plus fixed-lag
+autocovariance sums carried through the sampling scans, so the adaptive
+runner's convergence gate reads O(chains*d*L) sufficient statistics per
+block instead of depending on the accumulated O(draws) history
+(`diagnostics.ess_from_suffstats` is the host-side consumer).
 """
 
 from __future__ import annotations
@@ -15,6 +22,82 @@ import jax.numpy as jnp
 
 Array = jax.Array
 PotentialFn = Callable[[Array], Array]
+
+#: default autocovariance truncation for the streaming ESS accumulator —
+#: lags 1..L are tracked per chain per coordinate (issue: L ~ 50 resolves
+#: integrated autocorrelation times up to tau ~ 25 exactly; slower-mixing
+#: components fall back to the conservative geometric tail bound in
+#: diagnostics.ess_from_suffstats, which under- rather than over-reports)
+STREAM_DIAG_LAGS = 50
+
+
+class StreamDiagState(NamedTuple):
+    """Streaming-diagnostics sufficient statistics for ONE chain.
+
+    Carried through the compiled sampling scans (vmap over chains /
+    shard_map over a chain mesh axis adds the leading chains axis).  All
+    moment sums are anchored at the chain's FIRST accumulated draw
+    (``anchor``) — autocovariances are shift-invariant, so centering on a
+    typical-set point keeps the float32 sums catastrophic-cancellation
+    free without knowing the mean in advance; the true chain mean is
+    recovered on the host as ``anchor + s1/n``.
+
+    n       ()      draws accumulated
+    anchor  (d,)    first draw (centering anchor)
+    s1      (d,)    sum of centered draws            y_t = x_t - anchor
+    s2      (d,)    sum of squared centered draws
+    cross   (L, d)  lagged cross-products: row l-1 holds sum_t y_t*y_{t-l}
+    ring    (L, d)  last L centered draws, most recent first
+    head    (L, d)  first L centered draws (head[i] = y_{i+1})
+    """
+
+    n: Array
+    anchor: Array
+    s1: Array
+    s2: Array
+    cross: Array
+    ring: Array
+    head: Array
+
+
+def stream_diag_init(ndim: int, lags: int = STREAM_DIAG_LAGS,
+                     dtype=jnp.float32) -> StreamDiagState:
+    """Zero-initialized accumulator for one chain (vmap for an ensemble)."""
+    return StreamDiagState(
+        n=jnp.zeros((), jnp.int32),
+        anchor=jnp.zeros((ndim,), dtype),
+        s1=jnp.zeros((ndim,), dtype),
+        s2=jnp.zeros((ndim,), dtype),
+        cross=jnp.zeros((lags, ndim), dtype),
+        ring=jnp.zeros((lags, ndim), dtype),
+        head=jnp.zeros((lags, ndim), dtype),
+    )
+
+
+def stream_diag_update(s: StreamDiagState, x: Array) -> StreamDiagState:
+    """Merge one draw into the accumulator — O(L*d), jit/scan-safe.
+
+    The ring rows for not-yet-seen lags are zero, so their cross-product
+    contributions vanish without masking; ``head`` captures the first L
+    draws once (rows past L never match the write index).
+    """
+    lags = s.ring.shape[0]
+    anchor = jnp.where(s.n == 0, x, s.anchor)
+    y = (x - anchor).astype(s.s1.dtype)
+    cross = s.cross + s.ring * y[None, :]
+    head = jnp.where(
+        (jnp.arange(lags) == s.n)[:, None], y[None, :], s.head
+    )
+    ring = jnp.concatenate([y[None, :], s.ring[:-1]], axis=0)
+    return StreamDiagState(
+        n=s.n + 1,
+        anchor=anchor,
+        s1=s.s1 + y,
+        s2=s.s2 + y * y,
+        cross=cross,
+        ring=ring,
+        head=head,
+    )
 
 
 class HMCState(NamedTuple):

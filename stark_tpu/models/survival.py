@@ -169,17 +169,20 @@ class CoxPH(Model):
         (tests/test_sharded.py).
         """
         eta = data["x"] @ p["beta"]  # (m,) this shard's contiguous rows
-        t = data["t"].astype(eta.dtype)
+        # tie-equality comparisons run in data["t"]'s NATIVE dtype: the
+        # unsharded log_lik compares native times, and under
+        # jax_enable_x64 an f32 downcast (to pack the gather) would merge
+        # near-tie blocks only on the sharded path (ADVICE r5)
+        t = data["t"]
         s = jax.lax.axis_index(axis_name)
         num_shards = jax.lax.psum(1, axis_name)  # static axis size
 
-        # 1+2 packed into ONE gather (same one-fused-collective habit as
-        # flatten_model's psum): per-shard (prefix total, first time)
+        # two tiny O(P) gathers: the prefix totals in eta's dtype and the
+        # first local times in their own dtype (packing both into one
+        # stack would force the time downcast the tie fix exists to avoid)
         prefix_l = _cumulative_logsumexp(eta)
-        g1 = jax.lax.all_gather(
-            jnp.stack([prefix_l[-1], t[0]]), axis_name
-        )  # (P, 2)
-        totals, firsts = g1[:, 0], g1[:, 1]
+        totals = jax.lax.all_gather(prefix_l[-1], axis_name)  # (P,)
+        firsts = jax.lax.all_gather(t[0], axis_name)  # (P,) native dtype
 
         # exclusive cross-shard carry (log-space) onto the local prefix
         carry = jax.scipy.special.logsumexp(

@@ -435,7 +435,23 @@ def _make_vg_off(link):
 _vg_off = _make_vg_off("bernoulli_logit")
 
 
-@functools.partial(jax.jit, static_argnames=("lane_tile", "interpret"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("lane_tile", "interpret", "_precision", "_x_dtype"),
+)
+def _loglik_vg_jit(beta, xt, y, *, lane_tile, interpret, _precision,
+                   _x_dtype):
+    # _precision/_x_dtype are cache-key-only statics: _fused_call re-reads
+    # the STARK_FUSED_PRECISION / STARK_FUSED_X_DTYPE knobs at trace time,
+    # so keying the executable on the resolved values is what forces a
+    # retrace when a knob changes mid-process (ADVICE r5: a module-level
+    # jit otherwise reuses the stale executable for same-shape calls,
+    # silently violating the "numerics never change silently" contract)
+    del _precision, _x_dtype
+    return _fused_call(beta, xt, y, None, lane_tile=lane_tile,
+                       interpret=interpret)
+
+
 def logistic_loglik_value_and_grad(
     beta: jax.Array,
     xt: jax.Array,
@@ -448,7 +464,10 @@ def logistic_loglik_value_and_grad(
 
     beta: (D,), xt: (D, N) float32 — X TRANSPOSED — y: (N,) in {0, 1}.
     """
-    return _fused_call(beta, xt, y, None, lane_tile=lane_tile, interpret=interpret)
+    return _loglik_vg_jit(
+        beta, xt, y, lane_tile=lane_tile, interpret=interpret,
+        _precision=_dot_precision(), _x_dtype=_x_stream_dtype(),
+    )
 
 
 @jax.custom_vjp

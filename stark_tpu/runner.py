@@ -60,6 +60,9 @@ class AdaptiveResult(Posterior):
         self.converged = converged
         self.wall_s = wall_s
         self.budget_exhausted = False
+        # estimated draws beyond the ESS target at the measured ESS rate
+        # (None when unconverged or no rate estimate) — see run_end trace
+        self.overshoot_draws = None
 
 
 _ADAPT_KEYS = ("z", "log_eps", "log_T", "inv_mass")
@@ -174,6 +177,9 @@ def _sample_until_converged(
     adapt_touchup_frac: float = 0.2,
     trace: Optional[Any] = None,
     sync_blocks: Optional[bool] = None,
+    stream_diag: Optional[bool] = None,
+    adaptive_blocks: Optional[bool] = None,
+    diag_lags: Optional[int] = None,
     **cfg_kwargs,
 ) -> AdaptiveResult:
     """Run chains until R-hat < rhat_target AND min-ESS > ess_target.
@@ -242,6 +248,41 @@ def _sample_until_converged(
     checkpoints are bit-identical in both modes (only timing fields and
     the overlap trace fields differ); the serial mode exists for
     debugging and as the equivalence oracle in tests.
+
+    ``stream_diag`` (default: on; ``STARK_STREAM_DIAG=0`` escape hatch):
+    the compiled draw blocks additionally carry an ON-DEVICE streaming-
+    diagnostics accumulator (`kernels.base.StreamDiagState` — Welford
+    moments + lag-1..``diag_lags`` autocovariance sums, per chain per
+    coordinate), and the per-block ESS signal comes from
+    `diagnostics.ess_from_suffstats` on that O(chains*d*L) summary
+    instead of the full-history FFT pass over the worst-k components —
+    the convergence gate's host transfer stops scaling with the draw
+    count (the ``diag_bytes_to_host`` trace field documents it).  The
+    streaming estimate is an ESS LOWER BOUND (truncation errs
+    conservative), and it only decides *when to look*: every candidate
+    stop is still validated by the same full split-R-hat/ESS pass over
+    all draws before the run may stop.  Draws/checkpoints are unaffected
+    (the accumulator only consumes the draw stream); with the flag off
+    the runner is bit-identical to the pre-streaming behavior.
+
+    ``adaptive_blocks`` (default: on; ``STARK_ADAPTIVE_BLOCKS=0`` escape
+    hatch): replaces the fixed ``block_size`` march with an ESS-rate
+    forecaster.  Blocks grow geometrically (block_size/2 -> block_size ->
+    2x -> 4x, capped) while far from the target, and once an ESS rate is
+    measurable the next block is sized to the forecast deficit
+    ``(ess_target - min_ess)/rate`` (quantized to the geometric ladder to
+    bound compile variants), so a converging run stops within about one
+    small block of the target instead of overshooting by a full fixed
+    block.  The TOTAL draw budget is unchanged — ``max_blocks *
+    block_size`` draws per chain, so a budget-bounded run
+    (``rhat_target=0``) draws exactly the same total as the fixed march,
+    only the block boundaries (and checkpoint cadence) differ;
+    ``min_blocks`` still counts blocks, so the earliest stop comes after
+    ``min_blocks`` (now smaller) blocks, always full-pass
+    validated.  With the flag off the historical
+    fixed-size loop runs bit-exactly.  ``diag_lags`` (default
+    `kernels.base.STREAM_DIAG_LAGS` = 50) sets the autocovariance
+    truncation L.
     """
     cfg = SamplerConfig(**cfg_kwargs)
     if backend is None:
@@ -253,6 +294,16 @@ def _sample_until_converged(
             f"{type(backend).__name__} does not support the adaptive "
             "runner (no adaptive_parts); use JaxBackend or ShardedBackend"
         )
+    # streaming diagnostics + adaptive block scheduling (see docstring).
+    # Env escape hatches restore the historical behavior bit-exactly.
+    from .kernels.base import STREAM_DIAG_LAGS
+
+    if stream_diag is None:
+        stream_diag = os.environ.get("STARK_STREAM_DIAG", "1") != "0"
+    if adaptive_blocks is None:
+        adaptive_blocks = os.environ.get("STARK_ADAPTIVE_BLOCKS", "1") != "0"
+    if diag_lags is None:
+        diag_lags = STREAM_DIAG_LAGS
     # multi-process meshes: every process drives identical blocks on its
     # shard of the chains and (after the collect allgather) holds
     # identical host state, so each writes its own state files — shared
@@ -306,6 +357,17 @@ def _sample_until_converged(
         ap = backend.adaptive_parts(model, cfg, data)
     fm, data, extra = ap.fm, ap.data, ap.extra
 
+    if sync_blocks is None:
+        # multi-process meshes run serial: collect is a process_allgather
+        # (distributed.gather_draws) — a dispatched computation that is
+        # stream-ordered AFTER an already-enqueued block k+1, so a
+        # prefetch there wouldn't overlap anything; it would delay block
+        # k's health check and checkpoint durability by a whole block
+        sync_blocks = (
+            os.environ.get("STARK_SYNC_BLOCKS", "") == "1"
+            or jax.process_count() > 1
+        )
+
     is_chees = cfg.kernel == "chees"
     if is_chees:
         # ensemble kernel: blocks advance the whole ensemble through
@@ -318,6 +380,15 @@ def _sample_until_converged(
         parts = ap.chees
         chees_init_j, chees_warm_j, chees_samp_j = (
             ap.init_j, ap.warm_j, ap.samp_j,
+        )
+        if stream_diag and ap.samp_diag is None:
+            stream_diag = False  # backend without the streaming segment
+        # donation of the diag carry is safe only when a block's
+        # accumulators are read back BEFORE the next block is dispatched
+        # — i.e. the serial loop; the pipeline reads block k's diag while
+        # block k+1 (which consumed it) is already in flight
+        chees_samp_diag_j = (
+            ap.samp_diag(donate=sync_blocks) if stream_diag else None
         )
 
         def save_warmup_checkpoint(path, carry, key, key_warm, done, nd, nl):
@@ -523,7 +594,24 @@ def _sample_until_converged(
                     )
             return carry, n_div, n_leap
     else:
-        v_block = ap.get_block(block_size)
+        if stream_diag:
+            try:  # probe: older/third-party backends lack the diag carry
+                ap.get_block(
+                    block_size, diag_lags=diag_lags, donate_diag=sync_blocks
+                )
+            except TypeError:
+                stream_diag = False
+
+        def get_v_block(length):
+            """Compiled block runner for ``length`` transitions — the
+            streaming-diagnostics variant when the feature is on (the
+            backend caches per (length, diag, donate))."""
+            if stream_diag:
+                return ap.get_block(
+                    length, diag_lags=diag_lags, donate_diag=sync_blocks
+                )
+            return ap.get_block(length)
+
         # warmup runs as block_size-bounded dispatches too (same
         # device-program length cap as the draw blocks; the monolithic
         # warmup faulted the axon tunnel at benchmark scale) — shared
@@ -813,16 +901,111 @@ def _sample_until_converged(
     # the resumed draw count so every mode walks the same sequence
     halton_start = int(suff.count[0])
 
-    if sync_blocks is None:
-        # multi-process meshes run serial: collect is a process_allgather
-        # (distributed.gather_draws) — a dispatched computation that is
-        # stream-ordered AFTER an already-enqueued block k+1, so a
-        # prefetch there wouldn't overlap anything; it would delay block
-        # k's health check and checkpoint durability by a whole block
-        sync_blocks = (
-            os.environ.get("STARK_SYNC_BLOCKS", "") == "1"
-            or jax.process_count() > 1
+    diag = None
+    if stream_diag:
+        # device-resident streaming-diagnostics carry, (chains,)-batched.
+        # A resume rebuilds it from the stored draws (host reference
+        # implementation of the same accumulator), so the gate's summary
+        # covers the WHOLE history, not just post-resume blocks.
+        from .kernels.base import StreamDiagState
+
+        host_diag = diagnostics.stream_diag_from_draws(
+            draws_hist.view()
+            if draws_hist.rows
+            else np.zeros((chains, 0, fm.ndim), np.float32),
+            diag_lags,
+            chains=chains,
+            ndim=fm.ndim,
+            dtype=np.dtype(state.z.dtype),
         )
+        diag = StreamDiagState(
+            **{k: ap.put_chains(v) for k, v in host_diag.items()}
+        )
+
+    # adaptive block scheduler (STARK_ADAPTIVE_BLOCKS): the fixed march is
+    # re-expressed as a DRAW budget so both modes draw the same total —
+    # only the block boundaries differ.  ``sched["points"]`` is the
+    # per-processed-block (draws, min_ess) trail the ESS-rate forecaster
+    # reads; it is seeded from the resumed metrics history so a resumed
+    # run reconstructs the SAME schedule decisions the original made.
+    max_draws = max_blocks * block_size
+    blk_quantum = max(1, block_size // 2)
+    blk_cap = max(block_size, 4 * block_size)
+    sched = {"points": [], "forecast_draws": None, "rate": None}
+    for _r in history:
+        _e = _r.get("min_ess")
+        sched["points"].append(
+            (int(_r.get("draws_per_chain", 0)),
+             float(_e) if _e is not None else None)
+        )
+    draws_dispatched = halton_start
+
+    def _rate_and_deficit(points):
+        """(rate, deficit) from a (draws, min_ess) trail — window rate over
+        the last two finite points when it is positive, else the
+        cumulative rate; deficit is vs the LAST finite point."""
+        usable = [p for p in points if p[1] is not None]
+        if not usable:
+            return None, None
+        draws_u, ess_u = usable[-1]
+        rate = None
+        if len(usable) >= 2:
+            dd = draws_u - usable[-2][0]
+            de = ess_u - usable[-2][1]
+            if dd > 0 and de > 0:
+                rate = de / dd
+        if rate is None and draws_u > 0 and ess_u > 0:
+            rate = ess_u / draws_u
+        return rate, ess_target - ess_u
+
+    def next_block_len():
+        """Length of the next dispatch.  Fixed mode: always block_size
+        (the historical loop).  Adaptive mode: geometric growth from
+        block_size/2 capped at 4x (ramp ordinal = GLOBAL block ordinal,
+        so a resumed run continues the ramp), shrunk to the ESS-forecast
+        deficit (quantized to multiples of the base quantum so at most
+        cap/quantum compiled block variants exist), and truncated to the
+        remaining draw budget.
+
+        REPLAY DETERMINISM: the forecast reads the stats trail only up to
+        block ``m-2`` when sizing block ``m`` — exactly what the
+        pipelined loop (which dispatches m before processing m-1) can
+        know.  The serial loop deliberately ignores its one-block-fresher
+        stats, and a resumed run re-reads the same window from the
+        checkpointed history, so serial, pipelined, and crash-resumed
+        runs all size every block identically — which is what keeps the
+        supervised replay bit-identical (chaos: inflight_block_replay).
+        """
+        if not adaptive_blocks:
+            return block_size
+        remaining = max_draws - draws_dispatched
+        if remaining <= 0:
+            return 0
+        m = blocks_dispatched  # 0-based ordinal of the next dispatch
+        n = min(blk_cap, blk_quantum * (2 ** min(m, 8)))
+        rate, deficit = _rate_and_deficit(sched["points"][: max(0, m - 1)])
+        if rate and deficit is not None and deficit > 0:
+            # 1.1 safety: the rate estimate is noisy, and undershooting
+            # repeatedly costs a host round-trip per correction
+            need = int(np.ceil(1.1 * deficit / rate))
+            need = -(-max(need, 1) // blk_quantum) * blk_quantum
+            n = min(n, max(need, blk_quantum))
+        return min(n, remaining)
+
+    def note_block_ess(min_ess, draws_now):
+        """Record one processed block's ESS; refresh the REPORTING
+        forecast (trace/metrics fields) from the full trail — the
+        scheduler itself reads the delayed window above."""
+        sched["points"].append(
+            (int(draws_now),
+             float(min_ess) if np.isfinite(min_ess) else None)
+        )
+        rate, deficit = _rate_and_deficit(sched["points"])
+        sched["rate"] = rate
+        sched["forecast_draws"] = (
+            int(draws_now + max(0.0, deficit) / rate) if rate else None
+        )
+
     # overlap accounting across blocks: host-side seconds of the previous
     # cycle (diagnostics + persistence + checkpoint) and the running
     # device-seconds-per-block estimate (exact whenever the host waited)
@@ -836,30 +1019,38 @@ def _sample_until_converged(
 
             draw_store = DrawStore(draw_store_path, chains, fm.ndim)
 
-        def dispatch_block(key_block, key_snap):
-            """ENQUEUE one draw block on the device without waiting, and
-            refresh the carried device state so the next dispatch chains
-            off it.  Returns the pending-block record `process_block`
-            materializes later: the ``state``/``step_size``/``inv_mass``
-            (and chees adaptation) refs inside it are what block k's
-            health check gates and block k's checkpoint persists, and
-            ``key`` is the host RNG key as of THIS split — stored in the
-            checkpoint regardless of how far ahead the pipeline has
-            already split for later blocks."""
-            nonlocal state, step_size, inv_mass, halton_start
+        def dispatch_block(key_block, key_snap, length):
+            """ENQUEUE one draw block of ``length`` transitions on the
+            device without waiting, and refresh the carried device state
+            so the next dispatch chains off it.  Returns the
+            pending-block record `process_block` materializes later: the
+            ``state``/``step_size``/``inv_mass`` (and chees adaptation)
+            refs inside it are what block k's health check gates and
+            block k's checkpoint persists, and ``key`` is the host RNG
+            key as of THIS split — stored in the checkpoint regardless of
+            how far ahead the pipeline has already split for later
+            blocks.  With streaming diagnostics on, the block also
+            carries the StreamDiagState accumulators; ``pend["diag"]`` is
+            the post-block summary the convergence gate collects."""
+            nonlocal state, step_size, inv_mass, halton_start, diag
             if is_chees:
                 nonlocal run_carry
                 # Halton jitter continues the global sampling sequence
                 # (draws already dispatched = halton_start), so a resumed,
                 # blocked, or pipelined run walks the SAME stream
                 us = jnp.asarray(
-                    2.0 * halton(block_size, start=halton_start), jnp.float32
+                    2.0 * halton(length, start=halton_start), jnp.float32
                 )
-                halton_start += block_size
-                bkeys = jax.random.split(key_block, block_size)
-                run_carry, (zs, accept, divergent, n_leap) = chees_samp_j(
-                    run_carry, bkeys, us, *extra
-                )
+                halton_start += length
+                bkeys = jax.random.split(key_block, length)
+                if stream_diag:
+                    run_carry, diag, (zs, accept, divergent, n_leap) = (
+                        chees_samp_diag_j(run_carry, diag, bkeys, us, *extra)
+                    )
+                else:
+                    run_carry, (zs, accept, divergent, n_leap) = chees_samp_j(
+                        run_carry, bkeys, us, *extra
+                    )
                 # failpoint: NaN-poison the carried state — injected where
                 # a real numerical fault would surface (health_check=True
                 # catches it before block k's checkpoint; with the check
@@ -875,12 +1066,22 @@ def _sample_until_converged(
                     "inv_mass": inv_mass,
                     "log_eps": run_carry.log_eps,
                     "log_T": run_carry.log_T,
+                    "diag": diag,
+                    "len": length,
                     "outs": {"zs": zs, "accept": accept,
                              "divergent": divergent, "n_leap": n_leap},
                 }
             block_keys = ap.put_chains(jax.random.split(key_block, chains))
-            out = v_block(block_keys, state, step_size, inv_mass, data)
-            new_state, zs, accept, divergent, _energy, ngrad = out
+            if stream_diag:
+                out = get_v_block(length)(
+                    block_keys, state, diag, step_size, inv_mass, data
+                )
+                new_state, diag, zs, accept, divergent, _energy, ngrad = out
+            else:
+                out = get_v_block(length)(
+                    block_keys, state, step_size, inv_mass, data
+                )
+                new_state, zs, accept, divergent, _energy, ngrad = out
             # per-chain kernels CARRY the (possibly poisoned) state into
             # the next dispatch — same rebinding as the serial loop
             new_state = faults.poison("runner.carried_nan", new_state)
@@ -890,6 +1091,8 @@ def _sample_until_converged(
                 "state": new_state,
                 "step_size": step_size,
                 "inv_mass": inv_mass,
+                "diag": diag,
+                "len": length,
                 "outs": {"zs": zs, "accept": accept,
                          "divergent": divergent, "ngrad": ngrad},
             }
@@ -973,14 +1176,31 @@ def _sample_until_converged(
             max_rhat = (
                 float(np.max(finite_rhat)) if finite_rhat.size else float("inf")
             )
-            # ESS only on the worst-mixing components (by streaming R-hat);
-            # NaN R-hat counts as worst — it flags a suspicious component
-            k = min(diag_components, fm.ndim)
-            worst = np.argsort(np.where(np.isnan(srhat), -np.inf, -srhat))[:k]
-            # one fancy index off the preallocated history buffer — the old
-            # per-block concatenate over the block list was O(blocks²)
-            subset = draws_hist.take(worst)
-            ess_vals = diagnostics.ess(subset)
+            if stream_diag:
+                # streaming gate: the ONLY device->host traffic the
+                # convergence signal needs is the O(chains*d*L)
+                # accumulator summary — constant per block, independent
+                # of the accumulated draw count (the draws themselves
+                # still stream to the DrawStore/history for persistence
+                # and the stop-time validation pass)
+                diag_host = ap.collect(pend["diag"])
+                diag_bytes = int(
+                    sum(np.asarray(a).nbytes for a in diag_host)
+                )
+                ess_vals = diagnostics.ess_from_suffstats(*diag_host)
+            else:
+                # legacy gate: ESS only on the worst-mixing components (by
+                # streaming R-hat); NaN R-hat counts as worst — it flags a
+                # suspicious component.  One fancy index off the
+                # preallocated history buffer — still O(draws * k) host
+                # work and memory traffic per block
+                k = min(diag_components, fm.ndim)
+                worst = np.argsort(
+                    np.where(np.isnan(srhat), -np.inf, -srhat)
+                )[:k]
+                subset = draws_hist.take(worst)
+                diag_bytes = int(subset.nbytes)
+                ess_vals = diagnostics.ess(subset)
             finite_ess = ess_vals[np.isfinite(ess_vals)]
             # NaN ESS values (stuck components) are excluded from the
             # reported minimum — num_stuck_components carries that signal;
@@ -989,6 +1209,7 @@ def _sample_until_converged(
                 float(np.min(finite_ess)) if finite_ess.size else float("nan")
             )
             draws_per_chain = int(suff.count[0])
+            note_block_ess(min_ess, draws_per_chain)
             rec = {
                 "event": "block",
                 "block": blocks_done,
@@ -1016,11 +1237,35 @@ def _sample_until_converged(
                 ),
                 "wall_s": time.perf_counter() - t_start,
             }
-            if (
-                blocks_done >= min_blocks
-                and n_stuck == 0
+            if stream_diag:
+                # new fields ride ONLY the streaming mode, so the
+                # flags-off metrics trail stays byte-identical to the
+                # historical runner
+                rec["diag_bytes_to_host"] = diag_bytes
+                if sched["forecast_draws"] is not None:
+                    rec["ess_forecast"] = sched["forecast_draws"]
+            # failpoint: force the streaming gate optimistic (arm with the
+            # ``nan`` data directive) — the candidate stop then reaches
+            # the full validation pass early, which must reject it; the
+            # tier-1 guard test drills exactly this never-stop-on-a-
+            # rejected-validation invariant
+            forced_opt = (
+                faults.fail_point("runner.gate.optimistic") is not None
+            )
+            # min_blocks counts BLOCKS in both modes: under the adaptive
+            # scheduler the early blocks are smaller, so the earliest
+            # possible stop moves from min_blocks*block_size draws to
+            # min_blocks small blocks — the full validation pass still
+            # gates every stop on the complete history
+            min_gate = blocks_done >= min_blocks
+            gate_pass = (
+                n_stuck == 0
                 and max_rhat < rhat_target
                 and min_ess > ess_target
+            )
+            if (
+                min_gate
+                and (gate_pass or forced_opt)
                 and blocks_done >= next_full_check
             ):
                 # candidate stop: validate with the full split-form pass
@@ -1144,7 +1389,19 @@ def _sample_until_converged(
                     device_idle_s=round(idle, 4),
                     pipelined=not sync_blocks,
                     draws_per_chain=draws_per_chain,
+                    block_len=pend["len"],
                     block_grad_evals=blk_grads,
+                    # convergence-gate transfer accounting: constant
+                    # O(chains*d*L) with streaming diagnostics, O(draws*k)
+                    # under the legacy full-history gate — the contrast
+                    # trace_report's diagnostics table renders
+                    stream_diag=stream_diag,
+                    diag_bytes_to_host=diag_bytes,
+                    **(
+                        {"ess_forecast": sched["forecast_draws"]}
+                        if sched["forecast_draws"] is not None
+                        else {}
+                    ),
                 )
                 trace.emit(
                     "chain_health",
@@ -1226,8 +1483,12 @@ def _sample_until_converged(
 
         def dispatch_next():
             """Split the next block's key on the HOST (identical stream in
-            serial and pipelined order) and enqueue the block."""
-            nonlocal key, blocks_dispatched, profile_next
+            serial and pipelined order), size the block (fixed or
+            ESS-forecast adaptive), and enqueue it."""
+            nonlocal key, blocks_dispatched, profile_next, draws_dispatched
+            length = next_block_len()
+            if length <= 0:
+                return None
             key, key_block = jax.random.split(key)
             t_enq = time.perf_counter()
             if profile_next:
@@ -1236,19 +1497,34 @@ def _sample_until_converged(
                 # trace, then pipeline from the next block on
                 profile_next = False
                 with jax.profiler.trace(profile_dir):
-                    pend = dispatch_block(key_block, key)
+                    pend = dispatch_block(key_block, key, length)
                     jax.block_until_ready(pend["outs"])
             else:
-                pend = dispatch_block(key_block, key)
+                pend = dispatch_block(key_block, key, length)
             pend["t_enq"] = time.perf_counter() - t_enq
             blocks_dispatched += 1
+            draws_dispatched += length
             return pend
 
-        while blocks_done < max_blocks:
+        def can_dispatch():
+            # the fixed march counts BLOCKS (bit-exact legacy loop); the
+            # adaptive scheduler budgets DRAWS — same total either way
+            if adaptive_blocks:
+                return draws_dispatched < max_draws
+            return blocks_dispatched < max_blocks
+
+        def keep_running():
+            if adaptive_blocks:
+                return draws_hist.rows < max_draws
+            return blocks_done < max_blocks
+
+        while keep_running():
             if pending is None:
                 pending = dispatch_next()
+                if pending is None:
+                    break
             current, pending = pending, None
-            if not sync_blocks and blocks_dispatched < max_blocks:
+            if not sync_blocks and can_dispatch():
                 # the overlap: block k+1 starts on the device while the
                 # host processes block k below
                 pending = dispatch_next()
@@ -1280,6 +1556,17 @@ def _sample_until_converged(
         wall_s=time.perf_counter() - t_start,
     )
     result.budget_exhausted = budget_exhausted
+    # overshoot accounting: estimated draws spent beyond what the ESS
+    # target needed (at the measured rate) — the number the adaptive
+    # scheduler exists to drive toward ~one small block; surfaced in the
+    # trace so BENCH artifacts can show the win
+    overshoot = None
+    final_pts = [p for p in sched["points"] if p[1] is not None]
+    if converged and sched["rate"] and final_pts:
+        overshoot = int(
+            max(0.0, (final_pts[-1][1] - ess_target) / sched["rate"])
+        )
+    result.overshoot_draws = overshoot
     if trace.enabled:
         trace.emit(
             "run_end",
@@ -1288,5 +1575,9 @@ def _sample_until_converged(
             blocks=blocks_done,
             num_divergent=total_div,
             budget_exhausted=budget_exhausted,
+            stream_diag=stream_diag,
+            adaptive_blocks=adaptive_blocks,
+            **({"overshoot_draws": overshoot} if overshoot is not None
+               else {}),
         )
     return result

@@ -381,7 +381,8 @@ def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):
     return run
 
 
-def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int):
+def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int,
+                      diag_lags: Optional[int] = None):
     """One draw block for the segmented/adaptive drivers, jit/vmap-able
     per chain:
       block_run(key, state, step_size, inv_mass, data)
@@ -392,9 +393,20 @@ def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int):
     R-hat<1.01 — the primary metric — is measured without paying a host
     round-trip per transition.  Warmup has its own dispatch-bounded API
     (``make_segmented_warmup``).
+
+    ``diag_lags`` (streaming diagnostics, STARK_STREAM_DIAG): when set,
+    the block additionally carries a `kernels.base.StreamDiagState`
+    through the scan — Welford moments + lag-1..L autocovariance sums
+    updated per transition ON DEVICE — and the signature becomes
+      block_run(key, state, diag, step_size, inv_mass, data)
+        -> (HMCState, StreamDiagState, zs, accept, divergent, energy,
+            ngrad)
+    so the adaptive runner's convergence gate transfers O(d*L) sufficient
+    statistics per chain per block instead of re-reading the draw history
+    (`diagnostics.ess_from_suffstats`).
     """
     step_kernel = make_kernel(cfg)
-    from .kernels.base import scan_progress
+    from .kernels.base import scan_progress, stream_diag_update
 
     # clamp to the block length: an interval longer than one dispatch
     # block would otherwise never fire (scan indices restart per block;
@@ -404,14 +416,20 @@ def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int):
         min(cfg.progress_every, block_size) if cfg.progress_every else None,
     )
 
-    def block_run(key, state, step_size, inv_mass, data=None):
+    def _block_scan(key, state, diag, step_size, inv_mass, data):
+        """The ONE per-chain block scan serving both variants —
+        ``diag=None`` (resolved at trace time) compiles the historical
+        plain block; the streaming accumulator is threaded through the
+        carry otherwise.  One body so the transitions cannot drift
+        between the stream-on and stream-off compiled programs."""
         potential_fn = fm.bind(data)
         kernel = partial(step_kernel, potential_fn=potential_fn)
         # state was checkpointed/carried as raw arrays; rebuild gradient
         # lazily only if absent is not possible under jit, so the carried
         # state must include pe/grad (it does — HMCState is the carry).
 
-        def body(state, x):
+        def body(carry, x):
+            state, diag = carry
             # (index, key) only under the heartbeat — see make_chain_runner
             i, key = x if tick is not None else (None, x)
             state, info = kernel(
@@ -419,6 +437,8 @@ def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int):
             )
             if tick is not None:
                 tick(i, info.accept_prob)
+            if diag is not None:
+                diag = stream_diag_update(diag, state.z)
             out = (
                 state.z,
                 info.accept_prob,
@@ -426,16 +446,28 @@ def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int):
                 info.energy,
                 info.num_grad_evals,
             )
-            return state, out
+            return (state, diag), out
 
         keys = jax.random.split(key, block_size)
         xs = (jnp.arange(block_size), keys) if tick is not None else keys
-        state, (zs, accept, divergent, energy, ngrad) = jax.lax.scan(
-            body, state, xs
+        return jax.lax.scan(body, (state, diag), xs)
+
+    def block_run(key, state, step_size, inv_mass, data=None):
+        (state, _), (zs, accept, divergent, energy, ngrad) = _block_scan(
+            key, state, None, step_size, inv_mass, data
         )
         return state, zs, accept, divergent, energy, ngrad
 
-    return block_run
+    if diag_lags is None:
+        return block_run
+
+    def block_run_diag(key, state, diag, step_size, inv_mass, data=None):
+        (state, diag), (zs, accept, divergent, energy, ngrad) = _block_scan(
+            key, state, diag, step_size, inv_mass, data
+        )
+        return state, diag, zs, accept, divergent, energy, ngrad
+
+    return block_run_diag
 
 
 def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,

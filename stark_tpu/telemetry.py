@@ -485,6 +485,11 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                      "device_idle_frac"} | {},   # block-pipeline totals,
                                                  # when the writer emitted
                                                  # the overlap fields
+         "diag": {"stream_diag", "bytes_last", "bytes_max", "bytes_total",
+                  "ess_forecast_last", "adaptive_blocks",
+                  "overshoot_draws"} | {},       # streaming-diagnostics /
+                                                 # adaptive-scheduler
+                                                 # accounting, when emitted
          "restarts": int, "events": int}
 
     ``overlap`` aggregates the runner's pipelined ``sample_block``
@@ -492,6 +497,12 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     estimated device idle, total host wait, and the idle fraction
     (device_idle_s / total sample_block time — 0.0 when the device never
     starved).
+
+    ``diag`` aggregates the convergence-gate transfer accounting
+    (``diag_bytes_to_host`` per ``sample_block``: constant O(chains*d*L)
+    with streaming diagnostics on, growing O(draws*k) under the legacy
+    full-history gate), the last ESS forecast (predicted draws-per-chain
+    to reach the ESS target), and ``run_end``'s ``overshoot_draws``.
     """
     restarts_by_run: Dict[int, int] = {}
     for e in events:
@@ -501,7 +512,8 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     runs = sorted({e.get("run", 0) for e in events})
     if not runs:
         return {"run": 0, "meta": {}, "wall_s": None, "phases": {},
-                "health": {}, "restarts": 0, "events": 0}
+                "health": {}, "overlap": {}, "diag": {}, "restarts": 0,
+                "events": 0}
     run = runs[-1] if run is None else run
     evs = [e for e in events if e.get("run", 0) == run]
     # restart chain: the selected run's own restarts (it may itself be a
@@ -516,6 +528,7 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     phases: Dict[str, Dict[str, float]] = {}
     health: Dict[str, Any] = {}
     overlap: Dict[str, float] = {}
+    diag: Dict[str, Any] = {}
     saw_overlap = False
     wall = None
     div_latest = None
@@ -527,6 +540,19 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                 if e.get(k) is not None:
                     saw_overlap = True
                     overlap[k] = overlap.get(k, 0.0) + float(e[k])
+            if e.get("diag_bytes_to_host") is not None:
+                b = int(e["diag_bytes_to_host"])
+                diag["bytes_last"] = b
+                diag["bytes_max"] = max(diag.get("bytes_max", 0), b)
+                diag["bytes_total"] = diag.get("bytes_total", 0) + b
+            if e.get("stream_diag") is not None:
+                diag["stream_diag"] = bool(e["stream_diag"])
+            if e.get("ess_forecast") is not None:
+                diag["ess_forecast_last"] = e["ess_forecast"]
+        elif ev == "run_end":
+            for k in ("overshoot_draws", "adaptive_blocks"):
+                if e.get(k) is not None:
+                    diag[k] = e[k]
         if ev == "run_start":
             meta = {
                 k: v for k, v in e.items()
@@ -591,6 +617,7 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
         },
         "health": health,
         "overlap": overlap if saw_overlap else {},
+        "diag": diag,
         "restarts": restarts_total,
         "events": len(evs),
     }

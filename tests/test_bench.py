@@ -109,7 +109,10 @@ def test_runner_time_budget_and_progress_cb():
         seed=0,
     )
     assert post.budget_exhausted and not post.converged
-    assert post.draws_flat.shape[1] == 25  # exactly one block's draws kept
+    # exactly one block's draws kept (the adaptive scheduler's first
+    # block is block_size//2; the fixed march's is block_size)
+    assert post.draws_flat.shape[1] == post.history[-1]["draws_per_chain"]
+    assert 0 < post.draws_flat.shape[1] <= 25
     assert events[0] == "warmup_done"
     assert events.count("block") == 1
     assert events[-1] == "budget_exhausted"

@@ -291,6 +291,36 @@ def test_x_stream_dtype_knob(monkeypatch):
         _x_stream_dtype()
 
 
+def test_precision_knob_in_jit_cache_key(monkeypatch):
+    """Toggling STARK_FUSED_PRECISION / STARK_FUSED_X_DTYPE mid-process
+    must retrace the module-level-jitted public helper, never reuse the
+    stale same-shape executable (ADVICE r5): the resolved knob values are
+    threaded into the jit cache key as call-time statics."""
+    from stark_tpu.ops.logistic_fused import (
+        _loglik_vg_jit,
+        logistic_loglik_value_and_grad,
+    )
+
+    monkeypatch.delenv("STARK_FUSED_PRECISION", raising=False)
+    monkeypatch.delenv("STARK_FUSED_X_DTYPE", raising=False)
+    rng = np.random.default_rng(0)
+    xt = jnp.asarray(rng.standard_normal((4, 64)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, 64), jnp.float32)
+    beta = jnp.asarray(rng.standard_normal(4), jnp.float32)
+    v0, g0 = logistic_loglik_value_and_grad(beta, xt, y)
+    n0 = _loglik_vg_jit._cache_size()
+    # same shapes + same knobs: cache hit, no retrace
+    logistic_loglik_value_and_grad(beta, xt, y)
+    assert _loglik_vg_jit._cache_size() == n0
+    # knob change: a FRESH executable must be traced for the same shapes
+    monkeypatch.setenv("STARK_FUSED_PRECISION", "high")
+    v1, g1 = logistic_loglik_value_and_grad(beta, xt, y)
+    assert _loglik_vg_jit._cache_size() == n0 + 1
+    # CPU f32 dots are exact, so the numerics agree on the test host
+    np.testing.assert_allclose(np.asarray(v0), np.asarray(v1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1), rtol=1e-6)
+
+
 def test_grouped_lane_tile_env_cap(monkeypatch):
     """STARK_GROUPED_LANE_TILE caps the starting tile so large chain
     batches (C=128) can trade tile size for VMEM instead of being refused

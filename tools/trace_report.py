@@ -111,6 +111,30 @@ def render_run(events, run) -> str:
         ))
         out.append("")
 
+    # streaming-diagnostics / adaptive-scheduler accounting: what the
+    # convergence gate transferred per block (constant O(chains*d*L) with
+    # streaming on, growing with the history under the legacy gate), the
+    # last ESS forecast, and the end-of-run overshoot estimate
+    dg = s.get("diag") or {}
+    if dg:
+        def _bytes(v):
+            return None if v is None else f"{v / 1024.0:.1f} KiB"
+
+        rows = [
+            ("streaming gate", dg.get("stream_diag")),
+            ("adaptive blocks", dg.get("adaptive_blocks")),
+            ("gate transfer / block (last)", _bytes(dg.get("bytes_last"))),
+            ("gate transfer / block (max)", _bytes(dg.get("bytes_max"))),
+            ("gate transfer total", _bytes(dg.get("bytes_total"))),
+            ("ESS forecast (draws/chain)", dg.get("ess_forecast_last")),
+            ("overshoot (draws/chain)", dg.get("overshoot_draws")),
+        ]
+        out.append(_table(
+            [r for r in rows if r[1] is not None],
+            ("diagnostics transfer", "value"),
+        ))
+        out.append("")
+
     h = s["health"]
     if h:
         keys = (
