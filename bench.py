@@ -301,6 +301,12 @@ def main():
     from stark_tpu.platform import ensure_live_platform
 
     fell_back = ensure_live_platform(_env_int("BENCH_PROBE_TIMEOUT", 180))
+    # live run-health exporter (stark_tpu.statusd): STARK_STATUS_PORT=N
+    # serves /metrics /healthz /status for the whole bench (all supervised
+    # attempts); unset -> no server thread, nothing imported into the loop
+    from stark_tpu.statusd import maybe_start_from_env
+
+    maybe_start_from_env()
     import numpy as np
 
     import stark_tpu
@@ -492,6 +498,22 @@ def main():
     chees_converged = False
     chees_overlap = {}  # block-pipeline overlap from the supervised trace
     chees_diag = {}  # streaming-gate transfer + overshoot, same trace
+    # ChEES workload knobs, resolved ONCE: the sampling leg below and the
+    # ledger config key both read these — two copies of the defaults
+    # would let them drift, silently splitting the ledger's comparability
+    # groups.  grouped kernel: group offsets + group gradient fused into
+    # the Pallas pass over group-sorted rows — measured 11.8 -> 2.1 ms
+    # per ensemble gradient (C=32, N=1M, on-chip K=100 amortized);
+    # BENCH_GROUPED=0 falls back to the offset-path kernel.
+    # C=64 measured 19.2 ESS/s vs 14.8 at C=32 (grouped kernel,
+    # 2026-07-31): the ensemble gradient's X stream is shared, so
+    # doubling chains nearly doubles min-ESS at sublinear wall cost.
+    # The offset-path escape hatch keeps its measured C=32 configuration
+    # so BENCH_GROUPED=0 reproduces the r3 baseline.
+    grouped = os.environ.get("BENCH_GROUPED", "1") == "1"
+    cc = _env_int("BENCH_CHEES_CHAINS", 64 if grouped else 32)
+    chees_warm = _env_int("BENCH_CHEES_WARMUP", 400)
+    chees_samp = _env_int("BENCH_CHEES_SAMPLES", 500)
     if try_chees == "1" or (
         try_chees == "auto" and (platform != "cpu" or fell_back)
     ):
@@ -502,28 +524,15 @@ def main():
             )
             from stark_tpu.supervise import supervised_sample
 
-            # grouped kernel: group offsets + group gradient fused into the
-            # Pallas pass over group-sorted rows — measured 11.8 -> 2.1 ms
-            # per ensemble gradient (C=32, N=1M, on-chip K=100 amortized);
-            # BENCH_GROUPED=0 falls back to the offset-path kernel
-            grouped = os.environ.get("BENCH_GROUPED", "1") == "1"
             if grouped:
                 fused = FusedHierLogisticGrouped(
                     num_features=d, num_groups=groups
                 )
             else:
                 fused = FusedHierLogistic(num_features=d, num_groups=groups)
-            # C=64 measured 19.2 ESS/s vs 14.8 at C=32 (grouped kernel,
-            # 2026-07-31): the ensemble gradient's X stream is shared, so
-            # doubling chains nearly doubles min-ESS at sublinear wall
-            # cost.  The offset-path escape hatch keeps its measured C=32
-            # configuration so BENCH_GROUPED=0 reproduces the r3 baseline.
-            cc = _env_int("BENCH_CHEES_CHAINS", 64 if grouped else 32)
             # MAP init is what makes the metric adapt (random init leaves
             # eps ~0.007 and warmup never recovers); NUTS at a 200+200
             # budget measured 0.05 ESS/s unconverged vs ChEES converged
-            chees_warm = _env_int("BENCH_CHEES_WARMUP", 400)
-            chees_samp = _env_int("BENCH_CHEES_SAMPLES", 500)
             # cap the block even without a dispatch bound: one monolithic
             # 500-draw block means no mid-sampling checkpoint and no
             # progress signal (the CPU-fallback validation spent 1.8h in
@@ -721,6 +730,40 @@ def main():
             # BENCH_AUTODIFF=0 opt-out is respected even here
             timed_run(model, "NUTS autodiff")
 
+    def append_ledger_row(bench_dict, sampler):
+        """Cross-run perf regression ledger (stark_tpu.ledger): append
+        this run's headline numbers so `tools/perf_ledger.py check` can
+        gate the NEXT run against the trailing median.  Best-effort by
+        contract — a full disk must not turn a measured bench into a
+        failure — and STARK_PERF_LEDGER=0 opts out (tiny-scale tests)."""
+        try:
+            from stark_tpu import ledger as perf_ledger
+
+            ledger_path = perf_ledger.default_ledger_path()
+            if ledger_path is None:
+                return
+            row = perf_ledger.make_row(
+                source="bench.py",
+                # comparability key: every axis that changes the measured
+                # workload — rows gate only against identical configs.
+                # The sampler axis matters because the value can come
+                # from a fallback NUTS leg when ChEES failed/unconverged;
+                # its rows must never pollute the ChEES trailing median.
+                config=(
+                    f"flagship:n={n}:d={d}:g={groups}"
+                    f":cc={cc}:w={chees_warm}:s={chees_samp}"
+                    f":grouped={int(grouped)}"
+                    f":platform={platform}:fallback={fell_back}"
+                    f":sampler={sampler}"
+                ),
+                bench=bench_dict,
+            )
+            perf_ledger.append_row(row, ledger_path)
+            print(f"[bench] perf ledger row appended to {ledger_path}",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — the ledger must not fail the bench
+            print(f"[bench] perf ledger append failed: {e!r}", file=sys.stderr)
+
     picked = select_result(results)
     if picked is None:
         print(json.dumps({"metric": "bench failed: no result", "value": 0.0,
@@ -729,6 +772,18 @@ def main():
                           "platform": platform,
                           "accelerator_fallback": fell_back}),
               flush=True)
+        # a totally failed bench must still land in the ledger — with
+        # value 0.0 it FAILS the next `perf_ledger.py check` instead of
+        # leaving the gate staring at the previous good row (a measured
+        # zero effective-samples-per-second is what the run delivered).
+        # Filed under the flagship ChEES config key: that is the row
+        # series this run failed to extend, so the 0.0 gates against its
+        # healthy median rather than opening a fresh no-history config.
+        append_ledger_row(
+            {"value": 0.0, "wall_s": time.perf_counter() - t_bench,
+             "converged": False},
+            sampler=f"ChEES supervised, {cc} chains",
+        )
         return
     sampler_tag, ess_per_sec, rhat, converged = picked
 
@@ -815,8 +870,7 @@ def main():
     # strict JSON even when diagnostics go non-finite (stuck components
     # propagate NaN through min_ess/max_rhat): non-finite -> null / 0.0,
     # mirroring the runner's metrics-path guard
-    print(
-        json.dumps(
+    final = (
             {
                 "metric": "min-ESS/sec/chip, hierarchical logistic "
                 f"N={n} ({sampler_tag})",
@@ -878,9 +932,9 @@ def main():
                 ),
                 "wall_s": round(time.perf_counter() - t_bench, 1),
             }
-        ),
-        flush=True,
     )
+    print(json.dumps(final), flush=True)
+    append_ledger_row(final, sampler=sampler_tag)
 
 
 def remeasure_cpu_record():

@@ -13,6 +13,13 @@ human diagnostics go through the module logger to stderr.
 — schema-versioned JSONL events (phase timings, chain health) appended to
 PATH; render with ``python tools/trace_report.py PATH`` (see README
 "Observability").
+
+``--status-port N`` (or ``STARK_STATUS_PORT``) additionally serves the
+LIVE view of the same events over HTTP while the run is in flight:
+``/metrics`` (Prometheus text), ``/healthz`` (200/503 from the watchdog
+deadman + restart-budget state), ``/status`` (JSON snapshot).  Off by
+default — with no port configured no server thread starts.  Probe a
+running exporter with ``python -m stark_tpu status --port N``.
 """
 
 from __future__ import annotations
@@ -29,16 +36,33 @@ log = logging.getLogger("stark_tpu.cli")
 @contextlib.contextmanager
 def _traced(args):
     """Install a RunTrace as the ambient telemetry trace when --trace was
-    given; otherwise leave the (NullTrace) default in place."""
+    given; otherwise leave the (NullTrace) default in place.
+
+    ``--status-port`` / ``STARK_STATUS_PORT`` additionally starts the live
+    HTTP exporter (stark_tpu.statusd) — and, when no ``--trace`` path was
+    given, installs an in-memory ``RunTrace(None)`` bus so the exporter
+    still sees the run's events without writing a file.  The server is a
+    process daemon: it survives supervised restart attempts and is left
+    running until process exit (the final scrape of a finished run must
+    not race a teardown).
+    """
     path = getattr(args, "trace", None)
-    if not path:
+    status_port = getattr(args, "status_port", None)
+    # one source of truth for "is a port configured" (flag/env/=0-opt-out
+    # resolution): statusd.resolve_port via maybe_start_from_env — the
+    # import is cheap (no jax) and nothing starts when no port resolves
+    from .statusd import maybe_start_from_env
+
+    server = maybe_start_from_env(status_port)
+    if not path and server is None:
         yield None
         return
     from .telemetry import RunTrace, use_trace
 
-    with RunTrace(path) as tr, use_trace(tr):
+    with RunTrace(path if path else None) as tr, use_trace(tr):
         yield tr
-    log.info("trace written to %s", path)
+    if path:
+        log.info("trace written to %s", path)
 
 
 def _cmd_run(args) -> int:
@@ -170,6 +194,38 @@ def _cmd_chaos(args) -> int:
     return 0 if all(r["ok"] for r in results) else 1
 
 
+def _cmd_status(args) -> int:
+    """Probe a running exporter's endpoints (stark_tpu.statusd).
+
+    Prints the response body; the exit code follows the probe —
+    ``--healthz`` exits 0 on 200 and 1 on 503 (the shell-scriptable
+    deadman check), any endpoint exits 2 when nothing is listening.
+    """
+    import urllib.error
+    import urllib.request
+
+    from .statusd import resolve_port
+
+    port = resolve_port(args.port)
+    if port is None:
+        log.error("no port: pass --port or set STARK_STATUS_PORT")
+        return 2
+    endpoint = (
+        "healthz" if args.healthz else "metrics" if args.metrics else "status"
+    )
+    url = f"http://{args.host}:{port}/{endpoint}"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            print(resp.read().decode(), end="")
+            return 0
+    except urllib.error.HTTPError as e:
+        print(e.read().decode(), end="")
+        return 1 if e.code == 503 else 2
+    except OSError as e:
+        log.error("no exporter at %s: %s", url, e)
+        return 2
+
+
 def _cmd_list(args) -> int:
     from .benchmarks import ALL_BENCHMARKS
     from .config import _model_registry, _synth_registry
@@ -201,15 +257,22 @@ def main(argv=None) -> int:
         help="append schema-versioned JSONL run telemetry to PATH "
         "(render with tools/trace_report.py)",
     )
+    status_kw = dict(
+        type=int, metavar="PORT", default=None,
+        help="serve live /metrics /healthz /status on PORT while the run "
+        "is in flight (STARK_STATUS_PORT also works; off by default)",
+    )
 
     p_run = sub.add_parser("run", help="run a YAML config")
     p_run.add_argument("config")
     p_run.add_argument("--trace", **trace_kw)
+    p_run.add_argument("--status-port", **status_kw)
     p_run.set_defaults(fn=_cmd_run)
 
     p_bench = sub.add_parser("bench", help="run a named benchmark at smoke scale")
     p_bench.add_argument("name")
     p_bench.add_argument("--trace", **trace_kw)
+    p_bench.add_argument("--status-port", **status_kw)
     p_bench.set_defaults(fn=_cmd_bench)
 
     p_all = sub.add_parser(
@@ -217,6 +280,7 @@ def main(argv=None) -> int:
     )
     p_all.add_argument("--update-baseline", metavar="PATH", default=None)
     p_all.add_argument("--trace", **trace_kw)
+    p_all.add_argument("--status-port", **status_kw)
     p_all.set_defaults(fn=_cmd_bench_all)
 
     p_chaos = sub.add_parser(
@@ -236,7 +300,29 @@ def main(argv=None) -> int:
         help="list scenario names and exit",
     )
     p_chaos.add_argument("--trace", **trace_kw)
+    p_chaos.add_argument("--status-port", **status_kw)
     p_chaos.set_defaults(fn=_cmd_chaos)
+
+    p_status = sub.add_parser(
+        "status",
+        help="probe a running exporter (/status by default; see "
+        "--healthz/--metrics)",
+    )
+    p_status.add_argument(
+        "--port", type=int, default=None,
+        help="exporter port (default: STARK_STATUS_PORT)",
+    )
+    p_status.add_argument("--host", default="127.0.0.1")
+    p_status.add_argument("--timeout", type=float, default=5.0)
+    probe = p_status.add_mutually_exclusive_group()
+    probe.add_argument(
+        "--healthz", action="store_true",
+        help="probe /healthz; exit 0 on 200, 1 on 503",
+    )
+    probe.add_argument(
+        "--metrics", action="store_true", help="dump /metrics text"
+    )
+    p_status.set_defaults(fn=_cmd_status)
 
     p_list = sub.add_parser("list", help="list benchmarks/models/datasets")
     p_list.set_defaults(fn=_cmd_list)

@@ -1,0 +1,722 @@
+"""In-process metrics registry + trace-event collector (Prometheus text).
+
+The telemetry trace (telemetry.py) is a durable *post-hoc* artifact: the
+only way to see a run's health today is to wait for the JSONL file and run
+``tools/trace_report.py``.  This module is the *live* half: a tiny
+dependency-free metrics registry (counters / gauges / histograms with the
+Prometheus text exposition format) populated by a **trace event listener**
+— it subscribes to the records runner/sampler/supervise/consensus/
+tempering already emit (`telemetry.add_event_listener`), so no call site
+in the hot loop changes and the disabled path stays zero-cost (no
+listener registered → one truth test per emit, no registry, no thread).
+
+Three pieces:
+
+  * `MetricsRegistry` + `Counter`/`Gauge`/`Histogram` — the registry;
+    ``render()`` emits Prometheus text exposition (``# HELP``/``# TYPE``
+    + samples), served by `stark_tpu.statusd` at ``/metrics``.
+  * `RunHealth` — the liveness state machine behind ``/healthz``: healthy
+    until the watchdog declares a stall or the supervisor exhausts its
+    restart budget; a supervised restart marks the run unhealthy until
+    the next attempt's ``run_start`` (exactly the recover-after-restart
+    contract the chaos drill asserts).
+  * `TraceCollector` — the listener: maps trace events onto metrics,
+    keeps the ``/status`` JSON snapshot (current phase, block index, ESS
+    progress, attempt number, provenance), tracks the watchdog beat age
+    via `telemetry.add_progress_listener`, and samples per-device
+    ``memory_stats()`` at block boundaries (rate-limited, best-effort —
+    see `platform.device_memory_stats`).
+
+Counters are **monotone for the life of the process**: a supervised
+restart starts a new trace run but never resets a counter — exactly what
+a Prometheus ``rate()`` needs to stay meaningful across attempts.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from . import telemetry
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "RunHealth",
+    "TraceCollector",
+    "METRIC_PREFIX",
+]
+
+METRIC_PREFIX = "stark"
+
+#: default histogram buckets (seconds) — block/checkpoint walls span
+#: ~10 ms (tiny CPU drills) to minutes (compile-inclusive first blocks)
+_SECONDS_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                    60.0, 120.0, 300.0)
+
+
+def _escape_label(v: Any) -> str:
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _label_str(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """Shared core: a named family of labeled samples behind one lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def _key(self, labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+        return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        """(suffix, labels, value) rows for render()."""
+        with self._lock:
+            return [("", dict(k), v) for k, v in sorted(self._series.items())]
+
+
+class Counter(_Metric):
+    """Monotone counter: ``inc()`` only goes up; never reset."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        k = self._key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    """Settable gauge; ``set_function`` makes it scrape-time computed."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str):
+        super().__init__(name, help)
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._series[k] = float(value)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        """Compute the (unlabeled) value at scrape time (beat age etc.)."""
+        self._fn = fn
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            return self._series.get(self._key(labels))
+
+    def samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        if self._fn is not None:
+            try:
+                self.set(float(self._fn()))
+            except Exception:  # noqa: BLE001 — a scrape hook must not 500 /metrics
+                pass
+        return super().samples()
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (``_bucket``/``_sum``/``_count`` samples)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str,
+                 buckets: Iterable[float] = _SECONDS_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._counts: Dict[Tuple[Tuple[str, str], ...], List[float]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            row = self._counts.setdefault(
+                k, [0.0] * (len(self.buckets) + 2)  # buckets + sum + count
+            )
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    row[i] += 1
+            row[-2] += value
+            row[-1] += 1
+
+    def samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        out = []
+        with self._lock:
+            for k, row in sorted(self._counts.items()):
+                labels = dict(k)
+                for i, b in enumerate(self.buckets):
+                    out.append(("_bucket", {**labels, "le": _fmt_value(b)},
+                                row[i]))
+                out.append(("_bucket", {**labels, "le": "+Inf"}, row[-1]))
+                out.append(("_sum", labels, row[-2]))
+                out.append(("_count", labels, row[-1]))
+        return out
+
+
+class MetricsRegistry:
+    """Named metric families + the text exposition renderer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            have = self._metrics.get(metric.name)
+            if have is not None:
+                if type(have) is not type(metric):
+                    raise ValueError(
+                        f"metric {metric.name!r} already registered as "
+                        f"{type(have).__name__}"
+                    )
+                return have
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name: str, help: str) -> Counter:
+        return self.register(Counter(name, help))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help: str) -> Gauge:
+        return self.register(Gauge(name, help))  # type: ignore[return-value]
+
+    def histogram(self, name: str, help: str,
+                  buckets: Iterable[float] = _SECONDS_BUCKETS) -> Histogram:
+        return self.register(
+            Histogram(name, help, buckets)  # type: ignore[return-value]
+        )
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            rows = m.samples()
+            if not rows:
+                continue
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for suffix, labels, value in rows:
+                lines.append(
+                    f"{m.name}{suffix}{_label_str(labels)} {_fmt_value(value)}"
+                )
+        return "\n".join(lines) + "\n"
+
+
+class RunHealth:
+    """The ``/healthz`` state machine, driven by trace events.
+
+    States: healthy (200) → ``stall`` / ``restart:<fault>`` (503, cleared
+    by the next attempt's ``run_start``) → ``restart_budget_exhausted``
+    (503, sticky — the supervisor gave up; only a new process comes back
+    from that).  A finished run (``run_end``) is healthy: completed is
+    not a failure mode.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reason: Optional[str] = None
+        self._sticky = False
+        self._since: Optional[float] = None
+
+    def mark_unhealthy(self, reason: str, sticky: bool = False) -> None:
+        with self._lock:
+            if self._sticky:
+                return
+            self._reason = reason
+            self._sticky = sticky
+            self._since = time.time()
+
+    def mark_healthy(self) -> None:
+        with self._lock:
+            if self._sticky:
+                return
+            self._reason = None
+            self._since = None
+
+    def check(self) -> Tuple[bool, Dict[str, Any]]:
+        with self._lock:
+            if self._reason is None:
+                return True, {"healthy": True}
+            return False, {
+                "healthy": False,
+                "reason": self._reason,
+                "sticky": self._sticky,
+                "since": self._since,
+            }
+
+
+#: how often (seconds) the collector re-samples per-device memory_stats at
+#: block boundaries — the PJRT call is cheap but not free, and blocks on a
+#: drill model land every few ms
+_MEMORY_SAMPLE_EVERY_S = 2.0
+
+
+class TraceCollector:
+    """Trace-event listener that populates the registry + /status snapshot.
+
+    One instance per process (the status daemon owns it).  ``install()``
+    subscribes it to `telemetry.add_event_listener` (every emitted trace
+    record) and `telemetry.add_progress_listener` (liveness beats, the
+    same stream the watchdog eats) — nothing in the sampling loop knows
+    it exists.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 health: Optional[RunHealth] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.health = health if health is not None else RunHealth()
+        r, p = self.registry, METRIC_PREFIX
+        self._started_mono = time.monotonic()
+        self._started_ts = time.time()
+        self._last_beat = time.monotonic()
+        self._mem_last = 0.0
+        # True between a supervised restart record and the retry's
+        # run_start: that run_start CONTINUES the attempt count; any
+        # other run_start is a fresh run and resets it to 1
+        self._restart_pending = False
+        self._lock = threading.Lock()
+        # /status snapshot: the latest-seen run state, keyed by what an
+        # operator asks first ("where is it, is it moving, which attempt")
+        self._status: Dict[str, Any] = {
+            "phase": "idle",
+            "run": 0,
+            "attempt": 1,
+            "block": None,
+            "draws_per_chain": None,
+            "ess_forecast": None,
+            "health": {},
+            "meta": {},
+            "restarts": {},
+        }
+
+        # -- counters (monotone across attempts by construction) --
+        self.events = r.counter(
+            f"{p}_trace_events_total",
+            "trace events observed by the exporter, by event type",
+        )
+        self.runs_started = r.counter(
+            f"{p}_runs_started_total", "run_start events (one per attempt)"
+        )
+        self.runs_completed = r.counter(
+            f"{p}_runs_completed_total", "run_end events"
+        )
+        self.blocks = r.counter(
+            f"{p}_blocks_total",
+            "draw/warmup blocks retired, by phase label",
+        )
+        self.draws = r.counter(
+            f"{p}_draws_total",
+            "post-warmup draws retired across all chains",
+        )
+        self.grad_evals = r.counter(
+            f"{p}_grad_evals_total",
+            "gradient evaluations spent in retired draw blocks",
+        )
+        self.checkpoints = r.counter(
+            f"{p}_checkpoints_total", "checkpoint files written"
+        )
+        self.restarts = r.counter(
+            f"{p}_restarts_total",
+            "supervised restarts, by fault class label",
+        )
+        self.stalls = r.counter(
+            f"{p}_stalls_total", "watchdog stall detections"
+        )
+        self.faults_injected = r.counter(
+            f"{p}_faults_injected_total",
+            "armed failpoints that fired, by site label",
+        )
+        self.diag_bytes = r.counter(
+            f"{p}_diag_bytes_to_host_total",
+            "bytes the convergence gate transferred device-to-host",
+        )
+        self.device_idle_s = r.counter(
+            f"{p}_device_idle_seconds_total",
+            "estimated device idle attributed to host work between blocks",
+        )
+        self.host_hidden_s = r.counter(
+            f"{p}_host_hidden_seconds_total",
+            "host work hidden behind in-flight device blocks",
+        )
+        self.host_wait_s = r.counter(
+            f"{p}_host_wait_seconds_total",
+            "host time spent waiting on device block readbacks",
+        )
+        # -- gauges (latest-seen run state) --
+        self.g_up_since = r.gauge(
+            f"{p}_exporter_start_time_seconds",
+            "unix time the metrics exporter started",
+        )
+        self.g_up_since.set(self._started_ts)
+        self.g_run = r.gauge(
+            f"{p}_run", "current run ordinal within the trace"
+        )
+        self.g_attempt = r.gauge(
+            f"{p}_attempt", "current supervised attempt number (1-based)"
+        )
+        self.g_block = r.gauge(f"{p}_block", "latest retired block index")
+        self.g_draws_per_chain = r.gauge(
+            f"{p}_draws_per_chain", "post-warmup draws per chain so far"
+        )
+        self.g_draws_per_sec = r.gauge(
+            f"{p}_draws_per_second",
+            "total draw rate over the latest retired block",
+        )
+        self.g_max_rhat = r.gauge(
+            f"{p}_max_rhat", "latest worst-coordinate split R-hat"
+        )
+        self.g_min_ess = r.gauge(
+            f"{p}_min_ess", "latest worst-coordinate ESS estimate"
+        )
+        self.g_mean_accept = r.gauge(
+            f"{p}_mean_accept", "latest block mean acceptance probability"
+        )
+        self.g_step_size = r.gauge(
+            f"{p}_step_size", "latest mean step size"
+        )
+        self.g_divergent = r.gauge(
+            f"{p}_num_divergent", "cumulative divergences this run"
+        )
+        self.g_ess_forecast = r.gauge(
+            f"{p}_ess_forecast_draws",
+            "forecast draws/chain still needed to reach the ESS target",
+        )
+        self.g_converged = r.gauge(
+            f"{p}_converged", "last run_end convergence flag (1/0)"
+        )
+        self.g_overshoot = r.gauge(
+            f"{p}_overshoot_draws",
+            "estimated draws/chain past the ESS target at the last run_end",
+        )
+        self.g_budget_left = r.gauge(
+            f"{p}_restart_budget_remaining",
+            "restarts left in the supervisor's sliding window",
+        )
+        self.g_healthy = r.gauge(
+            f"{p}_healthy", "1 when /healthz reports 200, else 0"
+        )
+        self.g_beat_age = r.gauge(
+            f"{p}_watchdog_beat_age_seconds",
+            "seconds since the last progress beat (scrape-time)",
+        )
+        self.g_beat_age.set_function(
+            lambda: time.monotonic() - self._last_beat
+        )
+        self.g_deadline = r.gauge(
+            f"{p}_watchdog_deadline_seconds",
+            "stall deadline of the active watchdog (scrape-time; 0 = none)",
+        )
+        self.g_deadline.set_function(self._active_deadline)
+        self.g_device_memory = r.gauge(
+            f"{p}_device_memory_bytes",
+            "per-device memory_stats() sampled at block boundaries",
+        )
+        # -- histograms --
+        self.h_block_s = r.histogram(
+            f"{p}_sample_block_seconds",
+            "host wall of each retired draw block (checkpoint excluded)",
+        )
+        self.h_checkpoint_s = r.histogram(
+            f"{p}_checkpoint_seconds", "wall of each checkpoint write"
+        )
+
+    # -- wiring ------------------------------------------------------------
+
+    def install(self) -> "TraceCollector":
+        telemetry.add_event_listener(self.on_event)
+        telemetry.add_progress_listener(self.on_beat)
+        return self
+
+    def uninstall(self) -> None:
+        telemetry.remove_event_listener(self.on_event)
+        telemetry.remove_progress_listener(self.on_beat)
+
+    def on_beat(self) -> None:
+        self._last_beat = time.monotonic()
+
+    @staticmethod
+    def _active_deadline() -> float:
+        from . import watchdog
+
+        deadlines = [wd.deadline_s for wd in watchdog.active_watchdogs()]
+        return min(deadlines) if deadlines else 0.0
+
+    # -- event dispatch ----------------------------------------------------
+
+    def on_event(self, rec: Dict[str, Any]) -> None:
+        ev = rec.get("event")
+        if not isinstance(ev, str):
+            return
+        self.events.inc(event=ev)
+        handler = getattr(self, f"_on_{ev}", None)
+        if handler is not None:
+            handler(rec)
+        self.g_healthy.set(1.0 if self.health.check()[0] else 0.0)
+
+    def _set_status(self, **fields) -> None:
+        with self._lock:
+            self._status.update(fields)
+
+    def _on_run_start(self, rec: Dict[str, Any]) -> None:
+        self.runs_started.inc()
+        self.g_run.set(rec.get("run", 0))
+        meta = {
+            k: v for k, v in rec.items()
+            if k not in telemetry.ENVELOPE_KEYS
+        }
+        if self._restart_pending:
+            # retry of the same logical run: keep the attempt gauge AND
+            # the last-seen progress/health snapshot — they describe the
+            # run being resumed
+            self._restart_pending = False
+            self._set_status(phase="starting", run=rec.get("run", 0),
+                             meta=meta, block=None)
+        else:
+            # fresh run in this process (bench runs several legs): reset
+            # attempt and clear the previous run's progress/health so
+            # /status never reports run A's draws as run B's
+            self.g_attempt.set(1.0)
+            self._set_status(
+                phase="starting", run=rec.get("run", 0), meta=meta,
+                block=None, draws_per_chain=None, ess_forecast=None,
+                health={}, restarts={},
+            )
+        # a new attempt is underway: a prior stall/restart is recovered
+        # (budget exhaustion stays sticky inside RunHealth)
+        self.health.mark_healthy()
+
+    def _on_run_end(self, rec: Dict[str, Any]) -> None:
+        self.runs_completed.inc()
+        # a completed run closes any restart chain: whatever starts next
+        # in this process is a fresh run (attempt 1), not a retry
+        self._restart_pending = False
+        if rec.get("converged") is not None:
+            self.g_converged.set(1.0 if rec["converged"] else 0.0)
+        if rec.get("overshoot_draws") is not None:
+            self.g_overshoot.set(float(rec["overshoot_draws"]))
+        self._set_status(phase="done")
+        self.health.mark_healthy()
+
+    def _on_compile(self, rec: Dict[str, Any]) -> None:
+        self._set_status(phase="compile")
+
+    def _on_warmup_block(self, rec: Dict[str, Any]) -> None:
+        self.blocks.inc(phase="warmup")
+        self._set_status(phase="warmup")
+        self._sample_device_memory()
+
+    def _on_sample_block(self, rec: Dict[str, Any]) -> None:
+        self.blocks.inc(phase="sample")
+        chains = self._chains()
+        block_len = rec.get("block_len")
+        dur = rec.get("dur_s")
+        if block_len is not None:
+            self.draws.inc(float(block_len) * max(chains, 1))
+            if dur:
+                self.g_draws_per_sec.set(
+                    float(block_len) * max(chains, 1) / float(dur)
+                )
+        if dur is not None:
+            self.h_block_s.observe(float(dur))
+        if rec.get("block_grad_evals") is not None:
+            self.grad_evals.inc(float(rec["block_grad_evals"]))
+        if rec.get("diag_bytes_to_host") is not None:
+            self.diag_bytes.inc(float(rec["diag_bytes_to_host"]))
+        for field, ctr in (
+            ("device_idle_s", self.device_idle_s),
+            ("t_host_hidden_s", self.host_hidden_s),
+            ("t_wait_s", self.host_wait_s),
+        ):
+            if rec.get(field) is not None:
+                ctr.inc(max(float(rec[field]), 0.0))
+        if rec.get("block") is not None:
+            self.g_block.set(float(rec["block"]))
+        if rec.get("draws_per_chain") is not None:
+            self.g_draws_per_chain.set(float(rec["draws_per_chain"]))
+        if rec.get("ess_forecast") is not None:
+            self.g_ess_forecast.set(float(rec["ess_forecast"]))
+        self._set_status(
+            phase="sample",
+            block=rec.get("block"),
+            draws_per_chain=rec.get("draws_per_chain"),
+            ess_forecast=rec.get("ess_forecast"),
+        )
+        self._sample_device_memory()
+
+    def _on_checkpoint(self, rec: Dict[str, Any]) -> None:
+        self.checkpoints.inc()
+        if rec.get("dur_s") is not None:
+            self.h_checkpoint_s.observe(float(rec["dur_s"]))
+
+    def _on_chain_health(self, rec: Dict[str, Any]) -> None:
+        status = rec.get("status")
+        if status == "stall":
+            self.stalls.inc()
+            self.health.mark_unhealthy("stall")
+            self._set_status(phase="stalled")
+        elif status == "restart":
+            fault = str(rec.get("fault", "unknown"))
+            self.restarts.inc(fault=fault)
+            self.health.mark_unhealthy(f"restart:{fault}")
+            self._restart_pending = True
+            attempt = rec.get("attempt")
+            if attempt is not None:
+                # attempt N failed; attempt N+1 is what runs next
+                self.g_attempt.set(float(attempt) + 1.0)
+            if (rec.get("restarts_in_window") is not None
+                    and rec.get("max_restarts") is not None):
+                self.g_budget_left.set(
+                    max(
+                        float(rec["max_restarts"])
+                        - float(rec["restarts_in_window"]),
+                        0.0,
+                    )
+                )
+            with self._lock:
+                self._status["restarts"] = {
+                    k: rec[k]
+                    for k in ("attempt", "fault", "error", "backoff_s",
+                              "restarts_in_window", "max_restarts")
+                    if k in rec
+                }
+            self._set_status(phase="restarting")
+        elif status == "restart_budget_exhausted":
+            self.health.mark_unhealthy(
+                "restart_budget_exhausted", sticky=True
+            )
+            self.g_budget_left.set(0.0)
+            # the chain ended WITHOUT a retry: a later run_start in this
+            # process is a fresh run, not the restart's continuation
+            self._restart_pending = False
+            self._set_status(phase="failed")
+        else:
+            # per-block health: latest-seen diagnostics.  Other statuses
+            # (quarantine, shard_restart/shard_dropped, warmup_done, the
+            # in-scan stall trail) carry no diagnostic keys — they must
+            # not wipe the operator's last-seen R-hat/ESS snapshot
+            for field, g in (
+                ("max_rhat", self.g_max_rhat),
+                ("min_ess", self.g_min_ess),
+                ("mean_accept", self.g_mean_accept),
+                ("step_size", self.g_step_size),
+                ("num_divergent", self.g_divergent),
+            ):
+                v = rec.get(field)
+                if isinstance(v, (int, float)):
+                    g.set(float(v))
+            seen = {
+                k: rec[k]
+                for k in ("max_rhat", "min_ess", "mean_accept",
+                          "step_size", "num_divergent",
+                          "draws_per_chain")
+                if rec.get(k) is not None
+            }
+            if seen:
+                with self._lock:
+                    self._status["health"].update(seen)
+
+    def _on_fault(self, rec: Dict[str, Any]) -> None:
+        self.faults_injected.inc(site=str(rec.get("site", "unknown")))
+
+    # -- helpers -----------------------------------------------------------
+
+    def _chains(self) -> int:
+        with self._lock:
+            meta = self._status.get("meta", {})
+        for k in ("chains", "chains_per_shard"):
+            v = meta.get(k)
+            if isinstance(v, int) and v > 0:
+                return v
+        return 0
+
+    def _sample_device_memory(self) -> None:
+        now = time.monotonic()
+        if now - self._mem_last < _MEMORY_SAMPLE_EVERY_S:
+            return
+        self._mem_last = now
+        try:
+            from .platform import device_memory_stats
+
+            for dev in device_memory_stats():
+                for stat, value in dev["stats"].items():
+                    self.g_device_memory.set(
+                        float(value), device=dev["device"], stat=stat
+                    )
+        except Exception:  # noqa: BLE001 — sampling must not fault the run
+            pass
+
+    def status(self) -> Dict[str, Any]:
+        """The ``/status`` JSON snapshot."""
+        healthy, detail = self.health.check()
+        with self._lock:
+            snap = {
+                "phase": self._status["phase"],
+                "run": self._status["run"],
+                "attempt": self._status["attempt"],
+                "block": self._status["block"],
+                "draws_per_chain": self._status["draws_per_chain"],
+                "ess_forecast": self._status["ess_forecast"],
+                "health": dict(self._status["health"]),
+                "restarts": dict(self._status["restarts"]),
+                "meta": dict(self._status["meta"]),
+            }
+        attempt = self.g_attempt.value()
+        if attempt is not None:
+            snap["attempt"] = int(attempt)
+        snap.update(
+            healthy=healthy,
+            health_detail=detail,
+            beat_age_s=round(time.monotonic() - self._last_beat, 3),
+            uptime_s=round(time.monotonic() - self._started_mono, 3),
+            blocks_total=int(
+                self.blocks.value(phase="sample")
+                + self.blocks.value(phase="warmup")
+            ),
+            draws_total=int(self.draws.value()),
+        )
+        return snap

@@ -282,6 +282,7 @@ def consensus_sample(
             chains_per_shard=chains,
             combine=combine,
             **telemetry.device_info(),
+            **telemetry.provenance(),
         )
     fm = flatten_model(model, prior_scale=1.0 / num_shards)
     data = prepare_model_data(model, data)

@@ -304,6 +304,7 @@ def tempered_sample(
             swap_every=swap_every,
             adapt_ladder=adapt_ladder,
             **telemetry.device_info(),
+            **telemetry.provenance(),
         )
     key = jax.random.PRNGKey(seed)
     key_init, key_run = jax.random.split(key)

@@ -92,6 +92,44 @@ def enable_compilation_cache(cache_dir: str) -> Optional[str]:
     return cache_dir
 
 
+def device_memory_stats() -> "list[dict]":
+    """Per-local-device memory statistics, best-effort.
+
+    Returns ``[{"device": "0", "kind": "TPU v4", "stats": {...}}, ...]``
+    with ``stats`` straight from PJRT's ``Device.memory_stats()``
+    (``bytes_in_use``, ``peak_bytes_in_use``, ``bytes_limit``, ... —
+    whatever the runtime reports).  Devices without the API (CPU) or a
+    runtime that errors produce an empty ``stats`` dict; an unreachable
+    backend produces an empty list.  Consumed by the metrics collector at
+    block boundaries (`stark_tpu.metrics`) — sampling device memory must
+    never be the thing that faults a run, so everything here degrades
+    silently.
+    """
+    out = []
+    try:
+        import jax
+
+        for i, dev in enumerate(jax.local_devices()):
+            stats = {}
+            try:
+                raw = dev.memory_stats()
+                if raw:
+                    stats = {
+                        k: int(v) for k, v in raw.items()
+                        if isinstance(v, (int, float))
+                    }
+            except Exception:  # noqa: BLE001 — no stats on this device
+                pass
+            out.append({
+                "device": str(i),
+                "kind": getattr(dev, "device_kind", "unknown"),
+                "stats": stats,
+            })
+    except Exception:  # noqa: BLE001 — backend unreachable: nothing to report
+        return []
+    return out
+
+
 def probe_accelerator(timeout: int = None) -> bool:
     """True iff accelerator client init completes (subprocess probe).
 

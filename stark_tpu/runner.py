@@ -352,6 +352,7 @@ def _sample_until_converged(
             ess_target=ess_target,
             resuming=bool(resume_from),
             **telemetry.device_info(),
+            **telemetry.provenance(),
         )
     with trace.phase("compile", stage="build"):
         ap = backend.adaptive_parts(model, cfg, data)

@@ -723,6 +723,7 @@ def sample(
                 seed=seed,
                 backend=type(backend).__name__,
                 **telemetry.device_info(),
+                **telemetry.provenance(),
             )
         t0 = time.perf_counter()
         post = backend.run(

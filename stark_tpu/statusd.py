@@ -1,0 +1,270 @@
+"""Live run-health HTTP exporter: ``/metrics``, ``/healthz``, ``/status``.
+
+A stdlib-only (`http.server`) daemon thread that serves the in-process
+metrics registry (`stark_tpu.metrics`) while a run is in flight — the live
+counterpart to the post-hoc trace file.  **Off by default**: it starts
+only when ``--status-port`` / ``STARK_STATUS_PORT`` asks for it, and with
+the port unset nothing here is imported by the sampling path — no thread,
+no registry, no listener (the NullTrace zero-cost contract).
+
+Endpoints:
+
+  * ``GET /metrics``  — Prometheus text exposition (0.0.4) of the
+    registry: block/draw/restart counters, chain-health gauges, watchdog
+    beat age + deadline, per-device ``memory_stats()`` sampled at block
+    boundaries.  Counters are process-monotone: a supervised restart never
+    resets them.
+  * ``GET /healthz``  — 200 ``ok`` while the run is live; 503 with a JSON
+    reason when the watchdog declared a stall or a supervised restart is
+    in progress; recovers to 200 at the next attempt's ``run_start``;
+    sticky 503 once the restart budget is exhausted.  The deadman logic
+    lives in `metrics.RunHealth`, driven by the same trace events the
+    supervisor emits.
+  * ``GET /status``   — JSON snapshot: current phase, block index, ESS
+    progress/forecast, attempt number, restart record, run metadata
+    (model/kernel/chains + provenance).
+
+The server is **process-scoped, not attempt-scoped**: `supervise` may
+restart the run many times, the daemon (and the monotone counters behind
+it) survives every attempt.  It observes the run through the telemetry
+event-listener fan-out, so it works with ``--trace`` (file + live view)
+or without (an in-memory `RunTrace(None)` bus is installed by the CLI
+when only the port is given).
+
+Probe from a shell::
+
+    python -m stark_tpu status --port 8998              # /status, pretty
+    python -m stark_tpu status --port 8998 --healthz    # exit 0/1 = 200/503
+    curl -s localhost:8998/metrics | grep stark_draws_total
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from .metrics import MetricsRegistry, RunHealth, TraceCollector
+
+log = logging.getLogger("stark_tpu.statusd")
+
+__all__ = [
+    "STATUS_PORT_ENV",
+    "StatusServer",
+    "get_server",
+    "maybe_start_from_env",
+    "start_status_server",
+    "stop_status_server",
+]
+
+STATUS_PORT_ENV = "STARK_STATUS_PORT"
+
+#: bind address: loopback by default — the endpoints expose run metadata
+#: (git SHA, toolchain versions, device inventory) with no auth, so
+#: reaching them from another host is an explicit operator decision
+#: (STARK_STATUS_HOST=0.0.0.0 for a real Prometheus scrape target)
+STATUS_HOST_ENV = "STARK_STATUS_HOST"
+DEFAULT_HOST = "127.0.0.1"
+
+#: Prometheus text exposition content type
+_METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Request handler bound to one StatusServer via ``server.statusd``."""
+
+    server_version = "stark-statusd/1"
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        sd: "StatusServer" = self.server.statusd  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(
+                    200, sd.registry.render().encode(), _METRICS_CONTENT_TYPE
+                )
+            elif path == "/healthz":
+                healthy, detail = sd.health.check()
+                body = (
+                    b"ok\n"
+                    if healthy
+                    else (json.dumps(detail) + "\n").encode()
+                )
+                self._send(
+                    200 if healthy else 503,
+                    body,
+                    "text/plain; charset=utf-8"
+                    if healthy
+                    else "application/json",
+                )
+            elif path in ("/status", "/"):
+                body = (
+                    json.dumps(sd.collector.status(), indent=1, default=str)
+                    + "\n"
+                ).encode()
+                self._send(200, body, "application/json")
+            else:
+                self._send(404, b"not found\n", "text/plain; charset=utf-8")
+        except Exception as e:  # noqa: BLE001 — a scrape must never kill the daemon
+            try:
+                self._send(
+                    500,
+                    f"internal error: {type(e).__name__}\n".encode(),
+                    "text/plain; charset=utf-8",
+                )
+            except Exception:  # noqa: BLE001 — client already gone
+                pass
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        # scrapes arrive every few seconds: route to the module logger at
+        # DEBUG instead of BaseHTTPRequestHandler's bare stderr writes
+        log.debug("%s %s", self.address_string(), fmt % args)
+
+
+class StatusServer:
+    """One daemon-thread HTTP server over a collector/registry/health
+    triple.  ``start()`` binds and spawns the thread; ``port`` reflects
+    the ACTUAL bound port (pass 0 for an ephemeral one — tests do)."""
+
+    def __init__(
+        self,
+        port: int,
+        *,
+        host: str = DEFAULT_HOST,
+        collector: Optional[TraceCollector] = None,
+    ):
+        self.collector = (
+            collector if collector is not None else TraceCollector()
+        )
+        self.registry: MetricsRegistry = self.collector.registry
+        self.health: RunHealth = self.collector.health
+        self._requested = (host, int(port))
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self) -> "StatusServer":
+        if self._httpd is not None:
+            raise RuntimeError("status server already started")
+        self._httpd = ThreadingHTTPServer(self._requested, _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.statusd = self  # type: ignore[attr-defined]
+        self.collector.install()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"stark-statusd-{self.port}",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info(
+            "status endpoints on :%d (/metrics /healthz /status)", self.port
+        )
+        return self
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            self.collector.uninstall()
+            httpd.shutdown()
+            httpd.server_close()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def __enter__(self) -> "StatusServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+# process singleton: entry points call start_status_server once; a second
+# call (e.g. bench.py under the CLI) reuses the running daemon instead of
+# fighting over the port
+_SERVER: Optional[StatusServer] = None
+_SERVER_LOCK = threading.Lock()
+
+
+def get_server() -> Optional[StatusServer]:
+    return _SERVER
+
+
+def start_status_server(
+    port: int, *, host: Optional[str] = None
+) -> StatusServer:
+    """Start (or return the already-running) process status server.
+
+    ``host`` default: ``STARK_STATUS_HOST`` if set, else loopback."""
+    global _SERVER
+    if host is None:
+        host = os.environ.get(STATUS_HOST_ENV, "").strip() or DEFAULT_HOST
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            return _SERVER
+        _SERVER = StatusServer(port, host=host).start()
+        return _SERVER
+
+
+def stop_status_server() -> None:
+    global _SERVER
+    with _SERVER_LOCK:
+        srv, _SERVER = _SERVER, None
+    if srv is not None:
+        srv.stop()
+
+
+def resolve_port(cli_port: Optional[int] = None) -> Optional[int]:
+    """The effective status port: CLI flag wins, then STARK_STATUS_PORT;
+    None/unset/empty/invalid → no server (the default-off contract).
+
+    ``STARK_STATUS_PORT=0`` DISABLES the exporter — the repo-wide
+    ``=0 opts out`` env convention (STARK_PERF_LEDGER, STARK_COMPILE_CACHE,
+    STARK_STREAM_DIAG), and the opt-out a nested job needs when CI
+    exports a port globally.  An explicit CLI ``--status-port 0`` still
+    requests an ephemeral bind (a deliberate flag, not an inherited
+    environment)."""
+    if cli_port is not None:
+        return cli_port
+    raw = os.environ.get(STATUS_PORT_ENV, "").strip()
+    if not raw or raw == "0":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        log.warning("ignoring non-integer %s=%r", STATUS_PORT_ENV, raw)
+        return None
+
+
+def maybe_start_from_env(
+    cli_port: Optional[int] = None,
+) -> Optional[StatusServer]:
+    """Start the exporter iff a port was configured; None otherwise.
+
+    Never raises into the caller: a bind failure (port taken) logs and
+    returns None — observability must not kill the run it observes.
+    """
+    port = resolve_port(cli_port)
+    if port is None:
+        return None
+    try:
+        return start_status_server(port)
+    except Exception as e:  # noqa: BLE001 — exporter startup is best-effort
+        log.warning(
+            "status server on port %s failed to start (%s: %s) — "
+            "continuing without live endpoints",
+            port, type(e).__name__, e,
+        )
+        return None

@@ -411,7 +411,10 @@ def supervised_sample(
             _append_record(metrics_path, rec)
         if trace.enabled:
             # the failure-detection record, in the trace's vocabulary:
-            # a chain-health transition, not a new run
+            # a chain-health transition, not a new run.  Budget state
+            # rides along so live observers (/status, /metrics) can show
+            # how much supervision headroom remains without re-deriving
+            # the sliding window from the restart history.
             trace.emit(
                 "chain_health",
                 status="restart",
@@ -420,6 +423,8 @@ def supervised_sample(
                 error=f"{type(e).__name__}: {e}",
                 resumed_from_checkpoint=resumed,
                 backoff_s=round(delay, 3),
+                restarts_in_window=budget.in_window(),
+                max_restarts=budget.max_restarts,
             )
         if exhausted:
             if trace.enabled:

@@ -29,9 +29,24 @@ Design rules:
 
 Canonical event types (``EVENT_TYPES``): ``run_start``, ``compile``,
 ``warmup_block``, ``sample_block``, ``chain_health``, ``checkpoint``,
-``run_end``.  Auxiliary types (``progress``, ``adapt``, ``budget``) ride the
-same envelope; readers must ignore event types they don't know (that is the
-forward-compat rule that lets the schema grow without a version bump).
+``run_end``.  Auxiliary types (``AUX_EVENT_TYPES``: ``progress``, ``adapt``,
+``budget``, ``collect``, ``fault``) ride the same envelope; readers must
+ignore event types they don't know (that is the forward-compat rule that
+lets the schema grow without a version bump).  WRITERS are stricter: every
+``emit("<name>", ...)``/``phase("<name>", ...)`` site in ``stark_tpu/``
+must use a name from ``ALL_EVENT_TYPES`` — ``tools/lint_trace_schema.py``
+enforces it, so schema drift (an event the readers and the metrics
+exporter have never heard of) cannot land silently.
+
+Live consumers: besides the JSONL file, every emitted record is fanned out
+to registered **event listeners** (`add_event_listener`) — the in-process
+metrics registry (`stark_tpu.metrics`) subscribes one to populate the
+``/metrics``/``/status`` endpoints (`stark_tpu.statusd`) without touching
+any emit site.  A `RunTrace` built with ``path=None`` is a pure in-memory
+bus: events reach listeners but no file is written (how the status daemon
+observes an otherwise-untraced run).  With no listeners registered the
+fan-out is one truth test per emit; the `NullTrace` default path is
+unchanged (no record is built at all).
 
 Envelope fields present on EVERY event::
 
@@ -76,6 +91,16 @@ EVENT_TYPES = frozenset(
         "run_end",
     }
 )
+
+#: auxiliary event types: legal for writers, optional for readers —
+#: in-scan heartbeats, adaptation/budget markers, the host post-processing
+#: phase, and injected-fault records (faults.py)
+AUX_EVENT_TYPES = frozenset({"progress", "adapt", "budget", "collect",
+                             "fault"})
+
+#: the complete WRITER registry: every emit()/phase() call in stark_tpu/
+#: must use one of these names (tools/lint_trace_schema.py enforces it)
+ALL_EVENT_TYPES = EVENT_TYPES | AUX_EVENT_TYPES
 
 #: envelope keys every event must carry (validate_event)
 ENVELOPE_KEYS = ("schema", "event", "ts", "wall_s", "run")
@@ -127,16 +152,22 @@ class _TraceState:
 
     __slots__ = ("f", "t0", "run", "lock", "path", "last_progress_ts")
 
-    def __init__(self, path: str):
+    def __init__(self, path: Optional[str]):
         self.path = path
-        d = os.path.dirname(os.path.abspath(path))
-        os.makedirs(d, exist_ok=True)
-        self.f = open(path, "a")
+        if path is None:
+            # in-memory bus: no file — events exist only for the
+            # registered listeners (the status daemon's untraced mode)
+            self.f = None
+            self.run = 0
+        else:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self.f = open(path, "a")
+            # append semantics: continue the file's run numbering, never
+            # collide with a previous session's ordinals (run is monotone,
+            # so the last parseable line carries the current maximum)
+            self.run = _last_run_ordinal(path)
         self.t0 = time.perf_counter()
-        # append semantics: continue the file's run numbering, never
-        # collide with a previous session's ordinals (run is monotone, so
-        # the last parseable line carries the current maximum)
-        self.run = _last_run_ordinal(path)
         # emits can arrive from jax.debug.callback threads: one lock
         # serializes line writes so events never interleave mid-line
         self.lock = threading.Lock()
@@ -181,23 +212,35 @@ class RunTrace:
     ``emit`` never raises into the run: observability must not kill the
     sampler (the same rule as the runner's ``progress_cb``) — write errors
     disable the trace and the run continues.
+
+    ``path=None`` builds a pure in-memory bus: no file is opened and no
+    bytes are written, but every record still reaches the registered event
+    listeners (`add_event_listener`) — how the status daemon observes a
+    run nobody asked to trace to disk.
     """
 
     enabled = True
 
-    def __init__(self, path: str, *, tags: Optional[Dict[str, Any]] = None,
+    def __init__(self, path: Optional[str], *,
+                 tags: Optional[Dict[str, Any]] = None,
                  _state: Optional[_TraceState] = None):
         self._state = _state if _state is not None else _TraceState(path)
         self._tags = dict(tags) if tags else {}
 
     @property
-    def path(self) -> str:
+    def path(self) -> Optional[str]:
         return self._state.path
 
     def emit(self, event: str, **fields) -> Optional[Dict[str, Any]]:
-        """Write one event line; returns the record (None if disabled)."""
+        """Write one event line; returns the record (None if disabled).
+
+        Listeners see the record even when no file is attached (in-memory
+        bus) or the file died (full disk) — the live exporters must not
+        share the trace file's fate.
+        """
         st = self._state
-        if st.f is None:
+        listening = bool(_EVENT_LISTENERS)
+        if st.f is None and not listening:
             return None
         rec = {
             "schema": SCHEMA_VERSION,
@@ -213,11 +256,15 @@ class RunTrace:
                 if event == "run_start":
                     st.run += 1
                     rec["run"] = st.run
-                st.f.write(json.dumps(rec) + "\n")
-                st.f.flush()
+                if st.f is not None:
+                    st.f.write(json.dumps(rec) + "\n")
+                    st.f.flush()
         except (OSError, ValueError):  # closed/full disk: drop tracing,
             st.f = None  # never the run
-            return None
+            if not listening:
+                return None
+        if listening:
+            notify_event(rec)
         return rec
 
     def phase(self, event: str, **fields) -> _Phase:
@@ -316,6 +363,40 @@ _CALLBACK_TRACE: Any = NULL_TRACE
 # when nobody listens — one empty-list truth test per beat site.
 _PROGRESS_LISTENERS: List[Any] = []
 
+# event listeners: the live fan-out of every emitted trace record — the
+# metrics registry (stark_tpu.metrics) subscribes one so /metrics and /status
+# populate without any emit site changing.  Zero-cost when empty (one
+# truth test per emit); listeners must be cheap and never raise (the
+# exporter must not fault the run it observes).
+_EVENT_LISTENERS: List[Any] = []
+
+
+def add_event_listener(fn) -> None:
+    """Register ``fn(record)`` to receive every emitted trace record (the
+    full dict, envelope included).  Used by `stark_tpu.metrics`; listeners
+    must be cheap and must not raise (exceptions are swallowed)."""
+    if fn not in _EVENT_LISTENERS:
+        _EVENT_LISTENERS.append(fn)
+
+
+def remove_event_listener(fn) -> None:
+    try:
+        _EVENT_LISTENERS.remove(fn)
+    except ValueError:
+        pass
+
+
+def notify_event(rec: Dict[str, Any]) -> None:
+    """Fan one emitted record out to the event listeners; free when none
+    are registered, and a listener exception never reaches the run."""
+    if not _EVENT_LISTENERS:
+        return
+    for fn in list(_EVENT_LISTENERS):
+        try:
+            fn(rec)
+        except Exception:  # noqa: BLE001 — observability must not fault the run
+            pass
+
 
 def add_progress_listener(fn) -> None:
     """Register ``fn()`` to be called on every progress beat (see
@@ -388,6 +469,7 @@ def device_info() -> Dict[str, Any]:
         devs = jax.local_devices()
         return {
             "platform": devs[0].platform if devs else "unknown",
+            "device_kind": devs[0].device_kind if devs else "unknown",
             "device_count": jax.device_count(),
             "local_device_count": jax.local_device_count(),
             "process_index": jax.process_index(),
@@ -395,6 +477,66 @@ def device_info() -> Dict[str, Any]:
         }
     except Exception:  # noqa: BLE001 — tracing stays best-effort
         return {"platform": "unknown", "device_count": 0}
+
+
+#: provenance cache: the git subprocess and version lookups run once per
+#: process — run_start events fire per supervised attempt and must not
+#: pay a fork each time
+_PROVENANCE: Optional[Dict[str, Any]] = None
+
+
+def provenance() -> Dict[str, Any]:
+    """Best-effort run provenance for ``run_start`` events and perf-ledger
+    rows: the repo git SHA (with a ``-dirty`` suffix when the worktree has
+    modifications) and the jax/jaxlib versions.  Without these a cross-run
+    regression is unattributable — the ledger can say WHAT got slower but
+    not WHICH commit or toolchain did it.  Every field degrades to
+    ``None`` rather than failing (no git binary, not a checkout, jax
+    unimportable): provenance must never be the thing that kills a run.
+    """
+    global _PROVENANCE
+    if _PROVENANCE is not None:
+        return dict(_PROVENANCE)
+    out: Dict[str, Any] = {"git_sha": None, "jax_version": None,
+                           "jaxlib_version": None}
+    try:
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=repo, capture_output=True, text=True, timeout=10,
+        )
+        if sha.returncode == 0 and sha.stdout.strip():
+            # -uno: tracked files only — run artifacts this very layer
+            # appends (the perf ledger, traces under the repo) must not
+            # stamp every later run -dirty on a pristine source tree
+            dirty = subprocess.run(
+                ["git", "status", "--porcelain", "-uno"],
+                cwd=repo, capture_output=True, text=True, timeout=10,
+            )
+            suffix = (
+                "-dirty"
+                if dirty.returncode == 0 and dirty.stdout.strip()
+                else ""
+            )
+            out["git_sha"] = sha.stdout.strip() + suffix
+    except Exception:  # noqa: BLE001 — best-effort by contract
+        pass
+    try:
+        import jax
+
+        out["jax_version"] = jax.__version__
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        import jaxlib
+
+        out["jaxlib_version"] = jaxlib.__version__
+    except Exception:  # noqa: BLE001
+        pass
+    _PROVENANCE = out
+    return dict(out)
 
 
 def heartbeat(label, step, accept) -> None:

@@ -45,11 +45,25 @@ from typing import Any, Callable, Iterator, Optional
 
 from . import telemetry
 
-__all__ = ["StallError", "Watchdog", "watched"]
+__all__ = ["StallError", "Watchdog", "active_watchdogs", "watched"]
 
 
 class StallError(RuntimeError):
     """The watchdog aborted a run that stopped emitting progress beats."""
+
+
+# started watchdogs, for observers: the metrics exporter reports the
+# active deadman deadline (stark_watchdog_deadline_seconds) without any
+# wiring between supervise and the status daemon.  Guarded by a lock —
+# start/stop may race with a scrape thread.
+_ACTIVE: "list[Watchdog]" = []
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active_watchdogs() -> "list[Watchdog]":
+    """Snapshot of currently-started watchdogs (observability read-only)."""
+    with _ACTIVE_LOCK:
+        return list(_ACTIVE)
 
 
 def _interrupt_thread(target: threading.Thread) -> None:
@@ -150,12 +164,17 @@ class Watchdog:
         self._thread = threading.Thread(
             target=self._watch, name=f"stark-watchdog-{self.label}", daemon=True
         )
+        with _ACTIVE_LOCK:
+            _ACTIVE.append(self)
         self._thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
         telemetry.remove_progress_listener(self.beat)
+        with _ACTIVE_LOCK:
+            if self in _ACTIVE:
+                _ACTIVE.remove(self)
         t, self._thread = self._thread, None
         if t is not None:
             t.join(timeout=self.poll_s * 4 + 1.0)
@@ -171,6 +190,7 @@ class Watchdog:
                 self._trace.emit(
                     "chain_health",
                     status="stall",
+                    label=self.label,
                     deadline_s=self.deadline_s,
                     idle_s=round(idle, 3),
                     stall_count=self.stall_count,

@@ -1,0 +1,77 @@
+"""Repo lint: emitted trace event names must be in the schema registry.
+
+Readers tolerate unknown event types (forward compat), so a typo'd emit
+name would silently vanish from trace_report, the perf ledger, AND the
+live metrics exporter — the lint is the only thing that can catch the
+drift.  AST-based: strings/comments mentioning emit don't trip it.
+"""
+
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "lint_trace_schema",
+    os.path.join(os.path.dirname(__file__), "..", "tools",
+                 "lint_trace_schema.py"),
+)
+lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(lint)
+
+_PKG = os.path.join(os.path.dirname(__file__), "..", "stark_tpu")
+
+
+def test_every_emitted_event_name_is_documented():
+    violations = lint.lint_package(_PKG)
+    assert violations == [], (
+        "emit()/phase() with event names missing from "
+        "telemetry.ALL_EVENT_TYPES — document the event or fix the "
+        "name:\n" + "\n".join(violations)
+    )
+
+
+def test_package_emit_sites_are_actually_collected():
+    """Guard against the lint matching nothing (a regex/AST drift would
+    otherwise make the schema check vacuously green)."""
+    import collections
+
+    names = collections.Counter()
+    for root, _dirs, files in os.walk(_PKG):
+        if "__pycache__" in root:
+            continue
+        for f in files:
+            if f.endswith(".py"):
+                path = os.path.join(root, f)
+                for _ln, n in lint.find_event_names(
+                    open(path).read(), path
+                ):
+                    names[n] += 1
+    # the canonical emitters must all be present
+    for expected in ("run_start", "run_end", "sample_block",
+                     "warmup_block", "chain_health", "checkpoint",
+                     "compile"):
+        assert names[expected] > 0, f"lint no longer sees {expected!r}"
+
+
+def test_finder_flags_unknown_literal_names():
+    src = (
+        "def f(trace):\n"
+        "    trace.emit('sampel_block', dur_s=1.0)\n"  # typo'd
+        "    with trace.phase('compile'):\n"
+        "        pass\n"
+        "    name = 'run_start'\n"
+        "    trace.emit(name)\n"  # non-literal: skipped
+        "    # trace.emit('not_code')\n"
+        "    s = \"trace.emit('nor_me')\"\n"
+    )
+    hits = lint.find_event_names(src, "<test>")
+    assert hits == [(2, "sampel_block"), (3, "compile")]
+
+
+def test_lint_reports_the_typo(tmp_path):
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "mod.py").write_text(
+        "def f(trace):\n    trace.emit('sampel_block')\n"
+    )
+    violations = lint.lint_package(str(bad))
+    assert len(violations) == 1 and "sampel_block" in violations[0]

@@ -1,0 +1,361 @@
+"""Metrics registry + trace collector: exposition format, event mapping,
+restart-monotone counters, and the /healthz state machine.
+
+The exporter's contract is twofold: (1) ``/metrics`` output must be
+PARSEABLE Prometheus text (a scraper that chokes is worse than no
+exporter), and (2) counters are process-monotone — a supervised restart
+starts a new trace run but must never reset a counter, or every
+``rate()`` over the series breaks at exactly the moment (a crash loop)
+the operator needs it.
+"""
+
+import re
+import threading
+
+import pytest
+
+from stark_tpu import telemetry
+from stark_tpu.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    RunHealth,
+    TraceCollector,
+)
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'  # escaped \" \\ \n ok
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"          # metric name
+    rf"(\{{{_LABEL}(,{_LABEL})*\}})?"     # optional label set
+    r" (-?[0-9.eE+-]+|\+Inf|-Inf|NaN)$"   # value
+)
+
+
+def parse_exposition(text: str):
+    """Minimal 0.0.4 parser: {metric_line: value}; raises on a bad line."""
+    out = {}
+    types = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+            continue
+        assert _SAMPLE_RE.match(line), f"unparseable sample line: {line!r}"
+        key, _, value = line.rpartition(" ")
+        out[key] = float(value)
+    return out, types
+
+
+def test_counter_gauge_histogram_render_parseable():
+    r = MetricsRegistry()
+    c = r.counter("t_ops_total", "ops")
+    c.inc()
+    c.inc(2.5, kind="write")
+    g = r.gauge("t_depth", "queue depth")
+    g.set(3)
+    h = r.histogram("t_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    samples, types = parse_exposition(r.render())
+    assert types == {"t_ops_total": "counter", "t_depth": "gauge",
+                     "t_lat_seconds": "histogram"}
+    assert samples["t_ops_total"] == 1.0
+    assert samples['t_ops_total{kind="write"}'] == 2.5
+    assert samples["t_depth"] == 3.0
+    assert samples['t_lat_seconds_bucket{le="0.1"}'] == 1.0
+    assert samples['t_lat_seconds_bucket{le="1"}'] == 2.0
+    assert samples['t_lat_seconds_bucket{le="+Inf"}'] == 3.0
+    assert samples["t_lat_seconds_count"] == 3.0
+    assert samples["t_lat_seconds_sum"] == pytest.approx(5.55)
+
+
+def test_label_values_escaped():
+    r = MetricsRegistry()
+    c = r.counter("t_err_total", "errors")
+    c.inc(error='OSError: "disk\nfull"')
+    text = r.render()
+    # the newline and quotes must be escaped or the line-oriented format
+    # is corrupt for every later metric
+    sample_lines = [l for l in text.splitlines() if not l.startswith("#")]
+    assert len(sample_lines) == 1
+    assert "\\n" in sample_lines[0] and '\\"' in sample_lines[0]
+    parse_exposition(text)
+
+
+def test_counter_is_monotone():
+    c = Counter("t_total", "t")
+    c.inc(5)
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert c.value() == 5.0
+
+
+def test_gauge_scrape_time_function():
+    g = Gauge("t_age", "t")
+    g.set_function(lambda: 42.0)
+    assert g.samples() == [("", {}, 42.0)]
+    # a raising hook must not 500 the scrape
+    g.set_function(lambda: 1 / 0)
+    g.samples()
+
+
+def test_registry_rejects_kind_change():
+    r = MetricsRegistry()
+    r.counter("t_x", "x")
+    with pytest.raises(ValueError):
+        r.gauge("t_x", "x")
+    # same kind: register is get-or-create
+    assert r.counter("t_x", "x") is r.get("t_x")
+
+
+# ---------------------------------------------------------------------------
+# RunHealth state machine
+# ---------------------------------------------------------------------------
+
+
+def test_health_stall_recovers_on_healthy_mark():
+    h = RunHealth()
+    assert h.check()[0]
+    h.mark_unhealthy("stall")
+    ok, detail = h.check()
+    assert not ok and detail["reason"] == "stall"
+    h.mark_healthy()
+    assert h.check()[0]
+
+
+def test_health_budget_exhaustion_is_sticky():
+    h = RunHealth()
+    h.mark_unhealthy("restart_budget_exhausted", sticky=True)
+    h.mark_healthy()  # a later run_start must NOT clear a terminal state
+    ok, detail = h.check()
+    assert not ok and detail["sticky"]
+
+
+# ---------------------------------------------------------------------------
+# TraceCollector event mapping
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def collector():
+    c = TraceCollector().install()
+    yield c
+    c.uninstall()
+
+
+def _emit_attempt(tr, *, blocks, first_block=1, chains=2):
+    tr.emit("run_start", entry="sample_until_converged", model="M",
+            kernel="hmc", chains=chains)
+    for b in range(first_block, first_block + blocks):
+        tr.emit("sample_block", block=b, dur_s=0.1, block_len=25,
+                block_grad_evals=400, diag_bytes_to_host=4900,
+                device_idle_s=0.01, t_host_hidden_s=0.05, t_wait_s=0.02,
+                draws_per_chain=25 * b, ess_forecast=100 - b)
+        tr.emit("chain_health", block=b, max_rhat=1.05, min_ess=50.0 * b,
+                mean_accept=0.8, step_size=0.3, num_divergent=0)
+        tr.emit("checkpoint", block=b, dur_s=0.01)
+
+
+def test_collector_maps_run_events(collector):
+    tr = telemetry.RunTrace(None)
+    _emit_attempt(tr, blocks=3)
+    tr.emit("run_end", dur_s=1.0, converged=True, overshoot_draws=46)
+    samples, _ = parse_exposition(collector.registry.render())
+    assert samples["stark_runs_started_total"] == 1
+    assert samples["stark_runs_completed_total"] == 1
+    assert samples['stark_blocks_total{phase="sample"}'] == 3
+    assert samples["stark_draws_total"] == 3 * 25 * 2  # blocks*len*chains
+    assert samples["stark_grad_evals_total"] == 3 * 400
+    assert samples["stark_diag_bytes_to_host_total"] == 3 * 4900
+    assert samples["stark_checkpoints_total"] == 3
+    assert samples["stark_max_rhat"] == 1.05
+    assert samples["stark_min_ess"] == 150.0
+    assert samples["stark_converged"] == 1
+    assert samples["stark_overshoot_draws"] == 46
+    assert samples["stark_healthy"] == 1
+    snap = collector.status()
+    assert snap["phase"] == "done" and snap["draws_per_chain"] == 75
+    assert snap["meta"]["model"] == "M" and snap["healthy"]
+
+
+def test_counters_never_reset_across_attempts(collector):
+    """The restart-monotonicity contract: attempt 2 (a new trace run)
+    CONTINUES every counter — draws, blocks, restarts — it never zeroes."""
+    tr = telemetry.RunTrace(None)
+    _emit_attempt(tr, blocks=2)
+    tr.emit("chain_health", status="stall", deadline_s=1.0)
+    tr.emit("chain_health", status="restart", attempt=1, fault="stall",
+            restarts_in_window=1, max_restarts=3)
+    mid, _ = parse_exposition(collector.registry.render())
+    # attempt 2: resumes at block 3
+    _emit_attempt(tr, blocks=2, first_block=3)
+    tr.emit("run_end", dur_s=1.0, converged=True)
+    after, _ = parse_exposition(collector.registry.render())
+    assert mid['stark_blocks_total{phase="sample"}'] == 2
+    assert after['stark_blocks_total{phase="sample"}'] == 4
+    assert after["stark_draws_total"] == 4 * 25 * 2
+    assert after["stark_runs_started_total"] == 2
+    assert after['stark_restarts_total{fault="stall"}'] == 1
+    assert after["stark_stalls_total"] == 1
+    assert after["stark_attempt"] == 2
+    assert after["stark_restart_budget_remaining"] == 2
+    # monotone: nothing in `after` went below `mid` for counter families
+    for key, v in mid.items():
+        if "_total" in key and "_bucket" not in key:
+            assert after.get(key, 0.0) >= v, key
+
+
+def test_collector_health_flips_and_recovers(collector):
+    tr = telemetry.RunTrace(None)
+    tr.emit("run_start", model="M", chains=2)
+    assert collector.health.check()[0]
+    tr.emit("chain_health", status="stall", deadline_s=1.0)
+    assert not collector.health.check()[0]
+    samples, _ = parse_exposition(collector.registry.render())
+    assert samples["stark_healthy"] == 0
+    tr.emit("run_start", model="M", chains=2)  # supervisor's next attempt
+    assert collector.health.check()[0]
+    tr.emit("chain_health", status="restart_budget_exhausted",
+            restarts_in_window=4, max_restarts=3)
+    assert not collector.health.check()[0]
+    tr.emit("run_start", model="M", chains=2)  # sticky: no recovery
+    assert not collector.health.check()[0]
+    assert collector.status()["phase"] != "failed" or True
+
+
+def test_collector_counts_injected_faults(collector):
+    tr = telemetry.RunTrace(None)
+    tr.emit("fault", site="runner.block.pre", action="stall", hit=1)
+    samples, _ = parse_exposition(collector.registry.render())
+    assert samples[
+        'stark_faults_injected_total{site="runner.block.pre"}'
+    ] == 1
+
+
+def test_collector_ignores_malformed_records(collector):
+    """A listener must swallow anything — observability cannot fault the
+    run that feeds it."""
+    collector.on_event({})  # no event key
+    collector.on_event({"event": 7})  # non-string event
+    collector.on_event({"event": "sample_block"})  # no fields at all
+    collector.on_event({"event": "chain_health", "max_rhat": "NaN-ish"})
+    parse_exposition(collector.registry.render())
+
+
+def test_beat_age_gauge_tracks_progress_listener(collector):
+    import time
+
+    time.sleep(0.02)
+    age_before = dict(
+        parse_exposition(collector.registry.render())[0]
+    )["stark_watchdog_beat_age_seconds"]
+    assert age_before >= 0.02
+    telemetry.notify_progress()
+    age_after = dict(
+        parse_exposition(collector.registry.render())[0]
+    )["stark_watchdog_beat_age_seconds"]
+    assert age_after < age_before
+
+
+def test_watchdog_deadline_gauge_reads_active_watchdog(collector):
+    from stark_tpu.watchdog import Watchdog, active_watchdogs
+
+    samples, _ = parse_exposition(collector.registry.render())
+    assert samples["stark_watchdog_deadline_seconds"] == 0.0
+    wd = Watchdog(12.5).start()
+    try:
+        assert wd in active_watchdogs()
+        samples, _ = parse_exposition(collector.registry.render())
+        assert samples["stark_watchdog_deadline_seconds"] == 12.5
+    finally:
+        wd.stop()
+    assert wd not in active_watchdogs()
+    samples, _ = parse_exposition(collector.registry.render())
+    assert samples["stark_watchdog_deadline_seconds"] == 0.0
+
+
+def test_device_memory_sampling_never_raises(collector):
+    from stark_tpu.platform import device_memory_stats
+
+    stats = device_memory_stats()
+    # CPU devices typically report no stats; the shape contract holds
+    assert isinstance(stats, list)
+    for dev in stats:
+        assert set(dev) == {"device", "kind", "stats"}
+    collector._mem_last = 0.0
+    collector._sample_device_memory()  # must not raise on any platform
+
+
+def test_listener_dispatch_is_thread_safe(collector):
+    """Emits arrive from jax.debug.callback threads; concurrent counter
+    increments must not lose updates (the lock contract)."""
+    tr = telemetry.RunTrace(None)
+
+    def worker():
+        for b in range(50):
+            tr.emit("sample_block", block=b, dur_s=0.001, block_len=1)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    samples, _ = parse_exposition(collector.registry.render())
+    assert samples['stark_blocks_total{phase="sample"}'] == 200
+
+
+def test_non_diagnostic_health_statuses_keep_snapshot(collector):
+    """quarantine/shard_dropped/warmup_done chain_health events carry no
+    diagnostics — they must not wipe the /status health snapshot."""
+    tr = telemetry.RunTrace(None)
+    tr.emit("run_start", model="M", chains=2)
+    tr.emit("chain_health", block=1, max_rhat=1.02, min_ess=80.0)
+    tr.emit("chain_health", status="quarantine", path="x.npz",
+            reason="corrupt_checkpoint: boom")
+    tr.emit("chain_health", status="shard_dropped", shard=3)
+    snap = collector.status()
+    assert snap["health"]["max_rhat"] == 1.02
+    assert snap["health"]["min_ess"] == 80.0
+
+
+def test_attempt_gauge_resets_for_a_fresh_run(collector):
+    """attempt continues across a restart's run_start but resets to 1
+    when a NEW supervised run starts in the same process (bench runs
+    several legs per process)."""
+    tr = telemetry.RunTrace(None)
+    _emit_attempt(tr, blocks=1)
+    tr.emit("chain_health", status="restart", attempt=1, fault="transient")
+    _emit_attempt(tr, blocks=1, first_block=2)  # the retry
+    assert collector.status()["attempt"] == 2
+    tr.emit("run_end", dur_s=1.0, converged=True)
+    _emit_attempt(tr, blocks=1)  # a fresh, healthy second run
+    assert collector.status()["attempt"] == 1
+
+
+def test_fresh_run_clears_stale_status_snapshot(collector):
+    """Run B's /status must not report run A's progress/health/restarts
+    (a retry of the SAME run keeps them — they describe the resumed run)."""
+    tr = telemetry.RunTrace(None)
+    _emit_attempt(tr, blocks=2)
+    tr.emit("chain_health", status="restart", attempt=1, fault="transient")
+    tr.emit("run_start", model="M", chains=2)  # retry: snapshot retained
+    snap = collector.status()
+    assert snap["draws_per_chain"] == 50 and snap["restarts"]
+    tr.emit("run_end", dur_s=1.0, converged=True)
+    tr.emit("run_start", model="B", chains=2)  # fresh run
+    snap = collector.status()
+    assert snap["phase"] == "starting"
+    assert snap["draws_per_chain"] is None
+    assert snap["ess_forecast"] is None
+    assert snap["health"] == {} and snap["restarts"] == {}
+    assert snap["attempt"] == 1

@@ -1,0 +1,242 @@
+"""Live run-health exporter: endpoint contracts and the stall drill.
+
+Fast tier: endpoint behavior against synthetic trace events (healthz
+503 flip + recovery, parseable /metrics, /status snapshot, default-off).
+Slow tier: the REAL chaos stall scenario — a supervised run with an
+injected 60 s stall, scraped concurrently: /healthz must flip to 503
+when the watchdog fires and recover to 200 after the supervisor
+restart, and the monotone counters must never step backwards across
+the attempts.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from stark_tpu import telemetry
+from stark_tpu.statusd import StatusServer, maybe_start_from_env, resolve_port
+
+from test_metrics import parse_exposition
+
+
+def _get(port, path):
+    """(status_code, body_text) without raising on 4xx/5xx."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture
+def server():
+    srv = StatusServer(0, host="127.0.0.1").start()
+    yield srv
+    srv.stop()
+
+
+def test_endpoints_serve_metrics_status_healthz(server):
+    tr = telemetry.RunTrace(None)
+    tr.emit("run_start", entry="sample", model="M", kernel="hmc", chains=2,
+            git_sha="abc123")
+    tr.emit("sample_block", block=2, dur_s=0.1, block_len=25,
+            draws_per_chain=50, ess_forecast=120)
+    code, text = _get(server.port, "/metrics")
+    assert code == 200
+    samples, types = parse_exposition(text)
+    assert samples["stark_runs_started_total"] == 1
+    assert samples['stark_blocks_total{phase="sample"}'] == 1
+    assert types["stark_draws_total"] == "counter"
+    code, body = _get(server.port, "/healthz")
+    assert code == 200 and body == "ok\n"
+    code, body = _get(server.port, "/status")
+    assert code == 200
+    snap = json.loads(body)
+    assert snap["phase"] == "sample" and snap["block"] == 2
+    assert snap["ess_forecast"] == 120
+    assert snap["meta"]["git_sha"] == "abc123"
+    assert _get(server.port, "/nope")[0] == 404
+
+
+def test_healthz_flips_on_stall_and_recovers_on_restart(server):
+    tr = telemetry.RunTrace(None)
+    tr.emit("run_start", model="M", chains=2)
+    assert _get(server.port, "/healthz")[0] == 200
+    # the watchdog's stall event (what Watchdog._watch emits)
+    tr.emit("chain_health", status="stall", deadline_s=3.0, idle_s=3.2,
+            stall_count=1)
+    code, body = _get(server.port, "/healthz")
+    assert code == 503 and json.loads(body)["reason"] == "stall"
+    # the supervisor records the failed attempt…
+    tr.emit("chain_health", status="restart", attempt=1, fault="stall",
+            restarts_in_window=1, max_restarts=3)
+    assert _get(server.port, "/healthz")[0] == 503
+    # …and the next attempt's run_start is the recovery signal
+    tr.emit("run_start", model="M", chains=2)
+    assert _get(server.port, "/healthz")[0] == 200
+    # budget exhaustion is terminal: no later event recovers it
+    tr.emit("chain_health", status="restart_budget_exhausted",
+            restarts_in_window=4, max_restarts=3)
+    tr.emit("run_start", model="M", chains=2)
+    code, body = _get(server.port, "/healthz")
+    assert code == 503
+    assert json.loads(body)["reason"] == "restart_budget_exhausted"
+
+
+def test_off_by_default_no_thread_no_listener(monkeypatch):
+    """The zero-cost contract: port unset → no server thread, no event
+    listener, and a traced run writes byte-wise the same event shapes."""
+    monkeypatch.delenv("STARK_STATUS_PORT", raising=False)
+    assert resolve_port(None) is None
+    assert maybe_start_from_env(None) is None
+    assert not [
+        t for t in threading.enumerate()
+        if t.name.startswith("stark-statusd")
+    ]
+    assert not telemetry._EVENT_LISTENERS
+
+
+def test_cli_port_starts_and_singleton():
+    from stark_tpu import statusd
+
+    # an explicit CLI --status-port 0 requests an ephemeral bind
+    srv = maybe_start_from_env(0)
+    try:
+        assert srv is not None and srv.port is not None
+        # second call (e.g. bench.py under the CLI) reuses the daemon
+        assert maybe_start_from_env(0) is srv
+        assert _get(srv.port, "/healthz")[0] == 200
+    finally:
+        statusd.stop_status_server()
+    assert statusd.get_server() is None
+
+
+def test_env_port_zero_or_invalid_disables(monkeypatch):
+    # =0 opts out, the repo-wide env convention (STARK_PERF_LEDGER etc.):
+    # a nested job must be able to disable a CI-exported port
+    monkeypatch.setenv("STARK_STATUS_PORT", "0")
+    assert resolve_port(None) is None
+    assert maybe_start_from_env(None) is None
+    monkeypatch.setenv("STARK_STATUS_PORT", "not-a-port")
+    assert resolve_port(None) is None
+    assert maybe_start_from_env(None) is None
+
+
+def test_trace_file_bytes_unaffected_by_exporter(tmp_path):
+    """The exporter observes the trace, never mutates it: the same emit
+    sequence writes records with identical keys and identical non-clock
+    values whether or not a collector is listening."""
+
+    def run_one(path, with_server):
+        srv = StatusServer(0, host="127.0.0.1").start() if with_server else None
+        tr = telemetry.RunTrace(str(path))
+        tr.emit("run_start", model="M", kernel="hmc", chains=2)
+        tr.emit("sample_block", block=1, dur_s=0.5, block_len=25)
+        tr.emit("run_end", dur_s=1.0, converged=True)
+        tr.close()
+        if srv is not None:
+            srv.stop()
+        return telemetry.read_trace(str(path))
+
+    plain = run_one(tmp_path / "plain.jsonl", with_server=False)
+    served = run_one(tmp_path / "served.jsonl", with_server=True)
+    clock_keys = {"ts", "wall_s"}
+    assert len(plain) == len(served)
+    for a, b in zip(plain, served):
+        assert set(a) == set(b)
+        for k in set(a) - clock_keys:
+            assert a[k] == b[k], k
+
+
+def test_scrape_error_returns_500_not_crash(server):
+    """A poisoned registry must 500 the one request, not kill the daemon."""
+    server.collector.registry.render = lambda: 1 / 0  # type: ignore[assignment]
+    code, _ = _get(server.port, "/metrics")
+    assert code == 500
+    assert _get(server.port, "/healthz")[0] == 200  # daemon still alive
+
+
+# ---------------------------------------------------------------------------
+# the real thing: supervised stall chaos drill scraped live (slow tier,
+# same policy as chaos.py's stall_watchdog scenario)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_supervised_stall_drill_healthz_flip(tmp_path):
+    import jax.numpy as jnp
+
+    from stark_tpu import faults
+    from stark_tpu.model import Model, ParamSpec
+    from stark_tpu.supervise import supervised_sample
+
+    class StdNormal(Model):
+        def param_spec(self):
+            return {"x": ParamSpec((2,))}
+
+        def log_prior(self, p):
+            return -0.5 * jnp.sum(p["x"] ** 2)
+
+        def log_lik(self, p, data):
+            return jnp.zeros(())
+
+    srv = StatusServer(0, host="127.0.0.1").start()
+    seen = []  # (t, healthz_code, blocks_total) samples
+    stop = threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            code, _ = _get(srv.port, "/healthz")
+            text = _get(srv.port, "/metrics")[1]
+            samples, _types = parse_exposition(text)
+            seen.append(
+                (code, samples.get('stark_blocks_total{phase="sample"}', 0.0))
+            )
+            time.sleep(0.05)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    faults.reset()
+    faults.configure("runner.block.pre=stall(60)*1@1")
+    try:
+        # what the CLI's --status-port does when no --trace was given: an
+        # in-memory bus trace so the exporter sees the run's events
+        # (NullTrace would starve the collector — and the watchdog's
+        # stall event with it)
+        with telemetry.use_trace(telemetry.RunTrace(None)):
+            # deadline sized for this 1-core host: a first-block compile
+            # above the deadline would false-positive the watchdog (the
+            # documented "longer than the worst single dispatch including
+            # its compile" rule) — the injected stall is 60 s, so 8 s
+            # still detects it 7x faster while staying clear of compile
+            post = supervised_sample(
+                StdNormal(), workdir=str(tmp_path), seed=0,
+                stall_timeout_s=8.0, max_restarts=5, chains=2,
+                block_size=25, max_blocks=8, min_blocks=2,
+                rhat_target=10.0, ess_target=1.0, num_warmup=40,
+                kernel="hmc", num_leapfrog=8,
+            )
+    finally:
+        faults.reset()
+        stop.set()
+        poller.join(timeout=5)
+    assert post is not None
+    codes = [c for c, _ in seen]
+    assert 503 in codes, "healthz never flipped during the stall"
+    # the run finished: the final state must be recovered
+    assert _get(srv.port, "/healthz")[0] == 200
+    # monotone counters across the restart: never a backward step
+    blocks = [b for _, b in seen]
+    assert all(b2 >= b1 for b1, b2 in zip(blocks, blocks[1:]))
+    samples, _types = parse_exposition(_get(srv.port, "/metrics")[1])
+    assert samples['stark_restarts_total{fault="stall"}'] >= 1
+    assert samples["stark_stalls_total"] >= 1
+    assert samples["stark_runs_started_total"] >= 2
+    srv.stop()

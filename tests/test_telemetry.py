@@ -412,3 +412,186 @@ def test_reopened_trace_continues_run_ordinals(tmp_path):
     events = read_trace(str(p))
     assert [e["run"] for e in events] == [1, 1, 2, 2]
     assert summarize_trace(events)["wall_s"] == 0.7  # last run, unmerged
+
+
+# ---------------------------------------------------------------------------
+# event listeners + in-memory bus (the live-exporter fan-out)
+# ---------------------------------------------------------------------------
+
+
+def test_event_listeners_receive_every_record(tmp_path):
+    p = tmp_path / "t.jsonl"
+    seen = []
+    telemetry.add_event_listener(seen.append)
+    try:
+        with RunTrace(str(p)) as tr:
+            tr.emit("run_start", model="M")
+            with tr.phase("sample_block", block=1):
+                pass
+    finally:
+        telemetry.remove_event_listener(seen.append)
+    assert [e["event"] for e in seen] == ["run_start", "sample_block"]
+    # listeners see the SAME record that lands in the file
+    events = read_trace(str(p))
+    assert seen[0] == events[0] and seen[1] == events[1]
+    # removed: no further delivery
+    with RunTrace(str(p)) as tr:
+        tr.emit("run_end", dur_s=0.1)
+    assert len(seen) == 2
+
+
+def test_in_memory_trace_feeds_listeners_writes_nothing(tmp_path):
+    seen = []
+    telemetry.add_event_listener(seen.append)
+    try:
+        tr = RunTrace(None)  # the status daemon's untraced mode
+        assert tr.path is None and tr.enabled
+        tr.emit("run_start", model="M")
+        tr.emit("run_end", dur_s=0.2)
+    finally:
+        telemetry.remove_event_listener(seen.append)
+    assert [e["event"] for e in seen] == ["run_start", "run_end"]
+    assert seen[0]["run"] == 1 and seen[0]["schema"] == SCHEMA_VERSION
+    assert list(tmp_path.iterdir()) == []  # nothing hit the filesystem
+
+
+def test_in_memory_trace_without_listeners_is_noop():
+    tr = RunTrace(None)
+    assert tr.emit("run_start") is None  # nothing to deliver to
+
+
+def test_listener_exception_never_reaches_the_run(tmp_path):
+    p = tmp_path / "t.jsonl"
+
+    def bad(rec):
+        raise RuntimeError("listener bug")
+
+    telemetry.add_event_listener(bad)
+    try:
+        with RunTrace(str(p)) as tr:
+            assert tr.emit("run_start") is not None
+    finally:
+        telemetry.remove_event_listener(bad)
+    assert read_trace(str(p))[0]["event"] == "run_start"
+
+
+def test_no_listener_no_record_overhead(tmp_path):
+    """The zero-cost contract: without listeners, an emit on a file-less
+    trace builds nothing, and NullTrace still does nothing at all."""
+    assert not telemetry._EVENT_LISTENERS
+    assert RunTrace(None).emit("sample_block") is None
+    assert NULL_TRACE.emit("sample_block") is None
+
+
+# ---------------------------------------------------------------------------
+# provenance stamping (satellite: attributable ledger rows / run_starts)
+# ---------------------------------------------------------------------------
+
+
+def test_provenance_fields_and_caching():
+    prov = telemetry.provenance()
+    assert set(prov) == {"git_sha", "jax_version", "jaxlib_version"}
+    # best-effort: values may be None, but in this repo git + jax exist
+    assert prov["jax_version"]
+    assert prov["git_sha"]
+    # cached: the second call is the same content, not a new subprocess
+    assert telemetry.provenance() == prov
+    # callers mutate their copy safely
+    prov["git_sha"] = "clobbered"
+    assert telemetry.provenance()["git_sha"] != "clobbered"
+
+
+def test_run_start_carries_provenance_and_device_kind(tmp_path):
+    p = tmp_path / "t.jsonl"
+    with use_trace(RunTrace(str(p))):
+        stark_tpu.sample(
+            StdNormal2(), chains=2, kernel="hmc", num_leapfrog=4,
+            num_warmup=5, num_samples=5, seed=0,
+        )
+    start = read_trace(str(p), strict=False)[0]
+    assert start["event"] == "run_start"
+    for k in ("git_sha", "jax_version", "jaxlib_version", "device_kind"):
+        assert k in start, k
+    # summarize_trace surfaces them through meta (the ledger reads this)
+    meta = summarize_trace(read_trace(str(p), strict=False))["meta"]
+    assert "git_sha" in meta and "jax_version" in meta
+
+
+# ---------------------------------------------------------------------------
+# PR-1-era traces degrade gracefully in the report tool (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _pr1_era_trace(path):
+    """A trace as PR 1 wrote them: no overlap/diag/block_len/provenance
+    fields anywhere."""
+    events = [
+        {"event": "run_start", "entry": "sample", "model": "M",
+         "kernel": "nuts", "chains": 4, "platform": "cpu",
+         "device_count": 1},
+        {"event": "compile", "dur_s": 0.5, "stage": "setup"},
+        {"event": "warmup_block", "dur_s": 0.3, "start": 0, "end": 50},
+        {"event": "sample_block", "dur_s": 0.4, "t_dispatch_s": 0.3,
+         "t_diag_s": 0.1},
+        {"event": "chain_health", "max_rhat": 1.01, "min_ess": 200.0,
+         "mean_accept": 0.8, "num_divergent": 0},
+        {"event": "checkpoint", "dur_s": 0.05},
+        {"event": "run_end", "dur_s": 1.0, "num_divergent": 0},
+    ]
+    with open(path, "w") as f:
+        for i, e in enumerate(events):
+            f.write(json.dumps({
+                "schema": SCHEMA_VERSION, "ts": 1.0 + i,
+                "wall_s": float(i), "run": 1, **e,
+            }) + "\n")
+
+
+def test_trace_report_degrades_on_pr1_era_traces(tmp_path):
+    """Traces that predate the overlap/diag fields must render (no
+    KeyError), simply omitting the newer tables; --json emits the
+    summarize_trace dict with empty overlap/diag sections."""
+    import importlib.util
+
+    p = tmp_path / "old.jsonl"
+    _pr1_era_trace(p)
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "trace_report.py"),
+    )
+    trace_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_report)
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert trace_report.main([str(p)]) == 0
+    out = buf.getvalue()
+    assert "sample_block" in out and "max R-hat" in out
+    assert "block overlap" not in out  # absent, not crashed
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert trace_report.main([str(p), "--json"]) == 0
+    summary = json.loads(buf.getvalue())
+    assert summary["overlap"] == {} and summary["diag"] == {}
+    assert summary["health"]["max_rhat"] == 1.01
+    # the ledger ingests the same dict without choking on the gaps
+    from stark_tpu import ledger
+
+    row = ledger.make_row(source="test", config="old", trace_summary=summary)
+    assert row["device_idle_frac"] is None
+    assert row["ess_per_sec"] == pytest.approx(200.0)
+
+
+def test_trace_report_renders_na_for_missing_values():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "trace_report.py"),
+    )
+    trace_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_report)
+    assert trace_report._fmt(None) == "n/a"

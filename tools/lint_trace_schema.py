@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Repo lint: every emitted trace event name must be in the schema registry.
+
+The telemetry schema grew three consumer layers — ``summarize_trace`` /
+``trace_report``, the perf ledger, and the live metrics exporter — all
+keyed on event NAMES.  A typo'd or undocumented ``emit("sampel_block")``
+would silently vanish from every one of them (readers must tolerate
+unknown types by the forward-compat rule, so nothing would ever raise).
+This lint closes the loop: it statically collects every
+``*.emit("<name>", ...)`` and ``*.phase("<name>", ...)`` call in
+``stark_tpu/`` whose first argument is a string literal and fails if a
+name is missing from `stark_tpu.telemetry.ALL_EVENT_TYPES` (the canonical
+set plus the documented auxiliaries).  Non-literal first arguments (the
+`_Phase` re-emit helper's variable) are skipped — the names they forward
+were already collected at their literal call sites.
+
+AST-based (strings/comments can't trip it); `stark_tpu.telemetry` imports
+no jax at module load, so the lint runs anywhere.  Run directly
+(``python tools/lint_trace_schema.py``) or via the test suite
+(``tests/test_lint_trace_schema.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stark_tpu.telemetry import ALL_EVENT_TYPES  # noqa: E402
+
+#: emit-like attribute names whose first positional argument is an event
+#: type from the schema registry
+_EMIT_METHODS = frozenset({"emit", "phase"})
+
+
+def find_event_names(source: str, filename: str) -> List[Tuple[int, str]]:
+    """(lineno, event_name) of every literal emit()/phase() call."""
+    tree = ast.parse(source, filename=filename)
+    hits = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _EMIT_METHODS
+            and node.args
+        ):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            hits.append((node.lineno, arg.value))
+    return hits
+
+
+def lint_package(pkg_dir: str) -> List[str]:
+    """Violation strings ("path:line: name") for the whole package."""
+    violations = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        if "__pycache__" in root:
+            continue
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            with open(path) as f:
+                source = f.read()
+            for lineno, event in find_event_names(source, path):
+                if event not in ALL_EVENT_TYPES:
+                    violations.append(f"{path}:{lineno}: {event!r}")
+    return violations
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(repo, "stark_tpu")
+    violations = lint_package(pkg)
+    for v in violations:
+        print(v, file=sys.stderr)
+    if violations:
+        known = ", ".join(sorted(ALL_EVENT_TYPES))
+        print(
+            f"{len(violations)} emit/phase call(s) with event names missing "
+            f"from telemetry's schema registry (known: {known}) — add the "
+            "event to EVENT_TYPES/AUX_EVENT_TYPES (and document it) or fix "
+            "the name (see tools/lint_trace_schema.py docstring)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Cross-run performance regression ledger: ingest rows, gate on check.
+
+    # append a row from a bench artifact (the final JSON line of bench.py)
+    python tools/perf_ledger.py ingest --bench-json artifact.json \\
+        --config "flagship:n=20000" --note "r6 capture"
+
+    # append a row from a telemetry trace (any --trace'd run)
+    python tools/perf_ledger.py ingest --trace /tmp/t.jsonl --config smoke
+
+    # gate: newest row vs the trailing median of its config peers
+    python tools/perf_ledger.py check              # exit 1 on regression
+    python tools/perf_ledger.py check --strict --tolerance 0.15 --window 7
+    python tools/perf_ledger.py show               # render the ledger
+
+``ingest`` accepts ``--bench-json -`` to read the artifact from stdin
+(``python bench.py | tail -1 | python tools/perf_ledger.py ingest ...``);
+when a bench artifact AND a trace are both given the bench line wins per
+metric.  The ledger lives at ``bench_artifacts/ledger.jsonl`` unless
+``--ledger``/``STARK_PERF_LEDGER`` points elsewhere; ``bench.py``
+auto-appends after every full run (STARK_PERF_LEDGER=0 opts out).
+
+Row schema, tolerance semantics, and the trailing-median rule live in
+`stark_tpu.ledger` (shared with the bench auto-append); the trace read
+path reuses `telemetry.summarize_trace` — the same dict
+``tools/trace_report.py --json`` emits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# repo-root invocation without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stark_tpu import ledger  # noqa: E402
+
+
+def _load_bench_json(arg: str):
+    """The bench artifact dict from a file ('-' = stdin).  Accepts either
+    a bare JSON object or bench.py's full stdout (takes the LAST
+    parseable JSON line — the authoritative artifact line)."""
+    text = sys.stdin.read() if arg == "-" else open(arg).read()
+    text = text.strip()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            return rec
+    raise SystemExit(f"no parseable JSON object in {arg!r}")
+
+
+def _ledger_path(args) -> str:
+    path = args.ledger or ledger.default_ledger_path()
+    if path is None:
+        raise SystemExit(
+            f"ledger disabled ({ledger.LEDGER_ENV}=0) — pass --ledger PATH"
+        )
+    return path
+
+
+def cmd_ingest(args) -> int:
+    if not args.bench_json and not args.trace:
+        raise SystemExit("ingest needs --bench-json and/or --trace")
+    bench = _load_bench_json(args.bench_json) if args.bench_json else None
+    summary = None
+    if args.trace:
+        from stark_tpu.telemetry import read_trace, summarize_trace
+
+        summary = summarize_trace(read_trace(args.trace, strict=False))
+    config = args.config
+    if config is None and bench is not None:
+        # the bench artifact's metric string identifies the workload
+        config = str(bench.get("metric", "unknown"))
+    row = ledger.make_row(
+        source="perf_ledger ingest",
+        config=config or "unknown",
+        bench=bench,
+        trace_summary=summary,
+        note=args.note,
+    )
+    path = ledger.append_row(row, _ledger_path(args))
+    print(json.dumps({"ingested": row, "ledger": path}))
+    return 0
+
+
+def cmd_check(args) -> int:
+    path = _ledger_path(args)
+    rows = ledger.read_rows(path)
+    ok, report = ledger.check_rows(
+        rows,
+        window=args.window,
+        tolerance=args.tolerance,
+        min_history=args.min_history,
+        strict=args.strict,
+        config=args.config,
+        all_configs=args.all_configs,
+    )
+    for line in report:
+        print(line)
+    if not ok:
+        print(f"PERF REGRESSION ({path})", file=sys.stderr)
+        return 1
+    print("ok")
+    return 0
+
+
+def cmd_show(args) -> int:
+    rows = ledger.read_rows(_ledger_path(args))
+    if not rows:
+        print("(empty ledger)")
+        return 0
+    cols = ("ts", "config", "git_sha", "ess_per_sec", "wall_s",
+            "device_idle_frac", "overshoot_draws", "converged")
+    for r in rows:
+        print(json.dumps({k: r.get(k) for k in cols}))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--ledger", metavar="PATH", default=None,
+        help="ledger file (default: bench_artifacts/ledger.jsonl, "
+        f"override with {ledger.LEDGER_ENV})",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_in = sub.add_parser("ingest", help="append one row to the ledger")
+    p_in.add_argument(
+        "--bench-json", metavar="PATH",
+        help="bench artifact JSON ('-' = stdin; bench.py stdout works, "
+        "the last JSON line wins)",
+    )
+    p_in.add_argument(
+        "--trace", metavar="PATH",
+        help="telemetry trace to summarize into the row",
+    )
+    p_in.add_argument(
+        "--config", default=None,
+        help="comparability key (rows gate only against the same config)",
+    )
+    p_in.add_argument("--note", default=None)
+    p_in.set_defaults(fn=cmd_ingest)
+
+    p_ck = sub.add_parser(
+        "check", help="gate the newest row vs the trailing median"
+    )
+    p_ck.add_argument("--window", type=int, default=5,
+                      help="trailing rows in the median (default 5)")
+    p_ck.add_argument("--tolerance", type=float, default=0.25,
+                      help="allowed fractional slack (default 0.25)")
+    p_ck.add_argument("--min-history", type=int, default=2,
+                      help="prior rows required before gating (default 2)")
+    p_ck.add_argument("--strict", action="store_true",
+                      help="gate the efficiency metrics too, not just "
+                      "ess_per_sec")
+    gate = p_ck.add_mutually_exclusive_group()
+    gate.add_argument(
+        "--config", default=None,
+        help="gate the newest row of THIS config (use when other "
+        "configs may have appended after the run under test)",
+    )
+    gate.add_argument(
+        "--all-configs", action="store_true",
+        help="gate the newest row of every config in the ledger",
+    )
+    p_ck.set_defaults(fn=cmd_check)
+
+    p_sh = sub.add_parser("show", help="print the ledger, one row per line")
+    p_sh.set_defaults(fn=cmd_show)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,11 @@ that installs a `stark_tpu.telemetry.RunTrace`.  Stdlib-only on the read
 path apart from the schema helpers it shares with the writer
 (`stark_tpu.telemetry`) — no jax import, so it runs anywhere the trace
 file lands, including hosts with a dead accelerator tunnel.
+
+Forward/backward compat: fields a trace predates (PR-1-era files carry no
+overlap/diag accounting) render as ``n/a`` — never an error — and
+``--json`` emits the raw `summarize_trace` dict, the machine contract
+``tools/perf_ledger.py ingest --trace`` consumes for ledger rows.
 """
 
 from __future__ import annotations
@@ -32,8 +37,10 @@ from stark_tpu.telemetry import (  # noqa: E402
 
 
 def _fmt(v) -> str:
+    # "n/a", never a crash: traces written before a field existed (e.g.
+    # PR-1-era files predate the overlap/diag fields) must still render
     if v is None:
-        return "—"
+        return "n/a"
     if isinstance(v, bool):
         return "yes" if v else "no"
     if isinstance(v, float):
