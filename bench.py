@@ -730,10 +730,10 @@ def main():
             # BENCH_AUTODIFF=0 opt-out is respected even here
             timed_run(model, "NUTS autodiff")
 
-    def append_ledger_row(bench_dict, sampler):
-        """Cross-run perf regression ledger (stark_tpu.ledger): append
-        this run's headline numbers so `tools/perf_ledger.py check` can
-        gate the NEXT run against the trailing median.  Best-effort by
+    def append_ledger(config, bench_dict, extra_keys=(), label="perf"):
+        """Cross-run perf regression ledger (stark_tpu.ledger): append a
+        row so `tools/perf_ledger.py check` can gate the NEXT run against
+        the trailing median of its config series.  Best-effort by
         contract — a full disk must not turn a measured bench into a
         failure — and STARK_PERF_LEDGER=0 opts out (tiny-scale tests)."""
         try:
@@ -743,26 +743,32 @@ def main():
             if ledger_path is None:
                 return
             row = perf_ledger.make_row(
-                source="bench.py",
-                # comparability key: every axis that changes the measured
-                # workload — rows gate only against identical configs.
-                # The sampler axis matters because the value can come
-                # from a fallback NUTS leg when ChEES failed/unconverged;
-                # its rows must never pollute the ChEES trailing median.
-                config=(
-                    f"flagship:n={n}:d={d}:g={groups}"
-                    f":cc={cc}:w={chees_warm}:s={chees_samp}"
-                    f":grouped={int(grouped)}"
-                    f":platform={platform}:fallback={fell_back}"
-                    f":sampler={sampler}"
-                ),
-                bench=bench_dict,
+                source="bench.py", config=config, bench=bench_dict,
             )
+            for k in extra_keys:
+                if bench_dict.get(k) is not None:
+                    row[k] = bench_dict[k]
             perf_ledger.append_row(row, ledger_path)
-            print(f"[bench] perf ledger row appended to {ledger_path}",
+            print(f"[bench] {label} ledger row appended to {ledger_path}",
                   file=sys.stderr)
         except Exception as e:  # noqa: BLE001 — the ledger must not fail the bench
-            print(f"[bench] perf ledger append failed: {e!r}", file=sys.stderr)
+            print(f"[bench] {label} ledger append failed: {e!r}",
+                  file=sys.stderr)
+
+    def append_ledger_row(bench_dict, sampler):
+        # comparability key: every axis that changes the measured
+        # workload — rows gate only against identical configs.  The
+        # sampler axis matters because the value can come from a
+        # fallback NUTS leg when ChEES failed/unconverged; its rows must
+        # never pollute the ChEES trailing median.
+        append_ledger(
+            f"flagship:n={n}:d={d}:g={groups}"
+            f":cc={cc}:w={chees_warm}:s={chees_samp}"
+            f":grouped={int(grouped)}"
+            f":platform={platform}:fallback={fell_back}"
+            f":sampler={sampler}",
+            bench_dict,
+        )
 
     picked = select_result(results)
     if picked is None:
@@ -831,8 +837,16 @@ def main():
             })
             return row
 
+        fleet_problems = _env_int("BENCH_FLEET_PROBLEMS", 256)
         legs = (
             ("eight_schools", bmarks.bench_eight_schools, 25.0),
+            (
+                "fleet_eight_schools",
+                lambda: bmarks.bench_fleet_eight_schools(
+                    problems=fleet_problems
+                ),
+                240.0,
+            ),
             ("bnn_sghmc", bmarks.bench_bnn_sghmc, 130.0),
             (
                 "consensus_logistic",
@@ -840,6 +854,26 @@ def main():
                 320.0,
             ),
         )
+
+        def append_fleet_ledger_row(row):
+            """The fleet leg gets its OWN ledger config key (distinct
+            row series from the flagship), so `perf_ledger.py check`
+            ratchets the fleet speedup independently."""
+            append_ledger(
+                f"fleet:eight_schools:B={row.get('problems')}"
+                f":chains={row.get('chains')}"
+                f":platform={platform}",
+                row,
+                # fleet-specific evidence recorded for trend analysis;
+                # check/--strict gates only ledger.METRIC_SPECS, so these
+                # keys are NOT regression-gated
+                extra_keys=("converged_fraction", "speedup_vs_sequential",
+                            "speedup_vs_warm_sequential",
+                            "seq_per_job_ess_per_sec_est",
+                            "seq_warm_ess_per_sec_est", "fleet_grad_evals"),
+                label="fleet",
+            )
+
         for leg_name, leg_fn, est in legs:
             elapsed = time.perf_counter() - t_bench
             if elapsed + est > time_budget * 0.95:
@@ -853,7 +887,10 @@ def main():
             try:
                 t0x = time.perf_counter()
                 r = leg_fn()
-                extra_evidence.append(res_row(r))
+                row = res_row(r)
+                extra_evidence.append(row)
+                if leg_name == "fleet_eight_schools":
+                    append_fleet_ledger_row(row)
                 print(
                     f"[bench] extra evidence {leg_name}: "
                     f"{r.ess_per_sec:.2f} {r.metric_name} "

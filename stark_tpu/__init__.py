@@ -37,6 +37,7 @@ del _prec, _explicit_skip
 from . import bijectors, compare, diagnostics
 from .model import Model, ParamSpec, flatten_model, prepare_model_data
 from .chees import chees_sample
+from .fleet import FleetSpec, sample_fleet, supervised_sample_fleet
 from .runner import sample_until_converged
 from .sampler import Posterior, SamplerConfig, sample
 from .sghmc import sghmc_sample
@@ -50,10 +51,13 @@ __all__ = [
     "flatten_model",
     "prepare_model_data",
     "sample",
+    "sample_fleet",
     "sample_until_converged",
     "sghmc_sample",
     "chees_sample",
     "supervised_sample",
+    "supervised_sample_fleet",
+    "FleetSpec",
     "ChainHealthError",
     "Posterior",
     "SamplerConfig",

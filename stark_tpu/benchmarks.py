@@ -118,6 +118,137 @@ def bench_eight_schools(*, chains=4, num_warmup=500, num_samples=1000, seed=0):
     return _result("eight_schools_nuts", post, wall)
 
 
+def fleet_eight_schools_spec(problems: int, *, seed: int = 0):
+    """An eight-schools fleet: the classic dataset re-observed ``problems``
+    times with fresh measurement noise — same hierarchical structure,
+    different data per problem (the per-user/per-segment shape of ROADMAP
+    item 2)."""
+    from .fleet import FleetSpec
+    from .models.eight_schools import SIGMA, Y
+
+    rng = np.random.default_rng(seed)
+    y, sig = np.asarray(Y), np.asarray(SIGMA)
+    datasets = [
+        {
+            "y": (y + rng.normal(0.0, 0.25 * sig, y.shape)).astype(
+                np.float32
+            ),
+            "sigma": sig,
+        }
+        for _ in range(problems)
+    ]
+    return FleetSpec.from_problems(EightSchools(), datasets)
+
+
+def bench_fleet_eight_schools(
+    *, problems=256, chains=4, num_warmup=200, block_size=50, max_blocks=24,
+    ess_target=100.0, rhat_target=1.01, max_tree_depth=5, seq_probe=2,
+    seed=0,
+):
+    """Fleet leg: eight-schools x ``problems`` through ONE vmapped block
+    loop (stark_tpu.fleet), vs the same problems served sequentially.
+
+    Headline: AGGREGATE min-ESS/s — the sum of per-problem min-ESS over
+    the fleet wall (the throughput a per-user service actually delivers),
+    measured on the steady-state pass (`_timed` convention: the compile
+    pass is untimed, like every other leg).  ``max_tree_depth`` is capped
+    below the single-problem default because a vmapped NUTS batch steps
+    every lane until the DEEPEST tree finishes — bounding the depth
+    bounds the lane-sync waste (the sequential baseline runs the same
+    cap, so the comparison stays apples-to-apples).
+
+    TWO sequential baselines ride in ``extra``, both extrapolated from
+    ``seq_probe`` measured runs of the unmodified single-problem runner:
+
+    * ``seq_per_job_ess_per_sec_est`` — a FRESH backend per problem: the
+      one-job-per-process serving mode, the only way this repo served N
+      posteriors before the fleet runner (ROADMAP item 1), with each job
+      re-paying trace/compile (process startup excluded, so it is an
+      UNDERestimate of the real per-job cost).  ``speedup_vs_sequential``
+      is measured against this baseline.
+    * ``seq_warm_ess_per_sec_est`` — one shared backend across the sweep
+      (compiled segments reused): the in-process steady-state floor.  On
+      a CPU host batching cannot beat it (no parallel lane width — the
+      honest number rides in ``speedup_vs_warm_sequential``); on
+      dispatch-bound accelerators this is the gap the tfp.mcmc argument
+      says the fleet opens (PAPERS.md).
+    """
+    from .fleet import sample_fleet
+    from .runner import sample_until_converged
+
+    spec = fleet_eight_schools_spec(problems, seed=seed)
+    gate_kw = dict(
+        chains=chains, num_warmup=num_warmup, block_size=block_size,
+        max_blocks=max_blocks, min_blocks=2, ess_target=ess_target,
+        rhat_target=rhat_target, kernel="nuts",
+        max_tree_depth=max_tree_depth,
+    )
+    res, wall = _timed(lambda: sample_fleet(spec, seed=seed, **gate_kw))
+
+    per_ess = [p.min_ess for p in res.problems if p.min_ess is not None]
+    agg_ess = float(np.sum(per_ess)) if per_ess else float("nan")
+    max_rhat = float(np.max([
+        p.max_rhat for p in res.problems if p.max_rhat is not None
+    ] or [float("nan")]))
+    conv_frac = res.converged_fraction
+    fleet_rate = agg_ess / wall if wall else 0.0
+
+    def _run_one(i, backend):
+        r = sample_until_converged(
+            spec.model, spec.datasets[i], backend=backend,
+            seed=seed + i, adaptive_blocks=False, **gate_kw,
+        )
+        last = [h for h in r.history if h.get("event") == "block"][-1]
+        e = last.get("full_min_ess", last.get("min_ess"))
+        return float(e) if e is not None else 0.0
+
+    n_probe = max(1, min(seq_probe, problems))
+    # per-job baseline: fresh backend per problem (each probe re-traces)
+    pj_ess, backend = 0.0, None
+    t0 = time.perf_counter()
+    for i in range(n_probe):
+        backend = JaxBackend()
+        pj_ess += _run_one(i, backend)
+    pj_wall = time.perf_counter() - t0
+    pj_rate = (pj_ess / pj_wall) if pj_wall else 0.0
+    # warm baseline: the last probe's backend is compiled — re-run the
+    # same probe problems through it, steady-state
+    t0 = time.perf_counter()
+    warm_ess = sum(_run_one(i, backend) for i in range(n_probe))
+    warm_wall = time.perf_counter() - t0
+    warm_rate = (warm_ess / warm_wall) if warm_wall else 0.0
+
+    return BenchResult(
+        name=f"fleet_eight_schools_x{problems}",
+        wall_s=wall,
+        min_ess=agg_ess,
+        ess_per_sec=fleet_rate,
+        max_rhat=max_rhat,
+        metric_name="aggregate min-ESS/s",
+        # the fleet's own gate: a high-convergence fleet, not one lucky
+        # problem (max_rhat stays in the table as a diagnostic)
+        converged=conv_frac >= 0.95,
+        gate=">=95% problems converged",
+        extra={
+            "problems": problems,
+            "chains": chains,
+            "converged_fraction": round(conv_frac, 4),
+            "blocks_dispatched": res.blocks_dispatched,
+            "compactions": res.compactions,
+            "fleet_grad_evals": res.total_grad_evals,
+            "seq_probe": n_probe,
+            "seq_per_job_ess_per_sec_est": round(pj_rate, 3),
+            "seq_warm_ess_per_sec_est": round(warm_rate, 3),
+            "speedup_vs_sequential": round(
+                fleet_rate / pj_rate, 2
+            ) if pj_rate else None,
+            "speedup_vs_warm_sequential": round(
+                fleet_rate / warm_rate, 2
+            ) if warm_rate else None,
+        },
+    )
+
+
 def bench_hier_logistic(
     *, n=200_000, d=32, groups=1000, chains=16, num_warmup=450,
     num_samples=300, max_tree_depth=6, seed=0, backend=None,

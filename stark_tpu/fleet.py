@@ -1,0 +1,1487 @@
+"""Fleet sampling: one compiled, vmapped scan advances B independent
+posteriors per device dispatch (ROADMAP item 2).
+
+The tfp.mcmc paper (PAPERS.md) argues modern hardware wants thousands of
+chains per dispatch; production traffic wants thousands of *posteriors* —
+per-user / per-segment models with shared structure but different data.
+The single-problem runner amortizes the host round-trip over one problem's
+chains; at eight-schools scale (0.3 s wall) serving N small posteriors
+sequentially pays the dispatch + host-loop overhead N times.  This module
+vmaps the existing per-chain block scan (`sampler.make_block_runner`) and
+warmup parts over a leading PROBLEM axis, so ONE dispatch advances the
+whole fleet:
+
+  * **Model contract** — a `FleetSpec` wraps one shared `Model` (same
+    ``param_spec``/``log_prior``/``log_lik``) with a per-problem dataset
+    list; data leaves are stacked along a new axis 0 AFTER the model's
+    ``prepare_data`` layout hook runs per problem, so fused-layout models
+    batch correctly.
+  * **Kernel plumbing** — the NUTS/HMC block scan and the windowed warmup
+    gain the problem axis via an outer ``jax.vmap``; step-size /
+    mass-matrix adaptation state and the PR 4 `StreamDiagState` streaming
+    diagnostics carry are per problem per chain (one more leading axis on
+    the same layout).
+  * **Ragged convergence** — the streaming ESS gate is evaluated PER
+    PROBLEM; a problem that passes its full split-R-hat/ESS validation is
+    masked out (its persisted draws are frozen, its gradient evaluations
+    stop counting toward any budget) and lanes are COMPACTED out of the
+    batch at a block boundary once occupancy drops below
+    ``refill_occupancy`` — stragglers keep sampling in a smaller batch,
+    and queued problems (``max_batch``) are warmed up and swapped in.
+  * **Fleet-aware persistence/telemetry** — per-problem draw stores
+    (`FleetDrawStore`), one fleet checkpoint carrying the active set,
+    ``fleet_block`` / ``problem_converged`` / ``fleet_compact`` trace
+    events, and per-problem fields in ``/status`` (stark_tpu.metrics).
+
+Determinism contract: every problem owns an independent host-side PRNG
+stream (``PRNGKey(seed + index)``) advanced with exactly the single-problem
+runner's key discipline, and lanes of a vmapped batch are bit-identical to
+the unbatched computation on the same backend — so a problem's draws do
+not depend on which other problems share its batch, survive compaction /
+refill / crash-resume unchanged, and a straggler reaches the SAME draws
+as ``sample_until_converged(seed=seed+index, adaptive_blocks=False)``
+(tests/test_fleet.py drills all three).
+
+Escape hatches: ``STARK_FLEET=0`` (or ``fleet=False``) runs the problems
+SEQUENTIALLY through the unmodified single-problem runner — and a
+one-problem fleet always takes that path, so B=1 is bit-identical to
+`runner.sample_until_converged` by construction (draws, metrics trail,
+checkpoint arrays), the same flags-off discipline as PRs 3–4.
+
+Out of scope (documented, not silently wrong): the chees ensemble kernel
+(its warmup adapts cross-chain with its own host loop) and multi-process
+meshes raise; per-problem ``init_params``/adaptation import are not
+plumbed.  Supervision composes: `supervised_sample_fleet` runs the fleet
+under the PR 2 restart machinery, and a crash resumes the SURVIVING
+active set from the fleet checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import diagnostics, faults, telemetry
+from .adaptation import build_warmup_schedule
+from .kernels.base import STREAM_DIAG_LAGS, HMCState, StreamDiagState
+from .model import Model, flatten_model, prepare_model_data
+from .sampler import SamplerConfig, make_block_runner, make_warmup_parts
+
+Array = jax.Array
+PyTree = Any
+
+#: env escape hatch: "0" forces the sequential single-problem path
+FLEET_ENV = "STARK_FLEET"
+
+#: seed spacing between problems on RESEEDED sequential restarts — wide
+#: enough that the supervisor's per-attempt seed bump never walks one
+#: problem's cold stream onto a neighbor's (see `_cold_key`)
+_RESEED_STRIDE = 1 << 20
+
+
+# --------------------------------------------------------------------------
+# model contract: one shared Model, B stacked datasets
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSpec:
+    """One shared `Model` + per-problem datasets with identical pytree
+    structure and leaf shapes (the "shared structure, different data"
+    contract).  ``problem_ids`` name the problems in every persisted
+    artifact (draw stores, checkpoints, trace events, /status)."""
+
+    model: Model
+    datasets: Tuple[PyTree, ...]
+    problem_ids: Tuple[str, ...]
+
+    def __post_init__(self):
+        if not self.datasets:
+            raise ValueError("FleetSpec needs at least one problem")
+        if len(self.problem_ids) != len(self.datasets):
+            raise ValueError(
+                f"{len(self.problem_ids)} problem_ids for "
+                f"{len(self.datasets)} datasets"
+            )
+        if len(set(self.problem_ids)) != len(self.problem_ids):
+            raise ValueError("problem_ids must be unique")
+        ref = jax.tree.structure(self.datasets[0])
+        ref_shapes = [np.shape(a) for a in jax.tree.leaves(self.datasets[0])]
+        for i, d in enumerate(self.datasets[1:], start=1):
+            if jax.tree.structure(d) != ref:
+                raise ValueError(
+                    f"problem {self.problem_ids[i]!r}: data pytree "
+                    "structure differs from problem 0 (fleet batching "
+                    "needs identical structure and leaf shapes)"
+                )
+            shapes = [np.shape(a) for a in jax.tree.leaves(d)]
+            if shapes != ref_shapes:
+                raise ValueError(
+                    f"problem {self.problem_ids[i]!r}: data leaf shapes "
+                    f"{shapes} differ from problem 0's {ref_shapes} "
+                    "(fleet batching stacks along a new leading axis)"
+                )
+
+    @classmethod
+    def from_problems(
+        cls,
+        model: Model,
+        datasets: Sequence[PyTree],
+        problem_ids: Optional[Sequence[str]] = None,
+    ) -> "FleetSpec":
+        if problem_ids is None:
+            problem_ids = [f"p{i:04d}" for i in range(len(datasets))]
+        return cls(model, tuple(datasets), tuple(str(p) for p in problem_ids))
+
+    @classmethod
+    def from_stacked(
+        cls,
+        model: Model,
+        stacked: PyTree,
+        problem_ids: Optional[Sequence[str]] = None,
+    ) -> "FleetSpec":
+        """Split a pre-stacked pytree (leading axis = problems) back into
+        the per-problem dataset list (views, no copies)."""
+        sizes = {int(np.shape(leaf)[0]) for leaf in jax.tree.leaves(stacked)}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"stacked leaves disagree on the problem axis: {sizes}"
+            )
+        b = sizes.pop()
+        datasets = [jax.tree.map(lambda a, i=i: a[i], stacked) for i in range(b)]
+        return cls.from_problems(model, datasets, problem_ids)
+
+    @property
+    def num_problems(self) -> int:
+        return len(self.datasets)
+
+    def prepared_stacked(self) -> PyTree:
+        """Apply the model's host-side ``prepare_data`` layout hook PER
+        PROBLEM, then stack along a new leading problem axis — the device
+        layout every fleet dispatch closes over."""
+        prepared = [prepare_model_data(self.model, d) for d in self.datasets]
+        if prepared[0] is None:
+            raise ValueError("fleet sampling requires per-problem data")
+        return jax.tree.map(lambda *leaves: jnp.stack(leaves), *prepared)
+
+
+# --------------------------------------------------------------------------
+# results
+# --------------------------------------------------------------------------
+
+
+class FleetProblemResult:
+    """One problem's slice of a fleet run.  ``draws`` (constrained, named)
+    is computed lazily through a fm-shared jit cache so a 256-problem
+    fleet does not pay 256 recompiles of the constrain map."""
+
+    def __init__(self, problem_id, draws_flat, fm, *, converged,
+                 budget_exhausted, blocks, grad_evals, num_divergent,
+                 min_ess, max_rhat, history, _constrain_cache):
+        self.problem_id = problem_id
+        self.draws_flat = draws_flat  # (chains, n, d) unconstrained
+        self.flat_model = fm
+        self.converged = converged
+        self.budget_exhausted = budget_exhausted
+        self.blocks = blocks
+        self.grad_evals = grad_evals
+        self.num_divergent = num_divergent
+        self.min_ess = min_ess
+        self.max_rhat = max_rhat
+        self.history = history
+        self._cache = _constrain_cache
+        self._draws = None
+
+    @property
+    def draws(self) -> Dict[str, np.ndarray]:
+        if self._draws is None:
+            key = self.draws_flat.shape
+            fn = self._cache.get(key)
+            if fn is None:
+                fn = self._cache[key] = jax.jit(
+                    jax.vmap(jax.vmap(self.flat_model.constrain))
+                )
+            cpu = jax.local_devices(backend="cpu")[0]
+            with jax.default_device(cpu):
+                out = fn(jax.device_put(np.asarray(self.draws_flat), cpu))
+            self._draws = {k: np.asarray(v) for k, v in out.items()}
+        return self._draws
+
+    @property
+    def draws_per_chain(self) -> int:
+        return int(self.draws_flat.shape[1])
+
+
+class FleetResult:
+    """All problems' results + fleet-level accounting."""
+
+    def __init__(self, problems: List[FleetProblemResult], *, wall_s,
+                 blocks_dispatched, compactions, occupancy_trail,
+                 total_grad_evals, budget_exhausted=False):
+        self.problems = problems
+        self.wall_s = wall_s
+        self.blocks_dispatched = blocks_dispatched
+        self.compactions = compactions
+        self.occupancy_trail = occupancy_trail
+        self.total_grad_evals = total_grad_evals
+        self.budget_exhausted = budget_exhausted
+        self._by_id = {p.problem_id: p for p in problems}
+
+    def __getitem__(self, problem_id: str) -> FleetProblemResult:
+        return self._by_id[problem_id]
+
+    @property
+    def num_problems(self) -> int:
+        return len(self.problems)
+
+    @property
+    def converged_fraction(self) -> float:
+        if not self.problems:
+            return 0.0
+        return sum(p.converged for p in self.problems) / len(self.problems)
+
+    def aggregate_min_ess(self) -> float:
+        """Sum of per-problem min-ESS — the fleet throughput numerator
+        (aggregate min-ESS/s = this over the fleet wall)."""
+        vals = [p.min_ess for p in self.problems if p.min_ess is not None]
+        return float(np.nansum(vals)) if vals else float("nan")
+
+
+# --------------------------------------------------------------------------
+# per-problem draw persistence
+# --------------------------------------------------------------------------
+
+
+class FleetDrawStore:
+    """Per-problem `DrawStore` files under one directory, so every
+    persisted draw row is keyed by problem_id (``p_<id>.stkr``) — the
+    fleet flavor of the single-problem store path."""
+
+    def __init__(self, root: str, chains: int, dim: int):
+        self.root = root
+        self.chains = chains
+        self.dim = dim
+        self._stores: Dict[str, Any] = {}
+        os.makedirs(root, exist_ok=True)
+
+    def path(self, problem_id: str) -> str:
+        return os.path.join(self.root, f"p_{problem_id}.stkr")
+
+    def _store(self, problem_id: str):
+        s = self._stores.get(problem_id)
+        if s is None:
+            from .drawstore import DrawStore
+
+            s = self._stores[problem_id] = DrawStore(
+                self.path(problem_id), self.chains, self.dim
+            )
+        return s
+
+    def append(self, problem_id: str, block: np.ndarray) -> None:
+        self._store(problem_id).append(block)
+
+    def flush(self) -> None:
+        for s in self._stores.values():
+            s.flush()
+
+    def truncate(self, problem_id: str, n_draws: int) -> None:
+        from .drawstore import truncate_draws
+
+        p = self.path(problem_id)
+        if os.path.exists(p):
+            truncate_draws(p, n_draws)
+
+    def read(self, problem_id: str) -> Optional[np.ndarray]:
+        """(chains, n, d) history for one problem, or None."""
+        from .drawstore import read_draws
+
+        p = self.path(problem_id)
+        if not os.path.exists(p):
+            return None
+        stored, _, _ = read_draws(p, mmap=False)
+        return np.ascontiguousarray(stored.transpose(1, 0, 2))
+
+    def close_problem(self, problem_id: str) -> None:
+        """Close one problem's store once its file is final — open
+        handles stay bounded by the ACTIVE batch, not the whole fleet
+        (a thousands-of-posteriors sweep would otherwise exhaust the
+        process fd limit)."""
+        s = self._stores.pop(problem_id, None)
+        if s is not None:
+            s.close()
+
+    def close(self) -> None:
+        for s in self._stores.values():
+            s.close()
+        self._stores.clear()
+
+
+# --------------------------------------------------------------------------
+# vmapped kernel plumbing (problem axis on top of the chain axis)
+# --------------------------------------------------------------------------
+
+
+class _FleetParts:
+    """Compiled fleet callables, cached per (fm, cfg) instance: the
+    single-problem warmup parts and block runner with one extra leading
+    problem axis from an outer ``jax.vmap`` (data mapped over problems,
+    broadcast over chains — exactly the JaxBackend layout plus one axis).
+    XLA re-specializes per batch size; compaction sizes are bounded by
+    the refill threshold (at most O(log B) distinct sizes per run)."""
+
+    def __init__(self, fm, cfg: SamplerConfig):
+        self.fm = fm
+        self.cfg = cfg
+        init_carry, segment, _finalize = make_warmup_parts(fm, cfg)
+        self.finalize = _finalize
+        self.v_init = jax.jit(
+            jax.vmap(jax.vmap(init_carry, in_axes=(0, 0, None)),
+                     in_axes=(0, 0, 0))
+        )
+        self.v_seg = jax.jit(
+            jax.vmap(
+                jax.vmap(segment, in_axes=(1, None, None, 0, 0, 0, 0, None)),
+                in_axes=(0, None, None, 0, 0, 0, 0, 0),
+            )
+        )
+        self._blocks: Dict[Tuple[Any, ...], Any] = {}
+
+    def get_block(self, length: int, diag_lags: Optional[int] = None):
+        key = (length, diag_lags)
+        fn = self._blocks.get(key)
+        if fn is None:
+            inner_axes = (
+                (0, 0, 0, 0, None) if diag_lags is None
+                else (0, 0, 0, 0, 0, None)
+            )
+            # every input (incl. the data pytree) maps over the problem axis
+            outer_axes = (0,) * len(inner_axes)
+            fn = self._blocks[key] = jax.jit(
+                jax.vmap(
+                    jax.vmap(
+                        make_block_runner(self.fm, self.cfg, length,
+                                          diag_lags=diag_lags),
+                        in_axes=inner_axes,
+                    ),
+                    in_axes=outer_axes,
+                )
+            )
+        return fn
+
+
+#: compiled fleet parts per (model, cfg) — keyed on the model OBJECT
+#: (kept alive by the key, like JaxBackend's runner cache), so repeated
+#: fleet calls over the same model reuse every jitted warmup segment and
+#: block variant instead of re-tracing per call
+_PARTS_CACHE: Dict[Tuple[Any, ...], Tuple[Any, _FleetParts]] = {}
+
+
+def _fleet_parts_for(model: Model, cfg: SamplerConfig):
+    key = (model, cfg)
+    hit = _PARTS_CACHE.get(key)
+    if hit is None:
+        fm = flatten_model(model)
+        hit = _PARTS_CACHE[key] = (fm, _FleetParts(fm, cfg))
+    return hit
+
+
+def _fleet_warmup(parts: _FleetParts, cfg, warm_keys, z0, data, seg, trace):
+    """The fleet twin of `sampler.drive_segmented_warmup`: identical key
+    layout and schedule slicing per problem (so each lane's warmup is
+    bit-identical to the single-problem driver's), with the problem axis
+    leading every carried array.  Any schedule or key-discipline change
+    in `drive_segmented_warmup` must be mirrored here — the bit-identity
+    tests in tests/test_fleet.py are the drift alarm."""
+    with trace.phase("compile", stage="fleet_warmup_init"):
+        kinit = jax.vmap(jax.vmap(lambda k: jax.random.split(k, 2)))(warm_keys)
+        state, da, welford, inv_mass = jax.block_until_ready(
+            parts.v_init(kinit[:, :, 0], z0, data)
+        )
+        schedule = build_warmup_schedule(cfg.num_warmup)
+        aflags = np.asarray(schedule.adapt_mass)
+        wflags = np.asarray(schedule.window_end)
+        # (problems, num_warmup, chains, 2) step keys — the per-problem
+        # transpose of the single-problem driver's (num_warmup, chains, 2)
+        wkeys = jnp.transpose(
+            jax.vmap(
+                jax.vmap(lambda k: jax.random.split(k, max(cfg.num_warmup, 1)))
+            )(kinit[:, :, 1]),
+            (0, 2, 1, 3),
+        )
+    warm_div = None
+    for s in range(0, cfg.num_warmup, seg):
+        e = min(s + seg, cfg.num_warmup)
+        with trace.phase("warmup_block", start=s, end=e,
+                         fleet=int(z0.shape[0])):
+            state, da, welford, inv_mass, ndiv = jax.block_until_ready(
+                parts.v_seg(
+                    wkeys[:, s:e], jnp.asarray(aflags[s:e]),
+                    jnp.asarray(wflags[s:e]), state, da, welford, inv_mass,
+                    data,
+                )
+            )
+        telemetry.notify_progress()
+        warm_div = ndiv if warm_div is None else warm_div + ndiv
+    if warm_div is None:
+        warm_div = jnp.zeros(z0.shape[:2], jnp.int32)
+    return state, parts.finalize(da), inv_mass, warm_div
+
+
+# --------------------------------------------------------------------------
+# the fleet runner
+# --------------------------------------------------------------------------
+
+
+def _resolve_fleet_flag(fleet: Optional[bool]) -> bool:
+    if fleet is not None:
+        return bool(fleet)
+    return os.environ.get(FLEET_ENV, "1") != "0"
+
+
+class _ProblemState:
+    """Host-side bookkeeping for one problem (device state lives stacked
+    in the batch arrays; this is everything per-problem the gate,
+    persistence, and resume need)."""
+
+    __slots__ = (
+        "idx", "pid", "key", "hist", "suff", "blocks_done",
+        "next_full_check", "grad_evals", "total_div", "converged",
+        "budget_exhausted", "history", "min_ess", "max_rhat",
+    )
+
+    def __init__(self, idx: int, pid: str, key, chains: int, ndim: int):
+        self.idx = idx
+        self.pid = pid
+        self.key = key
+        self.hist = diagnostics.DrawHistory(chains, ndim)
+        self.suff = diagnostics.ChainSuffStats(chains, ndim)
+        self.blocks_done = 0
+        self.next_full_check = 0
+        self.grad_evals = 0
+        self.total_div = 0
+        self.converged = False
+        self.budget_exhausted = False
+        self.history: List[Dict[str, Any]] = []
+        self.min_ess: Optional[float] = None
+        self.max_rhat: Optional[float] = None
+
+    @property
+    def active(self) -> bool:
+        return not (self.converged or self.budget_exhausted)
+
+    def meta(self) -> Dict[str, Any]:
+        # only the LAST block record rides in the checkpoint: the full
+        # per-problem trail is already durable in the metrics JSONL, and
+        # serializing O(blocks) history per problem per checkpoint would
+        # make fleet checkpoints O(B*blocks^2) over a run
+        return {
+            "blocks_done": self.blocks_done,
+            "draws": self.hist.rows,
+            "next_full_check": self.next_full_check,
+            "grad_evals": self.grad_evals,
+            "num_divergent": self.total_div,
+            "converged": self.converged,
+            "budget_exhausted": self.budget_exhausted,
+            "history_tail": self.history[-1:],
+            "min_ess": self.min_ess,
+            "max_rhat": self.max_rhat,
+        }
+
+    def load_meta(self, m: Dict[str, Any]) -> None:
+        self.blocks_done = int(m.get("blocks_done", 0))
+        self.next_full_check = int(m.get("next_full_check", 0))
+        self.grad_evals = int(m.get("grad_evals", 0))
+        self.total_div = int(m.get("num_divergent", 0))
+        self.converged = bool(m.get("converged", False))
+        self.budget_exhausted = bool(m.get("budget_exhausted", False))
+        self.history = list(m.get("history_tail", m.get("history", [])))
+        self.min_ess = m.get("min_ess")
+        self.max_rhat = m.get("max_rhat")
+
+
+def sample_fleet(spec: FleetSpec, data: Any = None, **kwargs) -> FleetResult:
+    """Advance a fleet of independent posteriors — one vmapped dispatch
+    per block — until every problem converges or exhausts its budget.
+    See the module docstring for the contract; `_sample_fleet` for the
+    parameter reference.  The thin wrapper pins the telemetry trace as
+    ambient for the whole run (same discipline as the single runner)."""
+    if data is not None:
+        raise TypeError(
+            "sample_fleet takes per-problem data via FleetSpec, not a "
+            "shared data argument"
+        )
+    trace = telemetry.resolve_trace(kwargs.pop("trace", None))
+    with telemetry.use_trace(trace):
+        return _sample_fleet(spec, trace=trace, **kwargs)
+
+
+def _sample_fleet(
+    spec: FleetSpec,
+    *,
+    chains: int = 4,
+    block_size: int = 100,
+    max_blocks: int = 50,
+    min_blocks: int = 2,
+    rhat_target: float = 1.01,
+    ess_target: float = 400.0,
+    seed: int = 0,
+    fleet: Optional[bool] = None,
+    max_batch: Optional[int] = None,
+    refill_occupancy: float = 0.5,
+    checkpoint_path: Optional[str] = None,
+    resume_from: Optional[str] = None,
+    metrics_path: Optional[str] = None,
+    draw_store_path: Optional[str] = None,
+    health_check: bool = False,
+    reseed: Optional[int] = None,
+    time_budget_s: Optional[float] = None,
+    stream_diag: Optional[bool] = None,
+    diag_lags: Optional[int] = None,
+    diag_components: int = 64,
+    trace: Optional[Any] = None,
+    **cfg_kwargs,
+) -> FleetResult:
+    """The fleet block loop.
+
+    Each problem ``i`` owns the PRNG stream ``PRNGKey(seed + i)`` and the
+    single-problem runner's exact key discipline (init/warmup split, one
+    ``split`` per dispatched block), so its draws are independent of the
+    batch composition and bit-identical to
+    ``sample_until_converged(seed=seed+i, adaptive_blocks=False,
+    block_size=block_size)`` run unbatched.
+
+    ``max_batch``: device-batch capacity.  Problems beyond it queue;
+    compaction events refill the batch from the queue (new cohorts are
+    warmed up in one vmapped dispatch before joining).  Default: the
+    whole fleet in one batch.
+
+    ``refill_occupancy``: when the ACTIVE fraction of the current batch
+    drops strictly below this, converged lanes are compacted out at the
+    next block boundary (and the batch refilled from the queue).  1.0
+    compacts immediately on any convergence; 0.0 never compacts (masked
+    lanes ride along — their gradient evaluations still stop counting).
+
+    ``time_budget_s`` bounds the SAMPLING wall like the single runner:
+    the run stops after the first block past the budget, marking the
+    still-active problems ``budget_exhausted``.
+
+    Escape hatch: ``fleet=False`` (or ``STARK_FLEET=0``) and every B=1
+    fleet run the problems sequentially through the unmodified
+    `runner.sample_until_converged` — bit-identical artifacts to the
+    single-problem path.
+    """
+    cfg = SamplerConfig(**cfg_kwargs)
+    if cfg.kernel == "chees":
+        raise ValueError(
+            "fleet sampling supports the per-chain kernels (nuts/hmc); "
+            "the chees ensemble warmup has its own host loop"
+        )
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "fleet sampling is single-process for now (multi-process "
+            "meshes shard chains, not problems)"
+        )
+    if stream_diag is None:
+        stream_diag = os.environ.get("STARK_STREAM_DIAG", "1") != "0"
+    if diag_lags is None:
+        diag_lags = STREAM_DIAG_LAGS
+
+    use_fleet = _resolve_fleet_flag(fleet) and spec.num_problems > 1
+    if not use_fleet:
+        return _sample_fleet_sequential(
+            spec, chains=chains, block_size=block_size,
+            max_blocks=max_blocks, min_blocks=min_blocks,
+            rhat_target=rhat_target, ess_target=ess_target, seed=seed,
+            checkpoint_path=checkpoint_path, resume_from=resume_from,
+            metrics_path=metrics_path, draw_store_path=draw_store_path,
+            health_check=health_check, reseed=reseed,
+            time_budget_s=time_budget_s, stream_diag=stream_diag,
+            diag_lags=diag_lags, diag_components=diag_components,
+            trace=trace, **cfg_kwargs,
+        )
+
+    trace = telemetry.resolve_trace(trace)
+    t_start = time.perf_counter()
+    model = spec.model
+    fm, _parts_cached = _fleet_parts_for(model, cfg)
+    B = spec.num_problems
+    if trace.enabled:
+        trace.emit(
+            "run_start",
+            entry="sample_fleet",
+            fleet=True,
+            model=type(model).__name__,
+            kernel=cfg.kernel,
+            problems=B,
+            chains=chains,
+            block_size=block_size,
+            max_blocks=max_blocks,
+            rhat_target=rhat_target,
+            ess_target=ess_target,
+            resuming=bool(resume_from),
+            **telemetry.device_info(),
+            **telemetry.provenance(),
+        )
+    with trace.phase("compile", stage="fleet_setup"):
+        fdata_all = spec.prepared_stacked()
+        parts = _parts_cached
+
+    # the store holds no file handles until the first append (per-problem
+    # files open lazily), so creating it BEFORE the metrics handle means
+    # neither constructor failing can strand the other's open fd
+    store = (
+        FleetDrawStore(draw_store_path, chains, fm.ndim)
+        if draw_store_path else None
+    )
+    metrics_f = open(metrics_path, "a") if metrics_path else None
+    metrics_buf: List[str] = []
+
+    def emit(rec):
+        # records buffer within one fleet-block cycle and hit disk as ONE
+        # write+flush+fsync at the block boundary (`flush_metrics`): a
+        # 256-problem block emits O(B) records, and per-record fsyncs
+        # would serialize exactly the per-problem host overhead the fleet
+        # exists to amortize.  The crash-relevant boundaries (the
+        # fleet.block.* failpoints, the checkpoint) all sit AFTER the
+        # flush, so the durability story is unchanged at block
+        # granularity — the same unit the checkpoint accounts in.
+        telemetry.notify_progress()
+        if metrics_f:
+            metrics_buf.append(json.dumps(rec) + "\n")
+
+    def flush_metrics():
+        if metrics_f and metrics_buf:
+            metrics_f.write("".join(metrics_buf))
+            metrics_buf.clear()
+            metrics_f.flush()
+            os.fsync(metrics_f.fileno())
+
+    def _cold_key(i: int):
+        k = jax.random.PRNGKey(seed + i)
+        if reseed is not None:
+            # the supervisor bumps seed by the attempt number on reseeded
+            # restarts; over a fleet that bump ALIASES neighbor lattices
+            # (seed+attempt+i == seed+(i+attempt)), so a cold-started
+            # problem would replay a stream a neighbor consumed in the
+            # crashed attempt — folding the attempt in decorrelates them
+            # (resumed problems get the same fold on their saved keys)
+            k = jax.random.fold_in(k, reseed)
+        return k
+
+    probs = [
+        _ProblemState(
+            i, spec.problem_ids[i], _cold_key(i), chains, fm.ndim,
+        )
+        for i in range(B)
+    ]
+
+    # device batch: lane j holds problem order[j]; converged lanes stay
+    # (masked) until the next compaction
+    order: List[int] = []
+    state = step_size = inv_mass = diag = None
+    bdata = None  # device data for the CURRENT batch; refreshed only
+    pending: List[int] = []  # when the batch composition changes
+    compactions = 0
+    occupancy_trail: List[float] = []
+    blocks_dispatched = 0
+    fleet_budget_exhausted = False
+
+    def batch_data(indices: List[int]):
+        ix = jnp.asarray(indices)
+        return jax.tree.map(lambda a: a[ix], fdata_all)
+
+    def warm_cohort(indices: List[int]):
+        """Warm up a cohort of problems in one vmapped dispatch; returns
+        stacked (state, step_size, inv_mass) with a problem axis.  Key
+        layout per lane mirrors the single-problem runner exactly."""
+        z0s, wkeys = [], []
+        for i in indices:
+            p = probs[i]
+            p.key, key_init, key_warm = jax.random.split(p.key, 3)
+            z0s.append(
+                jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
+            )
+            wkeys.append(jax.random.split(key_warm, chains))
+        z0 = jnp.stack(z0s)
+        warm_keys = jnp.stack(wkeys)
+        st, ss, im, wdiv = _fleet_warmup(
+            parts, cfg, warm_keys, z0, batch_data(indices), block_size, trace
+        )
+        wdiv = np.asarray(wdiv)
+        for j, i in enumerate(indices):
+            rec = {
+                "event": "warmup_done",
+                "problem_id": probs[i].pid,
+                "num_divergent": int(wdiv[j].sum()),
+                "wall_s": time.perf_counter() - t_start,
+            }
+            emit(rec)
+        return st, ss, im
+
+    def init_diag_for(indices: List[int], histories, dtype):
+        """Stacked StreamDiagState for a cohort, rebuilt from each
+        problem's (possibly empty) draw history — the same host reference
+        accumulator the single runner uses on resume.  ``dtype`` is the
+        sampling state's dtype (f64 under x64), matching the carry the
+        compiled scan produces — the single runner threads state.z.dtype
+        the same way."""
+        dtype = np.dtype(dtype)
+        stacked = None
+        for i, hist in zip(indices, histories):
+            draws = (
+                hist.view() if hist.rows
+                else np.zeros((chains, 0, fm.ndim), np.float32)
+            )
+            host = diagnostics.stream_diag_from_draws(
+                draws, diag_lags, chains=chains, ndim=fm.ndim, dtype=dtype
+            )
+            if stacked is None:
+                stacked = {k: [v] for k, v in host.items()}
+            else:
+                for k, v in host.items():
+                    stacked[k].append(v)
+        return StreamDiagState(
+            **{k: jnp.asarray(np.stack(v)) for k, v in stacked.items()}
+        )
+
+    def concat_batches(a, b):
+        return jax.tree.map(
+            lambda x, y: jnp.concatenate([x, y], axis=0), a, b
+        )
+
+    def take_lanes(tree, lane_idx: List[int]):
+        ix = jnp.asarray(lane_idx, dtype=jnp.int32)
+        return jax.tree.map(lambda a: a[ix], tree)
+
+    def admit(indices: List[int]):
+        """Warm up ``indices`` and append them to the batch."""
+        nonlocal state, step_size, inv_mass, diag, order, bdata
+        st, ss, im = warm_cohort(indices)
+        dg = (
+            init_diag_for(indices, [probs[i].hist for i in indices],
+                          st.z.dtype)
+            if stream_diag else None
+        )
+        if state is None:
+            state, step_size, inv_mass, diag = st, ss, im, dg
+        else:
+            state = concat_batches(state, st)
+            step_size = jnp.concatenate([step_size, ss], axis=0)
+            inv_mass = jnp.concatenate([inv_mass, im], axis=0)
+            if stream_diag:
+                diag = concat_batches(diag, dg)
+        order = order + list(indices)
+        bdata = batch_data(order)
+        flush_metrics()
+
+    # ---- resume or cold start --------------------------------------------
+    # the handles above (metrics file, per-problem draw stores) are
+    # closed by the block loop's finally; anything that raises BEFORE
+    # that try is entered — resume validation, the first cohort's
+    # warmup — must not leak them across supervised restart attempts
+    try:
+        if resume_from:
+            from .checkpoint import load_checkpoint
+
+            arrays, meta = load_checkpoint(resume_from)
+            if not meta.get("fleet"):
+                raise ValueError(
+                    f"{resume_from!r} is not a fleet checkpoint"
+                )
+            if meta.get("kernel") != cfg.kernel:
+                raise ValueError(
+                    f"checkpoint was written by kernel={meta.get('kernel')!r}, "
+                    f"resuming run uses kernel={cfg.kernel!r}"
+                )
+            # chains shapes every per-problem array; block_size sets the
+            # key split cadence — a mismatch would not fail loudly on its
+            # own (chains dies in a deep shape error, block_size silently
+            # breaks the bit-identical replay the chaos drills rely on)
+            for field, current in (("chains", chains),
+                                   ("block_size", block_size)):
+                if meta.get(field) != current:
+                    raise ValueError(
+                        f"checkpoint was written with "
+                        f"{field}={meta.get(field)!r}, resuming run uses "
+                        f"{field}={current!r}"
+                    )
+            saved_ids = list(meta["problem_ids"])
+            if saved_ids != list(spec.problem_ids):
+                raise ValueError(
+                    "checkpointed problem_ids differ from this FleetSpec"
+                )
+            per_problem = meta["problems"]
+            for p in probs:
+                p.load_meta(per_problem[p.pid])
+            # draw histories: store wins (truncated to the accounted rows);
+            # otherwise the checkpoint carries them inline
+            for p in probs:
+                accounted = int(per_problem[p.pid].get("draws", 0))
+                blk = None
+                if store is not None:
+                    store.truncate(p.pid, accounted)
+                    blk = store.read(p.pid)
+                elif f"draws_{p.pid}" in arrays:
+                    blk = arrays[f"draws_{p.pid}"]
+                if blk is not None and blk.shape[1]:
+                    p.hist.append(np.asarray(blk))
+                    p.suff.update(np.asarray(blk))
+            active_ids = list(meta["active_ids"])
+            by_id = {p.pid: p for p in probs}
+            order = [by_id[a].idx for a in active_ids]
+            keys = np.asarray(arrays["keys"])
+            for j, a in enumerate(active_ids):
+                k = jnp.asarray(keys[j])
+                if reseed is not None:
+                    k = jax.random.fold_in(k, reseed)
+                by_id[a].key = k
+            if order:
+                state = HMCState(
+                    z=jnp.asarray(arrays["z"]),
+                    potential_energy=jnp.asarray(arrays["pe"]),
+                    grad=jnp.asarray(arrays["grad"]),
+                )
+                step_size = jnp.asarray(arrays["step_size"])
+                inv_mass = jnp.asarray(arrays["inv_mass"])
+                if stream_diag:
+                    diag = init_diag_for(
+                        order, [probs[i].hist for i in order],
+                        state.z.dtype,
+                    )
+                bdata = batch_data(order)
+            # else: every saved lane had already converged (a crash landed
+            # between full convergence and the next cohort's admission) —
+            # leave state None so the pending top-up below takes the
+            # cold-batch path instead of concatenating onto 0-lane arrays
+            pending = [
+                p.idx for p in probs
+                if p.active and p.idx not in set(order)
+            ]
+            if pending:
+                # top the resumed batch back up to capacity (a crash may have
+                # landed with the batch partially drained; resuming only the
+                # survivors would run the device under-occupied until the
+                # next compaction)
+                room = (
+                    (max_batch - len(order))
+                    if max_batch is not None else len(pending)
+                )
+                if room > 0:
+                    nxt, pending = pending[:room], pending[room:]
+                    admit(nxt)
+        else:
+            first = list(range(B if max_batch is None else min(max_batch, B)))
+            pending = list(range(len(first), B))
+            admit(first)
+
+        v_block = parts.get_block(
+            block_size, diag_lags=diag_lags if stream_diag else None
+        )
+    except BaseException:
+        flush_metrics()
+        if metrics_f:
+            metrics_f.close()
+        if store is not None:
+            store.close()
+        raise
+
+    def gate_and_record(p: _ProblemState, zs, divergent, blk_grads,
+                        diag_lane):
+        """One problem's share of a finished block: diagnostics, gate,
+        metrics record — the per-problem twin of the single runner's
+        `process_block` (same streaming gate, same full-pass validation,
+        same backoff)."""
+        p.blocks_done += 1
+        p.hist.append(zs)
+        if store is not None:
+            store.append(p.pid, zs)
+        p.total_div += int(np.sum(np.asarray(divergent)))
+        p.grad_evals += blk_grads
+        p.suff.update(zs)
+        srhat = p.suff.rhat()
+        n_stuck = int(np.count_nonzero(np.isnan(srhat)))
+        finite_rhat = srhat[~np.isnan(srhat)]
+        max_rhat = (
+            float(np.max(finite_rhat)) if finite_rhat.size else float("inf")
+        )
+        if diag_lane is not None:
+            diag_bytes = int(sum(np.asarray(a).nbytes for a in diag_lane))
+            ess_vals = diagnostics.ess_from_suffstats(*diag_lane)
+        else:
+            k = min(diag_components, fm.ndim)
+            worst = np.argsort(
+                np.where(np.isnan(srhat), -np.inf, -srhat)
+            )[:k]
+            subset = p.hist.take(worst)
+            diag_bytes = int(subset.nbytes)
+            ess_vals = diagnostics.ess(subset)
+        finite_ess = ess_vals[np.isfinite(ess_vals)]
+        min_ess = (
+            float(np.min(finite_ess)) if finite_ess.size else float("nan")
+        )
+        p.min_ess = min_ess if np.isfinite(min_ess) else None
+        p.max_rhat = max_rhat if np.isfinite(max_rhat) else None
+        rec = {
+            "event": "block",
+            "problem_id": p.pid,
+            "block": p.blocks_done,
+            "draws_per_chain": int(p.suff.count[0]),
+            "max_rhat": p.max_rhat,
+            "min_ess": p.min_ess,
+            "num_stuck_components": n_stuck,
+            "num_divergent": p.total_div,
+            "block_grad_evals": blk_grads,
+            "diag_bytes_to_host": diag_bytes,
+            "wall_s": time.perf_counter() - t_start,
+        }
+        min_gate = p.blocks_done >= min_blocks
+        gate_pass = (
+            n_stuck == 0
+            and max_rhat < rhat_target
+            and min_ess > ess_target
+        )
+        # same failpoint as the single runner's gate: a forced-optimistic
+        # streaming signal sends the candidate stop to the full
+        # validation pass early, which must reject it — the PR 4
+        # never-stop-past-failed-validation guard drills the fleet gate
+        # through the identical site
+        forced_opt = (
+            faults.fail_point("runner.gate.optimistic") is not None
+        )
+        if (
+            min_gate
+            and (gate_pass or forced_opt)
+            and p.blocks_done >= p.next_full_check
+        ):
+            full_draws = p.hist.view()
+            full_rhat = float(np.max(diagnostics.split_rhat(full_draws)))
+            full_ess = float(np.min(diagnostics.ess(full_draws)))
+            rec["full_max_rhat"] = full_rhat
+            rec["full_min_ess"] = full_ess
+            rec["full_max_rank_rhat"] = float(
+                np.max(diagnostics.rank_rhat(full_draws))
+            )
+            if full_rhat < rhat_target and full_ess > ess_target:
+                p.converged = True
+                p.min_ess = full_ess
+                p.max_rhat = full_rhat
+            else:
+                p.next_full_check = p.blocks_done + max(
+                    1, p.blocks_done // 4
+                )
+        if not p.converged and p.blocks_done >= max_blocks:
+            p.budget_exhausted = True
+        p.history.append(rec)
+        emit(rec)
+        if not p.active:
+            if store is not None:
+                # this problem's final block was appended above; no
+                # masked lane ever appends again, so its file is final
+                store.close_problem(p.pid)
+            status = "converged" if p.converged else "budget_exhausted"
+            emit({
+                "event": "problem_done",
+                "problem_id": p.pid,
+                "status": status,
+                "blocks": p.blocks_done,
+                "draws_per_chain": int(p.suff.count[0]),
+                "grad_evals": p.grad_evals,
+                "min_ess": p.min_ess,
+                "max_rhat": p.max_rhat,
+            })
+            if trace.enabled:
+                trace.emit(
+                    "problem_converged",
+                    problem_id=p.pid,
+                    status=status,
+                    blocks=p.blocks_done,
+                    draws_per_chain=int(p.suff.count[0]),
+                    grad_evals=p.grad_evals,
+                    min_ess=p.min_ess,
+                    max_rhat=p.max_rhat,
+                )
+
+    def save_fleet_checkpoint(path: str):
+        from .checkpoint import save_checkpoint
+
+        t_ckpt = time.perf_counter()
+        active_lanes = [j for j, i in enumerate(order) if probs[i].active]
+        active_ids = [probs[order[j]].pid for j in active_lanes]
+        st = take_lanes(state, active_lanes)
+        arrays = {
+            "z": np.asarray(st.z),
+            "pe": np.asarray(st.potential_energy),
+            "grad": np.asarray(st.grad),
+            "step_size": np.asarray(take_lanes(step_size, active_lanes)),
+            "inv_mass": np.asarray(take_lanes(inv_mass, active_lanes)),
+            "keys": np.stack(
+                [np.asarray(probs[order[j]].key) for j in active_lanes]
+            ) if active_lanes else np.zeros((0, 2), np.uint32),
+        }
+        if store is None:
+            for p in probs:
+                if p.hist.rows:
+                    arrays[f"draws_{p.pid}"] = p.hist.view()
+        else:
+            store.flush()
+        if health_check:
+            from .supervise import check_finite_state
+
+            check_finite_state(
+                {k: arrays[k] for k in
+                 ("z", "pe", "grad", "step_size", "inv_mass")}
+            )
+        save_checkpoint(
+            path,
+            arrays,
+            {
+                "fleet": True,
+                "kernel": cfg.kernel,
+                "model": type(model).__name__,
+                "chains": chains,
+                "block_size": block_size,
+                "problem_ids": list(spec.problem_ids),
+                "active_ids": active_ids,
+                "problems": {p.pid: p.meta() for p in probs},
+            },
+        )
+        if trace.enabled:
+            trace.emit(
+                "checkpoint",
+                stage="fleet",
+                path=path,
+                active=len(active_ids),
+                dur_s=round(time.perf_counter() - t_ckpt, 4),
+            )
+
+    # key advancement is batched: vmap maps the same deterministic
+    # threefry split over the stacked keys, so each lane's stream stays
+    # bit-identical to per-problem `jax.random.split` while the host
+    # pays O(1) dispatches per block instead of ~2B
+    v_split2 = jax.vmap(lambda k: jax.random.split(k))
+    v_split_chains = jax.vmap(lambda k: jax.random.split(k, chains))
+
+    try:
+        while any(probs[i].active for i in order):
+            # --- dispatch one fleet block over the CURRENT batch ---------
+            act_lanes = [i for i in order if probs[i].active]
+            blk_key: Dict[int, Any] = {}
+            if act_lanes:
+                pair = np.asarray(
+                    v_split2(jnp.stack([probs[i].key for i in act_lanes]))
+                )
+                for j, i in enumerate(act_lanes):
+                    probs[i].key = pair[j, 0]
+                    blk_key[i] = pair[j, 1]
+            # frozen lanes feed their STALE key — their stream must not
+            # advance (a resumed or compacted run never replays them);
+            # outputs are discarded
+            bkeys = v_split_chains(
+                jnp.stack([blk_key.get(i, probs[i].key) for i in order])
+            )
+            t_enq = time.perf_counter()
+            if stream_diag:
+                out = v_block(bkeys, state, diag, step_size, inv_mass, bdata)
+                state, diag, zs, accept, divergent, _energy, ngrad = out
+            else:
+                out = v_block(bkeys, state, step_size, inv_mass, bdata)
+                state, zs, accept, divergent, _energy, ngrad = out
+            state = faults.poison("runner.carried_nan", state)
+            blocks_dispatched += 1
+
+            # --- host side ------------------------------------------------
+            faults.fail_point("fleet.block.pre")
+            t_blk = time.perf_counter()
+            zs = np.asarray(zs)
+            divergent_h = np.asarray(divergent)
+            ngrad_h = np.asarray(ngrad)
+            diag_h = jax.tree.map(np.asarray, diag) if stream_diag else None
+            t_wait = time.perf_counter() - t_blk
+            if health_check:
+                from .supervise import check_finite_state
+
+                # one device→host transfer per array for the WHOLE batch;
+                # the per-lane loop below only slices host memory
+                z_h = np.asarray(state.z)
+                pe_h = np.asarray(state.potential_energy)
+                grad_h = np.asarray(state.grad)
+                ss_h = np.asarray(step_size)
+                im_h = np.asarray(inv_mass)
+                for j, i in enumerate(order):
+                    if not probs[i].active:
+                        continue  # masked lanes are not health-gated
+                    check_finite_state({
+                        "z": z_h[j],
+                        "pe": pe_h[j],
+                        "grad": grad_h[j],
+                        "step_size": ss_h[j],
+                        "inv_mass": im_h[j],
+                    })
+            block_grads_active = 0
+            for j, i in enumerate(order):
+                p = probs[i]
+                if not p.active:
+                    continue  # masked: draws discarded, grads not counted
+                blk_grads = int(ngrad_h[j].sum())
+                block_grads_active += blk_grads
+                diag_lane = (
+                    jax.tree.map(lambda a, j=j: a[j], diag_h)
+                    if stream_diag else None
+                )
+                gate_and_record(p, zs[j], divergent_h[j], blk_grads,
+                                diag_lane)
+            n_active = sum(probs[i].active for i in order)
+            occupancy = n_active / max(len(order), 1)
+            occupancy_trail.append(occupancy)
+            if trace.enabled:
+                trace.emit(
+                    "fleet_block",
+                    block=blocks_dispatched,
+                    batch=len(order),
+                    active=n_active,
+                    occupancy=round(occupancy, 4),
+                    block_len=block_size,
+                    chains=chains,
+                    block_grad_evals=block_grads_active,
+                    t_wait_s=round(t_wait, 4),
+                    dur_s=round(
+                        time.perf_counter() - t_enq, 4
+                    ),
+                )
+            emit({
+                "event": "fleet_block",
+                "block": blocks_dispatched,
+                "batch": len(order),
+                "active": n_active,
+                "occupancy": round(occupancy, 4),
+                "block_grad_evals": block_grads_active,
+                "wall_s": time.perf_counter() - t_start,
+            })
+
+            # --- compaction / refill at the block boundary ----------------
+            # strictly threshold-gated (the documented contract): a batch
+            # riding above refill_occupancy keeps its masked lanes even
+            # when a queue waits, so refills stay cohort-sized instead of
+            # paying a vmapped warmup dispatch per single convergence
+            if (
+                n_active < len(order)
+                and occupancy < refill_occupancy
+                and refill_occupancy > 0.0
+            ):
+                keep = [j for j, i in enumerate(order) if probs[i].active]
+                from_size = len(order)
+                state = take_lanes(state, keep)
+                step_size = take_lanes(step_size, keep)
+                inv_mass = take_lanes(inv_mass, keep)
+                if stream_diag:
+                    diag = take_lanes(diag, keep)
+                order = [order[j] for j in keep]
+                bdata = batch_data(order) if order else None
+                refill = []
+                if pending:
+                    room = (
+                        (max_batch - len(order))
+                        if max_batch is not None else len(pending)
+                    )
+                    refill, pending = pending[:room], pending[room:]
+                    if refill:
+                        admit(refill)
+                compactions += 1
+                if trace.enabled:
+                    trace.emit(
+                        "fleet_compact",
+                        from_batch=from_size,
+                        to_batch=len(order),
+                        refilled=len(refill),
+                        pending=len(pending),
+                    )
+                emit({
+                    "event": "fleet_compact",
+                    "from_batch": from_size,
+                    "to_batch": len(order),
+                    "refilled": len(refill),
+                    "pending": len(pending),
+                    "wall_s": time.perf_counter() - t_start,
+                })
+
+            flush_metrics()  # one write+fsync per fleet block (see emit)
+            if checkpoint_path:
+                save_fleet_checkpoint(checkpoint_path)
+            faults.fail_point("fleet.block.post")
+
+            if (
+                time_budget_s is not None
+                and time.perf_counter() - t_start > time_budget_s
+            ):
+                fleet_budget_exhausted = True
+                emit({
+                    "event": "budget_exhausted",
+                    "time_budget_s": float(time_budget_s),
+                    "wall_s": time.perf_counter() - t_start,
+                })
+                if trace.enabled:
+                    trace.emit(
+                        "budget", time_budget_s=float(time_budget_s),
+                        blocks=blocks_dispatched,
+                    )
+                break
+
+            if not any(probs[i].active for i in order) and pending:
+                # whole batch finished without triggering a refill (e.g.
+                # refill_occupancy=0): start the next cohort fresh
+                state = step_size = inv_mass = diag = bdata = None
+                order = []
+                room = max_batch if max_batch is not None else len(pending)
+                nxt, pending = pending[:room], pending[room:]
+                admit(nxt)
+    finally:
+        flush_metrics()
+        if metrics_f:
+            metrics_f.close()
+        if store is not None:
+            store.close()
+
+    wall = time.perf_counter() - t_start
+    constrain_cache: Dict[Any, Any] = {}
+    results = [
+        FleetProblemResult(
+            p.pid,
+            np.ascontiguousarray(p.hist.view()),
+            fm,
+            converged=p.converged,
+            budget_exhausted=p.budget_exhausted
+            or (fleet_budget_exhausted and not p.converged),
+            blocks=p.blocks_done,
+            grad_evals=p.grad_evals,
+            num_divergent=p.total_div,
+            min_ess=p.min_ess,
+            max_rhat=p.max_rhat,
+            history=p.history,
+            _constrain_cache=constrain_cache,
+        )
+        for p in probs
+    ]
+    total_grads = sum(p.grad_evals for p in probs)
+    if trace.enabled:
+        trace.emit(
+            "run_end",
+            dur_s=round(wall, 4),
+            converged=all(p.converged for p in probs),
+            problems=B,
+            converged_problems=sum(p.converged for p in probs),
+            blocks=blocks_dispatched,
+            compactions=compactions,
+            fleet_grad_evals=total_grads,
+            budget_exhausted=fleet_budget_exhausted,
+        )
+    return FleetResult(
+        results,
+        wall_s=wall,
+        blocks_dispatched=blocks_dispatched,
+        compactions=compactions,
+        occupancy_trail=occupancy_trail,
+        total_grad_evals=total_grads,
+        budget_exhausted=fleet_budget_exhausted,
+    )
+
+
+def _problem_path(path: Optional[str], pid: str, b: int) -> Optional[str]:
+    """Per-problem variant of a state-file path on sequential runs.  A
+    ONE-problem fleet keeps the caller's path untouched so its artifacts
+    land exactly where a plain single-problem run would (the B=1
+    bit-identity contract covers file layout too)."""
+    if path is None or b == 1:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.{pid}{ext}"
+
+
+def _sample_fleet_sequential(
+    spec: FleetSpec,
+    *,
+    chains, block_size, max_blocks, min_blocks, rhat_target, ess_target,
+    seed, checkpoint_path, resume_from, metrics_path, draw_store_path,
+    health_check, reseed, time_budget_s, stream_diag, diag_lags,
+    diag_components, trace,
+    **cfg_kwargs,
+) -> FleetResult:
+    """The escape hatch: problems run one at a time through the
+    UNMODIFIED single-problem runner (fixed block march — the fleet path
+    has no per-problem block sizing either), seeded ``seed + index`` like
+    their fleet lanes, so the two paths produce identical draws.
+
+    Crash-resume (B > 1): the supervisor's single-checkpoint contract
+    cannot see the per-problem files this path writes, so each problem
+    resumes ITSELF from its own checkpoint when one exists and is
+    healthy (unhealthy ones are quarantined, and a cold start
+    quarantines the problem's orphaned draw store) — a supervised
+    restart therefore continues the sweep from where the crash landed
+    instead of re-running every problem from scratch.  B=1 passes the
+    caller's paths through untouched (the supervisor drives resume)."""
+    from .backends.jax_backend import JaxBackend
+    from .runner import sample_until_converged
+    from .supervise import checkpoint_health, quarantine_path
+
+    t0 = time.perf_counter()
+    b = spec.num_problems
+    # one backend across the whole sweep: the runner caches compiled
+    # segments per (model, cfg) on the instance, so problems 2..B skip
+    # the re-jit (the steady-state serving loop, and what keeps the
+    # sequential escape hatch usable at fleet sizes)
+    backend = JaxBackend()
+    results = []
+    constrain_cache: Dict[Any, Any] = {}
+    budget_hit = False
+    total_grads = 0
+
+    for i, (pid, data_p) in enumerate(zip(spec.problem_ids, spec.datasets)):
+        remaining = None
+        if time_budget_s is not None:
+            remaining = time_budget_s - (time.perf_counter() - t0)
+            if remaining <= 0:
+                budget_hit = True
+                break
+        ckpt_p = _problem_path(checkpoint_path, pid, b)
+        resume_p = _problem_path(resume_from, pid, b)
+        store_p = _problem_path(draw_store_path, pid, b)
+        if b > 1:
+            if not (resume_p and os.path.exists(resume_p)):
+                resume_p = None
+            if resume_p is None and ckpt_p and os.path.exists(ckpt_p):
+                healthy, _reason = checkpoint_health(ckpt_p)
+                if healthy:
+                    resume_p = ckpt_p
+                else:
+                    quarantine_path(ckpt_p)
+            if (
+                resume_p is None
+                and store_p
+                and os.path.exists(store_p)
+            ):
+                # cold start: a discarded attempt's draws must not mix
+                # into this run's store (supervisor discipline, applied
+                # per problem)
+                quarantine_path(store_p)
+        seed_i = seed + i
+        if reseed is not None and b > 1:
+            # reseeded restart: the single runner folds `reseed` only
+            # into RESUMED keys, so a cold-started problem would replay
+            # a neighbor's attempt-0 stream (seed+attempt+i aliases
+            # seed+(i+attempt) — the same lattice collision `_cold_key`
+            # fixes on the vmapped path); spreading the problems keeps
+            # every attempt bump inside a problem's private seed range
+            seed_i = seed + i * _RESEED_STRIDE
+        res = sample_until_converged(
+            spec.model,
+            data_p,
+            backend=backend,
+            chains=chains,
+            block_size=block_size,
+            max_blocks=max_blocks,
+            min_blocks=min_blocks,
+            rhat_target=rhat_target,
+            ess_target=ess_target,
+            seed=seed_i,
+            checkpoint_path=ckpt_p,
+            resume_from=resume_p,
+            metrics_path=_problem_path(metrics_path, pid, b),
+            draw_store_path=store_p,
+            health_check=health_check,
+            reseed=reseed,
+            time_budget_s=remaining,
+            stream_diag=stream_diag,
+            diag_lags=diag_lags,
+            diag_components=diag_components,
+            adaptive_blocks=False,
+            trace=trace,
+            **cfg_kwargs,
+        )
+        grad_evals = int(sum(
+            r.get("block_grad_evals", 0)
+            for r in res.history
+            if r.get("event") == "block"
+        ))
+        total_grads += grad_evals
+        last = res.history[-1] if res.history else {}
+        results.append(
+            FleetProblemResult(
+                pid,
+                res.draws_flat,
+                res.flat_model,
+                converged=res.converged,
+                budget_exhausted=res.budget_exhausted,
+                blocks=len(
+                    [r for r in res.history if r.get("event") == "block"]
+                ),
+                grad_evals=grad_evals,
+                num_divergent=int(np.sum(
+                    res.sample_stats.get("num_divergent", 0)
+                )),
+                min_ess=last.get("full_min_ess", last.get("min_ess")),
+                max_rhat=last.get("full_max_rhat", last.get("max_rhat")),
+                history=res.history,
+                _constrain_cache=constrain_cache,
+            )
+        )
+    if len(results) < b:
+        # budget stop mid-sweep: problems never attempted still appear in
+        # the result (empty draws, budget_exhausted) — the fleet path
+        # reports every problem, and converged_fraction must count the
+        # unserved ones, not silently shrink its denominator
+        fm = flatten_model(spec.model)
+        for pid in spec.problem_ids[len(results):]:
+            results.append(
+                FleetProblemResult(
+                    pid,
+                    np.zeros((chains, 0, fm.ndim), np.float32),
+                    fm,
+                    converged=False,
+                    budget_exhausted=True,
+                    blocks=0,
+                    grad_evals=0,
+                    num_divergent=0,
+                    min_ess=None,
+                    max_rhat=None,
+                    history=[],
+                    _constrain_cache=constrain_cache,
+                )
+            )
+    return FleetResult(
+        results,
+        wall_s=time.perf_counter() - t0,
+        blocks_dispatched=sum(r.blocks for r in results),
+        compactions=0,
+        occupancy_trail=[],
+        total_grad_evals=total_grads,
+        budget_exhausted=budget_hit,
+    )
+
+
+def supervised_sample_fleet(
+    spec: FleetSpec,
+    *,
+    workdir: str,
+    **kwargs,
+) -> FleetResult:
+    """Run `sample_fleet` under the PR 2 supervision machinery
+    (`supervise.supervised_sample` with the fleet runner plugged in):
+    restart budget, fault taxonomy, backoff, watchdog, checkpoint health
+    gating.  A crash mid-fleet resumes the SURVIVING ACTIVE SET from the
+    fleet checkpoint — finished problems' draws are already durable and
+    are never re-sampled."""
+    from .supervise import supervised_sample
+
+    def _runner(spec_, data_, **kw):
+        assert data_ is None
+        return sample_fleet(spec_, **kw)
+
+    return supervised_sample(
+        spec, None, workdir=workdir, _runner=_runner, **kwargs
+    )

@@ -332,6 +332,7 @@ class TraceCollector:
             "health": {},
             "meta": {},
             "restarts": {},
+            "fleet": {},
         }
 
         # -- counters (monotone across attempts by construction) --
@@ -374,6 +375,15 @@ class TraceCollector:
         self.diag_bytes = r.counter(
             f"{p}_diag_bytes_to_host_total",
             "bytes the convergence gate transferred device-to-host",
+        )
+        self.fleet_problems_done = r.counter(
+            f"{p}_fleet_problems_done_total",
+            "fleet problems finished, by status label "
+            "(converged/budget_exhausted)",
+        )
+        self.fleet_compactions = r.counter(
+            f"{p}_fleet_compactions_total",
+            "fleet batch compaction/refill events",
         )
         self.device_idle_s = r.counter(
             f"{p}_device_idle_seconds_total",
@@ -436,6 +446,22 @@ class TraceCollector:
         self.g_budget_left = r.gauge(
             f"{p}_restart_budget_remaining",
             "restarts left in the supervisor's sliding window",
+        )
+        self.g_fleet_active = r.gauge(
+            f"{p}_fleet_active_problems",
+            "problems still sampling in the current fleet batch",
+        )
+        self.g_fleet_batch = r.gauge(
+            f"{p}_fleet_batch_size",
+            "device-batch lanes in the current fleet dispatch",
+        )
+        self.g_fleet_occupancy = r.gauge(
+            f"{p}_fleet_occupancy",
+            "active fraction of the fleet batch (compaction trigger)",
+        )
+        self.g_fleet_converged = r.gauge(
+            f"{p}_fleet_problems_converged",
+            "fleet problems that passed full convergence validation",
         )
         self.g_healthy = r.gauge(
             f"{p}_healthy", "1 when /healthz reports 200, else 0"
@@ -524,7 +550,7 @@ class TraceCollector:
             self._set_status(
                 phase="starting", run=rec.get("run", 0), meta=meta,
                 block=None, draws_per_chain=None, ess_forecast=None,
-                health={}, restarts={},
+                health={}, restarts={}, fleet={},
             )
         # a new attempt is underway: a prior stall/restart is recovered
         # (budget exhaustion stays sticky inside RunHealth)
@@ -587,6 +613,69 @@ class TraceCollector:
             ess_forecast=rec.get("ess_forecast"),
         )
         self._sample_device_memory()
+
+    def _on_fleet_block(self, rec: Dict[str, Any]) -> None:
+        """Fleet twin of ``sample_block`` (stark_tpu.fleet): one vmapped
+        dispatch advanced every ACTIVE problem.  Grad evals arrive
+        already masked to active lanes — a converged problem's budget
+        counter stops moving the moment it is masked out."""
+        self.blocks.inc(phase="fleet")
+        chains = rec.get("chains") or self._chains()
+        block_len = rec.get("block_len")
+        active = rec.get("active")
+        if block_len is not None and active is not None:
+            self.draws.inc(
+                float(block_len) * max(chains, 1) * float(active)
+            )
+        if rec.get("dur_s") is not None:
+            self.h_block_s.observe(float(rec["dur_s"]))
+        if rec.get("block_grad_evals") is not None:
+            self.grad_evals.inc(float(rec["block_grad_evals"]))
+        if rec.get("block") is not None:
+            self.g_block.set(float(rec["block"]))
+        for field, g in (
+            ("active", self.g_fleet_active),
+            ("batch", self.g_fleet_batch),
+            ("occupancy", self.g_fleet_occupancy),
+        ):
+            if rec.get(field) is not None:
+                g.set(float(rec[field]))
+        fleet = {
+            k: rec[k]
+            for k in ("block", "batch", "active", "occupancy")
+            if rec.get(k) is not None
+        }
+        with self._lock:
+            self._status["fleet"].update(fleet)
+        self._set_status(phase="sample", block=rec.get("block"))
+        self._sample_device_memory()
+
+    def _on_problem_converged(self, rec: Dict[str, Any]) -> None:
+        status = str(rec.get("status", "converged"))
+        self.fleet_problems_done.inc(status=status)
+        if status == "converged":
+            self.g_fleet_converged.set(
+                self.fleet_problems_done.value(status="converged")
+            )
+        # /status carries the per-problem identity of the latest finisher
+        # so an operator can see WHICH posterior just completed
+        done = {
+            k: rec[k]
+            for k in ("problem_id", "status", "blocks", "draws_per_chain",
+                      "grad_evals", "min_ess", "max_rhat")
+            if rec.get(k) is not None
+        }
+        with self._lock:
+            self._status["fleet"]["last_done"] = done
+            self._status["fleet"]["problems_done"] = int(
+                self.fleet_problems_done.value(status="converged")
+                + self.fleet_problems_done.value(status="budget_exhausted")
+            )
+
+    def _on_fleet_compact(self, rec: Dict[str, Any]) -> None:
+        self.fleet_compactions.inc()
+        with self._lock:
+            self._status["fleet"]["pending"] = rec.get("pending")
 
     def _on_checkpoint(self, rec: Dict[str, Any]) -> None:
         self.checkpoints.inc()
@@ -704,6 +793,7 @@ class TraceCollector:
                 "health": dict(self._status["health"]),
                 "restarts": dict(self._status["restarts"]),
                 "meta": dict(self._status["meta"]),
+                "fleet": dict(self._status["fleet"]),
             }
         attempt = self.g_attempt.value()
         if attempt is not None:

@@ -2,6 +2,7 @@ from .bnn import BayesianMLP, synth_bnn_data
 from .eight_schools import EightSchools, eight_schools_data
 from .glm import (
     FusedLinearRegression,
+    FusedPoissonRegression,
     LinearRegression,
     PoissonRegression,
     synth_linreg_data,
@@ -44,6 +45,7 @@ __all__ = [
     "FusedLinearMixedModel",
     "FusedLinearMixedModelGrouped",
     "FusedLinearRegression",
+    "FusedPoissonRegression",
     "FusedLogistic",
     "GaussianMixture",
     "HierLogistic",

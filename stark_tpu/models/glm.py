@@ -76,6 +76,26 @@ class PoissonRegression(Model):
         return jnp.sum(y * log_rate - jnp.exp(log_rate) - jax.lax.lgamma(y + 1.0))
 
 
+class FusedPoissonRegression(_TransposedXMixin, PoissonRegression):
+    """PoissonRegression with the one-pass fused value-and-grad op
+    (ops/glm_fused.py): value + beta-gradient from a single pass over the
+    transposed design matrix, precision knobs keyed into the jit cache at
+    call time.  ``STARK_FUSED_GLM=0`` falls back to the autodiff
+    likelihood ON THE SAME transposed layout, so the knob flips the
+    execution path without re-preparing data."""
+
+    def log_lik(self, p, data):
+        from ..ops.glm_fused import fused_glm_enabled, poisson_loglik
+
+        if not fused_glm_enabled():
+            log_rate = jnp.clip(p["beta"] @ data["xT"], -30.0, 30.0)
+            y = data["y"]
+            return jnp.sum(
+                y * log_rate - jnp.exp(log_rate) - jax.lax.lgamma(y + 1.0)
+            )
+        return poisson_loglik(p["beta"], data["xT"], data["y"])
+
+
 def synth_linreg_data(key, n, d, *, noise=0.5, dtype=jnp.float32):
     k1, k2, k3 = jax.random.split(key, 3)
     x = jax.random.normal(k1, (n, d), dtype)

@@ -1,0 +1,108 @@
+"""Fused value-and-grad for the GLM likelihoods (first of the zoo beyond
+the logistic/gaussian families — ROADMAP item 3).
+
+Same contract as `ops.logistic_fused`: the likelihood value AND its
+beta-gradient come out of ONE pass over the transposed design matrix
+(``xt`` is X transposed, (D, N) — rows on the 128-wide lane axis), wrapped
+in a ``jax.custom_vjp`` so the VJP never re-reads X, and the
+STARK_FUSED_PRECISION / STARK_FUSED_X_DTYPE knobs are threaded into the
+jit cache key as CALL-TIME STATICS (the PR 4 fix: toggling a knob
+mid-process must retrace, never silently reuse the stale executable).
+
+The Poisson kernel here is plain XLA (two dots sharing the X stream per
+evaluation), not Pallas — the fusion win at this stage is the one-pass
+value+grad contract and the halved HBM traffic of a bf16 X stream; a
+Mosaic kernel can slot in under the same API once the roofline says the
+XLA lowering leaves bandwidth on the table.
+
+Model side: `models.glm.FusedPoissonRegression` routes through
+`poisson_loglik` behind the ``STARK_FUSED_GLM`` knob (default on; ``0``
+falls back to the autodiff likelihood on the same transposed layout, so
+the flag flips the execution path without re-preparing data).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .logistic_fused import _dot_precision, _x_stream_dtype
+
+#: clip bound for the log-link rate, matching models.glm.PoissonRegression
+#: (a warmup excursion must not overflow float32 through exp)
+_LOG_RATE_CLIP = 30.0
+
+
+def fused_glm_enabled() -> bool:
+    """The STARK_FUSED_GLM knob (default on)."""
+    return os.environ.get("STARK_FUSED_GLM", "1") != "0"
+
+
+def _poisson_vg(beta, xt, y):
+    """(ll, dll/dbeta) of y ~ Poisson(exp(clip(X beta))) in one X pass.
+
+    beta: (D,), xt: (D, N) — X TRANSPOSED — y: (N,) counts (float).
+    The gradient masks rows whose linear predictor sits outside the clip
+    band, matching autodiff through ``jnp.clip`` (zero sensitivity at a
+    saturated rate), so the fused and autodiff paths agree everywhere the
+    posterior actually lives.
+    """
+    prec = _dot_precision()
+    # a bf16 X still streams from HBM at half width — XLA fuses this
+    # upcast into the dot's operand read, it never materializes f32 X
+    xs = xt.astype(jnp.float32)
+    eta_raw = jnp.dot(beta, xs, precision=prec)
+    eta = jnp.clip(eta_raw, -_LOG_RATE_CLIP, _LOG_RATE_CLIP)
+    mu = jnp.exp(eta)
+    ll = jnp.sum(y * eta - mu - jax.lax.lgamma(y + 1.0))
+    inside = (jnp.abs(eta_raw) < _LOG_RATE_CLIP).astype(jnp.float32)
+    resid = (y - mu) * inside
+    grad = jnp.dot(xs, resid, precision=prec)
+    return ll, grad
+
+
+@functools.partial(
+    jax.jit, static_argnames=("_precision", "_x_dtype")
+)
+def _poisson_vg_jit(beta, xt, y, *, _precision, _x_dtype):
+    # cache-key-only statics: _poisson_vg re-reads the env knobs at trace
+    # time, so keying the executable on the RESOLVED values forces a
+    # retrace when STARK_FUSED_PRECISION / STARK_FUSED_X_DTYPE change
+    # mid-process (the PR 4 logistic_fused fix, applied from day one)
+    del _precision, _x_dtype
+    return _poisson_vg(beta, xt, y)
+
+
+def poisson_loglik_value_and_grad(beta, xt, y):
+    """-> (ll scalar, dll/dbeta (D,)) in one pass over xt."""
+    return _poisson_vg_jit(
+        beta, xt, y,
+        _precision=_dot_precision(), _x_dtype=_x_stream_dtype(),
+    )
+
+
+@jax.custom_vjp
+def poisson_loglik(beta, xt, y):
+    """Differentiable fused op: Poisson log-lik of exp(clip(X beta)).
+
+    One pass yields both the value and its beta-gradient; the VJP chains
+    the precomputed gradient, never re-reading X.  Under ``vmap`` over
+    chains XLA batches the shared-X dots into one matmul per evaluation.
+    """
+    val, _ = _poisson_vg(beta, xt, y)
+    return val
+
+
+def _poisson_fwd(beta, xt, y):
+    val, gbeta = _poisson_vg(beta, xt, y)
+    return val, gbeta
+
+
+def _poisson_bwd(gbeta, ct):
+    return ct * gbeta, None, None
+
+
+poisson_loglik.defvjp(_poisson_fwd, _poisson_bwd)

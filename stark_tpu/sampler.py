@@ -235,6 +235,10 @@ def drive_segmented_warmup(cfg, v_init, v_seg, finalize, warm_keys, z0, data,
     jitted on one device (``make_segmented_warmup``) or shard_mapped over a
     mesh (``ShardedBackend``); the schedule slicing and key layout live
     here so the two execution paths cannot drift.
+
+    `fleet._fleet_warmup` mirrors this loop with a leading problem axis
+    and a bit-identity contract against it — any schedule/key change here
+    must be made there too (tests/test_fleet.py pins the identity).
     """
     trace = telemetry.get_trace()
     # warmup-carry init (find_reasonable_step_size) + the per-chain key

@@ -70,6 +70,7 @@ __all__ = [
     "checkpoint_health",
     "checkpoint_is_healthy",
     "classify_fault",
+    "quarantine_path",
     "supervised_sample",
 ]
 
@@ -201,6 +202,18 @@ class RestartBudget:
         return self.in_window(now) > self.max_restarts
 
 
+def quarantine_path(path: str) -> None:
+    """Move a bad artifact aside as ``path.bad`` / ``path.badN``:
+    numbered suffixes so a second quarantine in the same workdir never
+    overwrites the forensic copy of an earlier failure."""
+    dst = path + ".bad"
+    n = 1
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{path}.bad{n}"
+    os.replace(path, dst)
+
+
 def _ranks_agree(all_done) -> bool:
     """True iff every rank reported a healthy checkpoint at the SAME
     (phase, progress) — the resume-consistency rule for multi-process
@@ -291,6 +304,7 @@ def supervised_sample(
     seed: int = 0,
     reseed_on_restart: bool = True,
     trace=None,
+    _runner=None,
     **kwargs,
 ):
     """Run ``sample_until_converged`` under supervision.
@@ -328,9 +342,17 @@ def supervised_sample(
     cache enabled here, so they skip the re-jit of every segment.
 
     Returns the AdaptiveResult of the first successful attempt.
+
+    ``_runner`` (internal): the attempt callable — defaults to
+    `runner.sample_until_converged`; `fleet.supervised_sample_fleet`
+    plugs in the fleet runner so the SAME restart budget / fault
+    taxonomy / watchdog / checkpoint-health machinery supervises a
+    many-problem fleet (its checkpoints carry the surviving active set).
     """
     from .runner import sample_until_converged
 
+    if _runner is None:
+        _runner = sample_until_converged
     trace = telemetry.resolve_trace(trace)
 
     # a wall-clock budget is an absolute deadline across ALL attempts — a
@@ -366,16 +388,6 @@ def supervised_sample(
 
     store_path = kwargs.get("draw_store_path")
     budget = RestartBudget(max_restarts, restart_window_s)
-
-    def quarantine(path: str) -> None:
-        # numbered suffixes: a second quarantine in the same workdir must
-        # not overwrite the forensic copy of an earlier failure
-        dst = path + ".bad"
-        n = 1
-        while os.path.exists(dst):
-            n += 1
-            dst = f"{path}.bad{n}"
-        os.replace(path, dst)
 
     attempt = 0
 
@@ -455,12 +467,12 @@ def supervised_sample(
                         "chain_health", status="quarantine",
                         path=ckpt_path, reason=reason,
                     )
-                quarantine(ckpt_path)
-        resume = agree_resume(resume, quarantine=quarantine, trace=trace)
+                quarantine_path(ckpt_path)
+        resume = agree_resume(resume, quarantine=quarantine_path, trace=trace)
         if resume is None and store_path and os.path.exists(store_path):
             # cold start: draws persisted by a discarded run must not mix
             # into this run's store (a later resume reads the whole store)
-            quarantine(store_path)
+            quarantine_path(store_path)
         wd: Optional[Watchdog] = None
         try:
             remaining = (
@@ -479,7 +491,7 @@ def supervised_sample(
                         stall_timeout_s, trace=trace, label="supervise"
                     ).start()
                 try:
-                    return sample_until_converged(
+                    return _runner(
                         model,
                         data,
                         seed=seed + attempt if reseed_on_restart else seed,

@@ -98,9 +98,18 @@ EVENT_TYPES = frozenset(
 AUX_EVENT_TYPES = frozenset({"progress", "adapt", "budget", "collect",
                              "fault"})
 
+#: fleet-sampling event types (stark_tpu.fleet): ``fleet_block`` — one
+#: vmapped dispatch advanced the whole batch (occupancy/active/grad-eval
+#: accounting); ``problem_converged`` — one problem finished (status
+#: "converged" or "budget_exhausted", with its per-problem totals);
+#: ``fleet_compact`` — converged lanes were compacted out of the batch
+#: (and the batch refilled from the pending queue)
+FLEET_EVENT_TYPES = frozenset({"fleet_block", "problem_converged",
+                               "fleet_compact"})
+
 #: the complete WRITER registry: every emit()/phase() call in stark_tpu/
 #: must use one of these names (tools/lint_trace_schema.py enforces it)
-ALL_EVENT_TYPES = EVENT_TYPES | AUX_EVENT_TYPES
+ALL_EVENT_TYPES = EVENT_TYPES | AUX_EVENT_TYPES | FLEET_EVENT_TYPES
 
 #: envelope keys every event must carry (validate_event)
 ENVELOPE_KEYS = ("schema", "event", "ts", "wall_s", "run")
@@ -108,9 +117,11 @@ ENVELOPE_KEYS = ("schema", "event", "ts", "wall_s", "run")
 #: phase event types whose dur_s values tile the run wall.  ``collect`` is
 #: the auxiliary host post-processing phase (draw constraining, stat
 #: assembly) — not in the canonical set but timed like the others so phase
-#: sums account for the whole run
-PHASE_EVENTS = ("compile", "warmup_block", "sample_block", "checkpoint",
-                "collect")
+#: sums account for the whole run.  ``fleet_block`` is the fleet runner's
+#: per-dispatch sampling phase (stark_tpu.fleet) — a fleet run's wall is
+#: tiled by fleet_block + warmup_block + checkpoint, not sample_block
+PHASE_EVENTS = ("compile", "warmup_block", "sample_block", "fleet_block",
+                "checkpoint", "collect")
 
 
 def _last_run_ordinal(path: str) -> int:
@@ -632,6 +643,12 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                   "overshoot_draws"} | {},       # streaming-diagnostics /
                                                  # adaptive-scheduler
                                                  # accounting, when emitted
+         "fleet": {"problems", "blocks", "occupancy_last", "active_last",
+                   "batch_last", "grad_evals", "problems_converged",
+                   "problems_budget_exhausted",
+                   "compactions"} | {},          # fleet-sampling events
+                                                 # (stark_tpu.fleet), when
+                                                 # the run emitted them
          "restarts": int, "events": int}
 
     ``overlap`` aggregates the runner's pipelined ``sample_block``
@@ -654,8 +671,8 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     runs = sorted({e.get("run", 0) for e in events})
     if not runs:
         return {"run": 0, "meta": {}, "wall_s": None, "phases": {},
-                "health": {}, "overlap": {}, "diag": {}, "restarts": 0,
-                "events": 0}
+                "health": {}, "overlap": {}, "diag": {}, "fleet": {},
+                "restarts": 0, "events": 0}
     run = runs[-1] if run is None else run
     evs = [e for e in events if e.get("run", 0) == run]
     # restart chain: the selected run's own restarts (it may itself be a
@@ -671,12 +688,36 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     health: Dict[str, Any] = {}
     overlap: Dict[str, float] = {}
     diag: Dict[str, Any] = {}
+    fleet: Dict[str, Any] = {}
     saw_overlap = False
     wall = None
     div_latest = None
     accepts: List[float] = []
     for e in evs:
         ev = e["event"]
+        if ev == "fleet_block":
+            fleet["blocks"] = fleet.get("blocks", 0) + 1
+            if e.get("occupancy") is not None:
+                fleet["occupancy_last"] = e["occupancy"]
+            if e.get("active") is not None:
+                fleet["active_last"] = e["active"]
+            if e.get("batch") is not None:
+                fleet["batch_last"] = e["batch"]
+            if e.get("block_grad_evals") is not None:
+                fleet["grad_evals"] = (
+                    fleet.get("grad_evals", 0) + int(e["block_grad_evals"])
+                )
+        elif ev == "problem_converged":
+            key = (
+                "problems_converged"
+                if e.get("status", "converged") == "converged"
+                else "problems_budget_exhausted"
+            )
+            fleet[key] = fleet.get(key, 0) + 1
+        elif ev == "fleet_compact":
+            fleet["compactions"] = fleet.get("compactions", 0) + 1
+        elif ev == "run_start" and e.get("problems") is not None:
+            fleet["problems"] = e["problems"]
         if ev == "sample_block":
             for k in ("t_host_hidden_s", "device_idle_s", "t_wait_s"):
                 if e.get(k) is not None:
@@ -760,6 +801,7 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
         "health": health,
         "overlap": overlap if saw_overlap else {},
         "diag": diag,
+        "fleet": fleet,
         "restarts": restarts_total,
         "events": len(evs),
     }

@@ -1,0 +1,528 @@
+"""Fleet sampling (stark_tpu/fleet.py) — the PR 6 tentpole contracts:
+
+* a ONE-problem fleet is bit-identical to the single-problem runner
+  (draws, metrics trail modulo timing, checkpoint arrays) — it literally
+  routes through it, the same escape-hatch discipline as PRs 3-4;
+* ``STARK_FLEET=0`` (sequential) and the vmapped fleet path produce
+  identical per-problem draws;
+* ragged convergence: a converged problem's persisted draws never change
+  after masking, and its gradient evaluations stop counting, while a
+  straggler continues to the SAME draws an unbatched
+  ``sample_until_converged`` run with the same seed produces;
+* compaction is a no-op on results (refill_occupancy 0 vs 1 — identical
+  draws), and queued problems swap in deterministically (max_batch);
+* a crash mid-fleet resumes the SURVIVING active set from the fleet
+  checkpoint to bit-identical final draws (direct resume AND under the
+  supervised restart machinery);
+* the fleet trace events (fleet_block / problem_converged /
+  fleet_compact) are schema-registered, summarize into the ``fleet``
+  section, and feed the /status + /metrics collector (grad-eval counter
+  freezes when a problem converges).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from stark_tpu import faults
+from stark_tpu.checkpoint import load_checkpoint
+from stark_tpu.fleet import (
+    FleetSpec,
+    sample_fleet,
+    supervised_sample_fleet,
+)
+from stark_tpu.models.eight_schools import SIGMA, Y, EightSchools
+from stark_tpu.runner import sample_until_converged
+from stark_tpu.telemetry import (
+    ALL_EVENT_TYPES,
+    RunTrace,
+    read_trace,
+    summarize_trace,
+)
+
+_TIMING_KEYS = ("wall_s", "t_dispatch_s", "t_diag_s")
+
+
+def _make_spec(n=3, seed=0):
+    rng = np.random.default_rng(seed)
+    y, sig = np.asarray(Y), np.asarray(SIGMA)
+    datasets = [
+        {"y": (y + rng.normal(0, 2.0, y.shape)).astype(np.float32),
+         "sigma": sig}
+        for _ in range(n)
+    ]
+    return FleetSpec.from_problems(EightSchools(), datasets)
+
+
+# gates chosen so (with seed 0) at least one problem converges at
+# min_blocks and at least one straggles past it — asserted by the
+# fixture-dependent tests below, so a regression in the setup is loud
+_KW = dict(
+    chains=2, block_size=25, max_blocks=10, min_blocks=2, num_warmup=100,
+    ess_target=60.0, rhat_target=1.2, seed=0,
+)
+
+
+@pytest.fixture(scope="module")
+def fleet_run(tmp_path_factory):
+    """One canonical fleet run shared by the invariant tests: traced,
+    checkpointed, metrics'd, with per-problem draw stores."""
+    td = tmp_path_factory.mktemp("fleet")
+    spec = _make_spec()
+    trace_path = str(td / "trace.jsonl")
+    res = sample_fleet(
+        spec,
+        checkpoint_path=str(td / "fleet.ckpt.npz"),
+        metrics_path=str(td / "metrics.jsonl"),
+        draw_store_path=str(td / "draws"),
+        trace=RunTrace(trace_path),
+        **_KW,
+    )
+    return spec, res, td, trace_path
+
+
+def test_spec_validation():
+    model = EightSchools()
+    good = {"y": np.zeros(8, np.float32), "sigma": np.ones(8, np.float32)}
+    with pytest.raises(ValueError, match="at least one"):
+        FleetSpec.from_problems(model, [])
+    with pytest.raises(ValueError, match="structure"):
+        FleetSpec.from_problems(model, [good, {"y": good["y"]}])
+    with pytest.raises(ValueError, match="unique"):
+        FleetSpec(model, (good, good), ("a", "a"))
+    short = {"y": np.zeros(7, np.float32), "sigma": np.ones(7, np.float32)}
+    with pytest.raises(ValueError, match="p0001.*leaf shapes"):
+        FleetSpec.from_problems(model, [good, short])
+    spec = FleetSpec.from_problems(model, [good, good])
+    stacked = spec.prepared_stacked()
+    assert stacked["y"].shape == (2, 8)
+    # from_stacked round-trips
+    spec2 = FleetSpec.from_stacked(model, stacked, spec.problem_ids)
+    assert spec2.num_problems == 2
+    np.testing.assert_array_equal(
+        np.asarray(spec2.datasets[1]["y"]), good["y"]
+    )
+
+
+def test_chees_rejected():
+    spec = _make_spec(2)
+    with pytest.raises(ValueError, match="chees"):
+        sample_fleet(spec, kernel="chees")
+
+
+def test_ragged_convergence_and_straggler(fleet_run):
+    """The tentpole invariant: problems converge raggedly; a straggler
+    reaches the SAME draws as an unbatched single-problem run with the
+    same seed; a converged problem's draws and grad-eval counter freeze
+    at its own stop point."""
+    spec, res, _td, _tp = fleet_run
+    blocks = [p.blocks for p in res.problems]
+    assert all(p.converged for p in res.problems)
+    # ragged: not every problem stopped at the same block
+    assert min(blocks) < max(blocks), blocks
+    straggler = res.problems[int(np.argmax(blocks))]
+    early = res.problems[int(np.argmin(blocks))]
+
+    # the straggler matches the unmodified single-problem runner bit-for-
+    # bit (same per-problem PRNG stream, fixed block march)
+    i = int(np.argmax(blocks))
+    single = sample_until_converged(
+        spec.model, spec.datasets[i],
+        adaptive_blocks=False,
+        **{**_KW, "seed": _KW["seed"] + i},
+    )
+    np.testing.assert_array_equal(single.draws_flat, straggler.draws_flat)
+
+    # frozen after masking: the early problem's draw count is exactly its
+    # own stop point, untouched by the extra fleet blocks that ran after
+    assert early.draws_per_chain == early.blocks * _KW["block_size"]
+    assert straggler.blocks > early.blocks
+    # grad evals stop counting at the stop point: the counter equals the
+    # sum over the problem's OWN block records, nothing after
+    for p in res.problems:
+        recs = [r for r in p.history if r.get("event") == "block"]
+        assert len(recs) == p.blocks
+        assert p.grad_evals == sum(r["block_grad_evals"] for r in recs)
+    assert res.total_grad_evals == sum(p.grad_evals for p in res.problems)
+
+
+def test_compaction_invariance(fleet_run):
+    """Draws are independent of batch composition: never-compact (0.0)
+    and always-compact (1.0) runs produce identical per-problem draws,
+    and the fixture run observed at least one compaction."""
+    spec, res, _td, _tp = fleet_run
+    assert res.compactions >= 1
+    never = sample_fleet(spec, refill_occupancy=0.0, **_KW)
+    assert never.compactions == 0
+    always = sample_fleet(spec, refill_occupancy=1.0, **_KW)
+    for a, b, c in zip(res.problems, never.problems, always.problems):
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+        np.testing.assert_array_equal(a.draws_flat, c.draws_flat)
+
+
+def test_max_batch_refill(fleet_run):
+    """A capacity-2 batch queues the third problem and swaps it in at a
+    compaction boundary — same draws as the all-at-once batch."""
+    spec, res, _td, _tp = fleet_run
+    capped = sample_fleet(spec, max_batch=2, refill_occupancy=0.6, **_KW)
+    for a, b in zip(res.problems, capped.problems):
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+    assert capped.compactions >= 1
+
+
+def test_sequential_escape_hatch(fleet_run, tmp_path, monkeypatch):
+    """STARK_FLEET=0 routes through the single-problem runner per problem
+    — identical draws to the vmapped path."""
+    spec, res, _td, _tp = fleet_run
+    monkeypatch.setenv("STARK_FLEET", "0")
+    seq = sample_fleet(spec, **_KW)
+    for a, b in zip(res.problems, seq.problems):
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+        assert a.converged == b.converged
+        assert a.blocks == b.blocks
+
+
+def test_drawstore_per_problem(fleet_run):
+    """Every problem's store file holds exactly its persisted draws,
+    keyed by problem_id."""
+    from stark_tpu.drawstore import read_draws
+
+    spec, res, td, _tp = fleet_run
+    for p in res.problems:
+        path = str(td / "draws" / f"p_{p.problem_id}.stkr")
+        assert os.path.exists(path)
+        stored, chains, dim = read_draws(path, mmap=False)
+        np.testing.assert_array_equal(
+            stored.transpose(1, 0, 2), p.draws_flat
+        )
+
+
+def test_fleet_checkpoint_carries_active_set(fleet_run):
+    spec, res, td, _tp = fleet_run
+    arrays, meta = load_checkpoint(str(td / "fleet.ckpt.npz"))
+    assert meta["fleet"] is True
+    assert meta["problem_ids"] == list(spec.problem_ids)
+    # the final checkpoint has everything finished: empty active set
+    assert meta["active_ids"] == []
+    assert arrays["z"].shape[0] == 0
+    for pid, m in meta["problems"].items():
+        assert m["converged"] is True
+        assert m["draws"] == res[pid].draws_per_chain
+
+
+def test_trace_events_and_summary(fleet_run):
+    spec, res, _td, trace_path = fleet_run
+    events = read_trace(trace_path)
+    names = {e["event"] for e in events}
+    assert {"fleet_block", "problem_converged", "fleet_compact"} <= names
+    assert names <= ALL_EVENT_TYPES | {"progress"}
+    done = [e for e in events if e["event"] == "problem_converged"]
+    assert {e["problem_id"] for e in done} == set(spec.problem_ids)
+    for e in done:
+        assert e["status"] == "converged"
+        assert e["grad_evals"] == res[e["problem_id"]].grad_evals
+    # occupancy is monotone non-increasing between refills and the grad
+    # accounting in fleet_block covers only active lanes
+    fb = [e for e in events if e["event"] == "fleet_block"]
+    assert fb[0]["occupancy"] == 1.0
+    assert sum(e["block_grad_evals"] for e in fb) == res.total_grad_evals
+    s = summarize_trace(events)
+    assert s["fleet"]["problems"] == spec.num_problems
+    assert s["fleet"]["problems_converged"] == spec.num_problems
+    assert s["fleet"]["compactions"] == res.compactions
+    assert s["fleet"]["grad_evals"] == res.total_grad_evals
+
+
+def test_trace_report_renders_fleet_table(fleet_run):
+    import importlib.util
+    import sys
+
+    spec, _res, _td, trace_path = fleet_run
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec_ = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(root, "tools", "trace_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec_)
+    spec_.loader.exec_module(mod)
+    events = read_trace(trace_path)
+    out = mod.render_run(events, events[-1].get("run", 1))
+    assert "fleet" in out
+    for pid in spec.problem_ids:
+        assert pid in out
+
+
+def test_resume_after_crash(fleet_run, tmp_path):
+    """Chaos scenario: a crash with the fleet mid-flight resumes the
+    surviving active set from the checkpoint and finishes with draws
+    bit-identical to the uninjected run — including problems that had
+    already converged before the crash (their stores are not re-written)."""
+    spec, res, _td, _tp = fleet_run
+    ck = str(tmp_path / "fleet.ckpt.npz")
+    store = str(tmp_path / "draws")
+    faults.configure("fleet.block.post=crash@1")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            sample_fleet(
+                spec, checkpoint_path=ck, draw_store_path=store, **_KW
+            )
+    finally:
+        faults.configure(None)
+    # the crash landed after >= 1 problem converged (block 2 of the
+    # fixture schedule) — the resume must carry the survivors only
+    _arrays, meta = load_checkpoint(ck)
+    assert 0 < len(meta["active_ids"]) < spec.num_problems
+    resumed = sample_fleet(
+        spec, checkpoint_path=ck, resume_from=ck, draw_store_path=store,
+        **_KW,
+    )
+    for a, b in zip(res.problems, resumed.problems):
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+        assert a.converged and b.converged
+
+
+def test_supervised_fleet_restart(fleet_run, tmp_path):
+    """The fleet composes with the PR 2 supervision machinery: an
+    injected crash is classified, restarted from the fleet checkpoint,
+    and the final result matches the uninjected run bit-for-bit
+    (reseed_on_restart=False, same discipline as the chaos drills)."""
+    spec, res, _td, _tp = fleet_run
+    faults.configure("fleet.block.post=crash*1@1")
+    try:
+        out = supervised_sample_fleet(
+            spec,
+            workdir=str(tmp_path / "wd"),
+            max_restarts=2,
+            reseed_on_restart=False,
+            **_KW,
+        )
+    finally:
+        faults.configure(None)
+    for a, b in zip(res.problems, out.problems):
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+    restarts = [
+        json.loads(line)
+        for line in open(tmp_path / "wd" / "metrics.jsonl")
+        if '"restart"' in line
+    ]
+    assert len(restarts) == 1
+    assert restarts[0]["fault"] == "transient"
+    # (resumed_from_checkpoint records whether the FAILED attempt had
+    # resumed — attempt 1 started cold; the bit-identical draws above
+    # are the proof that the retry resumed the surviving active set)
+
+
+def test_resume_with_empty_active_set(tmp_path):
+    """A crash can land AFTER a whole cohort converged but BEFORE the
+    next cohort was admitted (refill_occupancy=0 never compacts, so the
+    checkpoint carries active_ids=[]).  Resuming that checkpoint must
+    take the cold-batch path for the pending problems instead of
+    concatenating onto the saved 0-lane arrays."""
+    spec = _make_spec(n=2)
+    kw = dict(
+        chains=2, block_size=50, max_blocks=10, min_blocks=1,
+        num_warmup=100, ess_target=5.0, rhat_target=2.0, seed=0,
+        max_batch=1, refill_occupancy=0.0,
+    )
+    ck = str(tmp_path / "fleet.ckpt.npz")
+    faults.configure("fleet.block.post=crash@1")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            sample_fleet(spec, checkpoint_path=ck, **kw)
+    finally:
+        faults.configure(None)
+    _arrays, meta = load_checkpoint(ck)
+    assert meta["active_ids"] == []  # the cohort converged pre-crash
+    resumed = sample_fleet(spec, checkpoint_path=ck, resume_from=ck, **kw)
+    assert all(p.converged for p in resumed.problems)
+    assert all(p.draws_per_chain > 0 for p in resumed.problems)
+
+
+def test_resume_rejects_config_mismatch(fleet_run):
+    """chains/block_size are baked into every per-problem array and the
+    key-split cadence — resuming with different values must fail loudly
+    instead of dying in a shape error or silently diverging."""
+    spec, _res, td, _tp = fleet_run
+    ck = str(td / "fleet.ckpt.npz")
+    for field, kw in (("chains", {**_KW, "chains": 4}),
+                      ("block_size", {**_KW, "block_size": 50})):
+        with pytest.raises(ValueError, match=field):
+            sample_fleet(spec, resume_from=ck, **kw)
+
+
+def test_reseeded_restart_decorrelates_streams():
+    """A reseeded restart (supervisor passes seed+attempt AND
+    reseed=attempt) must not replay a NEIGHBOR problem's attempt-0
+    stream: without the cold-key fold, problem 0 of a seed=1 attempt
+    aliases problem 1 of the seed=0 attempt (PRNGKey(1+0) == PRNGKey(0+1))."""
+    y, sig = np.asarray(Y), np.asarray(SIGMA)
+    data = {"y": y.astype(np.float32), "sigma": sig}
+    spec = FleetSpec.from_problems(EightSchools(), [data, data])
+    kw = dict(chains=2, block_size=25, max_blocks=2, min_blocks=2,
+              num_warmup=50, ess_target=1e9, rhat_target=1.0001)
+    base = sample_fleet(spec, seed=0, **kw)
+    retry = sample_fleet(spec, seed=1, reseed=1, **kw)
+    assert not np.array_equal(
+        base.problems[1].draws_flat, retry.problems[0].draws_flat
+    )
+
+
+def _strip_timing(rec):
+    return {k: v for k, v in rec.items() if k not in _TIMING_KEYS}
+
+
+def test_b1_bit_identity(tmp_path):
+    """A one-problem fleet IS the single-problem runner: draws, metrics
+    trail (modulo timing fields), and checkpoint arrays are identical,
+    and the artifacts land at the caller's paths unsuffixed."""
+    spec = _make_spec(1)
+    kw = {**_KW, "max_blocks": 4, "ess_target": 30.0}
+    fdir, sdir = tmp_path / "fleet", tmp_path / "single"
+    fdir.mkdir(), sdir.mkdir()
+    fres = sample_fleet(
+        spec,
+        checkpoint_path=str(fdir / "c.npz"),
+        metrics_path=str(fdir / "m.jsonl"),
+        **kw,
+    )
+    sres = sample_until_converged(
+        spec.model, spec.datasets[0],
+        checkpoint_path=str(sdir / "c.npz"),
+        metrics_path=str(sdir / "m.jsonl"),
+        adaptive_blocks=False,
+        **kw,
+    )
+    np.testing.assert_array_equal(
+        fres.problems[0].draws_flat, sres.draws_flat
+    )
+    fa, fmeta = load_checkpoint(str(fdir / "c.npz"))
+    sa, smeta = load_checkpoint(str(sdir / "c.npz"))
+    assert set(fa) == set(sa)
+    for k in fa:
+        np.testing.assert_array_equal(fa[k], sa[k])
+    assert fmeta["blocks_done"] == smeta["blocks_done"]
+    fm = [json.loads(l) for l in open(fdir / "m.jsonl")]
+    sm = [json.loads(l) for l in open(sdir / "m.jsonl")]
+    assert [_strip_timing(r) for r in fm] == [_strip_timing(r) for r in sm]
+    # and the constrained draws agree too
+    for k, v in fres.problems[0].draws.items():
+        np.testing.assert_array_equal(v, sres.draws[k])
+
+
+def test_sequential_budget_reports_unserved_problems(monkeypatch):
+    """A budget stop mid-sweep must not shrink the fleet: unserved
+    problems appear with budget_exhausted=True and empty draws, so a
+    converged-fraction gate sees the real denominator."""
+    spec = _make_spec(3)
+    monkeypatch.setenv("STARK_FLEET", "0")
+    res = sample_fleet(spec, time_budget_s=0.0, **_KW)
+    assert res.num_problems == 3
+    assert res.budget_exhausted
+    assert res.converged_fraction == 0.0
+    for p in res.problems:
+        assert p.budget_exhausted and not p.converged
+        assert p.draws_flat.shape == (_KW["chains"], 0, 10)
+    # lookup by id still works for every problem
+    assert res[spec.problem_ids[-1]].blocks == 0
+
+
+def test_forced_optimistic_gate_never_beats_validation():
+    """The PR 4 guard, on the fleet path: a forced-optimistic streaming
+    gate sends candidate stops to the full validation pass, which must
+    reject them — no problem may converge below an unreachable target."""
+    spec = _make_spec(2)
+    faults.configure("runner.gate.optimistic=nan")
+    try:
+        res = sample_fleet(
+            spec,
+            **{**_KW, "max_blocks": 3, "ess_target": 1e8},
+        )
+    finally:
+        faults.configure(None)
+    assert not any(p.converged for p in res.problems)
+    # the forced gate DID reach validation: full-pass fields recorded
+    recs = [r for p in res.problems for r in p.history
+            if "full_min_ess" in r]
+    assert recs, "forced-optimistic gate never reached the full pass"
+
+
+@pytest.mark.slow
+def test_supervised_sequential_resumes_per_problem(tmp_path, monkeypatch):
+    """Supervised + STARK_FLEET=0: a crash mid-sweep restarts with each
+    problem resuming its OWN checkpoint — the sweep finishes (all
+    problems converged) instead of cold-starting the fleet every
+    attempt."""
+    spec = _make_spec(3)
+    monkeypatch.setenv("STARK_FLEET", "0")
+    # runner.block.post hits once per processed block across the sweep;
+    # @3 crashes inside the second problem's run
+    faults.configure("runner.block.post=crash*1@3")
+    try:
+        res = supervised_sample_fleet(
+            spec,
+            workdir=str(tmp_path / "wd"),
+            max_restarts=2,
+            reseed_on_restart=False,
+            **_KW,
+        )
+    finally:
+        faults.configure(None)
+    assert all(p.converged for p in res.problems)
+    # per-problem checkpoints exist under the workdir
+    import glob
+
+    assert len(glob.glob(str(tmp_path / "wd" / "chain.ckpt.*.npz"))) == 3
+
+
+@pytest.mark.slow
+def test_bench_fleet_leg_smoke():
+    """The bench.py extra-evidence fleet leg at smoke scale: both
+    sequential baselines measured, the speedup fields present, and the
+    aggregate metric finite."""
+    from stark_tpu.benchmarks import bench_fleet_eight_schools
+
+    r = bench_fleet_eight_schools(
+        problems=6, chains=2, num_warmup=100, block_size=25,
+        max_blocks=12, ess_target=40.0, rhat_target=1.2, seq_probe=1,
+    )
+    assert r.extra["problems"] == 6
+    assert np.isfinite(r.ess_per_sec) and r.ess_per_sec > 0
+    assert r.extra["seq_per_job_ess_per_sec_est"] > 0
+    assert r.extra["seq_warm_ess_per_sec_est"] > 0
+    assert r.extra["speedup_vs_sequential"] is not None
+    assert 0.0 <= r.extra["converged_fraction"] <= 1.0
+
+
+def test_metrics_collector_fleet_events():
+    """The /metrics + /status collector consumes the fleet events: the
+    grad-eval counter advances only with active-lane grads, occupancy and
+    problem identity reach /status."""
+    from stark_tpu.metrics import TraceCollector
+
+    c = TraceCollector()
+    base = {"schema": 1, "ts": 0.0, "wall_s": 0.0, "run": 1}
+    c.on_event({**base, "event": "run_start", "entry": "sample_fleet",
+                "problems": 3, "chains": 2})
+    c.on_event({**base, "event": "fleet_block", "block": 1, "batch": 3,
+                "active": 3, "occupancy": 1.0, "block_len": 25,
+                "chains": 2, "block_grad_evals": 900, "dur_s": 0.5})
+    c.on_event({**base, "event": "problem_converged", "problem_id": "p0",
+                "status": "converged", "blocks": 2, "grad_evals": 600,
+                "draws_per_chain": 50})
+    c.on_event({**base, "event": "fleet_block", "block": 2, "batch": 3,
+                "active": 2, "occupancy": 2 / 3, "block_len": 25,
+                "chains": 2, "block_grad_evals": 600, "dur_s": 0.5})
+    c.on_event({**base, "event": "fleet_compact", "from_batch": 3,
+                "to_batch": 2, "refilled": 0, "pending": 0})
+    assert c.grad_evals.value() == 1500.0  # active lanes only
+    assert c.draws.value() == 25 * 2 * 3 + 25 * 2 * 2
+    assert c.fleet_compactions.value() == 1.0
+    st = c.status()
+    assert st["fleet"]["active"] == 2
+    assert st["fleet"]["occupancy"] == pytest.approx(2 / 3)
+    assert st["fleet"]["last_done"]["problem_id"] == "p0"
+    assert st["fleet"]["problems_done"] == 1
+    rendered = c.registry.render()
+    assert "fleet_active_problems" in rendered
+    assert "fleet_problems_done_total" in rendered

@@ -1,0 +1,76 @@
+"""`tools/perf_ledger.py check` wired into the test tier (ROADMAP item 3's
+"wire it into CI" note): the committed ledger must pass the gate, and a
+synthetic regression must fail it — so a bench round that lands a slower
+row breaks the suite instead of shipping silently.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOL = os.path.join(_REPO, "tools", "perf_ledger.py")
+_LEDGER = os.path.join(_REPO, "bench_artifacts", "ledger.jsonl")
+
+
+def _check(*args, env_extra=None):
+    env = dict(os.environ)
+    # the read path must not need an accelerator (or jax at all)
+    env["JAX_PLATFORMS"] = "cpu"
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, _TOOL, *args],
+        capture_output=True, text=True, env=env, cwd=_REPO,
+    )
+
+
+def test_committed_ledger_exists_and_passes():
+    """The repo ships a real ledger (the bench legs append to it) and the
+    CI gate accepts its current state — every config present."""
+    assert os.path.exists(_LEDGER), (
+        "bench_artifacts/ledger.jsonl must be committed so the regression "
+        "gate has a baseline"
+    )
+    rows = [json.loads(l) for l in open(_LEDGER) if l.strip()]
+    assert rows, "committed ledger must hold at least one row"
+    res = _check("check", "--all-configs")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "REGRESSION" not in res.stdout
+
+
+def _row(config, ess, ts):
+    return {
+        "schema": 1, "ts": ts, "source": "test", "config": config,
+        "ess_per_sec": ess, "wall_s": 10.0, "max_rhat": 1.005,
+        "converged": True,
+    }
+
+
+def test_synthetic_regression_fails(tmp_path):
+    """A 2x throughput drop against a healthy trailing median exits 1;
+    reverting it exits 0 — the ratchet both bites and releases."""
+    path = tmp_path / "ledger.jsonl"
+    t0 = time.time()
+    rows = [_row("cfg", 10.0, t0 + i) for i in range(4)]
+    rows.append(_row("cfg", 5.0, t0 + 9))  # 2x drop, ~3x past the band
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    res = _check("--ledger", str(path), "check")
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "REGRESSION" in res.stdout
+    # a healthy newest row passes again
+    rows.append(_row("cfg", 10.5, t0 + 10))
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    res = _check("--ledger", str(path), "check")
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_fresh_config_passes(tmp_path):
+    """A config with no history must not fail CI (fresh ledgers pass)."""
+    path = tmp_path / "ledger.jsonl"
+    path.write_text(json.dumps(_row("new-config", 3.0, time.time())) + "\n")
+    res = _check("--ledger", str(path), "check")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "insufficient history" in res.stdout

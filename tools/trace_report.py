@@ -142,6 +142,53 @@ def render_run(events, run) -> str:
         ))
         out.append("")
 
+    # fleet-sampling accounting (stark_tpu.fleet): batch occupancy /
+    # convergence rollup plus a per-problem table from the
+    # problem_converged events — which posterior finished when, at what
+    # gradient cost, and who straggled
+    fl = s.get("fleet") or {}
+    if fl:
+        rows = [
+            ("problems", fl.get("problems")),
+            ("converged", fl.get("problems_converged")),
+            ("budget exhausted", fl.get("problems_budget_exhausted")),
+            ("fleet blocks", fl.get("blocks")),
+            ("compactions", fl.get("compactions")),
+            ("last occupancy", fl.get("occupancy_last")),
+            ("last active/batch",
+             f"{fl['active_last']}/{fl['batch_last']}"
+             if fl.get("active_last") is not None
+             and fl.get("batch_last") is not None else None),
+            ("active grad evals", fl.get("grad_evals")),
+        ]
+        out.append(_table(
+            [r for r in rows if r[1] is not None], ("fleet", "value")
+        ))
+        out.append("")
+        done = [
+            e for e in events
+            if e.get("run") == s["run"] and e["event"] == "problem_converged"
+        ]
+        if done:
+            rows = [
+                (
+                    e.get("problem_id"),
+                    e.get("status"),
+                    e.get("blocks"),
+                    e.get("draws_per_chain"),
+                    e.get("grad_evals"),
+                    e.get("min_ess"),
+                    e.get("max_rhat"),
+                )
+                for e in done
+            ]
+            out.append(_table(
+                rows,
+                ("problem", "status", "blocks", "draws/chain",
+                 "grad evals", "min ESS", "max R-hat"),
+            ))
+            out.append("")
+
     h = s["health"]
     if h:
         keys = (
