@@ -79,6 +79,36 @@ def _env_int(name, default):
     return int(os.environ.get(name, default))
 
 
+def _fin(v, nd):
+    # strict-JSON rule shared by every evidence/artifact row: a stuck
+    # component's NaN must become null, never a bare NaN token that
+    # invalidates the whole artifact line
+    return round(v, nd) if math.isfinite(v) else None
+
+
+def res_row(res):
+    """One strict-JSON extra-evidence row from a BenchResult."""
+    row = {
+        "benchmark": res.name,
+        # null (not 0.0) for a non-finite rate: a stuck leg must
+        # stay distinguishable from a measured-(~)zero one —
+        # ``converged`` carries the finiteness, the value column
+        # must not erase it (ADVICE r5)
+        "value": _fin(res.ess_per_sec, 3),
+        "metric": res.metric_name,
+        "min_ess": _fin(res.min_ess, 1),
+        "wall_s": round(res.wall_s, 1),
+        "max_rhat": _fin(res.max_rhat, 4),
+        "converged": res.passed() and math.isfinite(res.ess_per_sec),
+        "gate": res.gate,
+    }
+    row.update({
+        k: (_fin(v, 4) if isinstance(v, float) else v)
+        for k, v in res.extra.items()
+    })
+    return row
+
+
 def select_result(results):
     """Pick the reported metric from (tag, ess_per_sec, max_rhat) tuples.
 
@@ -730,31 +760,6 @@ def main():
             # BENCH_AUTODIFF=0 opt-out is respected even here
             timed_run(model, "NUTS autodiff")
 
-    def append_ledger(config, bench_dict, extra_keys=(), label="perf"):
-        """Cross-run perf regression ledger (stark_tpu.ledger): append a
-        row so `tools/perf_ledger.py check` can gate the NEXT run against
-        the trailing median of its config series.  Best-effort by
-        contract — a full disk must not turn a measured bench into a
-        failure — and STARK_PERF_LEDGER=0 opts out (tiny-scale tests)."""
-        try:
-            from stark_tpu import ledger as perf_ledger
-
-            ledger_path = perf_ledger.default_ledger_path()
-            if ledger_path is None:
-                return
-            row = perf_ledger.make_row(
-                source="bench.py", config=config, bench=bench_dict,
-            )
-            for k in extra_keys:
-                if bench_dict.get(k) is not None:
-                    row[k] = bench_dict[k]
-            perf_ledger.append_row(row, ledger_path)
-            print(f"[bench] {label} ledger row appended to {ledger_path}",
-                  file=sys.stderr)
-        except Exception as e:  # noqa: BLE001 — the ledger must not fail the bench
-            print(f"[bench] {label} ledger append failed: {e!r}",
-                  file=sys.stderr)
-
     def append_ledger_row(bench_dict, sampler):
         # comparability key: every axis that changes the measured
         # workload — rows gate only against identical configs.  The
@@ -810,33 +815,6 @@ def main():
     ):
         from stark_tpu import benchmarks as bmarks
 
-        def _fin(v, nd):
-            # same strict-JSON rule as the flagship fields below: a stuck
-            # component's NaN must become null, never a bare NaN token
-            # that invalidates the whole artifact line
-            return round(v, nd) if math.isfinite(v) else None
-
-        def res_row(res):
-            row = {
-                "benchmark": res.name,
-                # null (not 0.0) for a non-finite rate: a stuck leg must
-                # stay distinguishable from a measured-(~)zero one —
-                # ``converged`` carries the finiteness, the value column
-                # must not erase it (ADVICE r5)
-                "value": _fin(res.ess_per_sec, 3),
-                "metric": res.metric_name,
-                "min_ess": _fin(res.min_ess, 1),
-                "wall_s": round(res.wall_s, 1),
-                "max_rhat": _fin(res.max_rhat, 4),
-                "converged": res.passed() and math.isfinite(res.ess_per_sec),
-                "gate": res.gate,
-            }
-            row.update({
-                k: (_fin(v, 4) if isinstance(v, float) else v)
-                for k, v in res.extra.items()
-            })
-            return row
-
         fleet_problems = _env_int("BENCH_FLEET_PROBLEMS", 256)
         legs = (
             ("eight_schools", bmarks.bench_eight_schools, 25.0),
@@ -847,6 +825,18 @@ def main():
                 ),
                 240.0,
             ),
+            # per-fused-op microbench legs (ROADMAP item 3): fused vs
+            # autodiff value-and-grad throughput, each ledgered under
+            # its own fusedvg:* config key so perf_ledger.py check
+            # ratchets every fused op independently
+            ("fused_vg_lmm",
+             lambda: bmarks.bench_fused_value_and_grad("lmm"), 70.0),
+            ("fused_vg_irt",
+             lambda: bmarks.bench_fused_value_and_grad("irt"), 25.0),
+            ("fused_vg_ordinal",
+             lambda: bmarks.bench_fused_value_and_grad("ordinal"), 25.0),
+            ("fused_vg_robust",
+             lambda: bmarks.bench_fused_value_and_grad("robust"), 15.0),
             ("bnn_sghmc", bmarks.bench_bnn_sghmc, 130.0),
             (
                 "consensus_logistic",
@@ -854,6 +844,17 @@ def main():
                 320.0,
             ),
         )
+
+        def append_fusedvg_ledger_row(row):
+            """Each fused-op microbench gets its OWN ledger config key,
+            so `perf_ledger.py check` ratchets the per-op value-and-grad
+            throughput independently of the flagship/fleet series."""
+            append_ledger(
+                fusedvg_config_key(row, platform),
+                row,
+                extra_keys=_FUSEDVG_EXTRA_KEYS,
+                label="fusedvg",
+            )
 
         def append_fleet_ledger_row(row):
             """The fleet leg gets its OWN ledger config key (distinct
@@ -888,9 +889,19 @@ def main():
                 t0x = time.perf_counter()
                 r = leg_fn()
                 row = res_row(r)
+                if leg_name.startswith("fused_vg_") and not row["converged"]:
+                    # a fused leg that fails its gate (broken kernel,
+                    # lost speedup) must record null ess/s, NEVER 0.0 —
+                    # same rule as a non-finite rate (ADVICE r5): the
+                    # measured rates stay readable in the extra keys,
+                    # but the gated value column can't drag the
+                    # trailing-median gate toward zero
+                    row["value"] = None
                 extra_evidence.append(row)
                 if leg_name == "fleet_eight_schools":
                     append_fleet_ledger_row(row)
+                elif leg_name.startswith("fused_vg_"):
+                    append_fusedvg_ledger_row(row)
                 print(
                     f"[bench] extra evidence {leg_name}: "
                     f"{r.ess_per_sec:.2f} {r.metric_name} "
@@ -974,6 +985,106 @@ def main():
     append_ledger_row(final, sampler=sampler_tag)
 
 
+#: fused-vg evidence recorded for trend analysis; check/--strict gates
+#: only ledger.METRIC_SPECS, so these keys are NOT regression-gated
+_FUSEDVG_EXTRA_KEYS = (
+    "autodiff_evals_per_sec", "speedup_vs_autodiff", "grad_parity_rel",
+)
+
+
+def append_ledger(config, bench_dict, extra_keys=(), label="perf",
+                  source="bench.py"):
+    """Cross-run perf regression ledger (stark_tpu.ledger): append a
+    row so `tools/perf_ledger.py check` can gate the NEXT run against
+    the trailing median of its config series.  Best-effort by
+    contract — a full disk must not turn a measured bench into a
+    failure — and STARK_PERF_LEDGER=0 opts out (tiny-scale tests).
+    The ONE append policy for every ledgered leg (flagship, fleet,
+    in-bench fusedvg extra evidence, and the standalone `microbench`
+    subcommand), so rows in a shared config series never diverge."""
+    try:
+        from stark_tpu import ledger as perf_ledger
+
+        ledger_path = perf_ledger.default_ledger_path()
+        if ledger_path is None:
+            return
+        row = perf_ledger.make_row(
+            source=source, config=config, bench=bench_dict,
+        )
+        for k in extra_keys:
+            if bench_dict.get(k) is not None:
+                row[k] = bench_dict[k]
+        perf_ledger.append_row(row, ledger_path)
+        print(f"[bench] {label} ledger row appended to {ledger_path}",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — the ledger must not fail the bench
+        print(f"[bench] {label} ledger append failed: {e!r}",
+              file=sys.stderr)
+
+
+def fusedvg_config_key(row, platform):
+    """Ledger series key for a fused-op microbench row — shared by the
+    in-bench extra-evidence path and the standalone `microbench`
+    subcommand so both append to the SAME trailing-median series."""
+    return (
+        f"fusedvg:{row.get('family')}"
+        f":n={row.get('n', row.get('persons'))}"
+        f":d={row.get('d', row.get('items'))}"
+        f":platform={platform}"
+    )
+
+
+def run_fused_microbench(argv):
+    """`python bench.py microbench [lmm irt ordinal robust]` — run the
+    per-fused-op value-and-grad legs standalone (no flagship run), print
+    one strict-JSON row per leg, and append each to the perf ledger
+    under its fusedvg:* config key.  The cheap way to (re)baseline the
+    fused-op series after a kernel change; `tools/perf_ledger.py check`
+    then gates the next round against it."""
+    import jax
+
+    from stark_tpu import benchmarks as bmarks
+
+    known = ("lmm", "irt", "ordinal", "robust")
+    unknown = [a for a in argv if a not in known]
+    if unknown:
+        # fail fast: a typo'd family silently falling back to the full
+        # default set would bench for minutes and append four unintended
+        # rows to the fusedvg:* ledger series being re-baselined
+        print(
+            f"[bench] microbench: unknown families {unknown!r}; "
+            f"choose from {', '.join(known)}",
+            file=sys.stderr,
+        )
+        return 2
+    fams = list(argv) or list(known)
+    platform = jax.devices()[0].platform
+    failed = False
+    for fam in fams:
+        try:
+            r = bmarks.bench_fused_value_and_grad(fam)
+        except Exception as e:  # noqa: BLE001 — one broken family must
+            # not hide the others' measurements
+            print(f"[bench] microbench {fam} failed: {e!r}", file=sys.stderr)
+            failed = True
+            continue
+        row = res_row(r)
+        if not row["converged"]:
+            # null, never 0.0: a failed fused leg gates as missing data
+            # (ADVICE r5 / the PR 4 convention)
+            row["value"] = None
+            failed = True
+        print(json.dumps(row), flush=True)
+        append_ledger(
+            fusedvg_config_key(row, platform),
+            row,
+            extra_keys=_FUSEDVG_EXTRA_KEYS,
+            label="fusedvg",
+            source="bench.py microbench",
+        )
+    return 1 if failed else 0
+
+
 def remeasure_cpu_record():
     """Refresh .bench_cpu_baseline.json's cost curve (run in a CPU process:
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py measure-cpu)."""
@@ -996,5 +1107,8 @@ def remeasure_cpu_record():
 if __name__ == "__main__":
     if "measure-cpu" in sys.argv:
         remeasure_cpu_record()
+    elif "microbench" in sys.argv:
+        fam_args = [a for a in sys.argv[1:] if a != "microbench"]
+        sys.exit(run_fused_microbench(fam_args))
     else:
         main()

@@ -68,6 +68,17 @@ class Model:
         unconstrained space (Stan-style random init)."""
         return None
 
+    def fused_tag(self) -> Optional[str]:
+        """Optional: short name of the fused likelihood family this model
+        routes through RIGHT NOW — knob state included, so a knob-gated
+        ``Fused*`` variant returns None when its ``STARK_FUSED_*`` knob
+        is off.  Telemetry stamps the value into ``run_start`` and the
+        per-block grad-eval records (``fused=``), so a trace/ledger row
+        says which execution path produced its numbers.  None (default)
+        -> plain autodiff likelihood.
+        """
+        return None
+
     def prepare_data(self, data: PyTree) -> PyTree:
         """Optional one-time, host-side data transform applied by backends
         BEFORE the compiled sample loop closes over the data.

@@ -9,8 +9,9 @@ from .glm import (
     synth_poisson_data,
 )
 from .gmm import GaussianMixture, synth_gmm_data
-from .irt import IRT2PL, synth_irt_data
+from .irt import IRT2PL, FusedIRT2PL, synth_irt_data
 from .lmm import (
+    FusedLMM,
     FusedLinearMixedModel,
     FusedLinearMixedModelGrouped,
     LinearMixedModel,
@@ -24,8 +25,9 @@ from .logistic import (
     Logistic,
     synth_logistic_data,
 )
-from .ordinal import OrderedLogistic, synth_ordinal_data
+from .ordinal import FusedOrderedLogistic, OrderedLogistic, synth_ordinal_data
 from .robust import (
+    FusedStudentTRegression,
     HorseshoeRegression,
     NegBinomialRegression,
     StudentTRegression,
@@ -42,10 +44,14 @@ __all__ = [
     "EightSchools",
     "FusedHierLogistic",
     "FusedHierLogisticGrouped",
+    "FusedIRT2PL",
+    "FusedLMM",
     "FusedLinearMixedModel",
     "FusedLinearMixedModelGrouped",
     "FusedLinearRegression",
+    "FusedOrderedLogistic",
     "FusedPoissonRegression",
+    "FusedStudentTRegression",
     "FusedLogistic",
     "GaussianMixture",
     "HierLogistic",

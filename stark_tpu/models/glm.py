@@ -47,6 +47,9 @@ class FusedLinearRegression(_TransposedXMixin, LinearRegression):
     no-offset entry skips the (N,) offset read and residual write the
     offset variant pays — same split as logistic_loglik)."""
 
+    def fused_tag(self):
+        return "gaussian"
+
     def log_lik(self, p, data):
         from ..ops.logistic_fused import gaussian_loglik
 
@@ -83,6 +86,11 @@ class FusedPoissonRegression(_TransposedXMixin, PoissonRegression):
     call time.  ``STARK_FUSED_GLM=0`` falls back to the autodiff
     likelihood ON THE SAME transposed layout, so the knob flips the
     execution path without re-preparing data."""
+
+    def fused_tag(self):
+        from ..ops.glm_fused import fused_glm_enabled
+
+        return "glm" if fused_glm_enabled() else None
 
     def log_lik(self, p, data):
         from ..ops.glm_fused import fused_glm_enabled, poisson_loglik

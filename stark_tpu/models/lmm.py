@@ -22,7 +22,10 @@ import jax.scipy.stats as jstats
 
 from ..bijectors import Exp
 from ..model import Model, ParamSpec
-from .logistic import TransposedXMixin as _TransposedXMixin
+from .logistic import (
+    KnobGatedFusedMixin,
+    TransposedXMixin as _TransposedXMixin,
+)
 
 
 class LinearMixedModel(Model):
@@ -64,6 +67,49 @@ class LinearMixedModel(Model):
         return jstats.norm.logpdf(data["y"], mu, p["sigma"])
 
 
+class FusedLMM(KnobGatedFusedMixin, LinearMixedModel):
+    """LMM with the shared one-pass fused value-and-grad
+    (ops/lmm_fused.py), behind the default-OFF ``STARK_FUSED_LMM`` knob.
+
+    Knob OFF (the default): ``prepare_data`` and ``log_lik`` are the
+    parent's — bit-identical to `LinearMixedModel`.  Knob ON at prepare
+    time: the row matrix is stored transposed (the shared fused layout,
+    STARK_FUSED_X_DTYPE honored) and the potential gradient costs ONE
+    pass instead of autodiff's forward+backward.  Data already prepared
+    under the fused layout keeps working after the knob flips off
+    (autodiff on the same transposed layout via the parent's
+    ``log_lik_rows`` dual-layout read), so warm starts, resumes, and
+    fleet-stacked datasets port across knob states.
+
+    Distinct from `FusedLinearMixedModel` (always-on Pallas offset
+    kernel) and `FusedLinearMixedModelGrouped` (fully-fused grouped
+    Mosaic kernel): this variant is the XLA-level scaffold instance the
+    rest of the zoo shares — and the knob-gated, parity-gated entry the
+    accelerator rounds ratchet on.
+    """
+
+    _FUSED_FAMILY = "lmm"
+
+    @staticmethod
+    def _fused_enabled():
+        from ..ops.lmm_fused import fused_lmm_enabled
+
+        return fused_lmm_enabled()
+
+    def _fallback_log_lik(self, p, data):
+        # knob-off on fused-layout data: the parent reads either layout
+        return super(KnobGatedFusedMixin, self).log_lik(p, data)
+
+    def _fused_log_lik(self, p, data):
+        from ..ops.lmm_fused import lmm_loglik
+
+        u = p["u_raw"] * p["tau"][None, :]  # (G, Q) non-centered
+        return lmm_loglik(
+            p["beta"], u, p["intercept"], p["sigma"],
+            data["xT"], data["z"], data["g"], data["y"],
+        )
+
+
 class FusedLinearMixedModel(_TransposedXMixin, LinearMixedModel):
     """LMM with the fused gaussian Pallas kernel.
 
@@ -74,6 +120,9 @@ class FusedLinearMixedModel(_TransposedXMixin, LinearMixedModel):
     random-effects rowwise dot and its scatter-add VJP stay in XLA via
     the offsets input (∂/∂offsets = residual/sigma²).
     """
+
+    def fused_tag(self):
+        return "lmm"
 
     def log_lik(self, p, data):
         from ..ops.logistic_fused import gaussian_offset_loglik
@@ -97,6 +146,9 @@ class FusedLinearMixedModelGrouped(LinearMixedModel):
     when no tile size keeps the window bounded.  Rows are NOT shardable
     (global tile layout) — use FusedLinearMixedModel on data meshes.
     """
+
+    def fused_tag(self):
+        return "lmm"
 
     def prepare_data(self, data):
         if "gl" in data or "offsets_path" in data:

@@ -141,12 +141,67 @@ class TransposedXMixin:
         return _row_axes_xt(data)
 
 
+class KnobGatedFusedMixin:
+    """Shared hooks for the default-OFF fused zoo variants (FusedLMM,
+    FusedOrderedLogistic, FusedStudentTRegression): knob-gated transposed
+    prepare, layout-aware row axes, knob-aware telemetry tag, and the
+    fused/fallback ``log_lik`` shell.  ONE copy of the knob-off
+    contract — knob off at prepare time is bit-identical to the parent
+    model, and data already in the fused layout keeps working after the
+    knob flips off (warm starts, resumes, fleet-stacked datasets port
+    across knob states).
+
+    Subclasses set ``_FUSED_FAMILY`` and implement ``_fused_enabled()``
+    (lazy op import) and ``_fused_log_lik(p, data)``; a parent whose
+    ``log_lik`` already reads both layouts overrides
+    ``_fallback_log_lik`` to defer to it (FusedLMM).
+    """
+
+    _FUSED_FAMILY: str
+
+    @staticmethod
+    def _fused_enabled() -> bool:
+        raise NotImplementedError
+
+    def prepare_data(self, data):
+        if self._fused_enabled():
+            return _transpose_x(data)
+        return data
+
+    def data_row_axes(self, data):
+        if "xT" in data:
+            return _row_axes_xt(data)
+        return super().data_row_axes(data)
+
+    def fused_tag(self):
+        return self._FUSED_FAMILY if self._fused_enabled() else None
+
+    def log_lik(self, p, data):
+        if "xT" not in data:
+            return super().log_lik(p, data)
+        if not self._fused_enabled():
+            return self._fallback_log_lik(p, data)
+        return self._fused_log_lik(p, data)
+
+    def _fallback_log_lik(self, p, data):
+        # knob flipped off after a fused-layout prepare: autodiff on the
+        # de-transposed matrix
+        x = data["xT"].T.astype(jnp.float32)
+        return super().log_lik(p, {**data, "x": x})
+
+    def _fused_log_lik(self, p, data):
+        raise NotImplementedError
+
+
 class FusedLogistic(TransposedXMixin, Logistic):
     """Logistic with the one-pass Pallas likelihood kernel.
 
     Identical posterior; the per-evaluation HBM traffic over the row
     matrix is halved vs autodiff (see ops/logistic_fused.py).
     """
+
+    def fused_tag(self):
+        return "logistic"
 
     def log_lik(self, p, data):
         from ..ops.logistic_fused import logistic_loglik
@@ -158,6 +213,9 @@ class FusedHierLogistic(TransposedXMixin, HierLogistic):
     """HierLogistic with the fused kernel: the X-pass runs in Pallas; the
     group-intercept gather and its segment-sum VJP stay in XLA via the
     custom_vjp residual output."""
+
+    def fused_tag(self):
+        return "logistic"
 
     def log_lik(self, p, data):
         from ..ops.logistic_fused import logistic_offset_loglik
@@ -183,6 +241,9 @@ class FusedHierLogisticGrouped(HierLogistic):
     tile layout is global (first_gid indexes absolute tiles) — use
     FusedHierLogistic for sharded runs.
     """
+
+    def fused_tag(self):
+        return "logistic"
 
     def prepare_data(self, data):
         if "gl" in data or "offsets_path" in data:

@@ -14,6 +14,7 @@ import jax.scipy.stats as jstats
 
 from ..bijectors import Ordered
 from ..model import Model, ParamSpec
+from .logistic import KnobGatedFusedMixin
 
 
 class OrderedLogistic(Model):
@@ -58,6 +59,36 @@ class OrderedLogistic(Model):
             + jnp.log1p(-jnp.exp(jnp.minimum(lower - upper, -1e-6)))
         )
         return jnp.sum(log_p)
+
+
+class FusedOrderedLogistic(KnobGatedFusedMixin, OrderedLogistic):
+    """Ordered logistic with the one-pass fused value-and-grad
+    (ops/ordinal_fused.py), behind the default-OFF
+    ``STARK_FUSED_ORDINAL`` knob.
+
+    Knob OFF (the default): bit-identical to `OrderedLogistic`.  Knob ON
+    at prepare time: the row matrix is stored transposed (the shared
+    fused layout, STARK_FUSED_X_DTYPE honored) and the potential
+    gradient — beta AND cutpoints — costs one pass over X.  Data already
+    in the fused layout keeps working after the knob flips off (autodiff
+    on the de-transposed matrix), so warm starts and fleet-stacked
+    datasets port across knob states.
+    """
+
+    _FUSED_FAMILY = "ordinal"
+
+    @staticmethod
+    def _fused_enabled():
+        from ..ops.ordinal_fused import fused_ordinal_enabled
+
+        return fused_ordinal_enabled()
+
+    def _fused_log_lik(self, p, data):
+        from ..ops.ordinal_fused import ordinal_loglik
+
+        return ordinal_loglik(
+            p["beta"], p["cutpoints"], data["xT"], data["y"]
+        )
 
 
 def synth_ordinal_data(key, n, d, *, num_categories=5, dtype=jnp.float32):

@@ -17,6 +17,7 @@ import jax.scipy.stats as jstats
 
 from ..bijectors import Exp
 from ..model import Model, ParamSpec
+from .logistic import KnobGatedFusedMixin
 
 
 def _half_cauchy_logpdf(x, scale):
@@ -52,6 +53,37 @@ class StudentTRegression(Model):
     def log_lik(self, p, data):
         mu = data["x"] @ p["beta"]
         return jnp.sum(jstats.t.logpdf(data["y"], p["nu"], mu, p["sigma"]))
+
+
+class FusedStudentTRegression(KnobGatedFusedMixin, StudentTRegression):
+    """Student-t robust regression with the one-pass fused
+    value-and-grad (ops/robust_fused.py), behind the default-OFF
+    ``STARK_FUSED_ROBUST`` knob.
+
+    Knob OFF (the default): bit-identical to `StudentTRegression`.
+    Knob ON at prepare time: the row matrix is stored transposed (the
+    shared fused layout, STARK_FUSED_X_DTYPE honored) and the potential
+    gradient — beta, sigma, AND nu — costs one pass over X, with the
+    classic robust tail-weighting computed once and shared by all three.
+    Data already in the fused layout keeps working after the knob flips
+    off (autodiff on the de-transposed matrix), so warm starts and
+    fleet-stacked datasets port across knob states.
+    """
+
+    _FUSED_FAMILY = "robust"
+
+    @staticmethod
+    def _fused_enabled():
+        from ..ops.robust_fused import fused_robust_enabled
+
+        return fused_robust_enabled()
+
+    def _fused_log_lik(self, p, data):
+        from ..ops.robust_fused import studentt_loglik
+
+        return studentt_loglik(
+            p["beta"], p["sigma"], p["nu"], data["xT"], data["y"]
+        )
 
 
 class NegBinomialRegression(Model):

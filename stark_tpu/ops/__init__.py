@@ -1,11 +1,37 @@
+from .irt_fused import irt_loglik, irt_loglik_value_and_grad
+from .lmm_fused import lmm_loglik, lmm_loglik_value_and_grad
 from .logistic_fused import (
     logistic_loglik,
     logistic_loglik_value_and_grad,
     logistic_offset_loglik,
 )
+from .ordinal_fused import ordinal_loglik, ordinal_loglik_value_and_grad
+from .precision import (
+    clip_band,
+    dot_precision,
+    fused_knob,
+    fused_value_and_grad,
+    precision_statics,
+    x_stream_dtype,
+)
+from .robust_fused import studentt_loglik, studentt_loglik_value_and_grad
 
 __all__ = [
+    "clip_band",
+    "dot_precision",
+    "fused_knob",
+    "fused_value_and_grad",
+    "irt_loglik",
+    "irt_loglik_value_and_grad",
+    "lmm_loglik",
+    "lmm_loglik_value_and_grad",
     "logistic_loglik",
     "logistic_loglik_value_and_grad",
     "logistic_offset_loglik",
+    "ordinal_loglik",
+    "ordinal_loglik_value_and_grad",
+    "precision_statics",
+    "studentt_loglik",
+    "studentt_loglik_value_and_grad",
+    "x_stream_dtype",
 ]

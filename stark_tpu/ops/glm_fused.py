@@ -24,12 +24,16 @@ the flag flips the execution path without re-preparing data).
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 
-from .logistic_fused import _dot_precision, _x_stream_dtype
+from .precision import (
+    clip_band,
+    dot_precision as _dot_precision,
+    fused_knob,
+    x_stream_dtype as _x_stream_dtype,
+)
 
 #: clip bound for the log-link rate, matching models.glm.PoissonRegression
 #: (a warmup excursion must not overflow float32 through exp)
@@ -37,8 +41,10 @@ _LOG_RATE_CLIP = 30.0
 
 
 def fused_glm_enabled() -> bool:
-    """The STARK_FUSED_GLM knob (default on)."""
-    return os.environ.get("STARK_FUSED_GLM", "1") != "0"
+    """The STARK_FUSED_GLM knob (default on — the historical setting;
+    the newer zoo knobs in ops/{lmm,irt,ordinal,robust}_fused.py
+    default off)."""
+    return fused_knob("STARK_FUSED_GLM", default=True)
 
 
 def _poisson_vg(beta, xt, y):
@@ -55,10 +61,9 @@ def _poisson_vg(beta, xt, y):
     # upcast into the dot's operand read, it never materializes f32 X
     xs = xt.astype(jnp.float32)
     eta_raw = jnp.dot(beta, xs, precision=prec)
-    eta = jnp.clip(eta_raw, -_LOG_RATE_CLIP, _LOG_RATE_CLIP)
+    eta, inside = clip_band(eta_raw, _LOG_RATE_CLIP)
     mu = jnp.exp(eta)
     ll = jnp.sum(y * eta - mu - jax.lax.lgamma(y + 1.0))
-    inside = (jnp.abs(eta_raw) < _LOG_RATE_CLIP).astype(jnp.float32)
     resid = (y - mu) * inside
     grad = jnp.dot(xs, resid, precision=prec)
     return ll, grad

@@ -42,13 +42,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from .logistic_fused import (
-    _LOG_2PI,
-    _default_lane_tile,
-    _dot_precision,
-    _link_parts,
-    _stream_arg,
-    _x_stream_dtype,
+from .logistic_fused import _LOG_2PI, _default_lane_tile, _link_parts
+from .precision import (
+    dot_precision as _dot_precision,
+    stream_arg as _stream_arg,
+    x_stream_dtype as _x_stream_dtype,
 )
 
 # Hard cap on the padded groups-per-tile: above this the one-hot slab and

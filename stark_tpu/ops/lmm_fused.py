@@ -1,0 +1,88 @@
+"""One-pass fused value-and-grad for the LMM gaussian likelihood.
+
+The linear mixed model's potential gradient is the zoo's most expensive
+autodiff round trip after the flagship: a forward pass builds
+``mu = intercept + X beta + rowsum(Z * u[g])`` and the per-row normal
+log-density, then the backward pass re-walks the whole graph — a second
+(D, N) X read for the beta cotangent, a scatter-add for the (G, Q)
+random-effect block, and the per-row residual chain for sigma.  Here the
+residual function computes the value AND every parameter gradient
+analytically in one traced pass (ops/precision.py scaffold): the eta dot
+and the gradient dot share the X stream inside one fusion region, the
+(G, Q) u-gradient is a single ``segment_sum``, and the custom_vjp
+backward never touches the data again.
+
+XLA-level (two dots sharing the X stream), not Pallas — the win at this
+stage is the one-pass contract plus the shared bf16 X stream
+(STARK_FUSED_X_DTYPE); the fully-fused Pallas treatment of this family
+already exists as `ops/hier_fused.py` / `FusedLinearMixedModelGrouped`
+and a Mosaic kernel can slot in under this same API when the roofline
+says the XLA lowering leaves bandwidth on the table.
+
+Model side: `models.lmm.FusedLMM` routes through `lmm_loglik` behind the
+default-OFF ``STARK_FUSED_LMM`` knob; knob-off runs are bit-identical to
+the historical `LinearMixedModel`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .logistic_fused import _LOG_2PI
+from .precision import dot_precision, fused_knob, fused_value_and_grad
+
+
+def fused_lmm_enabled() -> bool:
+    """The STARK_FUSED_LMM knob (default off: opt-in fused path)."""
+    return fused_knob("STARK_FUSED_LMM")
+
+
+def _lmm_vg(beta, u, intercept, sigma, xt, z, g, y):
+    """(ll, (d/dbeta, d/du, d/dintercept, d/dsigma)) in one pass.
+
+    beta: (D,); u: (G, Q) constrained random effects; xt: (D, N) — X
+    TRANSPOSED — z: (N, Q); g: (N,) int32 group ids; y: (N,).
+    ``ll = sum_i Normal(y_i | intercept + x_i beta + z_i . u[g_i], sigma)``.
+    """
+    prec = dot_precision()
+    # a bf16 X still streams at half width — XLA fuses the upcast into
+    # the dot's operand read, it never materializes an f32 copy
+    xs = xt.astype(jnp.float32)
+    eta = (
+        jnp.dot(beta, xs, precision=prec)
+        + intercept
+        + jnp.sum(z * u[g], axis=-1)
+    )
+    resid = y - eta
+    ssr = jnp.sum(resid * resid)
+    n = y.shape[-1]
+    val = -0.5 * ssr / sigma**2 - n * jnp.log(sigma) - 0.5 * n * _LOG_2PI
+    inv2 = 1.0 / (sigma * sigma)
+    g_beta = inv2 * jnp.dot(xs, resid, precision=prec)
+    # the (G, Q) random-effect gradient, one 1-D segment_sum PER COLUMN
+    # (Q is static and tiny): XLA:CPU lowers a (N, Q) scatter-add ~10x
+    # slower than Q contiguous 1-D ones (measured) — and the (N, Q)
+    # scatter is exactly what autodiff's u[g]-gather transpose emits,
+    # which is where most of this op's speedup comes from
+    g_u = inv2 * jnp.stack(
+        [
+            jax.ops.segment_sum(
+                z[:, q] * resid, g, num_segments=u.shape[0]
+            )
+            for q in range(u.shape[1])
+        ],
+        axis=1,
+    )
+    g_intercept = inv2 * jnp.sum(resid)
+    g_sigma = ssr * inv2 / sigma - n / sigma
+    return val, (g_beta, g_u, g_intercept, g_sigma)
+
+
+lmm_loglik, lmm_loglik_value_and_grad = fused_value_and_grad(_lmm_vg, ndiff=4)
+lmm_loglik.__doc__ = """Differentiable fused LMM log-lik (one X pass).
+
+``jax.grad`` through this op chains the gradients precomputed in the
+forward pass — the model's non-centered ``u = tau * u_raw`` product and
+the sigma bijector differentiate through the returned (G, Q) and scalar
+cotangents in XLA, outside the op."""

@@ -46,6 +46,17 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from .precision import dot_precision, stream_arg, x_stream_dtype
+
+# The precision/knob machinery lives in ops/precision.py (shared by every
+# fused op); these aliases keep this module's historical private names —
+# the jit cache keys are the RESOLVED values the functions return, so the
+# move is bit-identical and the retrace-on-knob-toggle behavior (ADVICE
+# r5) is unchanged.
+_dot_precision = dot_precision
+_x_stream_dtype = x_stream_dtype
+_stream_arg = stream_arg
+
 # Default lane-tile cap; the actual tile shrinks with D so the (D, LT) f32
 # slab stays within a fixed VMEM budget (see _default_lane_tile).
 _LANE_TILE = 8192
@@ -57,76 +68,6 @@ _SLAB_BUDGET_ELEMS = (2 * 1024 * 1024) // 4
 def _default_lane_tile(d: int) -> int:
     """Largest 128-multiple lane tile whose (d, tile) slab fits the budget."""
     return max(128, min(_LANE_TILE, (_SLAB_BUDGET_ELEMS // max(d, 1)) // 128 * 128))
-
-
-def _dot_precision():
-    """MXU precision for the fused kernels' dots (STARK_FUSED_PRECISION).
-
-    f32 matmuls on the TPU MXU are EMULATED in bf16 passes: DEFAULT is
-    one pass (inputs truncated to bf16), HIGH three passes (~f32-accurate),
-    HIGHEST six.  The grouped hierarchical kernel runs four dots per tile
-    over a stream one-third the offset kernel's, so at HIGHEST it is
-    MXU-pass-bound, not HBM-bound (pass-count arithmetic + the measured
-    65 GB/s effective rate, BASELINE.md r5) — the knob exists so the
-    on-chip roofline can measure the precision/throughput trade and the
-    sampler can adopt the cheapest setting whose posterior matches.
-    Default stays HIGHEST: numerics never change silently.
-    """
-    import os
-
-    name = os.environ.get("STARK_FUSED_PRECISION", "highest").lower()
-    try:
-        return {
-            "highest": jax.lax.Precision.HIGHEST,
-            "high": jax.lax.Precision.HIGH,
-            "default": jax.lax.Precision.DEFAULT,
-        }[name]
-    except KeyError:
-        raise ValueError(
-            f"STARK_FUSED_PRECISION={name!r}: use highest|high|default"
-        ) from None
-
-
-def _x_stream_dtype():
-    """HBM storage dtype for the streamed design matrix
-    (STARK_FUSED_X_DTYPE: f32 default | bf16).
-
-    The X stream is the dominant HBM traffic of every fused kernel
-    (~94% of the grouped kernel's bytes at the flagship shape); bf16
-    halves it — the stream-side lever that compounds with the MXU-side
-    `_dot_precision` lever once the kernel stops being pass-bound.
-    Opt-in because it changes the DATA, not just the arithmetic: X is
-    rounded to bf16 ONCE at prepare time, and the posterior is exactly
-    that of the rounded design matrix (kernels cast back to f32
-    in-register, so all accumulation stays f32).  Adopt via the same
-    parity gate as the precision knob (tools/precision_parity.py with
-    PARITY_X_DTYPE=bf16).  Adaptation-artifact fingerprints key on the
-    CALLER's raw data, so warm starts port across X dtypes — the
-    touch-up re-equilibrates and the convergence gate still validates.
-    """
-    import os
-
-    name = os.environ.get("STARK_FUSED_X_DTYPE", "f32").lower()
-    try:
-        return {
-            "f32": jnp.float32,
-            "float32": jnp.float32,
-            "bf16": jnp.bfloat16,
-            "bfloat16": jnp.bfloat16,
-        }[name]
-    except KeyError:
-        raise ValueError(
-            f"STARK_FUSED_X_DTYPE={name!r}: use f32|bf16"
-        ) from None
-
-
-def _stream_arg(xt):
-    """Pass a design-matrix slab to pallas in its storage dtype (bf16
-    streams halve HBM traffic; kernels cast back to f32 in-register);
-    anything else is normalized to f32."""
-    if xt.dtype == jnp.bfloat16:
-        return xt
-    return xt.astype(jnp.float32)
 
 
 def _link_parts(link, y, logits, mask):

@@ -1,0 +1,93 @@
+"""One-pass fused value-and-grad for the ordered-logistic likelihood.
+
+The ordinal likelihood is one (N, D) matvec plus a two-gather over the
+padded cutpoint vector and the all-log-space category probability
+``log[sigmoid(u) - sigmoid(l)]`` (stable form: ``logsig(u) + logsig(-l)
++ log1p(-exp(min(l-u, -eps)))``).  Under autodiff the backward pass
+re-reads X for the beta cotangent and runs two scatter-adds for the
+cutpoint gradient.  The fused residual function computes everything in
+one traced pass: the eta dot and the gradient dot share the X stream,
+and the two cutpoint scatter-adds collapse into a single concatenated
+``segment_sum`` over the padded vector (the gradient to the ±big pad
+entries is discarded by the slice, exactly as autodiff drops gradients
+to the concatenated constants).
+
+The per-row eta-gradient is derived THROUGH the stable formula including
+its clamp: inside the clamp band the ``log1p`` correction terms cancel
+between the upper and lower links for d/d eta but NOT for the two
+cutpoint partials, and outside the band (cutpoint gap at the eps floor)
+they vanish from both — matching ``jnp.minimum``'s sensitivity.
+
+Model side: `models.ordinal.FusedOrderedLogistic` routes through
+`ordinal_loglik` behind the default-OFF ``STARK_FUSED_ORDINAL`` knob on
+the shared transposed-X layout; knob-off runs are bit-identical to the
+historical `OrderedLogistic`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .precision import dot_precision, fused_knob, fused_value_and_grad
+
+#: the stable-form clamp floor on log(1 - e^{l-u}); matches
+#: models.ordinal.OrderedLogistic exactly (parity depends on it)
+_GAP_EPS = -1e-6
+
+
+def fused_ordinal_enabled() -> bool:
+    """The STARK_FUSED_ORDINAL knob (default off: opt-in fused path)."""
+    return fused_knob("STARK_FUSED_ORDINAL")
+
+
+def _ordinal_vg(beta, cutpoints, xt, y):
+    """(ll, (d/dbeta, d/dcutpoints)) in one pass over xt.
+
+    beta: (D,); cutpoints: (K-1,) strictly increasing (constrained
+    space); xt: (D, N) — X TRANSPOSED — y: (N,) categories in {0..K-1}.
+    """
+    prec = dot_precision()
+    xs = xt.astype(jnp.float32)
+    eta = jnp.dot(beta, xs, precision=prec)
+    big = jnp.asarray(1e9, eta.dtype)
+    cpad = jnp.concatenate([-big[None], cutpoints, big[None]])  # (K+1,)
+    yi = y.astype(jnp.int32)
+    upper = cpad[yi + 1] - eta
+    lower = cpad[yi] - eta
+    m = jnp.minimum(lower - upper, _GAP_EPS)
+    val = jnp.sum(
+        jax.nn.log_sigmoid(upper)
+        + jax.nn.log_sigmoid(-lower)
+        + jnp.log1p(-jnp.exp(m))
+    )
+    # partials of one row's log-prob through the stable form:
+    #   d/d upper = sigmoid(-upper) + r,   d/d lower = -sigmoid(lower) - r
+    # with r = e^m/(1-e^m) the log1p-correction term, masked to zero
+    # where the clamp saturates (jnp.minimum's zero sensitivity there)
+    e = jnp.exp(m)
+    r = jnp.where(lower - upper < _GAP_EPS, e / (1.0 - e), 0.0)
+    d_upper = jax.nn.sigmoid(-upper) + r
+    d_lower = -jax.nn.sigmoid(lower) - r
+    # d eta/d(upper,lower) = -1 each; the r terms cancel in the sum
+    d_eta = -(d_upper + d_lower)
+    g_beta = jnp.dot(xs, d_eta, precision=prec)
+    # both cutpoint scatters in ONE segment_sum over the padded vector;
+    # the ±big pad entries (indices 0 and K) absorb the gradients that
+    # autodiff drops at the concatenated constants — the slice discards
+    # them identically
+    g_cpad = jax.ops.segment_sum(
+        jnp.concatenate([d_upper, d_lower]),
+        jnp.concatenate([yi + 1, yi]),
+        num_segments=cpad.shape[0],
+    )
+    return val, (g_beta, g_cpad[1:-1])
+
+
+ordinal_loglik, ordinal_loglik_value_and_grad = fused_value_and_grad(
+    _ordinal_vg, ndiff=2
+)
+ordinal_loglik.__doc__ = """Differentiable fused ordered-logistic
+log-lik (one X pass).  ``jax.grad`` chains the precomputed (D,) and
+(K-1,) gradients; the `Ordered` cutpoint bijector differentiates
+outside."""

@@ -1,0 +1,233 @@
+"""Shared mixed-precision + fused value-and-grad machinery for every
+fused op in the zoo.
+
+Before this module each fused-op file carried its own copy of the
+precision plumbing (ops/logistic_fused.py defined it, ops/hier_fused.py
+and ops/glm_fused.py re-imported the private names) and every new fused
+likelihood re-implemented the same ~100 lines of custom_vjp + jit-cache
+boilerplate.  This module is the single home for
+
+* the two process-wide mixed-precision knobs every fused op honors:
+  ``STARK_FUSED_PRECISION`` (`dot_precision`) for the MXU dot passes and
+  ``STARK_FUSED_X_DTYPE`` (`x_stream_dtype`) for the HBM storage dtype of
+  the streamed design matrix (bf16 slabs halve the dominant traffic;
+  kernels/ops cast back to f32 in-register so accumulation stays f32);
+
+* the call-time-static jit-key convention (`precision_statics`) that
+  makes toggling either knob mid-process RETRACE instead of silently
+  reusing a stale executable (the ADVICE-r5 fix, now shared);
+
+* the boolean ``STARK_FUSED_<FAMILY>`` model knobs (`fused_knob`) behind
+  which each fused model variant routes to its op or falls back to
+  autodiff;
+
+* `fused_value_and_grad` — the scaffold that turns a one-pass residual
+  function into the full fused-op contract (a differentiable
+  ``custom_vjp`` scalar whose VJP chains the precomputed gradients and
+  never re-reads the data, plus a jitted direct value-and-grad entry
+  keyed on the resolved precision knobs), so a new likelihood is ~a
+  residual function, not 600 lines;
+
+* `clip_band` — the shared clip-band gradient mask (saturated rows get
+  zero sensitivity, exactly matching autodiff through ``jnp.clip``).
+
+Data-layout contract (shared by every fused op): models store the row
+matrix TRANSPOSED — ``xT`` of shape (D, N), rows on the 128-wide TPU
+lane axis — produced once, host-side, by ``Model.prepare_data``
+(`models.logistic.TransposedXMixin` / `_transpose_x`), so the hot path
+never pays a layout change and fleet batching (`FleetSpec.prepare_data`
+stacking) adds its problem axis on top of the already-fused layout.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import os
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "clip_band",
+    "dot_precision",
+    "fused_knob",
+    "fused_value_and_grad",
+    "precision_statics",
+    "stream_arg",
+    "x_stream_dtype",
+]
+
+
+def dot_precision():
+    """MXU precision for the fused kernels' dots (STARK_FUSED_PRECISION).
+
+    f32 matmuls on the TPU MXU are EMULATED in bf16 passes: DEFAULT is
+    one pass (inputs truncated to bf16), HIGH three passes (~f32-accurate),
+    HIGHEST six.  The grouped hierarchical kernel runs four dots per tile
+    over a stream one-third the offset kernel's, so at HIGHEST it is
+    MXU-pass-bound, not HBM-bound (pass-count arithmetic + the measured
+    65 GB/s effective rate, BASELINE.md r5) — the knob exists so the
+    on-chip roofline can measure the precision/throughput trade and the
+    sampler can adopt the cheapest setting whose posterior matches
+    (tools/precision_parity.py is that gate).  Default stays HIGHEST:
+    numerics never change silently.
+    """
+    name = os.environ.get("STARK_FUSED_PRECISION", "highest").lower()
+    try:
+        return {
+            "highest": jax.lax.Precision.HIGHEST,
+            "high": jax.lax.Precision.HIGH,
+            "default": jax.lax.Precision.DEFAULT,
+        }[name]
+    except KeyError:
+        raise ValueError(
+            f"STARK_FUSED_PRECISION={name!r}: use highest|high|default"
+        ) from None
+
+
+def x_stream_dtype():
+    """HBM storage dtype for the streamed design matrix
+    (STARK_FUSED_X_DTYPE: f32 default | bf16).
+
+    The X stream is the dominant HBM traffic of every fused kernel
+    (~94% of the grouped kernel's bytes at the flagship shape); bf16
+    halves it — the stream-side lever that compounds with the MXU-side
+    `dot_precision` lever once the kernel stops being pass-bound.
+    Opt-in because it changes the DATA, not just the arithmetic: X is
+    rounded to bf16 ONCE at prepare time, and the posterior is exactly
+    that of the rounded design matrix (kernels cast back to f32
+    in-register, so all accumulation stays f32).  Adopt via the same
+    parity gate as the precision knob (tools/precision_parity.py, which
+    sweeps the whole zoo over both knobs).  Adaptation-artifact
+    fingerprints key on the CALLER's raw data, so warm starts port
+    across X dtypes — the touch-up re-equilibrates and the convergence
+    gate still validates.
+    """
+    name = os.environ.get("STARK_FUSED_X_DTYPE", "f32").lower()
+    try:
+        return {
+            "f32": jnp.float32,
+            "float32": jnp.float32,
+            "bf16": jnp.bfloat16,
+            "bfloat16": jnp.bfloat16,
+        }[name]
+    except KeyError:
+        raise ValueError(
+            f"STARK_FUSED_X_DTYPE={name!r}: use f32|bf16"
+        ) from None
+
+
+def stream_arg(xt):
+    """Pass a design-matrix slab to a kernel in its storage dtype (bf16
+    streams halve HBM traffic; kernels cast back to f32 in-register);
+    anything else is normalized to f32."""
+    if xt.dtype == jnp.bfloat16:
+        return xt
+    return xt.astype(jnp.float32)
+
+
+def precision_statics():
+    """The two resolved precision knobs as jit cache-key statics.
+
+    Pass ``**precision_statics()`` into a jit whose ``static_argnames``
+    include ``("_precision", "_x_dtype")`` and whose body re-reads the
+    env knobs at trace time: keying the executable on the RESOLVED
+    values is what forces a retrace when a knob changes mid-process —
+    a module-level jit otherwise reuses the stale executable for
+    same-shape calls, silently violating the "numerics never change
+    silently" contract (ADVICE r5).
+    """
+    return {"_precision": dot_precision(), "_x_dtype": x_stream_dtype()}
+
+
+def fused_knob(name: str, *, default: bool = False) -> bool:
+    """Boolean ``STARK_FUSED_<FAMILY>`` model knob: unset -> ``default``,
+    ``"0"`` -> off, anything else -> on.
+
+    Family knobs gate which EXECUTION PATH a ``Fused*`` model variant
+    takes (fused op vs autodiff fallback); they are read at
+    prepare/trace time, so within one compiled run the path is fixed.
+    The new zoo knobs default OFF — a knob-off run is bit-identical to
+    the historical model — while ``STARK_FUSED_GLM`` keeps its
+    historical default-on.
+    """
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val != "0"
+
+
+def clip_band(eta_raw, clip: float):
+    """(eta, inside): the clipped linear predictor and the f32 mask that
+    zeroes gradient terms where the band saturates.
+
+    ``inside`` is exactly the sensitivity autodiff assigns through
+    ``jnp.clip`` (zero at a saturated link), so fused and autodiff
+    gradients agree everywhere — including warmup excursions outside
+    the band.
+    """
+    eta = jnp.clip(eta_raw, -clip, clip)
+    inside = (jnp.abs(eta_raw) < clip).astype(eta_raw.dtype)
+    return eta, inside
+
+
+def fused_value_and_grad(
+    vg: Callable, *, ndiff: int
+) -> Tuple[Callable, Callable]:
+    """Scaffold: one residual function -> the full fused-op contract.
+
+    ``vg(*args) -> (value, grads)`` must compute the likelihood value
+    AND the tuple of gradients w.r.t. its first ``ndiff`` arguments in
+    ONE pass over the data arguments (positions ``ndiff`` onward —
+    design matrices, index vectors, responses).  Returns
+
+    * ``op`` — a ``jax.custom_vjp`` scalar function over the same
+      arguments.  Differentiable: the VJP scales the precomputed
+      gradients by the cotangent and never re-reads the data args
+      (their cotangents are None), so ``jax.value_and_grad`` through a
+      potential that calls ``op`` costs exactly one ``vg`` evaluation.
+    * ``op_value_and_grad`` — the jitted direct entry returning
+      ``(value, grads)``, with the resolved STARK_FUSED_PRECISION /
+      STARK_FUSED_X_DTYPE knobs threaded in as call-time statics (a
+      mid-process knob toggle retraces; the jit object is exposed as
+      ``op_value_and_grad._jit`` for cache introspection in tests).
+
+    The scaffold does not jit ``op`` itself: it runs inside the
+    sampler's compiled potential, which owns that trace.
+    """
+    nargs = len(inspect.signature(vg).parameters)
+    if not 0 < ndiff <= nargs:
+        raise ValueError(f"ndiff={ndiff} out of range for {nargs}-arg vg")
+
+    @functools.partial(jax.jit, static_argnames=("_precision", "_x_dtype"))
+    def _vg_jit(*args, _precision, _x_dtype):
+        # cache-key-only statics; vg re-reads the env knobs at trace time
+        del _precision, _x_dtype
+        return vg(*args)
+
+    def op_value_and_grad(*args):
+        return _vg_jit(*args, **precision_statics())
+
+    op_value_and_grad._jit = _vg_jit
+    op_value_and_grad.__doc__ = (
+        f"One-pass (value, grads w.r.t. first {ndiff} args) of {vg.__name__},"
+        " jitted with the precision knobs as call-time statics."
+    )
+
+    @jax.custom_vjp
+    def op(*args):
+        val, _ = vg(*args)
+        return val
+
+    def _fwd(*args):
+        return vg(*args)
+
+    def _bwd(grads, ct):
+        cts = tuple(jax.tree.map(lambda g: ct * g, gr) for gr in grads)
+        return cts + (None,) * (nargs - ndiff)
+
+    op.defvjp(_fwd, _bwd)
+    op.__wrapped__ = vg
+    return op, op_value_and_grad

@@ -338,12 +338,19 @@ def _sample_until_converged(
     # chain health, checkpoint timings.  Default is the ambient trace
     # (NullTrace unless a --trace flag / bench driver installed one).
     trace = telemetry.resolve_trace(trace)
+    # which fused likelihood family (if any) will evaluate every gradient
+    # of this run — knob state resolved HERE, once, so the tag matches
+    # the execution path the compiled potential actually takes.  Stamped
+    # into run_start and every per-block grad-eval record below: a trace
+    # or ledger row then says which path produced its numbers.
+    fused_tag = model.fused_tag() if hasattr(model, "fused_tag") else None
     t_run0 = time.perf_counter()  # run_end dur covers setup/compile too
     if trace.enabled:
         trace.emit(
             "run_start",
             entry="sample_until_converged",
             model=type(model).__name__,
+            **({"fused": fused_tag} if fused_tag else {}),
             kernel=cfg.kernel,
             chains=chains,
             block_size=block_size,
@@ -1236,6 +1243,9 @@ def _sample_until_converged(
                 "grad_eval_basis": (
                     "tree_leaves" if cfg.kernel == "nuts" else "leapfrog"
                 ),
+                # fused-path tag rides ONLY fused-model runs, so the
+                # plain-model metrics trail stays byte-identical
+                **({"fused": fused_tag} if fused_tag else {}),
                 "wall_s": time.perf_counter() - t_start,
             }
             if stream_diag:
@@ -1392,6 +1402,7 @@ def _sample_until_converged(
                     draws_per_chain=draws_per_chain,
                     block_len=pend["len"],
                     block_grad_evals=blk_grads,
+                    **({"fused": fused_tag} if fused_tag else {}),
                     # convergence-gate transfer accounting: constant
                     # O(chains*d*L) with streaming diagnostics, O(draws*k)
                     # under the legacy full-history gate — the contrast

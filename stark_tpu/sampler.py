@@ -716,10 +716,14 @@ def sample(
     ctx = jax.debug_nans(True) if debug_nans else contextlib.nullcontext()
     with ctx, telemetry.use_trace(trace):
         if trace.enabled:
+            fused_tag = (
+                model.fused_tag() if hasattr(model, "fused_tag") else None
+            )
             trace.emit(
                 "run_start",
                 entry="sample",
                 model=type(model).__name__,
+                **({"fused": fused_tag} if fused_tag else {}),
                 kernel=cfg.kernel,
                 chains=chains,
                 num_warmup=cfg.num_warmup,

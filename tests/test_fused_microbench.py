@@ -1,0 +1,109 @@
+"""bench.py's per-fused-op microbench legs: row shape, the null-not-0.0
+convention for failed fused legs, and the shared fusedvg ledger config
+key used by both the extra-evidence path and the `microbench`
+subcommand.
+"""
+
+import importlib.util
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def micro_result(monkeypatch_module=None):
+    os.environ["BENCH_FUSEDVG_SCALE"] = "0.02"
+    try:
+        from stark_tpu.benchmarks import bench_fused_value_and_grad
+
+        yield bench_fused_value_and_grad("irt", reps=5, rounds=1)
+    finally:
+        os.environ.pop("BENCH_FUSEDVG_SCALE", None)
+
+
+def test_microbench_result_shape(micro_result):
+    r = micro_result
+    assert r.name == "fused_vg_irt"
+    assert r.metric_name == "fused vg evals/s"
+    assert math.isfinite(r.ess_per_sec) and r.ess_per_sec > 0
+    assert r.extra["knob"] == "STARK_FUSED_IRT"
+    assert os.environ.get("STARK_FUSED_IRT") is None  # knob restored
+    assert r.extra["autodiff_evals_per_sec"] > 0
+    assert r.extra["grad_parity_rel"] < 1e-3
+    # min_ess/max_rhat are NaN by design (not a sampling leg) -> they
+    # must land as null, never 0.0, in the evidence row
+    assert math.isnan(r.min_ess) and math.isnan(r.max_rhat)
+
+
+def test_res_row_nulls_nonfinite(bench, micro_result):
+    row = bench.res_row(micro_result)
+    assert row["min_ess"] is None and row["max_rhat"] is None
+    assert isinstance(row["value"], float)
+
+
+def test_failed_fused_leg_emits_null_not_zero(bench, micro_result):
+    """A fused leg whose rate goes non-finite (broken kernel) must carry
+    value null — the PR 4 convention — so perf_ledger's trailing-median
+    gate sees missing data, not a measured zero."""
+    import dataclasses
+
+    broken = dataclasses.replace(micro_result, ess_per_sec=float("nan"))
+    row = bench.res_row(broken)
+    assert row["value"] is None
+    assert row["converged"] is False
+
+
+def test_gate_failure_row_value_nulled_by_bench_loop(bench, micro_result):
+    """The extra-evidence loop nulls the value of a fused row that fails
+    its >=1.3x gate while keeping the measured rates in the extra keys
+    (exactly what `run_fused_microbench` does standalone)."""
+    import dataclasses
+
+    slow = dataclasses.replace(micro_result, converged=False)
+    row = bench.res_row(slow)
+    # simulate the loop's fused-leg post-processing
+    if not row["converged"]:
+        row["value"] = None
+    assert row["value"] is None
+    assert row["autodiff_evals_per_sec"] > 0  # evidence preserved
+
+
+def test_fusedvg_config_key_stable(bench):
+    row_lmm = {"family": "lmm", "n": 200000, "d": 32}
+    row_irt = {"family": "irt", "persons": 2000, "items": 200}
+    assert bench.fusedvg_config_key(row_lmm, "cpu") == (
+        "fusedvg:lmm:n=200000:d=32:platform=cpu"
+    )
+    assert bench.fusedvg_config_key(row_irt, "cpu") == (
+        "fusedvg:irt:n=2000:d=200:platform=cpu"
+    )
+
+
+def test_microbench_speedup_recorded(micro_result):
+    sp = micro_result.extra["speedup_vs_autodiff"]
+    assert sp is None or (np.isfinite(sp) and sp > 0)
+
+
+def test_microbench_rejects_unknown_family(bench, capsys):
+    """A typo'd family must fail fast (exit 2), not silently fall back
+    to benching the full default set and appending unintended ledger
+    rows to the series being re-baselined."""
+    rc = bench.run_fused_microbench(["ordnial"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "unknown families" in err and "ordnial" in err

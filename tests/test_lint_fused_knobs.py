@@ -1,0 +1,81 @@
+"""tools/lint_fused_knobs.py: every STARK_FUSED_* env knob read under
+stark_tpu/ must be documented in the README coverage table and named by
+at least one test (the autodiff-fallback / retrace coverage contract).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import lint_fused_knobs  # noqa: E402
+
+
+def test_repo_is_clean():
+    violations = lint_fused_knobs.lint_repo(REPO)
+    assert violations == [], "\n".join(violations)
+
+
+def test_collector_finds_all_knob_families():
+    """The AST collector must see the shared precision pair AND every
+    per-family boolean knob — a knob the collector can't see is a knob
+    the lint can't protect."""
+    knobs = lint_fused_knobs.collect_knobs(os.path.join(REPO, "stark_tpu"))
+    assert {
+        "STARK_FUSED_PRECISION",
+        "STARK_FUSED_X_DTYPE",
+        "STARK_FUSED_GLM",
+        "STARK_FUSED_LMM",
+        "STARK_FUSED_IRT",
+        "STARK_FUSED_ORDINAL",
+        "STARK_FUSED_ROBUST",
+    } <= set(knobs)
+
+
+@pytest.mark.parametrize(
+    "source,expect",
+    [
+        ('import os\nos.environ.get("STARK_FUSED_NEW", "0")\n',
+         ["STARK_FUSED_NEW"]),
+        ('from .precision import fused_knob\n'
+         'fused_knob("STARK_FUSED_OTHER")\n',
+         ["STARK_FUSED_OTHER"]),
+        ('import os\nos.getenv("STARK_FUSED_ALT")\n', ["STARK_FUSED_ALT"]),
+        # comments/docstrings must not trip the AST collector
+        ('# os.environ.get("STARK_FUSED_FAKE")\n"""STARK_FUSED_DOC"""\n',
+         []),
+        # non-knob env reads are ignored
+        ('import os\nos.environ.get("STARK_SYNC_BLOCKS")\n', []),
+    ],
+)
+def test_find_knob_reads(source, expect):
+    hits = lint_fused_knobs.find_knob_reads(source, "<test>")
+    assert [k for _ln, k in hits] == expect
+
+
+def test_undocumented_knob_fails(tmp_path):
+    """A knob read that is in neither the README nor any test must
+    produce both violations."""
+    repo = tmp_path
+    pkg = repo / "stark_tpu"
+    pkg.mkdir()
+    (pkg / "newop.py").write_text(
+        'import os\nFLAG = os.environ.get("STARK_FUSED_MYSTERY", "0")\n'
+    )
+    (repo / "tests").mkdir()
+    (repo / "README.md").write_text("# nothing here\n")
+    violations = lint_fused_knobs.lint_repo(str(repo))
+    assert len(violations) == 2
+    assert all("STARK_FUSED_MYSTERY" in v for v in violations)
+
+
+def test_cli_exit_zero():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_fused_knobs.py")],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr

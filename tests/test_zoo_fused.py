@@ -1,0 +1,344 @@
+"""The shared fused value-and-grad layer across the model zoo
+(ops/precision.py scaffold + ops/{lmm,irt,ordinal,robust}_fused.py):
+per-op fused-vs-autodiff parity, knob-off bit-identity with the
+historical models, mid-process precision retrace, bf16-band parity, and
+a fleet smoke over a fused-layout model.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import stark_tpu
+from stark_tpu.model import flatten_model, prepare_model_data
+from stark_tpu.models import (
+    FusedIRT2PL,
+    FusedLMM,
+    FusedOrderedLogistic,
+    FusedStudentTRegression,
+    IRT2PL,
+    LinearMixedModel,
+    OrderedLogistic,
+    StudentTRegression,
+    synth_irt_data,
+    synth_lmm_data,
+    synth_ordinal_data,
+    synth_studentt_data,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _lmm_case():
+    data, _ = synth_lmm_data(KEY, 600, 5, 40)
+    return LinearMixedModel(5, 40), FusedLMM(5, 40), data, "STARK_FUSED_LMM"
+
+
+def _irt_case():
+    data, _ = synth_irt_data(KEY, 40, 15)
+    return IRT2PL(40, 15), FusedIRT2PL(40, 15), data, "STARK_FUSED_IRT"
+
+
+def _ordinal_case():
+    data, _ = synth_ordinal_data(KEY, 600, 5, num_categories=4)
+    return (
+        OrderedLogistic(5, 4), FusedOrderedLogistic(5, 4), data,
+        "STARK_FUSED_ORDINAL",
+    )
+
+
+def _robust_case():
+    data, _ = synth_studentt_data(KEY, 600, 5)
+    return (
+        StudentTRegression(5), FusedStudentTRegression(5), data,
+        "STARK_FUSED_ROBUST",
+    )
+
+
+CASES = {
+    "lmm": _lmm_case,
+    "irt": _irt_case,
+    "ordinal": _ordinal_case,
+    "robust": _robust_case,
+}
+
+
+@pytest.fixture(params=sorted(CASES))
+def zoo_case(request):
+    return (request.param,) + CASES[request.param]()
+
+
+def test_value_and_grad_parity(zoo_case, monkeypatch):
+    """Knob ON: fused potential+grad match autodiff through the plain
+    model over a spread of parameter points (typical set + excursions),
+    at tight f32 tolerance."""
+    _name, plain, fused, data, knob = zoo_case
+    monkeypatch.setenv(knob, "1")
+    fm_p, fm_f = flatten_model(plain), flatten_model(fused)
+    dp = prepare_model_data(plain, data)
+    df = prepare_model_data(fused, data)
+    for s in range(5):
+        z = 0.4 * s * jax.random.normal(jax.random.PRNGKey(s), (fm_p.ndim,))
+        vp, gp = fm_p.potential_and_grad(z, dp)
+        vf, gf = fm_f.potential_and_grad(z, df)
+        np.testing.assert_allclose(vp, vf, rtol=1e-5, atol=1e-4)
+        scale = float(jnp.max(jnp.abs(gp))) + 1e-6
+        np.testing.assert_allclose(
+            np.asarray(gf) / scale, np.asarray(gp) / scale,
+            rtol=1e-4, atol=2e-5,
+        )
+
+
+def test_knob_off_bit_identity(zoo_case):
+    """Knob OFF (the default): the Fused* variant IS the historical
+    model — same prepared data pytree, bit-identical potential and
+    gradient (not just close: the fallback must route through the very
+    same computation)."""
+    _name, plain, fused, data, knob = zoo_case
+    assert os.environ.get(knob) is None  # default-off contract
+    fm_p, fm_f = flatten_model(plain), flatten_model(fused)
+    dp = prepare_model_data(plain, data)
+    df = prepare_model_data(fused, data)
+    assert jax.tree.structure(dp) == jax.tree.structure(df)
+    assert "xT" not in df and "y_grid" not in df
+    z = 0.3 * jax.random.normal(jax.random.PRNGKey(7), (fm_p.ndim,))
+    vp, gp = jax.jit(fm_p.potential_and_grad)(z, dp)
+    vf, gf = jax.jit(fm_f.potential_and_grad)(z, df)
+    assert np.asarray(vp).tobytes() == np.asarray(vf).tobytes()
+    assert np.asarray(gp).tobytes() == np.asarray(gf).tobytes()
+
+
+def test_knob_off_after_fused_prepare(zoo_case, monkeypatch):
+    """Data prepared under the fused layout keeps working when the knob
+    flips off (autodiff fallback on the same layout) — the warm-start /
+    resume porting contract."""
+    _name, plain, fused, data, knob = zoo_case
+    monkeypatch.setenv(knob, "1")
+    fm_p, fm_f = flatten_model(plain), flatten_model(fused)
+    dp = prepare_model_data(plain, data)
+    df = prepare_model_data(fused, data)  # fused layout
+    z = 0.3 * jax.random.normal(jax.random.PRNGKey(3), (fm_p.ndim,))
+    monkeypatch.setenv(knob, "0")
+    v0, g0 = fm_f.potential_and_grad(z, df)  # autodiff on fused layout
+    vp, gp = fm_p.potential_and_grad(z, dp)
+    np.testing.assert_allclose(v0, vp, rtol=1e-5, atol=1e-4)
+    scale = float(jnp.max(jnp.abs(gp))) + 1e-6
+    np.testing.assert_allclose(
+        np.asarray(g0) / scale, np.asarray(gp) / scale,
+        rtol=1e-4, atol=2e-5,
+    )
+
+
+def test_bf16_band_parity(zoo_case, monkeypatch):
+    """STARK_FUSED_X_DTYPE=bf16: the fused path agrees with autodiff on
+    the SAME bf16-rounded design matrix within the documented mid band
+    (the rounding is a data change, not an arithmetic error)."""
+    _name, plain, fused, data, knob = zoo_case
+    monkeypatch.setenv(knob, "1")
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "bf16")
+    fm_f = flatten_model(fused)
+    df = prepare_model_data(fused, data)
+    if "xT" in df:
+        assert df["xT"].dtype == jnp.bfloat16
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "f32")
+    fm_p = flatten_model(plain)
+    ref = dict(data)
+    if "x" in ref:
+        ref["x"] = (
+            jnp.asarray(ref["x"]).astype(jnp.bfloat16).astype(jnp.float32)
+        )
+    dp = prepare_model_data(plain, ref)
+    z = 0.3 * jax.random.normal(jax.random.PRNGKey(5), (fm_p.ndim,))
+    vp, gp = fm_p.potential_and_grad(z, dp)
+    vf, gf = fm_f.potential_and_grad(z, df)
+    np.testing.assert_allclose(vp, vf, rtol=5e-3, atol=1e-2)
+    scale = float(jnp.max(jnp.abs(gp))) + 1e-6
+    np.testing.assert_allclose(
+        np.asarray(gf) / scale, np.asarray(gp) / scale,
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+_VG_ENTRIES = {
+    "lmm": ("stark_tpu.ops.lmm_fused", "lmm_loglik_value_and_grad"),
+    "irt": ("stark_tpu.ops.irt_fused", "irt_grid_loglik_value_and_grad"),
+    "ordinal": (
+        "stark_tpu.ops.ordinal_fused", "ordinal_loglik_value_and_grad"
+    ),
+    "robust": (
+        "stark_tpu.ops.robust_fused", "studentt_loglik_value_and_grad"
+    ),
+}
+
+
+def _vg_args(name, fused, data, monkeypatch, knob):
+    monkeypatch.setenv(knob, "1")
+    df = prepare_model_data(fused, data)
+    if name == "lmm":
+        g, q = fused.num_groups, fused.num_random
+        return (
+            jnp.zeros((fused.num_features,)), jnp.zeros((g, q)),
+            jnp.asarray(0.1), jnp.asarray(1.0),
+            df["xT"], df["z"], df["g"], df["y"],
+        )
+    if name == "irt":
+        return (
+            jnp.zeros((fused.num_persons,)),
+            jnp.ones((fused.num_items,)),
+            jnp.zeros((fused.num_items,)),
+            df["y_grid"],
+        )
+    if name == "ordinal":
+        k = fused.num_categories
+        return (
+            jnp.zeros((fused.num_features,)),
+            jnp.linspace(-1.0, 1.0, k - 1),
+            df["xT"], df["y"],
+        )
+    return (
+        jnp.zeros((fused.num_features,)), jnp.asarray(1.0),
+        jnp.asarray(5.0), df["xT"], df["y"],
+    )
+
+
+def test_precision_statics_force_retrace(zoo_case, monkeypatch):
+    """Toggling STARK_FUSED_PRECISION mid-process produces a fresh
+    executable for every zoo op's direct entry (the shared call-time-
+    static cache key from ops/precision.py), never a stale reuse."""
+    import importlib
+
+    name, _plain, fused, data, knob = zoo_case
+    mod, attr = _VG_ENTRIES[name]
+    vg = getattr(importlib.import_module(mod), attr)
+    args = _vg_args(name, fused, data, monkeypatch, knob)
+    monkeypatch.delenv("STARK_FUSED_PRECISION", raising=False)
+    before = vg._jit._cache_size()
+    val, grads = vg(*args)
+    assert np.isfinite(float(val)) and len(grads) >= 2
+    mid = vg._jit._cache_size()
+    monkeypatch.setenv("STARK_FUSED_PRECISION", "default")
+    vg(*args)
+    after = vg._jit._cache_size()
+    assert mid >= before
+    assert after == mid + 1  # new static key -> new trace
+
+
+def test_custom_vjp_one_pass(zoo_case, monkeypatch):
+    """jax.grad through each fused op equals the one-pass direct grads
+    (the scaffold's VJP chains, never recomputes)."""
+    import importlib
+
+    name, _plain, fused, data, knob = zoo_case
+    mod_name, attr = _VG_ENTRIES[name]
+    mod = importlib.import_module(mod_name)
+    vg = getattr(mod, attr)
+    op = getattr(mod, attr.replace("_value_and_grad", ""))
+    args = _vg_args(name, fused, data, monkeypatch, knob)
+    _val, grads = vg(*args)
+    g_vjp = jax.grad(op, argnums=tuple(range(len(grads))))(*args)
+    for direct, chained in zip(grads, g_vjp):
+        np.testing.assert_allclose(direct, chained, rtol=1e-6, atol=1e-7)
+
+
+def test_irt_ragged_triples_fused(monkeypatch):
+    """Incomplete response sets (no dense grid) keep the triple layout
+    and still take the fused scatter path, matching autodiff."""
+    plain, fused, data, knob = _irt_case()
+    keep = np.arange(len(np.asarray(data["y"]))) % 3 != 0  # drop a third
+    ragged = {k: jnp.asarray(np.asarray(v)[keep]) for k, v in data.items()}
+    monkeypatch.setenv(knob, "1")
+    df = prepare_model_data(fused, ragged)
+    assert "y_grid" not in df  # grid check must refuse the ragged set
+    fm_p, fm_f = flatten_model(plain), flatten_model(fused)
+    dp = prepare_model_data(plain, ragged)
+    z = 0.3 * jax.random.normal(jax.random.PRNGKey(2), (fm_p.ndim,))
+    vp, gp = fm_p.potential_and_grad(z, dp)
+    vf, gf = fm_f.potential_and_grad(z, df)
+    np.testing.assert_allclose(vp, vf, rtol=1e-5)
+    np.testing.assert_allclose(gp, gf, rtol=1e-4, atol=1e-4)
+
+
+def test_irt_grid_layout_refuses_row_split(monkeypatch):
+    """The dense (P, I) grid pins y_grid rows to theta entries: row-
+    splitting entry points (SG-HMC minibatches, consensus shards, mesh
+    data sharding) must fail fast on grid-prepared data instead of
+    slicing y_grid against a full-length theta — while the triples
+    layout (knob off, or ragged) keeps its default row axes."""
+    plain, fused, data, knob = _irt_case()
+    monkeypatch.setenv(knob, "1")
+    df = prepare_model_data(fused, data)
+    assert "y_grid" in df
+    with pytest.raises(NotImplementedError, match="grid layout"):
+        fused.data_row_axes(df)
+    with pytest.raises(NotImplementedError, match="grid layout"):
+        fused.data_shard_row_axes(df)
+    # triples keep the shardable default (each triple carries its ids)
+    monkeypatch.setenv(knob, "0")
+    dt = prepare_model_data(fused, data)
+    assert jax.tree.leaves(fused.data_row_axes(dt)) == [0] * len(dt)
+    assert jax.tree.leaves(plain.data_row_axes(data)) == [0] * len(data)
+
+
+def test_sampling_smoke_fused_lmm(monkeypatch, tmp_path):
+    """End-to-end: a fused-path model samples through the adaptive
+    runner with finite draws, and the run_start + per-block grad-eval
+    telemetry carries the fused= execution-path tag."""
+    from stark_tpu.telemetry import RunTrace, read_trace
+
+    monkeypatch.setenv("STARK_FUSED_LMM", "1")
+    data, _ = synth_lmm_data(KEY, 400, 3, 12)
+    model = FusedLMM(3, 12)
+    tpath = str(tmp_path / "trace.jsonl")
+    post = stark_tpu.sample_until_converged(
+        model, data, chains=2, kernel="nuts", block_size=25,
+        max_blocks=4, min_blocks=1, num_warmup=100, ess_target=20.0,
+        rhat_target=1.5, seed=0, trace=RunTrace(tpath),
+    )
+    events = read_trace(tpath)
+    assert np.all(np.isfinite(post.draws["beta"]))
+    starts = [e for e in events if e["event"] == "run_start"]
+    assert starts and starts[0]["fused"] == "lmm"
+    blocks = [e for e in events if e["event"] == "sample_block"]
+    assert blocks and all(b.get("fused") == "lmm" for b in blocks)
+    # the plain model's trace stays untagged (byte-identity contract)
+    tpath2 = str(tmp_path / "trace_plain.jsonl")
+    stark_tpu.sample_until_converged(
+        LinearMixedModel(3, 12), data, chains=2, kernel="nuts",
+        block_size=25, max_blocks=4, min_blocks=1, num_warmup=100,
+        ess_target=20.0, rhat_target=1.5, seed=0, trace=RunTrace(tpath2),
+    )
+    for e in read_trace(tpath2):
+        assert "fused" not in e
+
+
+def test_fleet_smoke_fused_layout(monkeypatch):
+    """One FleetSpec over a fused-layout model: per-problem prepare_data
+    runs the fused transform before stacking, and every lane samples
+    finite draws through the vmapped runner."""
+    from stark_tpu.fleet import FleetSpec, sample_fleet
+
+    monkeypatch.setenv("STARK_FUSED_ORDINAL", "1")
+    rng = np.random.default_rng(0)
+    base, _ = synth_ordinal_data(KEY, 240, 3, num_categories=4)
+    base = {k: np.asarray(v) for k, v in base.items()}
+    datasets = []
+    for _ in range(3):
+        d = dict(base)
+        d["x"] = (d["x"] + rng.normal(0, 0.05, d["x"].shape)).astype(
+            np.float32
+        )
+        datasets.append(d)
+    model = FusedOrderedLogistic(3, 4)
+    spec = FleetSpec.from_problems(model, datasets)
+    res = sample_fleet(
+        spec, chains=2, block_size=25, max_blocks=6, min_blocks=1,
+        num_warmup=100, ess_target=40.0, rhat_target=1.3, seed=0,
+    )
+    assert len(res.problems) == 3
+    for pr in res.problems:
+        draws = pr.draws["beta"]
+        assert draws.shape[0] == 2 and draws.shape[-1] == 3
+        assert np.all(np.isfinite(draws))

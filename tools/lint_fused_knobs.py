@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Repo lint: every ``STARK_FUSED_*`` knob must be documented and tested.
+
+The fused-op layer grew a family of env knobs (the shared precision pair
+plus one boolean per likelihood family), each changing which executable
+evaluates every gradient of a run.  An undocumented knob is invisible to
+operators; an untested one can silently lose its autodiff fallback.
+This lint closes both loops statically:
+
+1. AST-collect every ``STARK_FUSED_<NAME>`` string literal passed to an
+   env-read call (``os.environ.get`` / ``os.getenv`` / ``environ.pop`` /
+   ``precision.fused_knob``) under ``stark_tpu/``.
+2. Fail if a collected knob is missing from the README zoo-coverage
+   table (the operator-facing contract), or
+3. appears nowhere under ``tests/`` (every knob needs a test exercising
+   its fallback/retrace behavior — the per-op knob-off bit-identity and
+   precision-retrace tests reference the knob by name).
+
+AST-based (strings in comments can't trip it); imports nothing from the
+package, so it runs anywhere.  Run directly or via
+``tests/test_lint_fused_knobs.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+#: call names whose string-literal argument is an env-knob read
+_READ_FUNCS = frozenset({"get", "getenv", "pop", "fused_knob"})
+
+_KNOB_RE = re.compile(r"^STARK_FUSED_[A-Z0-9_]+$")
+
+
+def _call_name(node: ast.Call) -> str:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def find_knob_reads(source: str, filename: str) -> List[Tuple[int, str]]:
+    """(lineno, knob) for every STARK_FUSED_* literal in an env-read call."""
+    tree = ast.parse(source, filename=filename)
+    hits = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _call_name(node) in _READ_FUNCS):
+            continue
+        for arg in node.args:
+            if (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+                and _KNOB_RE.match(arg.value)
+            ):
+                hits.append((node.lineno, arg.value))
+    return hits
+
+
+def collect_knobs(pkg_dir: str) -> Dict[str, List[str]]:
+    """knob -> ["path:line", ...] across the package."""
+    knobs: Dict[str, List[str]] = {}
+    for root, _dirs, files in os.walk(pkg_dir):
+        if "__pycache__" in root:
+            continue
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            with open(path) as f:
+                source = f.read()
+            for lineno, knob in find_knob_reads(source, path):
+                knobs.setdefault(knob, []).append(f"{path}:{lineno}")
+    return knobs
+
+
+def _grep_tree(tree_dir: str, needles: Set[str]) -> Set[str]:
+    """Which needles appear in any .py file under tree_dir."""
+    found: Set[str] = set()
+    for root, _dirs, files in os.walk(tree_dir):
+        if "__pycache__" in root:
+            continue
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            with open(os.path.join(root, name)) as f:
+                text = f.read()
+            found.update(n for n in needles if n in text)
+            if found == needles:
+                return found
+    return found
+
+
+def lint_repo(repo: str) -> List[str]:
+    """Violation strings for the whole repo; empty = clean."""
+    knobs = collect_knobs(os.path.join(repo, "stark_tpu"))
+    if not knobs:
+        return ["no STARK_FUSED_* env reads found under stark_tpu/ — "
+                "the collector itself is broken"]
+    violations = []
+    readme_path = os.path.join(repo, "README.md")
+    readme = open(readme_path).read() if os.path.exists(readme_path) else ""
+    tested = _grep_tree(os.path.join(repo, "tests"), set(knobs))
+    for knob in sorted(knobs):
+        where = knobs[knob][0]
+        if knob not in readme:
+            violations.append(
+                f"{where}: {knob} is read but missing from the README "
+                "zoo-coverage table — document the knob (model, default, "
+                "parity band)"
+            )
+        if knob not in tested:
+            violations.append(
+                f"{where}: {knob} is read but referenced by no test under "
+                "tests/ — add an autodiff-fallback / retrace test that "
+                "names the knob"
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = lint_repo(repo)
+    for v in violations:
+        print(v, file=sys.stderr)
+    if violations:
+        print(
+            f"{len(violations)} STARK_FUSED_* knob violation(s) — see "
+            "tools/lint_fused_knobs.py docstring",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
