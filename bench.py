@@ -825,6 +825,10 @@ def main():
                 ),
                 240.0,
             ),
+            # ragged-vs-legacy NUTS scheduling leg (STARK_RAGGED_NUTS):
+            # lane occupancy + occupancy-adjusted throughput on the
+            # mixed-depth synthetic, own nutssched:* ledger series
+            ("nutssched", bmarks.bench_nuts_sched, 90.0),
             # per-fused-op microbench legs (ROADMAP item 3): fused vs
             # autodiff value-and-grad throughput, each ledgered under
             # its own fusedvg:* config key so perf_ledger.py check
@@ -861,17 +865,12 @@ def main():
             row series from the flagship), so `perf_ledger.py check`
             ratchets the fleet speedup independently."""
             append_ledger(
-                f"fleet:eight_schools:B={row.get('problems')}"
-                f":chains={row.get('chains')}"
-                f":platform={platform}",
+                fleet_config_key(row, platform),
                 row,
                 # fleet-specific evidence recorded for trend analysis;
                 # check/--strict gates only ledger.METRIC_SPECS, so these
                 # keys are NOT regression-gated
-                extra_keys=("converged_fraction", "speedup_vs_sequential",
-                            "speedup_vs_warm_sequential",
-                            "seq_per_job_ess_per_sec_est",
-                            "seq_warm_ess_per_sec_est", "fleet_grad_evals"),
+                extra_keys=_FLEET_EXTRA_KEYS,
                 label="fleet",
             )
 
@@ -889,7 +888,10 @@ def main():
                 t0x = time.perf_counter()
                 r = leg_fn()
                 row = res_row(r)
-                if leg_name.startswith("fused_vg_") and not row["converged"]:
+                if (
+                    leg_name.startswith("fused_vg_")
+                    or leg_name == "nutssched"
+                ) and not row["converged"]:
                     # a fused leg that fails its gate (broken kernel,
                     # lost speedup) must record null ess/s, NEVER 0.0 —
                     # same rule as a non-finite rate (ADVICE r5): the
@@ -902,6 +904,12 @@ def main():
                     append_fleet_ledger_row(row)
                 elif leg_name.startswith("fused_vg_"):
                     append_fusedvg_ledger_row(row)
+                elif leg_name == "nutssched":
+                    append_ledger(
+                        nutssched_config_key(row, platform), row,
+                        extra_keys=_NUTSSCHED_EXTRA_KEYS,
+                        label="nutssched",
+                    )
                 print(
                     f"[bench] extra evidence {leg_name}: "
                     f"{r.ess_per_sec:.2f} {r.metric_name} "
@@ -991,6 +999,51 @@ _FUSEDVG_EXTRA_KEYS = (
     "autodiff_evals_per_sec", "speedup_vs_autodiff", "grad_parity_rel",
 )
 
+#: nutssched evidence recorded for trend analysis (same non-gated rule);
+#: the acceptance numbers — occupancy both ways, >=1.3x speedup, the
+#: dispatch-probe executed counts — all ride the committed rows
+_NUTSSCHED_EXTRA_KEYS = (
+    "legacy_evals_per_sec", "speedup_vs_legacy", "bit_identical",
+    "lane_occupancy_legacy", "lane_occupancy_ragged",
+    "executed_batched_evals_legacy", "executed_batched_evals_ragged",
+    "executed_per_draw_legacy", "executed_per_draw_ragged",
+    "useful_per_draw",
+)
+
+#: fleet evidence keys (shared by the in-bench leg and row committers)
+_FLEET_EXTRA_KEYS = (
+    "converged_fraction", "speedup_vs_sequential",
+    "speedup_vs_warm_sequential", "seq_per_job_ess_per_sec_est",
+    "seq_warm_ess_per_sec_est", "fleet_grad_evals", "sched",
+    "max_tree_depth",
+)
+
+
+def fleet_config_key(row, platform):
+    """Ledger series key for the fleet eight-schools leg.  Legacy-
+    scheduled rows keep the historical key (series continuity with the
+    PR 6 baseline); STARK_RAGGED_NUTS rows — whose depth cap is lifted,
+    a different workload — get their own ``sched=ragged`` series."""
+    key = (
+        f"fleet:eight_schools:B={row.get('problems')}"
+        f":chains={row.get('chains')}"
+        f":platform={platform}"
+    )
+    if row.get("sched") == "ragged":
+        key += f":sched=ragged:depth={row.get('max_tree_depth')}"
+    return key
+
+
+def nutssched_config_key(row, platform):
+    """Ledger series key for the ragged-NUTS scheduling microbench —
+    shared by the in-bench extra-evidence path and the standalone
+    `microbench` subcommand so both append to the SAME series."""
+    return (
+        f"nutssched:mixed_depth:n={row.get('n')}:d={row.get('d')}"
+        f":chains={row.get('chains')}:depth={row.get('max_tree_depth')}"
+        f":platform={platform}"
+    )
+
 
 def append_ledger(config, bench_dict, extra_keys=(), label="perf",
                   source="bench.py"):
@@ -1035,22 +1088,23 @@ def fusedvg_config_key(row, platform):
 
 
 def run_fused_microbench(argv):
-    """`python bench.py microbench [lmm irt ordinal robust]` — run the
-    per-fused-op value-and-grad legs standalone (no flagship run), print
+    """`python bench.py microbench [lmm irt ordinal robust nutssched]` —
+    run the per-op microbench legs standalone (no flagship run), print
     one strict-JSON row per leg, and append each to the perf ledger
-    under its fusedvg:* config key.  The cheap way to (re)baseline the
-    fused-op series after a kernel change; `tools/perf_ledger.py check`
-    then gates the next round against it."""
+    under its own config key (``fusedvg:*`` for the fused value-and-grad
+    families, ``nutssched:*`` for the ragged-NUTS scheduling leg).  The
+    cheap way to (re)baseline a series after a kernel change;
+    `tools/perf_ledger.py check` then gates the next round against it."""
     import jax
 
     from stark_tpu import benchmarks as bmarks
 
-    known = ("lmm", "irt", "ordinal", "robust")
+    known = ("lmm", "irt", "ordinal", "robust", "nutssched")
     unknown = [a for a in argv if a not in known]
     if unknown:
         # fail fast: a typo'd family silently falling back to the full
-        # default set would bench for minutes and append four unintended
-        # rows to the fusedvg:* ledger series being re-baselined
+        # default set would bench for minutes and append unintended rows
+        # to the ledger series being re-baselined
         print(
             f"[bench] microbench: unknown families {unknown!r}; "
             f"choose from {', '.join(known)}",
@@ -1062,7 +1116,11 @@ def run_fused_microbench(argv):
     failed = False
     for fam in fams:
         try:
-            r = bmarks.bench_fused_value_and_grad(fam)
+            r = (
+                bmarks.bench_nuts_sched()
+                if fam == "nutssched"
+                else bmarks.bench_fused_value_and_grad(fam)
+            )
         except Exception as e:  # noqa: BLE001 — one broken family must
             # not hide the others' measurements
             print(f"[bench] microbench {fam} failed: {e!r}", file=sys.stderr)
@@ -1070,16 +1128,22 @@ def run_fused_microbench(argv):
             continue
         row = res_row(r)
         if not row["converged"]:
-            # null, never 0.0: a failed fused leg gates as missing data
+            # null, never 0.0: a failed leg gates as missing data
             # (ADVICE r5 / the PR 4 convention)
             row["value"] = None
             failed = True
         print(json.dumps(row), flush=True)
+        if fam == "nutssched":
+            key = nutssched_config_key(row, platform)
+            extra, label = _NUTSSCHED_EXTRA_KEYS, "nutssched"
+        else:
+            key = fusedvg_config_key(row, platform)
+            extra, label = _FUSEDVG_EXTRA_KEYS, "fusedvg"
         append_ledger(
-            fusedvg_config_key(row, platform),
+            key,
             row,
-            extra_keys=_FUSEDVG_EXTRA_KEYS,
-            label="fusedvg",
+            extra_keys=extra,
+            label=label,
             source="bench.py microbench",
         )
     return 1 if failed else 0

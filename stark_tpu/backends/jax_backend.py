@@ -208,27 +208,33 @@ class JaxBackend:
         return self._cache[key]
 
     def _get_block(self, model, fm, cfg):
-        """get_block(length, diag_lags=None, donate_diag=False) -> jitted
-        vmapped block runner (cached).  ``diag_lags`` threads the streaming-
-        diagnostics carry (extra chains-batched StreamDiagState arg after
-        ``state``); ``donate_diag`` donates those buffers so the serial
-        loop updates the O(chains*d*L) accumulators in place."""
+        """get_block(length, diag_lags=None, donate_diag=False,
+        ragged=False) -> jitted vmapped block runner (cached).
+        ``diag_lags`` threads the streaming-diagnostics carry (extra
+        chains-batched StreamDiagState arg after ``state``);
+        ``donate_diag`` donates those buffers so the serial loop updates
+        the O(chains*d*L) accumulators in place.  ``ragged``
+        (STARK_RAGGED_NUTS) selects the step-synchronized NUTS scheduler —
+        same signatures plus one trailing per-chain lane-iteration output
+        (drivers that request it unpack accordingly)."""
 
-        def get(length, diag_lags=None, donate_diag=False):
+        def get(length, diag_lags=None, donate_diag=False, ragged=False):
             if diag_lags is None:
                 return self._cached(
-                    model, cfg, ("block", length),
+                    model, cfg, ("block", length, ragged),
                     lambda: jax.jit(jax.vmap(
-                        make_block_runner(fm, cfg, length),
+                        make_block_runner(fm, cfg, length, ragged=ragged),
                         in_axes=(0, 0, 0, 0, None),
                     )),
                 )
             return self._cached(
-                model, cfg, ("block", length, diag_lags, donate_diag),
+                model, cfg, ("block", length, diag_lags, donate_diag,
+                             ragged),
                 lambda: jax.jit(
                     jax.vmap(
                         make_block_runner(fm, cfg, length,
-                                          diag_lags=diag_lags),
+                                          diag_lags=diag_lags,
+                                          ragged=ragged),
                         in_axes=(0, 0, 0, 0, 0, None),
                     ),
                     donate_argnums=(2,) if donate_diag else (),

@@ -143,7 +143,7 @@ def fleet_eight_schools_spec(problems: int, *, seed: int = 0):
 
 def bench_fleet_eight_schools(
     *, problems=256, chains=4, num_warmup=200, block_size=50, max_blocks=24,
-    ess_target=100.0, rhat_target=1.01, max_tree_depth=5, seq_probe=2,
+    ess_target=100.0, rhat_target=1.01, max_tree_depth=None, seq_probe=2,
     seed=0,
 ):
     """Fleet leg: eight-schools x ``problems`` through ONE vmapped block
@@ -152,11 +152,15 @@ def bench_fleet_eight_schools(
     Headline: AGGREGATE min-ESS/s — the sum of per-problem min-ESS over
     the fleet wall (the throughput a per-user service actually delivers),
     measured on the steady-state pass (`_timed` convention: the compile
-    pass is untimed, like every other leg).  ``max_tree_depth`` is capped
-    below the single-problem default because a vmapped NUTS batch steps
-    every lane until the DEEPEST tree finishes — bounding the depth
-    bounds the lane-sync waste (the sequential baseline runs the same
-    cap, so the comparison stays apples-to-apples).
+    pass is untimed, like every other leg).  ``max_tree_depth`` defaults
+    to 5 on the legacy scheduler — a vmapped NUTS batch steps every lane
+    until the DEEPEST tree finishes, so bounding the depth bounds the
+    lane-sync waste — and lifts to the single-problem default of 10 when
+    the step-synchronized scheduler is on (``STARK_RAGGED_NUTS=1``):
+    ragged lanes advance their own trees, so a deep straggler costs only
+    itself.  The sequential baseline always runs the same depth as the
+    fleet, so the comparison stays apples-to-apples, and the ledger row
+    records the scheduler + depth in its config key (distinct series).
 
     TWO sequential baselines ride in ``extra``, both extrapolated from
     ``seq_probe`` measured runs of the unmodified single-problem runner:
@@ -175,8 +179,14 @@ def bench_fleet_eight_schools(
       says the fleet opens (PAPERS.md).
     """
     from .fleet import sample_fleet
+    from .kernels.nuts_ragged import ragged_nuts_enabled
     from .runner import sample_until_converged
 
+    ragged = ragged_nuts_enabled()
+    if max_tree_depth is None:
+        # the PR 6 depth cap exists ONLY to bound legacy lane-sync waste;
+        # the ragged scheduler removes that coupling, so the cap lifts
+        max_tree_depth = 10 if ragged else 5
     spec = fleet_eight_schools_spec(problems, seed=seed)
     gate_kw = dict(
         chains=chains, num_warmup=num_warmup, block_size=block_size,
@@ -233,6 +243,8 @@ def bench_fleet_eight_schools(
         extra={
             "problems": problems,
             "chains": chains,
+            "sched": "ragged" if ragged else "legacy",
+            "max_tree_depth": max_tree_depth,
             "converged_fraction": round(conv_frac, 4),
             "blocks_dispatched": res.blocks_dispatched,
             "compactions": res.compactions,
@@ -708,6 +720,211 @@ def bench_fused_value_and_grad(
     )
 
 
+class _GradEvalProbe:
+    """Dispatch-count probe for the NUTS block loops (jit trace
+    instrumentation — ROADMAP item 3's "profile the NUTS tree-building
+    scan for dispatch-bound segments").  Wraps a FlatModel's bound
+    potential so every EXECUTED fused value-and-grad — including the
+    ones vmap's batched ``while_loop``s run for already-finished (masked)
+    lanes, which never show up in ``num_grad_evals`` — bumps a host
+    counter via ``jax.debug.callback``.  ``calls`` / the calibration in
+    `bench_nuts_sched` turn that into executed-batched-evaluation counts,
+    the denominator of the lane-occupancy numbers the trace events only
+    estimate from the carry."""
+
+    def __init__(self, fm):
+        self._fm = fm
+        self.calls = 0
+
+    def bind(self, data=None):
+        from .model import Potential
+        from .kernels.base import value_and_grad_of
+
+        inner = self._fm.bind(data)
+        vag = value_and_grad_of(inner)
+
+        def counting(z):
+            v, g = vag(z)
+            jax.debug.callback(self._bump, jnp.zeros((), jnp.int32))
+            return v, g
+
+        return Potential(lambda z: inner(z), counting)
+
+    def _bump(self, _x):
+        self.calls += 1
+
+    def snapshot(self) -> int:
+        """Drain pending callback effects, then read the counter —
+        ``jax.block_until_ready`` waits only for OUTPUT buffers, not for
+        debug-callback side effects, so every probe read must cross this
+        barrier or risk undercounting."""
+        jax.effects_barrier()
+        return self.calls
+
+
+def bench_nuts_sched(
+    *, n=8192, d=16, chains=24, block_size=64, max_tree_depth=8,
+    rounds=3, seed=0,
+) -> BenchResult:
+    """``bench.py microbench nutssched``: step-synchronized (ragged) vs
+    legacy NUTS block scheduling on a mixed-curvature synthetic.
+
+    The workload is a logistic posterior (N x d likelihood, so the
+    gradient evaluation — not the scheduler bookkeeping — dominates each
+    iteration) sampled by ``chains`` lanes whose step sizes are spread
+    over octaves: lanes deliberately build trees of different depths, and
+    NUTS's per-transition direction/depth randomness de-synchronizes them
+    further — exactly the raggedness that makes the legacy vmapped loops
+    pay max-lane-tree at every level.
+
+    Measured, per scheduler:
+
+    * **bit identity** — ragged draws/stats must equal legacy's exactly
+      (the determinism contract, asserted before anything is timed);
+    * **executed vs useful gradient evaluations** — executed counts come
+      from the `_GradEvalProbe` dispatch-count instrumentation (a
+      separate probed pass, so timing stays clean), useful from the
+      kernels' ``num_grad_evals``; their ratio is the lane occupancy;
+    * **occupancy-adjusted throughput** — useful gradient evaluations
+      per second over ``rounds`` interleaved timed rounds (max rate per
+      path, the `_fused_vg_case` de-noising convention).
+
+    Headline ``ess_per_sec`` carries the RAGGED useful-grads/s; the
+    legacy rate, speedup, both occupancies and both executed counts ride
+    ``extra`` under the ``nutssched:*`` ledger config key.  Gate:
+    bit-identical AND occupancy strictly improves AND >= 1.3x
+    occupancy-adjusted throughput.
+    """
+    import os
+
+    from .kernels.base import init_state
+    from .model import flatten_model, prepare_model_data
+    from .models import Logistic, synth_logistic_data
+    from .sampler import SamplerConfig, make_block_runner
+
+    scale = float(os.environ.get("BENCH_NUTSSCHED_SCALE", 1.0))
+    n = max(int(n * scale), 512)
+    t0 = time.perf_counter()
+    model = Logistic(num_features=d)
+    data, _ = synth_logistic_data(jax.random.PRNGKey(seed), n, d)
+    fm = flatten_model(model)
+    pdata = prepare_model_data(model, data)
+    cfg = SamplerConfig(kernel="nuts", max_tree_depth=max_tree_depth)
+    pot = fm.bind(pdata)
+    key = jax.random.PRNGKey(seed + 1)
+    kz, kb = jax.random.split(key)
+    z0 = 0.05 * jax.vmap(fm.init_flat)(jax.random.split(kz, chains))
+    state = jax.vmap(lambda z: init_state(pot, z))(z0)
+    # mixed curvature: two interleaved step-size groups around the
+    # posterior scale (~2/sqrt(n) for a logistic GLM) — the small-step
+    # lanes build trees ~1 doubling deeper on average, and NUTS's
+    # per-transition randomness spreads each lane's depth further.  The
+    # groups stay within a factor 1.5 so no single lane dominates every
+    # round (a lane that is ALWAYS deepest is the one case where the
+    # legacy max-lane sync is already tight)
+    base = 2.7 / np.sqrt(n)
+    step_size = jnp.asarray(
+        base * np.where(np.arange(chains) % 2 == 0, 1.0, 2.0 / 3.0),
+        jnp.float32,
+    )
+    inv_mass = jnp.ones((chains, d), jnp.float32)
+    bkeys = jax.random.split(kb, chains)
+    args = (bkeys, state, step_size, inv_mass, pdata)
+
+    def build(source_fm, ragged):
+        return jax.jit(jax.vmap(
+            make_block_runner(source_fm, cfg, block_size, ragged=ragged),
+            in_axes=(0, 0, 0, 0, None),
+        ))
+
+    legacy_fn, ragged_fn = build(fm, False), build(fm, True)
+    out_l = jax.block_until_ready(legacy_fn(*args))
+    out_r = jax.block_until_ready(ragged_fn(*args))
+    identical = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(out_l[1:6], out_r[1:6])
+    )
+    ngrad = np.asarray(out_l[5])
+    useful = int(ngrad.sum())
+    lane_iters = np.asarray(out_r[6])
+
+    # --- dispatch-count probe (separate pass: callbacks poison timing) --
+    probe = _GradEvalProbe(fm)
+    # calibrate callback multiplicity for one vmapped batched evaluation
+    # (jax may invoke the callback once per batch or once per lane)
+    probe.calls = 0
+    jax.block_until_ready(
+        jax.jit(jax.vmap(probe.bind(pdata).value_and_grad))(z0)
+    )
+    per_eval = max(probe.snapshot(), 1)
+    counts = {}
+    for name, ragged in (("legacy", False), ("ragged", True)):
+        probe.calls = 0
+        jax.block_until_ready(build(probe, ragged)(*args))
+        counts[name] = probe.snapshot() // per_eval
+    occ_legacy = useful / max(counts["legacy"] * chains, 1)
+    occ_ragged = useful / max(counts["ragged"] * chains, 1)
+
+    # --- occupancy-adjusted throughput (clean, interleaved rounds) ------
+    def one_round(fn):
+        t = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        return useful / (time.perf_counter() - t)
+
+    rate_l, rate_r = 0.0, 0.0
+    for _ in range(rounds):
+        rate_l = max(rate_l, one_round(legacy_fn))
+        rate_r = max(rate_r, one_round(ragged_fn))
+    speedup = rate_r / rate_l if rate_l > 0 else float("nan")
+    ok = bool(
+        identical
+        and np.isfinite(speedup)
+        and speedup >= 1.3
+        and occ_ragged > occ_legacy
+    )
+    draws = chains * block_size
+    return BenchResult(
+        name="nuts_sched_mixed_depth",
+        wall_s=time.perf_counter() - t0,
+        min_ess=float("nan"),  # not a sampling leg: no ESS to report
+        ess_per_sec=rate_r if identical else float("nan"),
+        max_rhat=float("nan"),
+        metric_name="useful grad evals/s",
+        converged=ok,
+        gate="bit-identical + occupancy up + >=1.3x vs legacy NUTS",
+        extra={
+            "family": "nutssched",
+            "n": n,
+            "d": d,
+            "chains": chains,
+            "block_size": block_size,
+            "max_tree_depth": max_tree_depth,
+            "bit_identical": identical,
+            "legacy_evals_per_sec": round(rate_l, 3),
+            "speedup_vs_legacy": (
+                round(speedup, 3) if np.isfinite(speedup) else None
+            ),
+            "useful_grad_evals": useful,
+            "executed_batched_evals_legacy": counts["legacy"],
+            "executed_batched_evals_ragged": counts["ragged"],
+            "lane_occupancy_legacy": round(occ_legacy, 4),
+            "lane_occupancy_ragged": round(occ_ragged, 4),
+            # grad evals the batch EXECUTED per effective draw, by path —
+            # the per-draw cost the lane sync inflates
+            "executed_per_draw_legacy": round(
+                counts["legacy"] * chains / draws, 2
+            ),
+            "executed_per_draw_ragged": round(
+                counts["ragged"] * chains / draws, 2
+            ),
+            "useful_per_draw": round(useful / draws, 2),
+            # carry-accounting cross-check: the ragged loop's iteration
+            # count must equal the probe's executed-batched-evals
+            "sched_iters_max": int(lane_iters.max()),
+        },
+    )
+
+
 ALL_BENCHMARKS = {
     "eight_schools": bench_eight_schools,
     "hier_logistic": bench_hier_logistic,
@@ -719,4 +936,5 @@ ALL_BENCHMARKS = {
     "fused_vg_irt": lambda: bench_fused_value_and_grad("irt"),
     "fused_vg_ordinal": lambda: bench_fused_value_and_grad("ordinal"),
     "fused_vg_robust": lambda: bench_fused_value_and_grad("robust"),
+    "nuts_sched": bench_nuts_sched,
 }

@@ -48,6 +48,12 @@ one-problem fleet always takes that path, so B=1 is bit-identical to
 `runner.sample_until_converged` by construction (draws, metrics trail,
 checkpoint arrays), the same flags-off discipline as PRs 3–4.
 
+``STARK_RAGGED_NUTS=1`` routes the fleet's NUTS block dispatches through
+the step-synchronized scheduler (`kernels.nuts_ragged`): the B x chains
+lanes — where max-tree lane sync is worst — each advance their own tree
+per batched gradient evaluation, draws stay bit-identical, and
+``fleet_block`` events gain lane-occupancy accounting.
+
 Out of scope (documented, not silently wrong): the chees ensemble kernel
 (its warmup adapts cross-chain with its own host loop) and multi-process
 meshes raise; per-problem ``init_params``/adaptation import are not
@@ -353,8 +359,9 @@ class _FleetParts:
         )
         self._blocks: Dict[Tuple[Any, ...], Any] = {}
 
-    def get_block(self, length: int, diag_lags: Optional[int] = None):
-        key = (length, diag_lags)
+    def get_block(self, length: int, diag_lags: Optional[int] = None,
+                  ragged: bool = False):
+        key = (length, diag_lags, ragged)
         fn = self._blocks.get(key)
         if fn is None:
             inner_axes = (
@@ -363,11 +370,17 @@ class _FleetParts:
             )
             # every input (incl. the data pytree) maps over the problem axis
             outer_axes = (0,) * len(inner_axes)
+            # ragged (STARK_RAGGED_NUTS): the step-synchronized NUTS
+            # scheduler — the B x chains lanes of the doubly-vmapped loop
+            # slip independently (the fleet is where max-tree lane sync
+            # is worst), and the runners return one extra trailing
+            # (problems, chains) lane-iteration output
             fn = self._blocks[key] = jax.jit(
                 jax.vmap(
                     jax.vmap(
                         make_block_runner(self.fm, self.cfg, length,
-                                          diag_lags=diag_lags),
+                                          diag_lags=diag_lags,
+                                          ragged=ragged),
                         in_axes=inner_axes,
                     ),
                     in_axes=outer_axes,
@@ -592,6 +605,13 @@ def _sample_fleet(
         stream_diag = os.environ.get("STARK_STREAM_DIAG", "1") != "0"
     if diag_lags is None:
         diag_lags = STREAM_DIAG_LAGS
+    # step-synchronized NUTS scheduling (STARK_RAGGED_NUTS): the fleet is
+    # where the B x chains lane product makes max-tree sync worst — the
+    # ragged block runners let every lane advance its own tree and add a
+    # (problems, chains) lane-iteration output for occupancy accounting
+    from .kernels.nuts_ragged import ragged_nuts_enabled
+
+    ragged = ragged_nuts_enabled(cfg)
 
     use_fleet = _resolve_fleet_flag(fleet) and spec.num_problems > 1
     if not use_fleet:
@@ -882,7 +902,8 @@ def _sample_fleet(
             admit(first)
 
         v_block = parts.get_block(
-            block_size, diag_lags=diag_lags if stream_diag else None
+            block_size, diag_lags=diag_lags if stream_diag else None,
+            ragged=ragged,
         )
     except BaseException:
         flush_metrics()
@@ -1087,12 +1108,21 @@ def _sample_fleet(
                 jnp.stack([blk_key.get(i, probs[i].key) for i in order])
             )
             t_enq = time.perf_counter()
+            lane_iters = None
             if stream_diag:
                 out = v_block(bkeys, state, diag, step_size, inv_mass, bdata)
-                state, diag, zs, accept, divergent, _energy, ngrad = out
+                if ragged:
+                    (state, diag, zs, accept, divergent, _energy, ngrad,
+                     lane_iters) = out
+                else:
+                    state, diag, zs, accept, divergent, _energy, ngrad = out
             else:
                 out = v_block(bkeys, state, step_size, inv_mass, bdata)
-                state, zs, accept, divergent, _energy, ngrad = out
+                if ragged:
+                    (state, zs, accept, divergent, _energy, ngrad,
+                     lane_iters) = out
+                else:
+                    state, zs, accept, divergent, _energy, ngrad = out
             state = faults.poison("runner.carried_nan", state)
             blocks_dispatched += 1
 
@@ -1140,6 +1170,18 @@ def _sample_fleet(
             n_active = sum(probs[i].active for i in order)
             occupancy = n_active / max(len(order), 1)
             occupancy_trail.append(occupancy)
+            # ragged-NUTS lane occupancy: useful (active-lane) gradients
+            # over the max(lane_iters) x all-lanes gradients the batched
+            # loop actually executed — distinct from the problem-level
+            # ``occupancy`` above (active problems per batch slot).
+            # Fields ride ONLY knob-on runs (knob-off trails byte-equal).
+            sched_fields = {}
+            if ragged and lane_iters is not None:
+                from .kernels.nuts_ragged import lane_occupancy_fields
+
+                sched_fields = lane_occupancy_fields(
+                    lane_iters, useful=block_grads_active
+                )
             if trace.enabled:
                 trace.emit(
                     "fleet_block",
@@ -1154,6 +1196,7 @@ def _sample_fleet(
                     dur_s=round(
                         time.perf_counter() - t_enq, 4
                     ),
+                    **sched_fields,
                 )
             emit({
                 "event": "fleet_block",
@@ -1162,6 +1205,7 @@ def _sample_fleet(
                 "active": n_active,
                 "occupancy": round(occupancy, 4),
                 "block_grad_evals": block_grads_active,
+                **sched_fields,
                 "wall_s": time.perf_counter() - t_start,
             })
 

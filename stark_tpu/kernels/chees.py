@@ -1,8 +1,11 @@
 """ChEES-HMC — accelerator-first adaptive HMC (no trajectory trees).
 
 Vmapped iterative NUTS pays the full 2^max_depth gradient budget for EVERY
-chain at EVERY step (masked lanes still execute under vmap), and its
-tree-building control flow is exactly what XLA dislikes.  ChEES-HMC
+chain at EVERY step (masked lanes still execute under vmap; the
+step-synchronized scheduler in `kernels/nuts_ragged.py` —
+STARK_RAGGED_NUTS — shrinks that to end-of-block straggler imbalance,
+but a per-lane tree budget remains), and its tree-building control flow
+is exactly what XLA dislikes.  ChEES-HMC
 (Hoffman, Radul & Sountsov 2021 — PAPERS.md, pattern only) replaces the
 tree with plain fixed-length trajectories whose length is ADAPTED
 cross-chain by gradient ascent on the ChEES criterion

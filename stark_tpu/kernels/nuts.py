@@ -20,6 +20,14 @@ O(max_depth) checkpoint-stack scheme from the iterative-NUTS literature
 Trajectory-level proposal selection is biased progressive sampling over
 subtree weights; within-subtree selection is uniform multinomial, with
 log-weights ``H0 - H(leaf)``.
+
+The transition is decomposed into shared single-step helpers —
+`_leaf_step` (one leapfrog + leaf bookkeeping), `_merge_traj` (one
+doubling-round close), `_traj_init` / `_subtree_init` — consumed both by
+the nested-loop `nuts_step` here and by the step-synchronized ragged block
+scheduler (`kernels.nuts_ragged`, STARK_RAGGED_NUTS).  The two execution
+orders therefore run the SAME per-lane op/key sequence by construction,
+which is what makes their draws bit-identical.
 """
 
 from __future__ import annotations
@@ -44,6 +52,9 @@ _DIVERGENCE_THRESHOLD = 1000.0
 
 
 def _is_turning(inv_mass_diag, r_left, r_right, r_sum):
+    # trajectory-level check: two O(d) velocity scalings per doubling
+    # round (the per-leaf checkpoint sweep keeps its scalings hoisted in
+    # ``vr_ckpts`` instead — see _leaf_step)
     v_left = inv_mass_diag * r_left
     v_right = inv_mass_diag * r_right
     rho = r_sum - 0.5 * (r_left + r_right)
@@ -66,25 +77,14 @@ class _Subtree(NamedTuple):
     num_leaves: Array
 
 
-def _build_subtree(
-    key,
-    depth,
-    z0,
-    r0,
-    grad0,
-    potential_fn,
-    directed_step,
-    inv_mass_diag,
-    energy0,
-    max_depth,
-):
-    """Generate up to 2**depth leaves starting one leapfrog step past the
-    (z0, r0, grad0) edge, with in-flight U-turn checkpoint checks."""
+def _subtree_init(z0, r0, grad0, energy0, max_depth):
+    """Fresh subtree state anchored at the (z0, r0, grad0) edge, plus the
+    zeroed checkpoint stacks: raw momenta, cumulative momentum sums, and
+    the velocity-scaled momenta (``vr_ckpts = r_ckpts * inv_mass``) kept
+    incrementally so the per-leaf U-turn sweep never rescales the whole
+    (max_depth, d) stack."""
     d = z0.shape[0]
     dtype = z0.dtype
-    num_target = jnp.left_shift(jnp.int32(1), depth.astype(jnp.int32))
-    slots = jnp.arange(max_depth, dtype=jnp.int32)
-
     init = _Subtree(
         z_far=z0,
         r_far=r0,
@@ -102,76 +102,126 @@ def _build_subtree(
     )
     r_ckpts = jnp.zeros((max_depth, d), dtype)
     s_ckpts = jnp.zeros((max_depth, d), dtype)
+    vr_ckpts = jnp.zeros((max_depth, d), dtype)
+    return init, r_ckpts, s_ckpts, vr_ckpts
+
+
+def _leaf_step(st, r_ckpts, s_ckpts, vr_ckpts, i, key, *, potential_fn,
+               directed_step, inv_mass_diag, energy0, slots):
+    """ONE subtree leaf: a single leapfrog step (one gradient evaluation)
+    plus multinomial proposal selection and the checkpoint-stack U-turn
+    bookkeeping.  Shared verbatim by the nested-loop kernel below and the
+    step-synchronized ragged scheduler (`kernels.nuts_ragged`) so the two
+    cannot drift — a lane's per-leaf op and key-split sequence is
+    identical in both, which is the bit-identity contract."""
+    key, key_sel = jax.random.split(key)
+    z, r, grad, pe = leapfrog_step(
+        potential_fn, st.z_far, st.r_far, st.grad_far, directed_step,
+        inv_mass_diag,
+    )
+    energy = pe + kinetic_energy(r, inv_mass_diag)
+    delta = energy - energy0
+    delta = jnp.where(jnp.isnan(delta), jnp.inf, delta)
+    diverging = delta > _DIVERGENCE_THRESHOLD
+    log_w = -delta
+    accept_leaf = jnp.minimum(1.0, jnp.exp(-delta))
+
+    new_log_weight = jnp.logaddexp(st.log_weight, log_w)
+    take = jax.random.uniform(key_sel, ()) < jnp.exp(log_w - new_log_weight)
+    z_prop = jnp.where(take, z, st.z_prop)
+    pe_prop = jnp.where(take, pe, st.pe_prop)
+    grad_prop = jnp.where(take, grad, st.grad_prop)
+    energy_prop = jnp.where(take, energy, st.energy_prop)
+
+    r_sum = st.r_sum + r
+
+    # --- checkpoint bookkeeping -------------------------------------
+    idx_max = jax.lax.population_count(jnp.right_shift(i, 1)).astype(jnp.int32)
+    trailing_ones = (
+        jax.lax.population_count(jnp.bitwise_xor(i, i + 1)).astype(jnp.int32) - 1
+    )
+    idx_min = idx_max - trailing_ones + 1
+    is_even = (i % 2) == 0
+    # the velocity scaling of the CURRENT momentum, computed once: it is
+    # both this leaf's right-endpoint velocity and (on even leaves) the
+    # hoisted checkpoint row — the sweep below never touches
+    # ``inv_mass_diag`` again, so the (max_depth, d) rescale the old code
+    # paid per leaf is gone while every product stays bitwise the same
+    v_now = r * inv_mass_diag
+    r_ckpts = jnp.where(
+        is_even, r_ckpts.at[idx_max].set(r), r_ckpts
+    )
+    s_ckpts = jnp.where(
+        is_even, s_ckpts.at[idx_max].set(r_sum), s_ckpts
+    )
+    vr_ckpts = jnp.where(
+        is_even, vr_ckpts.at[idx_max].set(v_now), vr_ckpts
+    )
+    # closed-subtree U-turn checks (odd leaves only), vectorized + masked
+    sub_r_sums = r_sum[None, :] - s_ckpts + r_ckpts  # (max_depth, d)
+    rho = sub_r_sums - 0.5 * (r_ckpts + r[None, :])
+    turn_each = (jnp.sum(vr_ckpts * rho, axis=-1) <= 0.0) | (
+        jnp.sum(v_now[None, :] * rho, axis=-1) <= 0.0
+    )
+    mask = (slots >= idx_min) & (slots <= idx_max)
+    turning = (~is_even) & jnp.any(turn_each & mask)
+
+    st = _Subtree(
+        z_far=z,
+        r_far=r,
+        grad_far=grad,
+        z_prop=z_prop,
+        pe_prop=pe_prop,
+        grad_prop=grad_prop,
+        energy_prop=energy_prop,
+        r_sum=r_sum,
+        log_weight=new_log_weight,
+        turning=turning,
+        diverging=diverging,
+        sum_accept=st.sum_accept + accept_leaf,
+        num_leaves=st.num_leaves + 1,
+    )
+    return st, r_ckpts, s_ckpts, vr_ckpts, i + 1, key
+
+
+def _build_subtree(
+    key,
+    depth,
+    z0,
+    r0,
+    grad0,
+    potential_fn,
+    directed_step,
+    inv_mass_diag,
+    energy0,
+    max_depth,
+):
+    """Generate up to 2**depth leaves starting one leapfrog step past the
+    (z0, r0, grad0) edge, with in-flight U-turn checkpoint checks."""
+    num_target = jnp.left_shift(jnp.int32(1), depth.astype(jnp.int32))
+    slots = jnp.arange(max_depth, dtype=jnp.int32)
+    init, r_ckpts, s_ckpts, vr_ckpts = _subtree_init(
+        z0, r0, grad0, energy0, max_depth
+    )
 
     def cond(carry):
-        st, _, _, i, _ = carry
+        st, _, _, _, i, _ = carry
         return (i < num_target) & ~st.turning & ~st.diverging
 
     def body(carry):
-        st, r_ckpts, s_ckpts, i, key = carry
-        key, key_sel = jax.random.split(key)
-        z, r, grad, pe = leapfrog_step(
-            potential_fn, st.z_far, st.r_far, st.grad_far, directed_step, inv_mass_diag
+        st, rc, sc, vc, i, key = carry
+        return _leaf_step(
+            st, rc, sc, vc, i, key,
+            potential_fn=potential_fn,
+            directed_step=directed_step,
+            inv_mass_diag=inv_mass_diag,
+            energy0=energy0,
+            slots=slots,
         )
-        energy = pe + kinetic_energy(r, inv_mass_diag)
-        delta = energy - energy0
-        delta = jnp.where(jnp.isnan(delta), jnp.inf, delta)
-        diverging = delta > _DIVERGENCE_THRESHOLD
-        log_w = -delta
-        accept_leaf = jnp.minimum(1.0, jnp.exp(-delta))
 
-        new_log_weight = jnp.logaddexp(st.log_weight, log_w)
-        take = jax.random.uniform(key_sel, ()) < jnp.exp(log_w - new_log_weight)
-        z_prop = jnp.where(take, z, st.z_prop)
-        pe_prop = jnp.where(take, pe, st.pe_prop)
-        grad_prop = jnp.where(take, grad, st.grad_prop)
-        energy_prop = jnp.where(take, energy, st.energy_prop)
-
-        r_sum = st.r_sum + r
-
-        # --- checkpoint bookkeeping -------------------------------------
-        idx_max = jax.lax.population_count(jnp.right_shift(i, 1)).astype(jnp.int32)
-        trailing_ones = (
-            jax.lax.population_count(jnp.bitwise_xor(i, i + 1)).astype(jnp.int32) - 1
-        )
-        idx_min = idx_max - trailing_ones + 1
-        is_even = (i % 2) == 0
-        r_ckpts = jnp.where(
-            is_even, r_ckpts.at[idx_max].set(r), r_ckpts
-        )
-        s_ckpts = jnp.where(
-            is_even, s_ckpts.at[idx_max].set(r_sum), s_ckpts
-        )
-        # closed-subtree U-turn checks (odd leaves only), vectorized + masked
-        sub_r_sums = r_sum[None, :] - s_ckpts + r_ckpts  # (max_depth, d)
-        v_l = r_ckpts * inv_mass_diag[None, :]
-        v_r = (r * inv_mass_diag)[None, :]
-        rho = sub_r_sums - 0.5 * (r_ckpts + r[None, :])
-        turn_each = (jnp.sum(v_l * rho, axis=-1) <= 0.0) | (
-            jnp.sum(v_r * rho, axis=-1) <= 0.0
-        )
-        mask = (slots >= idx_min) & (slots <= idx_max)
-        turning = (~is_even) & jnp.any(turn_each & mask)
-
-        st = _Subtree(
-            z_far=z,
-            r_far=r,
-            grad_far=grad,
-            z_prop=z_prop,
-            pe_prop=pe_prop,
-            grad_prop=grad_prop,
-            energy_prop=energy_prop,
-            r_sum=r_sum,
-            log_weight=new_log_weight,
-            turning=turning,
-            diverging=diverging,
-            sum_accept=st.sum_accept + accept_leaf,
-            num_leaves=st.num_leaves + 1,
-        )
-        return st, r_ckpts, s_ckpts, i + 1, key
-
-    st, _, _, _, _ = jax.lax.while_loop(
-        cond, body, (init, r_ckpts, s_ckpts, jnp.zeros((), jnp.int32), key)
+    st, _, _, _, _, _ = jax.lax.while_loop(
+        cond, body,
+        (init, r_ckpts, s_ckpts, vr_ckpts, jnp.zeros((), jnp.int32), key),
     )
     return st
 
@@ -196,20 +246,9 @@ class _Traj(NamedTuple):
     depth: Array
 
 
-def nuts_step(
-    key: Array,
-    state: HMCState,
-    potential_fn: PotentialFn,
-    step_size: Array,
-    inv_mass_diag: Array,
-    max_depth: int = 10,
-):
-    """One NUTS transition. Returns (new HMCState, HMCInfo)."""
-    key_mom, key_loop = jax.random.split(key)
-    r0 = sample_momentum(key_mom, inv_mass_diag)
-    energy0 = state.potential_energy + kinetic_energy(r0, inv_mass_diag)
-
-    traj = _Traj(
+def _traj_init(state: HMCState, r0, energy0) -> _Traj:
+    """Fresh single-point trajectory at the start of a transition."""
+    return _Traj(
         z_left=state.z,
         r_left=r0,
         grad_left=state.grad,
@@ -228,6 +267,70 @@ def nuts_step(
         num_leaves=jnp.zeros((), jnp.int32),
         depth=jnp.zeros((), jnp.int32),
     )
+
+
+def _merge_traj(traj: _Traj, sub: _Subtree, going_right, key_take,
+                inv_mass_diag) -> _Traj:
+    """Close one doubling round: biased progressive sampling between the
+    old trajectory and the finished subtree, edge merge, and the
+    trajectory-level U-turn check.  Shared by the nested-loop kernel and
+    the ragged scheduler."""
+    ok = ~sub.turning & ~sub.diverging
+
+    # biased progressive sampling between old trajectory and new subtree
+    p_take = jnp.exp(jnp.minimum(0.0, sub.log_weight - traj.log_weight))
+    take = ok & (jax.random.uniform(key_take, ()) < p_take)
+    z_prop = jnp.where(take, sub.z_prop, traj.z_prop)
+    pe_prop = jnp.where(take, sub.pe_prop, traj.pe_prop)
+    grad_prop = jnp.where(take, sub.grad_prop, traj.grad_prop)
+    energy_prop = jnp.where(take, sub.energy_prop, traj.energy_prop)
+
+    # merged edges (only meaningful when ok; the transition ends otherwise)
+    z_left = jnp.where(going_right, traj.z_left, sub.z_far)
+    r_left = jnp.where(going_right, traj.r_left, sub.r_far)
+    g_left = jnp.where(going_right, traj.grad_left, sub.grad_far)
+    z_right = jnp.where(going_right, sub.z_far, traj.z_right)
+    r_right = jnp.where(going_right, sub.r_far, traj.r_right)
+    g_right = jnp.where(going_right, sub.grad_far, traj.grad_right)
+
+    r_sum = traj.r_sum + sub.r_sum
+    turning_total = _is_turning(inv_mass_diag, r_left, r_right, r_sum)
+
+    return _Traj(
+        z_left=z_left,
+        r_left=r_left,
+        grad_left=g_left,
+        z_right=z_right,
+        r_right=r_right,
+        grad_right=g_right,
+        z_prop=z_prop,
+        pe_prop=pe_prop,
+        grad_prop=grad_prop,
+        energy_prop=energy_prop,
+        r_sum=r_sum,
+        log_weight=jnp.logaddexp(traj.log_weight, sub.log_weight),
+        turning=sub.turning | turning_total,
+        diverging=sub.diverging,
+        sum_accept=traj.sum_accept + sub.sum_accept,
+        num_leaves=traj.num_leaves + sub.num_leaves,
+        depth=traj.depth + 1,
+    )
+
+
+def nuts_step(
+    key: Array,
+    state: HMCState,
+    potential_fn: PotentialFn,
+    step_size: Array,
+    inv_mass_diag: Array,
+    max_depth: int = 10,
+):
+    """One NUTS transition. Returns (new HMCState, HMCInfo)."""
+    key_mom, key_loop = jax.random.split(key)
+    r0 = sample_momentum(key_mom, inv_mass_diag)
+    energy0 = state.potential_energy + kinetic_energy(r0, inv_mass_diag)
+
+    traj = _traj_init(state, r0, energy0)
 
     def cond(carry):
         traj, _ = carry
@@ -254,46 +357,7 @@ def nuts_step(
             energy0,
             max_depth,
         )
-        ok = ~sub.turning & ~sub.diverging
-
-        # biased progressive sampling between old trajectory and new subtree
-        p_take = jnp.exp(jnp.minimum(0.0, sub.log_weight - traj.log_weight))
-        take = ok & (jax.random.uniform(key_take, ()) < p_take)
-        z_prop = jnp.where(take, sub.z_prop, traj.z_prop)
-        pe_prop = jnp.where(take, sub.pe_prop, traj.pe_prop)
-        grad_prop = jnp.where(take, sub.grad_prop, traj.grad_prop)
-        energy_prop = jnp.where(take, sub.energy_prop, traj.energy_prop)
-
-        # merged edges (only meaningful when ok; loop exits otherwise)
-        z_left = jnp.where(going_right, traj.z_left, sub.z_far)
-        r_left = jnp.where(going_right, traj.r_left, sub.r_far)
-        g_left = jnp.where(going_right, traj.grad_left, sub.grad_far)
-        z_right = jnp.where(going_right, sub.z_far, traj.z_right)
-        r_right = jnp.where(going_right, sub.r_far, traj.r_right)
-        g_right = jnp.where(going_right, sub.grad_far, traj.grad_right)
-
-        r_sum = traj.r_sum + sub.r_sum
-        turning_total = _is_turning(inv_mass_diag, r_left, r_right, r_sum)
-
-        new = _Traj(
-            z_left=z_left,
-            r_left=r_left,
-            grad_left=g_left,
-            z_right=z_right,
-            r_right=r_right,
-            grad_right=g_right,
-            z_prop=z_prop,
-            pe_prop=pe_prop,
-            grad_prop=grad_prop,
-            energy_prop=energy_prop,
-            r_sum=r_sum,
-            log_weight=jnp.logaddexp(traj.log_weight, sub.log_weight),
-            turning=sub.turning | turning_total,
-            diverging=sub.diverging,
-            sum_accept=traj.sum_accept + sub.sum_accept,
-            num_leaves=traj.num_leaves + sub.num_leaves,
-            depth=traj.depth + 1,
-        )
+        new = _merge_traj(traj, sub, going_right, key_take, inv_mass_diag)
         return new, key
 
     traj, _ = jax.lax.while_loop(cond, body, (traj, key_loop))

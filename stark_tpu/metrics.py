@@ -463,6 +463,11 @@ class TraceCollector:
             f"{p}_fleet_problems_converged",
             "fleet problems that passed full convergence validation",
         )
+        self.g_lane_occupancy = r.gauge(
+            f"{p}_nuts_lane_occupancy",
+            "ragged-NUTS useful-gradient fraction of the last block "
+            "(STARK_RAGGED_NUTS; 1.0 = no lane-sync waste)",
+        )
         self.g_healthy = r.gauge(
             f"{p}_healthy", "1 when /healthz reports 200, else 0"
         )
@@ -606,6 +611,8 @@ class TraceCollector:
             self.g_draws_per_chain.set(float(rec["draws_per_chain"]))
         if rec.get("ess_forecast") is not None:
             self.g_ess_forecast.set(float(rec["ess_forecast"]))
+        if rec.get("lane_occupancy") is not None:
+            self.g_lane_occupancy.set(float(rec["lane_occupancy"]))
         self._set_status(
             phase="sample",
             block=rec.get("block"),
@@ -637,6 +644,7 @@ class TraceCollector:
             ("active", self.g_fleet_active),
             ("batch", self.g_fleet_batch),
             ("occupancy", self.g_fleet_occupancy),
+            ("lane_occupancy", self.g_lane_occupancy),
         ):
             if rec.get(field) is not None:
                 g.set(float(rec[field]))

@@ -377,6 +377,7 @@ def _sample_until_converged(
         )
 
     is_chees = cfg.kernel == "chees"
+    ragged = False  # resolved on the per-chain branch below
     if is_chees:
         # ensemble kernel: blocks advance the whole ensemble through
         # chees sample segments (frozen adaptation), checkpointed as a
@@ -609,16 +610,32 @@ def _sample_until_converged(
                 )
             except TypeError:
                 stream_diag = False
+        # step-synchronized NUTS scheduling (STARK_RAGGED_NUTS): the block
+        # runners gain one trailing lane-iteration output (occupancy
+        # accounting).  Knob-gated per config, and probed like the diag
+        # carry — a backend without the ragged path (sharded meshes,
+        # whose data-sharded potentials carry collectives that must run
+        # in lockstep) falls back to the legacy scan.
+        from .kernels.nuts_ragged import ragged_nuts_enabled
+
+        ragged = ragged_nuts_enabled(cfg)
+        if ragged:
+            try:
+                ap.get_block(block_size, ragged=True)
+            except TypeError:
+                ragged = False
 
         def get_v_block(length):
             """Compiled block runner for ``length`` transitions — the
             streaming-diagnostics variant when the feature is on (the
-            backend caches per (length, diag, donate))."""
+            backend caches per (length, diag, donate, ragged))."""
+            kw = {"ragged": True} if ragged else {}
             if stream_diag:
                 return ap.get_block(
-                    length, diag_lags=diag_lags, donate_diag=sync_blocks
+                    length, diag_lags=diag_lags, donate_diag=sync_blocks,
+                    **kw,
                 )
-            return ap.get_block(length)
+            return ap.get_block(length, **kw)
 
         # warmup runs as block_size-bounded dispatches too (same
         # device-program length cap as the draw blocks; the monolithic
@@ -1080,16 +1097,25 @@ def _sample_until_converged(
                              "divergent": divergent, "n_leap": n_leap},
                 }
             block_keys = ap.put_chains(jax.random.split(key_block, chains))
+            lane_iters = None
             if stream_diag:
                 out = get_v_block(length)(
                     block_keys, state, diag, step_size, inv_mass, data
                 )
-                new_state, diag, zs, accept, divergent, _energy, ngrad = out
+                if ragged:
+                    (new_state, diag, zs, accept, divergent, _energy,
+                     ngrad, lane_iters) = out
+                else:
+                    new_state, diag, zs, accept, divergent, _energy, ngrad = out
             else:
                 out = get_v_block(length)(
                     block_keys, state, step_size, inv_mass, data
                 )
-                new_state, zs, accept, divergent, _energy, ngrad = out
+                if ragged:
+                    (new_state, zs, accept, divergent, _energy, ngrad,
+                     lane_iters) = out
+                else:
+                    new_state, zs, accept, divergent, _energy, ngrad = out
             # per-chain kernels CARRY the (possibly poisoned) state into
             # the next dispatch — same rebinding as the serial loop
             new_state = faults.poison("runner.carried_nan", new_state)
@@ -1102,7 +1128,8 @@ def _sample_until_converged(
                 "diag": diag,
                 "len": length,
                 "outs": {"zs": zs, "accept": accept,
-                         "divergent": divergent, "ngrad": ngrad},
+                         "divergent": divergent, "ngrad": ngrad,
+                         **({"lane_iters": lane_iters} if ragged else {})},
             }
 
         def process_block(pend, next_in_flight):
@@ -1144,6 +1171,18 @@ def _sample_until_converged(
                 )
                 zs, zs_dm = np.asarray(zs), None
                 blk_grads = int(np.sum(np.asarray(ngrad)))
+            # ragged-NUTS occupancy accounting: the batch executed
+            # max(lane_iters) iterations x chains lane-gradients; the
+            # useful fraction is what the step-synchronized scheduler
+            # exists to raise (fields ride ONLY ragged runs, so the
+            # knob-off metrics/trace trails stay byte-identical)
+            sched_fields = {}
+            if ragged and outs.get("lane_iters") is not None:
+                from .kernels.nuts_ragged import lane_occupancy_fields
+
+                sched_fields = lane_occupancy_fields(
+                    ap.collect(outs["lane_iters"])
+                )
             t_wait = time.perf_counter() - t_blk
             if health_check:
                 # poisoned state must never reach the checkpoint; the
@@ -1246,6 +1285,8 @@ def _sample_until_converged(
                 # fused-path tag rides ONLY fused-model runs, so the
                 # plain-model metrics trail stays byte-identical
                 **({"fused": fused_tag} if fused_tag else {}),
+                # ragged-NUTS scheduling fields ride ONLY knob-on runs
+                **sched_fields,
                 "wall_s": time.perf_counter() - t_start,
             }
             if stream_diag:
@@ -1409,6 +1450,7 @@ def _sample_until_converged(
                     # trace_report's diagnostics table renders
                     stream_diag=stream_diag,
                     diag_bytes_to_host=diag_bytes,
+                    **sched_fields,
                     **(
                         {"ess_forecast": sched["forecast_draws"]}
                         if sched["forecast_draws"] is not None

@@ -386,7 +386,8 @@ def make_chain_runner(fm: FlatModel, cfg: SamplerConfig):
 
 
 def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int,
-                      diag_lags: Optional[int] = None):
+                      diag_lags: Optional[int] = None,
+                      ragged: bool = False):
     """One draw block for the segmented/adaptive drivers, jit/vmap-able
     per chain:
       block_run(key, state, step_size, inv_mass, data)
@@ -408,7 +409,23 @@ def make_block_runner(fm: FlatModel, cfg: SamplerConfig, block_size: int,
     so the adaptive runner's convergence gate transfers O(d*L) sufficient
     statistics per chain per block instead of re-reading the draw history
     (`diagnostics.ess_from_suffstats`).
+
+    ``ragged`` (STARK_RAGGED_NUTS, NUTS only): route the block through the
+    step-synchronized scheduler (`kernels.nuts_ragged`) — one batched
+    gradient evaluation per lane per loop iteration, with each vmapped
+    lane advancing its own tree/transition independently.  Draws and all
+    per-transition stats are BIT-IDENTICAL to this scan (shared per-leaf
+    code and key discipline); both signatures gain ONE trailing output,
+    the per-lane live-iteration count (lane-occupancy accounting).
     """
+    if ragged:
+        from .kernels.nuts_ragged import make_ragged_block_runner
+
+        # raises on non-NUTS / progress_every configs — drivers gate on
+        # `ragged_nuts_enabled(cfg)` so a knob-on incompatible run falls
+        # back to the legacy scan instead of reaching this error
+        return make_ragged_block_runner(fm, cfg, block_size,
+                                        diag_lags=diag_lags)
     step_kernel = make_kernel(cfg)
     from .kernels.base import scan_progress, stream_diag_update
 
@@ -529,13 +546,27 @@ def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
     )
     spans = [(s, min(s + seg, total)) for s in range(0, total, seg)]
 
+    # step-synchronized NUTS scheduling (STARK_RAGGED_NUTS): blocks gain a
+    # per-chain lane-iteration output; probed like the runner does — a
+    # get_block without the kwarg (sharded meshes) keeps the legacy scan
+    from .kernels.nuts_ragged import ragged_nuts_enabled
+
+    ragged = ragged_nuts_enabled(cfg)
+    if ragged and spans:
+        try:
+            get_block(spans[0][1] - spans[0][0], ragged=True)
+        except TypeError:
+            ragged = False
+
     def dispatch(span):
         """Enqueue one segment (async) and chain the carried state."""
         nonlocal state
         s, e = span
         # block_run splits its own per-step keys from one key per chain
-        out = get_block(e - s)(skeys[:, s, :], state, step_size, inv_mass,
-                               data)
+        fn = (
+            get_block(e - s, ragged=True) if ragged else get_block(e - s)
+        )
+        out = fn(skeys[:, s, :], state, step_size, inv_mass, data)
         state = out[0]
         return out[1:]
 
@@ -550,9 +581,19 @@ def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
             pend = dispatch(spans[i + 1])
         with trace.phase("sample_block", start=s, end=e,
                          pipelined=not sync_blocks) as ph:
-            zs, accept, divergent, energy, ngrad = collect(outs)
+            if ragged:
+                zs, accept, divergent, energy, ngrad, lane_iters = collect(
+                    outs
+                )
+            else:
+                zs, accept, divergent, energy, ngrad = collect(outs)
             if trace.enabled:
                 ph.note(mean_accept=round(float(np.mean(accept)), 4))
+                if ragged:
+                    # lane-occupancy accounting (shared field definition)
+                    from .kernels.nuts_ragged import lane_occupancy_fields
+
+                    ph.note(**lane_occupancy_fields(lane_iters))
         num_divergent += divergent.astype(np.int64).sum(axis=1)
         if trace.enabled:
             trace.emit(

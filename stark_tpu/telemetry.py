@@ -649,6 +649,11 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                    "compactions"} | {},          # fleet-sampling events
                                                  # (stark_tpu.fleet), when
                                                  # the run emitted them
+         "nutssched": {"ragged", "occupancy_last", "occupancy_min",
+                       "occupancy_mean", "blocks",
+                       "sched_iters_total"} | {},  # ragged-NUTS lane
+                                                 # occupancy (STARK_RAGGED_
+                                                 # NUTS), when emitted
          "restarts": int, "events": int}
 
     ``overlap`` aggregates the runner's pipelined ``sample_block``
@@ -662,6 +667,12 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     with streaming diagnostics on, growing O(draws*k) under the legacy
     full-history gate), the last ESS forecast (predicted draws-per-chain
     to reach the ESS target), and ``run_end``'s ``overshoot_draws``.
+
+    ``nutssched`` aggregates the step-synchronized NUTS scheduler's
+    lane-occupancy fields (``lane_occupancy`` / ``sched_iters`` on
+    ``sample_block`` and ``fleet_block`` events — useful gradient
+    evaluations over the max-lane iterations x lanes the batched loop
+    executed); present only on STARK_RAGGED_NUTS runs.
     """
     restarts_by_run: Dict[int, int] = {}
     for e in events:
@@ -672,7 +683,7 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     if not runs:
         return {"run": 0, "meta": {}, "wall_s": None, "phases": {},
                 "health": {}, "overlap": {}, "diag": {}, "fleet": {},
-                "restarts": 0, "events": 0}
+                "nutssched": {}, "restarts": 0, "events": 0}
     run = runs[-1] if run is None else run
     evs = [e for e in events if e.get("run", 0) == run]
     # restart chain: the selected run's own restarts (it may itself be a
@@ -689,12 +700,31 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     overlap: Dict[str, float] = {}
     diag: Dict[str, Any] = {}
     fleet: Dict[str, Any] = {}
+    nutssched: Dict[str, Any] = {}
+    occ_sum = 0.0
     saw_overlap = False
     wall = None
     div_latest = None
     accepts: List[float] = []
     for e in evs:
         ev = e["event"]
+        if (
+            ev in ("sample_block", "fleet_block")
+            and e.get("lane_occupancy") is not None
+        ):
+            occ = float(e["lane_occupancy"])
+            nutssched["ragged"] = bool(e.get("ragged_nuts", True))
+            nutssched["occupancy_last"] = occ
+            nutssched["occupancy_min"] = min(
+                nutssched.get("occupancy_min", occ), occ
+            )
+            nutssched["blocks"] = nutssched.get("blocks", 0) + 1
+            occ_sum += occ
+            if e.get("sched_iters") is not None:
+                nutssched["sched_iters_total"] = (
+                    nutssched.get("sched_iters_total", 0)
+                    + int(e["sched_iters"])
+                )
         if ev == "fleet_block":
             fleet["blocks"] = fleet.get("blocks", 0) + 1
             if e.get("occupancy") is not None:
@@ -790,6 +820,10 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
             else 0.0,
             4,
         )
+    if nutssched.get("blocks"):
+        nutssched["occupancy_mean"] = round(
+            occ_sum / nutssched["blocks"], 4
+        )
     return {
         "run": run,
         "meta": meta,
@@ -802,6 +836,7 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
         "overlap": overlap if saw_overlap else {},
         "diag": diag,
         "fleet": fleet,
+        "nutssched": nutssched,
         "restarts": restarts_total,
         "events": len(evs),
     }

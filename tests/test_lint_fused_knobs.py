@@ -21,9 +21,9 @@ def test_repo_is_clean():
 
 
 def test_collector_finds_all_knob_families():
-    """The AST collector must see the shared precision pair AND every
-    per-family boolean knob — a knob the collector can't see is a knob
-    the lint can't protect."""
+    """The AST collector must see the shared precision pair, every
+    per-family boolean knob, AND the kernel-scheduler knob — a knob the
+    collector can't see is a knob the lint can't protect."""
     knobs = lint_fused_knobs.collect_knobs(os.path.join(REPO, "stark_tpu"))
     assert {
         "STARK_FUSED_PRECISION",
@@ -33,6 +33,7 @@ def test_collector_finds_all_knob_families():
         "STARK_FUSED_IRT",
         "STARK_FUSED_ORDINAL",
         "STARK_FUSED_ROBUST",
+        "STARK_RAGGED_NUTS",
     } <= set(knobs)
 
 
@@ -50,6 +51,9 @@ def test_collector_finds_all_knob_families():
          []),
         # non-knob env reads are ignored
         ('import os\nos.environ.get("STARK_SYNC_BLOCKS")\n', []),
+        # the scheduler knob IS covered
+        ('import os\nos.environ.get("STARK_RAGGED_NUTS", "0")\n',
+         ["STARK_RAGGED_NUTS"]),
     ],
 )
 def test_find_knob_reads(source, expect):

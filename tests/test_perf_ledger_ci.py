@@ -67,6 +67,35 @@ def test_synthetic_regression_fails(tmp_path):
     assert res.returncode == 0, res.stdout + res.stderr
 
 
+def test_nutssched_rows_committed():
+    """The ragged-NUTS scheduling series is part of the gated ledger: a
+    committed ``nutssched:*`` row exists, its newest entry passed the
+    bench's own gate with the claimed >=1.3x occupancy-adjusted speedup
+    and a strictly-better lane occupancy, and both fleet scheduler
+    variants (legacy depth-5 cap + ragged lifted-depth) are recorded."""
+    rows = [json.loads(l) for l in open(_LEDGER) if l.strip()]
+    sched = [r for r in rows if r["config"].startswith("nutssched:")]
+    assert sched, "committed ledger must carry a nutssched:* row"
+    newest = sched[-1]
+    assert newest["converged"] is True
+    assert newest["bit_identical"] is True
+    assert newest["speedup_vs_legacy"] >= 1.3
+    assert (
+        newest["lane_occupancy_ragged"] > newest["lane_occupancy_legacy"]
+    )
+    fleet_cfgs = {
+        r["config"] for r in rows
+        if r["config"].startswith("fleet:eight_schools:")
+    }
+    assert any(":sched=ragged:" in c for c in fleet_cfgs), (
+        "fleet ledger must record the ragged-scheduler (lifted depth cap) "
+        "variant"
+    )
+    assert any(":sched=ragged:" not in c for c in fleet_cfgs), (
+        "fleet ledger must keep the legacy depth-capped series too"
+    )
+
+
 def test_fresh_config_passes(tmp_path):
     """A config with no history must not fail CI (fresh ledgers pass)."""
     path = tmp_path / "ledger.jsonl"

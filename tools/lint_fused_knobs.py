@@ -1,20 +1,23 @@
 #!/usr/bin/env python
-"""Repo lint: every ``STARK_FUSED_*`` knob must be documented and tested.
+"""Repo lint: every kernel-execution env knob must be documented + tested.
 
 The fused-op layer grew a family of env knobs (the shared precision pair
-plus one boolean per likelihood family), each changing which executable
-evaluates every gradient of a run.  An undocumented knob is invisible to
-operators; an untested one can silently lose its autodiff fallback.
-This lint closes both loops statically:
+plus one boolean per likelihood family), and the kernel scheduler added
+``STARK_RAGGED_NUTS`` — each changes which executable evaluates every
+gradient (or how the batched loops schedule them) for a run.  An
+undocumented knob is invisible to operators; an untested one can
+silently lose its fallback path.  This lint closes both loops
+statically:
 
-1. AST-collect every ``STARK_FUSED_<NAME>`` string literal passed to an
-   env-read call (``os.environ.get`` / ``os.getenv`` / ``environ.pop`` /
+1. AST-collect every covered knob string literal (``STARK_FUSED_<NAME>``
+   or ``STARK_RAGGED_NUTS``) passed to an env-read call
+   (``os.environ.get`` / ``os.getenv`` / ``environ.pop`` /
    ``precision.fused_knob``) under ``stark_tpu/``.
-2. Fail if a collected knob is missing from the README zoo-coverage
-   table (the operator-facing contract), or
+2. Fail if a collected knob is missing from the README (the
+   operator-facing contract — the zoo-coverage table for fused knobs,
+   the "Ragged NUTS scheduling" section for the scheduler knob), or
 3. appears nowhere under ``tests/`` (every knob needs a test exercising
-   its fallback/retrace behavior — the per-op knob-off bit-identity and
-   precision-retrace tests reference the knob by name).
+   its fallback / knob-off bit-identity behavior by name).
 
 AST-based (strings in comments can't trip it); imports nothing from the
 package, so it runs anywhere.  Run directly or via
@@ -32,7 +35,9 @@ from typing import Dict, List, Set, Tuple
 #: call names whose string-literal argument is an env-knob read
 _READ_FUNCS = frozenset({"get", "getenv", "pop", "fused_knob"})
 
-_KNOB_RE = re.compile(r"^STARK_FUSED_[A-Z0-9_]+$")
+#: covered knobs: the fused-op family plus the kernel-scheduler knob —
+#: extend the alternation when a new execution-path knob family lands
+_KNOB_RE = re.compile(r"^STARK_(?:FUSED_[A-Z0-9_]+|RAGGED_NUTS)$")
 
 
 def _call_name(node: ast.Call) -> str:
@@ -98,8 +103,8 @@ def lint_repo(repo: str) -> List[str]:
     """Violation strings for the whole repo; empty = clean."""
     knobs = collect_knobs(os.path.join(repo, "stark_tpu"))
     if not knobs:
-        return ["no STARK_FUSED_* env reads found under stark_tpu/ — "
-                "the collector itself is broken"]
+        return ["no STARK_FUSED_*/STARK_RAGGED_NUTS env reads found under "
+                "stark_tpu/ — the collector itself is broken"]
     violations = []
     readme_path = os.path.join(repo, "README.md")
     readme = open(readme_path).read() if os.path.exists(readme_path) else ""
@@ -109,14 +114,14 @@ def lint_repo(repo: str) -> List[str]:
         if knob not in readme:
             violations.append(
                 f"{where}: {knob} is read but missing from the README "
-                "zoo-coverage table — document the knob (model, default, "
-                "parity band)"
+                "coverage docs — document the knob (zoo table for fused "
+                "knobs; Performance section for scheduler knobs)"
             )
         if knob not in tested:
             violations.append(
                 f"{where}: {knob} is read but referenced by no test under "
-                "tests/ — add an autodiff-fallback / retrace test that "
-                "names the knob"
+                "tests/ — add a fallback / knob-off bit-identity test "
+                "that names the knob"
             )
     return violations
 

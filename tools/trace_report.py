@@ -142,6 +142,28 @@ def render_run(events, run) -> str:
         ))
         out.append("")
 
+    # ragged-NUTS scheduling (STARK_RAGGED_NUTS): lane occupancy — the
+    # useful fraction of the gradient evaluations the batched block loop
+    # executed (1.0 = no lane-sync waste); present only on knob-on runs
+    ns = s.get("nutssched") or {}
+    if ns:
+        def _pct(v):
+            return None if v is None else f"{100.0 * v:.1f}%"
+
+        rows = [
+            ("step-synchronized (ragged)", ns.get("ragged")),
+            ("lane occupancy (last)", _pct(ns.get("occupancy_last"))),
+            ("lane occupancy (min)", _pct(ns.get("occupancy_min"))),
+            ("lane occupancy (mean)", _pct(ns.get("occupancy_mean"))),
+            ("scheduler iterations", ns.get("sched_iters_total")),
+            ("blocks accounted", ns.get("blocks")),
+        ]
+        out.append(_table(
+            [r for r in rows if r[1] is not None],
+            ("NUTS scheduling", "value"),
+        ))
+        out.append("")
+
     # fleet-sampling accounting (stark_tpu.fleet): batch occupancy /
     # convergence rollup plus a per-problem table from the
     # problem_converged events — which posterior finished when, at what
