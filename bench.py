@@ -890,14 +890,18 @@ def main():
                 row = res_row(r)
                 if (
                     leg_name.startswith("fused_vg_")
-                    or leg_name == "nutssched"
+                    or leg_name in ("nutssched", "fleet_eight_schools")
                 ) and not row["converged"]:
                     # a fused leg that fails its gate (broken kernel,
                     # lost speedup) must record null ess/s, NEVER 0.0 —
                     # same rule as a non-finite rate (ADVICE r5): the
                     # measured rates stay readable in the extra keys,
                     # but the gated value column can't drag the
-                    # trailing-median gate toward zero
+                    # trailing-median gate toward zero.  The fleet leg
+                    # joins the rule: a DEGRADED fleet (quarantined /
+                    # exhausted problems past the 95% gate) records its
+                    # degraded + lost_problems evidence, not a poisoned
+                    # aggregate value
                     row["value"] = None
                 extra_evidence.append(row)
                 if leg_name == "fleet_eight_schools":
@@ -1010,12 +1014,15 @@ _NUTSSCHED_EXTRA_KEYS = (
     "useful_per_draw",
 )
 
-#: fleet evidence keys (shared by the in-bench leg and row committers)
+#: fleet evidence keys (shared by the in-bench leg and row committers);
+#: degraded + lost_problems make a lossy (quarantine-degraded) fleet
+#: visible in its ledger row — such rows also fail the converged-
+#: fraction gate and therefore carry a null value (never 0.0)
 _FLEET_EXTRA_KEYS = (
     "converged_fraction", "speedup_vs_sequential",
     "speedup_vs_warm_sequential", "seq_per_job_ess_per_sec_est",
     "seq_warm_ess_per_sec_est", "fleet_grad_evals", "sched",
-    "max_tree_depth",
+    "max_tree_depth", "degraded", "lost_problems",
 )
 
 

@@ -37,7 +37,12 @@ del _prec, _explicit_skip
 from . import bijectors, compare, diagnostics
 from .model import Model, ParamSpec, flatten_model, prepare_model_data
 from .chees import chees_sample
-from .fleet import FleetSpec, sample_fleet, supervised_sample_fleet
+from .fleet import (
+    FleetSpec,
+    ProblemBudget,
+    sample_fleet,
+    supervised_sample_fleet,
+)
 from .runner import sample_until_converged
 from .sampler import Posterior, SamplerConfig, sample
 from .sghmc import sghmc_sample
@@ -58,6 +63,7 @@ __all__ = [
     "supervised_sample",
     "supervised_sample_fleet",
     "FleetSpec",
+    "ProblemBudget",
     "ChainHealthError",
     "Posterior",
     "SamplerConfig",

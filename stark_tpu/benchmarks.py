@@ -237,7 +237,14 @@ def bench_fleet_eight_schools(
         max_rhat=max_rhat,
         metric_name="aggregate min-ESS/s",
         # the fleet's own gate: a high-convergence fleet, not one lucky
-        # problem (max_rhat stays in the table as a diagnostic)
+        # problem (max_rhat stays in the table as a diagnostic).
+        # converged_fraction counts quarantined/budget-exhausted
+        # problems as NOT converged over the FULL denominator, and a
+        # quarantined problem's min_ess is None (never 0.0/NaN), so a
+        # degraded fleet fails this gate instead of silently shipping a
+        # shrunken aggregate — bench.py then records a null (not 0.0)
+        # value, keeping the trailing-median regression gate clean (the
+        # PR 7 null-not-0.0 convention).
         converged=conv_frac >= 0.95,
         gate=">=95% problems converged",
         extra={
@@ -246,6 +253,10 @@ def bench_fleet_eight_schools(
             "sched": "ragged" if ragged else "legacy",
             "max_tree_depth": max_tree_depth,
             "converged_fraction": round(conv_frac, 4),
+            # degraded completion (per-problem fault domains): recorded
+            # on every row so a lossy fleet is visible in the ledger
+            "degraded": res.degraded,
+            "lost_problems": len(res.lost_problems),
             "blocks_dispatched": res.blocks_dispatched,
             "compactions": res.compactions,
             "fleet_grad_evals": res.total_grad_evals,

@@ -35,6 +35,31 @@ Scenario matrix (`SCENARIOS`):
   clean_identity         failpoints disarmed: two runs are bit-identical
                          (the harness is a no-op when off)
 
+Fleet fault-domain scenarios (per-PROBLEM containment — stark_tpu.fleet):
+
+  fleet_lane_reseed      one lane's carried state goes NaN once: the lane
+                         is reseeded IN PLACE (attempt-folded key), every
+                         problem still converges, zero supervisor restarts
+  fleet_lane_quarantine  one lane is poisoned every block, past its
+                         restart budget: reseeded then QUARANTINED (store
+                         quarantined with the reason persisted), the
+                         surviving B-1 problems' draws bit-identical to
+                         the uninjected fleet, degraded=True + lost named
+  fleet_problem_deadline a slow fleet block + one problem's deadline_s
+                         budget: that problem exits budget_exhausted, the
+                         neighbors converge, nothing restarts
+  fleet_ckpt_corrupt_one one problem's draw store is torn at a checkpoint
+                         boundary, then the process crashes: the
+                         supervised resume quarantines THAT store (reason
+                         persisted), cold-restarts the one problem, and
+                         the fleet completes — one transient restart, no
+                         fleet-wide cold start
+  fleet_stall_watchdog   a hung fleet dispatch: the PR 2 watchdog (fed by
+                         the fleet's progress beats) aborts the attempt
+                         and the supervisor resumes the surviving active
+                         set — whole-fleet restart stays reserved for
+                         process-level faults like this one
+
 The drill models are tiny on purpose: the contracts under test are
 supervision mechanics, not posterior quality — every scenario finishes in
 seconds on one CPU.
@@ -332,6 +357,224 @@ def inflight_block_replay(workdir: str) -> Dict[str, Any]:
     )
     return {"restarts": 2, "resumed_block": first,
             "bit_identical": True}
+
+
+# -- fleet fault domains (stark_tpu.fleet): the problem, not the fleet, --
+# -- is the unit of failure ----------------------------------------------
+
+#: fleet drill settings: B=3 eight-schools variants, loose gates — the
+#: contracts under test are lane containment mechanics, not posteriors
+#: (hmc: the cheap compile; the NUTS fleet path has its own tests)
+_FLEET_KW = dict(
+    chains=2,
+    block_size=25,
+    max_blocks=8,
+    min_blocks=2,
+    num_warmup=100,
+    ess_target=40.0,
+    rhat_target=1.3,
+    kernel="hmc",
+    num_leapfrog=12,
+)
+
+
+#: ONE model instance across every fleet scenario: the fleet's compiled-
+#: parts cache is keyed on the model object, so sharing it means the
+#: matrix pays the warmup/block jit once instead of per scenario
+_FLEET_MODEL = None
+
+
+def _fleet_spec(n: int = 3, budgets=None):
+    from .fleet import FleetSpec
+    from .models.eight_schools import SIGMA, Y, EightSchools
+
+    global _FLEET_MODEL
+    if _FLEET_MODEL is None:
+        _FLEET_MODEL = EightSchools()
+    rng = np.random.default_rng(0)
+    y, sig = np.asarray(Y), np.asarray(SIGMA)
+    datasets = [
+        {"y": (y + rng.normal(0, 2.0, y.shape)).astype(np.float32),
+         "sigma": sig}
+        for _ in range(n)
+    ]
+    return FleetSpec.from_problems(_FLEET_MODEL, datasets,
+                                   budgets=budgets)
+
+
+def _fleet_metrics(workdir: str) -> List[Dict[str, Any]]:
+    with open(os.path.join(workdir, "fleet_metrics.jsonl")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@_scenario("fleet_lane_reseed")
+def fleet_lane_reseed(workdir: str) -> Dict[str, Any]:
+    """One lane's carried state goes non-finite ONCE: the per-lane scan
+    contains it — the lane is reseeded in place under an attempt-folded
+    key, every problem (including the reseeded one) converges, and the
+    supervisor never hears about it (zero restarts, not degraded)."""
+    from .fleet import sample_fleet
+
+    spec = _fleet_spec()
+    faults.configure("fleet.lane_nan=nan(1)*1")
+    res = sample_fleet(
+        spec, health_check=True, problem_max_restarts=2, seed=0,
+        metrics_path=os.path.join(workdir, "fleet_metrics.jsonl"),
+        **_FLEET_KW,
+    )
+    assert all(p.converged for p in res.problems), [
+        p.status for p in res.problems
+    ]
+    assert res.degraded is False and res.lost_problems == []
+    assert res.problems[1].lane_restarts == 1
+    reseeds = [
+        r for r in _fleet_metrics(workdir)
+        if r.get("event") == "problem_reseeded"
+    ]
+    assert len(reseeds) == 1 and reseeds[0]["problem_id"] == "p0001"
+    assert reseeds[0]["fault"] == "poisoned_state"
+    return {"reseeds": 1, "converged": True}
+
+
+@_scenario("fleet_lane_quarantine")
+def fleet_lane_quarantine(workdir: str) -> Dict[str, Any]:
+    """One lane is poisoned EVERY block — past its per-problem restart
+    budget it is quarantined (store quarantined, reason persisted), the
+    fleet completes degraded, and the surviving B-1 problems' draws are
+    BIT-IDENTICAL to the uninjected fleet (the headline fault-isolation
+    invariant)."""
+    from .fleet import sample_fleet
+
+    spec = _fleet_spec()
+    kw = dict(_FLEET_KW, seed=0, health_check=True, problem_max_restarts=1)
+    ref = sample_fleet(
+        spec, draw_store_path=os.path.join(workdir, "ref_draws"), **kw
+    )
+    faults.reset()
+    # @1: block 1 lands cleanly (the lane's store file exists before the
+    # poison), then every later block poisons the lane — reseed at block
+    # 2, quarantine at block 3
+    faults.configure("fleet.lane_nan=nan(1)@1")
+    store = os.path.join(workdir, "draws")
+    res = sample_fleet(
+        spec, draw_store_path=store,
+        metrics_path=os.path.join(workdir, "fleet_metrics.jsonl"), **kw
+    )
+    assert res.degraded is True and res.lost_problems == ["p0001"]
+    assert res.problems[1].status == "failed:poisoned_state"
+    assert res.problems[1].min_ess is None, "poisoned ESS leaked"
+    for a, b in zip(ref.problems, res.problems):
+        if a.problem_id != "p0001":
+            assert b.converged
+            np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+    # reseeded once (budget 1), quarantined on the second poison
+    lines = _fleet_metrics(workdir)
+    assert len([r for r in lines
+                if r.get("event") == "problem_reseeded"]) == 1
+    done = [r for r in lines if r.get("event") == "problem_done"
+            and r.get("problem_id") == "p0001"]
+    assert done and done[-1]["status"] == "failed:poisoned_state"
+    # the forensic copy + its reason sidecar are on disk
+    bad = glob.glob(os.path.join(store, "p_p0001.stkr.bad*"))
+    reasons = [p for p in bad if p.endswith(".reason.json")]
+    assert reasons, f"no persisted quarantine reason ({bad})"
+    with open(reasons[0]) as f:
+        reason = json.load(f)
+    assert "poisoned_state" in reason["reason"]
+    return {"lost": res.lost_problems, "survivors_bit_identical": True}
+
+
+@_scenario("fleet_problem_deadline")
+def fleet_problem_deadline(workdir: str) -> Dict[str, Any]:
+    """A slow fleet block (``fleet.lane_stall`` sleep) plus ONE
+    problem's tight ``deadline_s`` budget: that problem exits
+    budget_exhausted at the block boundary; the neighbors converge,
+    nothing restarts, and the fleet is NOT degraded (a tripped tenant
+    gate is a policy outcome, not a fault)."""
+    from .fleet import ProblemBudget, sample_fleet
+
+    spec = _fleet_spec(budgets=[ProblemBudget(deadline_s=0.05), None, None])
+    faults.configure("fleet.lane_stall=sleep(0.3)*1")
+    res = sample_fleet(
+        spec, seed=0,
+        metrics_path=os.path.join(workdir, "fleet_metrics.jsonl"),
+        **_FLEET_KW,
+    )
+    assert res.problems[0].status == "budget_exhausted"
+    assert not res.problems[0].converged
+    for p in res.problems[1:]:
+        assert p.converged, p.status
+    assert res.degraded is False
+    done = [r for r in _fleet_metrics(workdir)
+            if r.get("event") == "problem_done"
+            and r.get("problem_id") == "p0000"]
+    assert done and done[0]["status"] == "budget_exhausted"
+    assert done[0].get("deadline_s") == 0.05
+    return {"exhausted": "p0000", "degraded": False}
+
+
+@_scenario("fleet_ckpt_corrupt_one")
+def fleet_ckpt_corrupt_one(workdir: str) -> Dict[str, Any]:
+    """One problem's draw store is torn at a checkpoint boundary, then
+    the process crashes.  The supervised restart must contain the
+    artifact fault to THAT problem: its store is quarantined (reason
+    persisted), the problem cold-restarts against its lane budget, and
+    the fleet completes fully converged off ONE transient restart — the
+    other problems resume their saved lanes, never cold-starting."""
+    from .fleet import supervised_sample_fleet
+
+    spec = _fleet_spec()
+    faults.configure(
+        "fleet.ckpt_corrupt_one=corrupt*1@1; fleet.block.post=crash*1@1"
+    )
+    res = supervised_sample_fleet(
+        spec, workdir=workdir, max_restarts=2, reseed_on_restart=False,
+        seed=0, problem_max_restarts=1, **_FLEET_KW,
+    )
+    assert all(p.converged for p in res.problems), [
+        p.status for p in res.problems
+    ]
+    assert res.degraded is False
+    rs = _restarts(_metrics(workdir))
+    assert len(rs) == 1 and rs[0]["fault"] == "transient", rs
+    # the supervisor's default store path is workdir/draws.stkr — the
+    # fleet store makes it a DIRECTORY of per-problem files
+    bad = glob.glob(os.path.join(workdir, "draws.stkr", "p_*.stkr.bad*"))
+    stores = [p for p in bad if not p.endswith(".reason.json")]
+    reasons = [p for p in bad if p.endswith(".reason.json")]
+    assert len(stores) == 1, f"expected ONE quarantined store: {bad}"
+    assert reasons, "quarantine reason not persisted"
+    with open(reasons[0]) as f:
+        assert "corrupt_checkpoint" in json.load(f)["reason"]
+    reseeded = [p for p in res.problems if p.lane_restarts > 0]
+    assert len(reseeded) == 1, "exactly the torn problem reseeds"
+    return {"restarts": 1, "quarantined_stores": 1,
+            "reseeded": reseeded[0].problem_id}
+
+
+@_scenario("fleet_stall_watchdog")
+def fleet_stall_watchdog(workdir: str) -> Dict[str, Any]:
+    """A hung fleet dispatch: the watchdog — fed by the fleet's
+    per-block progress beats — aborts the attempt at the deadline and
+    the supervisor restarts from the fleet checkpoint, resuming the
+    surviving active set.  No human, no Ctrl-C."""
+    from .fleet import supervised_sample_fleet
+
+    spec = _fleet_spec()
+    faults.configure("fleet.block.pre=stall(60)*1@1")
+    t0 = time.monotonic()
+    res = supervised_sample_fleet(
+        spec, workdir=workdir, max_restarts=2, stall_timeout_s=3.0,
+        seed=0, **_FLEET_KW,
+    )
+    wall = time.monotonic() - t0
+    assert all(p.converged for p in res.problems)
+    rs = _restarts(_metrics(workdir))
+    assert len(rs) == 1 and rs[0]["fault"] == "stall", rs
+    assert wall < 45.0, (
+        f"watchdog did not break the 60s fleet stall (wall {wall:.0f}s)"
+    )
+    return {"restarts": 1, "wall_s": round(wall, 1)}
 
 
 @_scenario("clean_identity")

@@ -32,6 +32,18 @@ whole fleet:
     (`FleetDrawStore`), one fleet checkpoint carrying the active set,
     ``fleet_block`` / ``problem_converged`` / ``fleet_compact`` trace
     events, and per-problem fields in ``/status`` (stark_tpu.metrics).
+  * **Per-problem fault domains** — the PROBLEM, not the fleet, is the
+    unit of failure: the post-block finite scan runs per lane, a
+    poisoned lane is reseeded in place (attempt-folded key) up to its
+    `ProblemBudget.max_restarts`, then QUARANTINED (masked, artifacts
+    quarantined with the reason, terminal ``failed:poisoned_state``)
+    while the surviving B-1 lanes continue bit-identically; per-problem
+    ``ess_target`` / ``deadline_s`` budgets close their own gates
+    (``budget_exhausted``) without touching neighbors; and the fleet
+    completes DEGRADED (`FleetResult.degraded` + ``lost_problems``)
+    instead of dying with one tenant.  Whole-fleet restart — the PR 2
+    supervisor — is reserved for process-level faults (crash, stall,
+    corrupt fleet checkpoint).
 
 Determinism contract: every problem owns an independent host-side PRNG
 stream (``PRNGKey(seed + index)``) advanced with exactly the single-problem
@@ -66,6 +78,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -83,6 +96,8 @@ from .sampler import SamplerConfig, make_block_runner, make_warmup_parts
 Array = jax.Array
 PyTree = Any
 
+log = logging.getLogger("stark_tpu.fleet")
+
 #: env escape hatch: "0" forces the sequential single-problem path
 FLEET_ENV = "STARK_FLEET"
 
@@ -91,6 +106,37 @@ FLEET_ENV = "STARK_FLEET"
 #: problem's cold stream onto a neighbor's (see `_cold_key`)
 _RESEED_STRIDE = 1 << 20
 
+#: fold_in salt applied BEFORE the lane-restart ordinal when a poisoned
+#: lane is reseeded in place: lane-reseed streams must never alias the
+#: supervisor's attempt folds (`_cold_key` folds the bare attempt number)
+_LANE_RESEED_SALT = 0x51AB
+
+#: sequential-hatch twin of the lane-reseed fold: the single runner takes
+#: an int seed, so a lane retry shifts the problem's seed by a stride far
+#: outside any neighbor's ``seed + i`` lattice.  NOT a multiple of
+#: `_RESEED_STRIDE`: ``r * 2^34`` would alias problem ``i + r*2^14``'s
+#: reseeded base seed on fleets past 16384 problems — the +1 keeps every
+#: retry off both lattices
+_LANE_SEED_STRIDE = (1 << 34) + 1
+
+#: fault class a quarantined lane carries (matches supervise's taxonomy)
+_FAULT_POISONED = "poisoned_state"
+_FAULT_CORRUPT = "corrupt_checkpoint"
+
+
+def _status_string(failed, converged, budget_exhausted, *,
+                   default: str) -> str:
+    """The ONE terminal-status fold every reporter shares (results,
+    metrics JSONL, trace events): ``failed:<fault>`` wins, then
+    ``converged``, then ``budget_exhausted``, else ``default``."""
+    if failed:
+        return f"failed:{failed}"
+    if converged:
+        return "converged"
+    if budget_exhausted:
+        return "budget_exhausted"
+    return default
+
 
 # --------------------------------------------------------------------------
 # model contract: one shared Model, B stacked datasets
@@ -98,15 +144,64 @@ _RESEED_STRIDE = 1 << 20
 
 
 @dataclasses.dataclass(frozen=True)
+class ProblemBudget:
+    """Per-problem gate targets and fault budget — the per-tenant
+    contract ROADMAP item 2 lists and the item-1 control plane admits
+    jobs against.  ``None`` fields inherit the fleet-wide defaults
+    (`sample_fleet`'s ``ess_target`` / ``problem_max_restarts``; there is
+    no fleet-wide deadline default — a deadline is always a per-problem
+    decision).
+
+    * ``ess_target``   — this problem's convergence target.
+    * ``deadline_s``   — deadline on the run's CUMULATIVE sampling wall
+      (the fleet checkpoint persists elapsed wall, so supervised
+      restarts do not re-grant the window); a problem still active past
+      it exits ``budget_exhausted`` (masked like a converged one — it
+      never poisons neighbors), and on the sequential hatch the same
+      clamp bounds every attempt including `ChainHealthError` retries.
+    * ``max_restarts`` — in-place lane reseeds allowed before the
+      problem is QUARANTINED (terminal ``failed:poisoned_state``).
+    """
+
+    ess_target: Optional[float] = None
+    deadline_s: Optional[float] = None
+    max_restarts: Optional[int] = None
+
+    def __post_init__(self):
+        if self.deadline_s is not None and self.deadline_s < 0:
+            raise ValueError("deadline_s must be >= 0")
+        if self.max_restarts is not None and self.max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+
+    def resolve(self, ess_target: float, max_restarts: int):
+        """The ONE None-means-inherit fold both execution paths share:
+        -> (ess_target, deadline_s, max_restarts) with fleet defaults
+        filled in (there is no fleet-wide deadline default)."""
+        return (
+            float(self.ess_target) if self.ess_target is not None
+            else float(ess_target),
+            self.deadline_s,
+            self.max_restarts if self.max_restarts is not None
+            else int(max_restarts),
+        )
+
+
+_DEFAULT_BUDGET = ProblemBudget()
+
+
+@dataclasses.dataclass(frozen=True)
 class FleetSpec:
     """One shared `Model` + per-problem datasets with identical pytree
     structure and leaf shapes (the "shared structure, different data"
     contract).  ``problem_ids`` name the problems in every persisted
-    artifact (draw stores, checkpoints, trace events, /status)."""
+    artifact (draw stores, checkpoints, trace events, /status).
+    ``budgets`` (optional, aligned with ``datasets``; entries may be
+    None) carry per-problem `ProblemBudget` gate targets."""
 
     model: Model
     datasets: Tuple[PyTree, ...]
     problem_ids: Tuple[str, ...]
+    budgets: Optional[Tuple[Optional[ProblemBudget], ...]] = None
 
     def __post_init__(self):
         if not self.datasets:
@@ -118,6 +213,18 @@ class FleetSpec:
             )
         if len(set(self.problem_ids)) != len(self.problem_ids):
             raise ValueError("problem_ids must be unique")
+        if self.budgets is not None:
+            if len(self.budgets) != len(self.datasets):
+                raise ValueError(
+                    f"{len(self.budgets)} budgets for "
+                    f"{len(self.datasets)} datasets"
+                )
+            for i, b in enumerate(self.budgets):
+                if b is not None and not isinstance(b, ProblemBudget):
+                    raise ValueError(
+                        f"budgets[{i}] is {type(b).__name__}, expected "
+                        "ProblemBudget or None"
+                    )
         ref = jax.tree.structure(self.datasets[0])
         ref_shapes = [np.shape(a) for a in jax.tree.leaves(self.datasets[0])]
         for i, d in enumerate(self.datasets[1:], start=1):
@@ -141,10 +248,20 @@ class FleetSpec:
         model: Model,
         datasets: Sequence[PyTree],
         problem_ids: Optional[Sequence[str]] = None,
+        budgets: Optional[Sequence[Optional[ProblemBudget]]] = None,
     ) -> "FleetSpec":
         if problem_ids is None:
             problem_ids = [f"p{i:04d}" for i in range(len(datasets))]
-        return cls(model, tuple(datasets), tuple(str(p) for p in problem_ids))
+        return cls(
+            model, tuple(datasets), tuple(str(p) for p in problem_ids),
+            tuple(budgets) if budgets is not None else None,
+        )
+
+    def budget_for(self, i: int) -> ProblemBudget:
+        """Problem ``i``'s budget (an all-defaults one when unset)."""
+        if self.budgets is None or self.budgets[i] is None:
+            return _DEFAULT_BUDGET
+        return self.budgets[i]
 
     @classmethod
     def from_stacked(
@@ -186,11 +303,17 @@ class FleetSpec:
 class FleetProblemResult:
     """One problem's slice of a fleet run.  ``draws`` (constrained, named)
     is computed lazily through a fm-shared jit cache so a 256-problem
-    fleet does not pay 256 recompiles of the constrain map."""
+    fleet does not pay 256 recompiles of the constrain map.
+
+    ``failed`` (None when the problem was never quarantined) is the fault
+    class of a terminal quarantine — ``status`` folds the three terminal
+    outcomes into the one string the service layer reports per tenant:
+    ``converged`` / ``budget_exhausted`` / ``failed:<fault>``."""
 
     def __init__(self, problem_id, draws_flat, fm, *, converged,
                  budget_exhausted, blocks, grad_evals, num_divergent,
-                 min_ess, max_rhat, history, _constrain_cache):
+                 min_ess, max_rhat, history, _constrain_cache,
+                 failed=None, failed_reason=None, lane_restarts=0):
         self.problem_id = problem_id
         self.draws_flat = draws_flat  # (chains, n, d) unconstrained
         self.flat_model = fm
@@ -202,8 +325,18 @@ class FleetProblemResult:
         self.min_ess = min_ess
         self.max_rhat = max_rhat
         self.history = history
+        self.failed = failed
+        self.failed_reason = failed_reason
+        self.lane_restarts = lane_restarts
         self._cache = _constrain_cache
         self._draws = None
+
+    @property
+    def status(self) -> str:
+        return _status_string(
+            self.failed, self.converged, self.budget_exhausted,
+            default="incomplete",
+        )
 
     @property
     def draws(self) -> Dict[str, np.ndarray]:
@@ -249,13 +382,29 @@ class FleetResult:
 
     @property
     def converged_fraction(self) -> float:
+        """Converged over ALL problems: a quarantined or exhausted lane
+        counts as NOT converged — the denominator never shrinks."""
         if not self.problems:
             return 0.0
         return sum(p.converged for p in self.problems) / len(self.problems)
 
+    @property
+    def lost_problems(self) -> List[str]:
+        """problem_ids of terminally quarantined (``failed:*``) problems
+        — the fleet twin of degraded consensus's ``lost_shards``."""
+        return [p.problem_id for p in self.problems if p.failed]
+
+    @property
+    def degraded(self) -> bool:
+        """True when the fleet completed AROUND lost problems (any lane
+        was quarantined).  Budget-exhausted problems are a policy
+        outcome, not a fault — they do not degrade the fleet."""
+        return bool(self.lost_problems)
+
     def aggregate_min_ess(self) -> float:
         """Sum of per-problem min-ESS — the fleet throughput numerator
-        (aggregate min-ESS/s = this over the fleet wall)."""
+        (aggregate min-ESS/s = this over the fleet wall).  Quarantined
+        problems carry ``min_ess=None`` and contribute nothing."""
         vals = [p.min_ess for p in self.problems if p.min_ess is not None]
         return float(np.nansum(vals)) if vals else float("nan")
 
@@ -461,18 +610,39 @@ def _resolve_fleet_flag(fleet: Optional[bool]) -> bool:
 class _ProblemState:
     """Host-side bookkeeping for one problem (device state lives stacked
     in the batch arrays; this is everything per-problem the gate,
-    persistence, and resume need)."""
+    persistence, resume — and now the per-problem FAULT DOMAIN — need).
+
+    ``ess_target`` / ``deadline_s`` / ``max_restarts`` are the resolved
+    per-problem budget (spec budget, fleet default where unset);
+    ``lane_restarts`` counts in-place reseeds of this problem's lane,
+    and ``failed`` (a fault-class string) marks a terminal quarantine.
+    """
 
     __slots__ = (
         "idx", "pid", "key", "hist", "suff", "blocks_done",
         "next_full_check", "grad_evals", "total_div", "converged",
         "budget_exhausted", "history", "min_ess", "max_rhat",
+        "ess_target", "deadline_s", "max_restarts", "lane_restarts",
+        "failed", "failed_reason",
     )
 
-    def __init__(self, idx: int, pid: str, key, chains: int, ndim: int):
+    def __init__(self, idx: int, pid: str, key, chains: int, ndim: int, *,
+                 ess_target: float, deadline_s: Optional[float],
+                 max_restarts: int):
         self.idx = idx
         self.pid = pid
         self.key = key
+        self.ess_target = ess_target
+        self.deadline_s = deadline_s
+        self.max_restarts = max_restarts
+        self.lane_restarts = 0
+        self.failed: Optional[str] = None
+        self.failed_reason: Optional[str] = None
+        self.history: List[Dict[str, Any]] = []
+        self._reset(chains, ndim)
+
+    def _reset(self, chains: int, ndim: int) -> None:
+        """Cold-lane bookkeeping: everything a reseed discards."""
         self.hist = diagnostics.DrawHistory(chains, ndim)
         self.suff = diagnostics.ChainSuffStats(chains, ndim)
         self.blocks_done = 0
@@ -481,13 +651,28 @@ class _ProblemState:
         self.total_div = 0
         self.converged = False
         self.budget_exhausted = False
-        self.history: List[Dict[str, Any]] = []
         self.min_ess: Optional[float] = None
         self.max_rhat: Optional[float] = None
 
+    def reseed(self, key, chains: int, ndim: int) -> None:
+        """Cold-restart this problem's lane in place: discard its draws
+        and diagnostics, take the attempt-folded key.  ``lane_restarts``
+        is the one counter a reseed must NOT reset — it is the budget."""
+        self.key = key
+        self._reset(chains, ndim)
+
     @property
     def active(self) -> bool:
-        return not (self.converged or self.budget_exhausted)
+        return not (
+            self.converged or self.budget_exhausted or self.failed
+        )
+
+    @property
+    def status(self) -> str:
+        return _status_string(
+            self.failed, self.converged, self.budget_exhausted,
+            default="active",
+        )
 
     def meta(self) -> Dict[str, Any]:
         # only the LAST block record rides in the checkpoint: the full
@@ -505,6 +690,12 @@ class _ProblemState:
             "history_tail": self.history[-1:],
             "min_ess": self.min_ess,
             "max_rhat": self.max_rhat,
+            # fault-domain state: a quarantined lane STAYS quarantined
+            # across supervised restarts, and a resumed lane's reseed
+            # budget picks up where the crashed attempt left it
+            "lane_restarts": self.lane_restarts,
+            "failed": self.failed,
+            "failed_reason": self.failed_reason,
         }
 
     def load_meta(self, m: Dict[str, Any]) -> None:
@@ -517,6 +708,9 @@ class _ProblemState:
         self.history = list(m.get("history_tail", m.get("history", [])))
         self.min_ess = m.get("min_ess")
         self.max_rhat = m.get("max_rhat")
+        self.lane_restarts = int(m.get("lane_restarts", 0))
+        self.failed = m.get("failed")
+        self.failed_reason = m.get("failed_reason")
 
 
 def sample_fleet(spec: FleetSpec, data: Any = None, **kwargs) -> FleetResult:
@@ -555,6 +749,7 @@ def _sample_fleet(
     health_check: bool = False,
     reseed: Optional[int] = None,
     time_budget_s: Optional[float] = None,
+    problem_max_restarts: int = 1,
     stream_diag: Optional[bool] = None,
     diag_lags: Optional[int] = None,
     diag_components: int = 64,
@@ -583,12 +778,35 @@ def _sample_fleet(
 
     ``time_budget_s`` bounds the SAMPLING wall like the single runner:
     the run stops after the first block past the budget, marking the
-    still-active problems ``budget_exhausted``.
+    still-active problems ``budget_exhausted`` (a problem that already
+    converged is NEVER re-marked — its result is final).
+
+    **Per-problem fault domains.**  With ``health_check`` on, the
+    post-block finite scan runs PER LANE: a problem whose carried state
+    goes non-finite is reseeded in place (cold lane restart under an
+    attempt-folded key — `_LANE_RESEED_SALT` keeps the stream off every
+    neighbor's and off the supervisor's attempt folds) up to its
+    ``max_restarts`` budget (`ProblemBudget.max_restarts`, default
+    ``problem_max_restarts``), then QUARANTINED: masked like a converged
+    problem, its draw store quarantined with the reason persisted
+    (`supervise.quarantine_path`), terminal status
+    ``failed:poisoned_state`` — while the other B-1 lanes continue with
+    bit-identical draws to an uninjected fleet.  Whole-fleet restart is
+    reserved for process-level faults (crash / stall / corrupt FLEET
+    checkpoint); a single problem's corrupt draw store detected on
+    resume is likewise contained (quarantine + lane reseed).  Per-problem
+    ``deadline_s`` (wall since this run's start) trips a problem into
+    ``budget_exhausted`` without touching its neighbors.  The fleet then
+    completes DEGRADED: `FleetResult.degraded` / ``lost_problems`` name
+    what was lost (mirroring degraded consensus).
 
     Escape hatch: ``fleet=False`` (or ``STARK_FLEET=0``) and every B=1
     fleet run the problems sequentially through the unmodified
     `runner.sample_until_converged` — bit-identical artifacts to the
-    single-problem path.
+    single-problem path (per-problem budgets and `ChainHealthError`
+    containment are honored there too, but a reseeded lane's retry
+    stream differs from the vmapped path's fold — reseeds are a recovery
+    path, not part of the identity contract).
     """
     cfg = SamplerConfig(**cfg_kwargs)
     if cfg.kernel == "chees":
@@ -624,6 +842,7 @@ def _sample_fleet(
             health_check=health_check, reseed=reseed,
             time_budget_s=time_budget_s, stream_diag=stream_diag,
             diag_lags=diag_lags, diag_components=diag_components,
+            problem_max_restarts=problem_max_restarts,
             trace=trace, **cfg_kwargs,
         )
 
@@ -695,12 +914,32 @@ def _sample_fleet(
             k = jax.random.fold_in(k, reseed)
         return k
 
+    def _lane_key(i: int, restarts: int):
+        """Key for lane-reseed attempt ``restarts`` of problem ``i`` —
+        salted so it can never alias the problem's own cold stream, a
+        neighbor's, or any supervisor attempt fold."""
+        k = jax.random.fold_in(_cold_key(i), _LANE_RESEED_SALT)
+        return jax.random.fold_in(k, restarts)
+
+    def _budget_for(i: int):
+        ess, deadline, mr = spec.budget_for(i).resolve(
+            ess_target, problem_max_restarts
+        )
+        return dict(ess_target=ess, deadline_s=deadline, max_restarts=mr)
+
     probs = [
         _ProblemState(
             i, spec.problem_ids[i], _cold_key(i), chains, fm.ndim,
+            **_budget_for(i),
         )
         for i in range(B)
     ]
+
+    # cumulative sampling wall carried ACROSS supervised attempts (the
+    # fleet checkpoint persists it): per-problem deadline_s budgets are a
+    # tenant contract on total wall, so a crash-looping fleet must not
+    # re-grant every tenant a fresh deadline window per attempt
+    wall_offset = 0.0
 
     # device batch: lane j holds problem order[j]; converged lanes stay
     # (masked) until the next compaction
@@ -801,6 +1040,183 @@ def _sample_fleet(
         bdata = batch_data(order)
         flush_metrics()
 
+    def quarantine_problem(p: _ProblemState, fault: str, reason: str,
+                           quarantined_as: Optional[str] = None):
+        """Terminal per-problem quarantine: mask the lane like a
+        converged problem (the surviving B-1 continue untouched), move
+        its draw store aside with the REASON persisted
+        (`supervise.quarantine_path` + its ``.reason.json`` sidecar),
+        and record the loss everywhere a tenant's fate must be visible
+        — metrics JSONL, trace (``problem_quarantined``), and through
+        the collector /metrics + /status.  ``quarantined_as``: the
+        forensic copy's path when the caller already moved the store
+        (the resume corrupt-store path) — events must name it either
+        way."""
+        from .supervise import quarantine_path
+
+        p.failed = fault
+        p.failed_reason = reason
+        # a poisoned problem's diagnostics are not evidence: they must
+        # never leak into aggregate-ESS numerators or bench gates
+        p.min_ess = None
+        p.max_rhat = None
+        if store is not None and quarantined_as is None:
+            store.close_problem(p.pid)
+            path = store.path(p.pid)
+            if os.path.exists(path):
+                quarantined_as = quarantine_path(
+                    path, reason=f"{p.pid}: {fault}: {reason}"
+                )
+        log.warning(
+            "fleet problem %s quarantined (%s) after %d lane restart(s): "
+            "%s", p.pid, fault, p.lane_restarts, reason,
+        )
+        emit({
+            "event": "problem_done",
+            "problem_id": p.pid,
+            "status": p.status,
+            "fault": fault,
+            "reason": reason,
+            "lane_restarts": p.lane_restarts,
+            "blocks": p.blocks_done,
+            "quarantined_store": quarantined_as,
+            "wall_s": time.perf_counter() - t_start,
+        })
+        if trace.enabled:
+            trace.emit(
+                "problem_quarantined",
+                problem_id=p.pid,
+                status=p.status,
+                fault=fault,
+                reason=reason,
+                lane_restarts=p.lane_restarts,
+                quarantined_store=quarantined_as,
+            )
+
+    def reseed_problem(p: _ProblemState, fault: str, reason: str,
+                       quarantined_as: Optional[str] = None) -> bool:
+        """One lane fault: cold-restart the lane in place under an
+        attempt-folded key when restart budget remains (True), else
+        quarantine the problem (False).  The single-run analogue is the
+        supervisor's reseeded restart — scoped to ONE lane.
+        ``quarantined_as``: forensic copy of an already-quarantined
+        store (the resume corrupt-store path), named in the events."""
+        p.lane_restarts += 1
+        if p.lane_restarts > p.max_restarts:
+            quarantine_problem(p, fault, reason,
+                               quarantined_as=quarantined_as)
+            return False
+        if store is not None:
+            # the lane's persisted draws are discarded with the lane
+            # (close first: truncating under the open async writer races
+            # its write offset)
+            store.close_problem(p.pid)
+            store.truncate(p.pid, 0)
+        p.reseed(_lane_key(p.idx, p.lane_restarts), chains, fm.ndim)
+        log.warning(
+            "fleet problem %s lane reseeded (%s, restart %d/%d): %s",
+            p.pid, fault, p.lane_restarts, p.max_restarts, reason,
+        )
+        extra = (
+            {"quarantined_store": quarantined_as}
+            if quarantined_as else {}
+        )
+        emit({
+            "event": "problem_reseeded",
+            "problem_id": p.pid,
+            "fault": fault,
+            "reason": reason,
+            "lane_restarts": p.lane_restarts,
+            "max_restarts": p.max_restarts,
+            **extra,
+            "wall_s": time.perf_counter() - t_start,
+        })
+        if trace.enabled:
+            trace.emit(
+                "problem_reseeded",
+                problem_id=p.pid,
+                fault=fault,
+                reason=reason,
+                lane_restarts=p.lane_restarts,
+                max_restarts=p.max_restarts,
+                **extra,
+            )
+        return True
+
+    def finish_problem(p: _ProblemState, **extra):
+        """A problem reached a NON-FAULT terminal status (converged /
+        budget_exhausted): close its store file (no masked lane ever
+        appends again) and announce it."""
+        if store is not None:
+            store.close_problem(p.pid)
+        status = p.status
+        emit({
+            "event": "problem_done",
+            "problem_id": p.pid,
+            "status": status,
+            "blocks": p.blocks_done,
+            "draws_per_chain": int(p.suff.count[0]),
+            "grad_evals": p.grad_evals,
+            "min_ess": p.min_ess,
+            "max_rhat": p.max_rhat,
+            **extra,
+        })
+        if trace.enabled:
+            trace.emit(
+                "problem_converged",
+                problem_id=p.pid,
+                status=status,
+                blocks=p.blocks_done,
+                draws_per_chain=int(p.suff.count[0]),
+                grad_evals=p.grad_evals,
+                min_ess=p.min_ess,
+                max_rhat=p.max_rhat,
+                **extra,
+            )
+
+    def poison_lane_site(st):
+        """``fleet.lane_nan`` (action ``nan``, arg = problem ordinal,
+        default 0): NaN-fill ONE problem's lanes of the carried state —
+        the injection the B-1 bit-identity invariant is drilled
+        against.  An inactive/absent target fizzles (the shot is still
+        consumed, matching `kill_shards`)."""
+        act = faults.fail_point("fleet.lane_nan")
+        if act is None or act.kind != "nan":
+            return st
+        target = act.arg_int(0)
+        for j, i in enumerate(order):
+            if i == target and probs[i].active:
+                lane = jnp.asarray(j)
+
+                def bad(x, lane=lane):
+                    x = jnp.asarray(x)
+                    if jnp.issubdtype(x.dtype, jnp.floating):
+                        return x.at[lane].set(jnp.nan)
+                    return x
+
+                return jax.tree.map(bad, st)
+        return st
+
+    def corrupt_one_store_site():
+        """``fleet.ckpt_corrupt_one`` (action ``corrupt``): tear the
+        header of the FIRST ACTIVE problem's draw store right after the
+        checkpoint-boundary flush — per-problem-artifact bitrot, which
+        the per-problem resume path must detect and CONTAIN (quarantine
+        + lane reseed) instead of failing the fleet resume."""
+        act = faults.fail_point("fleet.ckpt_corrupt_one")
+        if act is None or act.kind != "corrupt" or store is None:
+            return
+        for i in order:
+            path = store.path(probs[i].pid)
+            if probs[i].active and os.path.exists(path):
+                with open(path, "r+b") as f:
+                    f.write(b"\xde\xad\xbe\xef" * 6)
+                log.warning(
+                    "failpoint fleet.ckpt_corrupt_one: tore the header "
+                    "of %s", path,
+                )
+                return
+
     # ---- resume or cold start --------------------------------------------
     # the handles above (metrics file, per-problem draw stores) are
     # closed by the block loop's finally; anything that raises BEFORE
@@ -837,17 +1253,56 @@ def _sample_fleet(
                 raise ValueError(
                     "checkpointed problem_ids differ from this FleetSpec"
                 )
+            from .supervise import quarantine_path
+
+            wall_offset = float(meta.get("elapsed_wall_s", 0.0))
             per_problem = meta["problems"]
             for p in probs:
                 p.load_meta(per_problem[p.pid])
             # draw histories: store wins (truncated to the accounted rows);
             # otherwise the checkpoint carries them inline
+            corrupt_cold: List[int] = []
             for p in probs:
                 accounted = int(per_problem[p.pid].get("draws", 0))
                 blk = None
                 if store is not None:
-                    store.truncate(p.pid, accounted)
-                    blk = store.read(p.pid)
+                    try:
+                        store.truncate(p.pid, accounted)
+                        blk = store.read(p.pid)
+                    except Exception as e:  # noqa: BLE001 — contained below
+                        # ONE problem's persisted draws are unreadable: a
+                        # per-problem artifact fault, not a fleet fault —
+                        # the store is quarantined with the reason and the
+                        # problem cold-restarts against its lane budget
+                        # (fleet.ckpt_corrupt_one drills this); the other
+                        # B-1 problems resume untouched
+                        reason = f"{type(e).__name__}: {e}"
+                        store.close_problem(p.pid)
+                        quarantined_as = None
+                        if os.path.exists(store.path(p.pid)):
+                            quarantined_as = quarantine_path(
+                                store.path(p.pid),
+                                reason=f"{p.pid}: {_FAULT_CORRUPT}: "
+                                       f"{reason}",
+                            )
+                        if p.active:
+                            if reseed_problem(
+                                p, _FAULT_CORRUPT, reason,
+                                quarantined_as=quarantined_as,
+                            ):
+                                corrupt_cold.append(p.idx)
+                        elif not p.failed:
+                            # a finished problem's draws are gone for
+                            # good: the fleet completes degraded around
+                            # it rather than re-serving proven work off
+                            # garbage bytes
+                            p.converged = False
+                            p.budget_exhausted = False
+                            quarantine_problem(
+                                p, _FAULT_CORRUPT, reason,
+                                quarantined_as=quarantined_as,
+                            )
+                        blk = None
                 elif f"draws_{p.pid}" in arrays:
                     blk = arrays[f"draws_{p.pid}"]
                 if blk is not None and blk.shape[1]:
@@ -855,21 +1310,30 @@ def _sample_fleet(
                     p.suff.update(np.asarray(blk))
             active_ids = list(meta["active_ids"])
             by_id = {p.pid: p for p in probs}
-            order = [by_id[a].idx for a in active_ids]
             keys = np.asarray(arrays["keys"])
-            for j, a in enumerate(active_ids):
+            # lanes to RESUME from the saved arrays: still-active
+            # problems whose stores survived (quarantined problems stay
+            # quarantined; corrupt-store ones cold-start via pending)
+            cold = set(corrupt_cold)
+            keep = [
+                j for j, a in enumerate(active_ids)
+                if by_id[a].active and by_id[a].idx not in cold
+            ]
+            order = [by_id[active_ids[j]].idx for j in keep]
+            for j in keep:
                 k = jnp.asarray(keys[j])
                 if reseed is not None:
                     k = jax.random.fold_in(k, reseed)
-                by_id[a].key = k
+                by_id[active_ids[j]].key = k
             if order:
+                ix = np.asarray(keep, dtype=np.int64)
                 state = HMCState(
-                    z=jnp.asarray(arrays["z"]),
-                    potential_energy=jnp.asarray(arrays["pe"]),
-                    grad=jnp.asarray(arrays["grad"]),
+                    z=jnp.asarray(arrays["z"][ix]),
+                    potential_energy=jnp.asarray(arrays["pe"][ix]),
+                    grad=jnp.asarray(arrays["grad"][ix]),
                 )
-                step_size = jnp.asarray(arrays["step_size"])
-                inv_mass = jnp.asarray(arrays["inv_mass"])
+                step_size = jnp.asarray(arrays["step_size"][ix])
+                inv_mass = jnp.asarray(arrays["inv_mass"][ix])
                 if stream_diag:
                     diag = init_diag_for(
                         order, [probs[i].hist for i in order],
@@ -880,9 +1344,10 @@ def _sample_fleet(
             # between full convergence and the next cohort's admission) —
             # leave state None so the pending top-up below takes the
             # cold-batch path instead of concatenating onto 0-lane arrays
+            in_batch = set(order)
             pending = [
                 p.idx for p in probs
-                if p.active and p.idx not in set(order)
+                if p.active and p.idx not in in_batch
             ]
             if pending:
                 # top the resumed batch back up to capacity (a crash may have
@@ -966,7 +1431,7 @@ def _sample_fleet(
         gate_pass = (
             n_stuck == 0
             and max_rhat < rhat_target
-            and min_ess > ess_target
+            and min_ess > p.ess_target
         )
         # same failpoint as the single runner's gate: a forced-optimistic
         # streaming signal sends the candidate stop to the full
@@ -989,7 +1454,7 @@ def _sample_fleet(
             rec["full_max_rank_rhat"] = float(
                 np.max(diagnostics.rank_rhat(full_draws))
             )
-            if full_rhat < rhat_target and full_ess > ess_target:
+            if full_rhat < rhat_target and full_ess > p.ess_target:
                 p.converged = True
                 p.min_ess = full_ess
                 p.max_rhat = full_rhat
@@ -1002,32 +1467,9 @@ def _sample_fleet(
         p.history.append(rec)
         emit(rec)
         if not p.active:
-            if store is not None:
-                # this problem's final block was appended above; no
-                # masked lane ever appends again, so its file is final
-                store.close_problem(p.pid)
-            status = "converged" if p.converged else "budget_exhausted"
-            emit({
-                "event": "problem_done",
-                "problem_id": p.pid,
-                "status": status,
-                "blocks": p.blocks_done,
-                "draws_per_chain": int(p.suff.count[0]),
-                "grad_evals": p.grad_evals,
-                "min_ess": p.min_ess,
-                "max_rhat": p.max_rhat,
-            })
-            if trace.enabled:
-                trace.emit(
-                    "problem_converged",
-                    problem_id=p.pid,
-                    status=status,
-                    blocks=p.blocks_done,
-                    draws_per_chain=int(p.suff.count[0]),
-                    grad_evals=p.grad_evals,
-                    min_ess=p.min_ess,
-                    max_rhat=p.max_rhat,
-                )
+            # this problem's final block was appended above; no masked
+            # lane ever appends again, so its store file is final
+            finish_problem(p)
 
     def save_fleet_checkpoint(path: str):
         from .checkpoint import save_checkpoint
@@ -1052,6 +1494,7 @@ def _sample_fleet(
                     arrays[f"draws_{p.pid}"] = p.hist.view()
         else:
             store.flush()
+            corrupt_one_store_site()
         if health_check:
             from .supervise import check_finite_state
 
@@ -1071,6 +1514,11 @@ def _sample_fleet(
                 "problem_ids": list(spec.problem_ids),
                 "active_ids": active_ids,
                 "problems": {p.pid: p.meta() for p in probs},
+                # cumulative wall including prior attempts: what resumed
+                # runs charge per-problem deadline_s budgets against
+                "elapsed_wall_s": (
+                    time.perf_counter() - t_start + wall_offset
+                ),
             },
         )
         if trace.enabled:
@@ -1124,18 +1572,28 @@ def _sample_fleet(
                 else:
                     state, zs, accept, divergent, _energy, ngrad = out
             state = faults.poison("runner.carried_nan", state)
+            state = poison_lane_site(state)
             blocks_dispatched += 1
 
             # --- host side ------------------------------------------------
             faults.fail_point("fleet.block.pre")
+            # a pathologically slow lane (``sleep`` action): the
+            # per-problem ``deadline_s`` budget is what turns the delay
+            # into a per-tenant outcome instead of a fleet-wide fate
+            faults.fail_point("fleet.lane_stall")
             t_blk = time.perf_counter()
             zs = np.asarray(zs)
             divergent_h = np.asarray(divergent)
             ngrad_h = np.asarray(ngrad)
             diag_h = jax.tree.map(np.asarray, diag) if stream_diag else None
             t_wait = time.perf_counter() - t_blk
+            # per-LANE finite scan: a poisoned lane is a PROBLEM fault,
+            # contained below (reseed-or-quarantine) — never a fleet
+            # fault.  Whole-fleet restart stays reserved for process-
+            # level faults (crash / stall / corrupt fleet checkpoint).
+            poisoned: List[Tuple[int, int, str]] = []
             if health_check:
-                from .supervise import check_finite_state
+                from .supervise import ChainHealthError, check_finite_state
 
                 # one device→host transfer per array for the WHOLE batch;
                 # the per-lane loop below only slices host memory
@@ -1147,18 +1605,24 @@ def _sample_fleet(
                 for j, i in enumerate(order):
                     if not probs[i].active:
                         continue  # masked lanes are not health-gated
-                    check_finite_state({
-                        "z": z_h[j],
-                        "pe": pe_h[j],
-                        "grad": grad_h[j],
-                        "step_size": ss_h[j],
-                        "inv_mass": im_h[j],
-                    })
+                    try:
+                        check_finite_state({
+                            "z": z_h[j],
+                            "pe": pe_h[j],
+                            "grad": grad_h[j],
+                            "step_size": ss_h[j],
+                            "inv_mass": im_h[j],
+                        })
+                    except ChainHealthError as e:
+                        poisoned.append((j, i, str(e)))
+            poisoned_idx = {i for _j, i, _r in poisoned}
             block_grads_active = 0
             for j, i in enumerate(order):
                 p = probs[i]
-                if not p.active:
-                    continue  # masked: draws discarded, grads not counted
+                if not p.active or i in poisoned_idx:
+                    # masked or poisoned: draws discarded, grads not
+                    # counted (a poisoned lane's block is not evidence)
+                    continue
                 blk_grads = int(ngrad_h[j].sum())
                 block_grads_active += blk_grads
                 diag_lane = (
@@ -1167,6 +1631,85 @@ def _sample_fleet(
                 )
                 gate_and_record(p, zs[j], divergent_h[j], blk_grads,
                                 diag_lane)
+
+            # --- lane containment -----------------------------------------
+            if poisoned:
+                rewarm_js: List[int] = []
+                rewarm_idx: List[int] = []
+                for j, i, reason in poisoned:
+                    if reseed_problem(probs[i], _FAULT_POISONED, reason):
+                        rewarm_js.append(j)
+                        rewarm_idx.append(i)
+                # cold-restart the reseeded lanes IN PLACE: one vmapped
+                # warmup dispatch per round, scattered back into their
+                # batch slots — every other lane's arrays (and key
+                # stream) are untouched, which is what keeps the B-1
+                # survivors bit-identical.  A lane whose REWARM itself
+                # comes back non-finite (a genuinely broken tenant
+                # posterior) burns its own restart budget right here, so
+                # poisoned state cannot reach the fleet checkpoint
+                # through the rewarm path either.
+                while rewarm_js:
+                    st, ss, im = warm_cohort(rewarm_idx)
+                    z_w = np.asarray(st.z)
+                    pe_w = np.asarray(st.potential_energy)
+                    g_w = np.asarray(st.grad)
+                    ss_w = np.asarray(ss)
+                    im_w = np.asarray(im)
+                    ok = [
+                        k for k in range(len(rewarm_idx))
+                        if all(
+                            np.all(np.isfinite(a[k]))
+                            for a in (z_w, pe_w, g_w, ss_w, im_w)
+                        )
+                    ]
+                    if ok:
+                        ix = jnp.asarray(
+                            [rewarm_js[k] for k in ok], dtype=jnp.int32
+                        )
+                        sub = jnp.asarray(ok, dtype=jnp.int32)
+                        state = jax.tree.map(
+                            lambda a, b: a.at[ix].set(b[sub]), state, st
+                        )
+                        step_size = step_size.at[ix].set(ss[sub])
+                        inv_mass = inv_mass.at[ix].set(im[sub])
+                        if stream_diag:
+                            ok_idx = [rewarm_idx[k] for k in ok]
+                            dg = init_diag_for(
+                                ok_idx,
+                                [probs[i].hist for i in ok_idx],
+                                st.z.dtype,
+                            )
+                            diag = jax.tree.map(
+                                lambda a, b: a.at[ix].set(b), diag, dg
+                            )
+                    retry_js: List[int] = []
+                    retry_idx: List[int] = []
+                    for k in range(len(rewarm_idx)):
+                        if k in ok:
+                            continue
+                        if reseed_problem(
+                            probs[rewarm_idx[k]], _FAULT_POISONED,
+                            "non-finite warmup state after lane reseed",
+                        ):
+                            retry_js.append(rewarm_js[k])
+                            retry_idx.append(rewarm_idx[k])
+                    rewarm_js, rewarm_idx = retry_js, retry_idx
+
+            # --- per-problem deadlines ------------------------------------
+            # charged against the CUMULATIVE wall (wall_offset restores
+            # prior attempts' elapsed time on resume)
+            now_wall = time.perf_counter() - t_start + wall_offset
+            for p in probs:
+                if (
+                    p.active and p.deadline_s is not None
+                    and now_wall > p.deadline_s
+                ):
+                    # the tenant's own gate target tripped: it exits
+                    # budget_exhausted, masked like a converged problem
+                    # — it never poisons (or restarts) its neighbors
+                    p.budget_exhausted = True
+                    finish_problem(p, deadline_s=p.deadline_s)
             n_active = sum(probs[i].active for i in order)
             occupancy = n_active / max(len(order), 1)
             occupancy_trail.append(occupancy)
@@ -1229,6 +1772,9 @@ def _sample_fleet(
                 order = [order[j] for j in keep]
                 bdata = batch_data(order) if order else None
                 refill = []
+                # a queued problem whose deadline already passed exits
+                # budget_exhausted at the gate above — never admit it
+                pending = [i for i in pending if probs[i].active]
                 if pending:
                     room = (
                         (max_batch - len(order))
@@ -1280,6 +1826,9 @@ def _sample_fleet(
             if not any(probs[i].active for i in order) and pending:
                 # whole batch finished without triggering a refill (e.g.
                 # refill_occupancy=0): start the next cohort fresh
+                pending = [i for i in pending if probs[i].active]
+                if not pending:
+                    break
                 state = step_size = inv_mass = diag = bdata = None
                 order = []
                 room = max_batch if max_batch is not None else len(pending)
@@ -1300,8 +1849,12 @@ def _sample_fleet(
             np.ascontiguousarray(p.hist.view()),
             fm,
             converged=p.converged,
+            # a converged (or quarantined) problem is never re-marked by
+            # a fleet-level time-budget trip — its terminal status is
+            # already decided
             budget_exhausted=p.budget_exhausted
-            or (fleet_budget_exhausted and not p.converged),
+            or (fleet_budget_exhausted and not p.converged
+                and not p.failed),
             blocks=p.blocks_done,
             grad_evals=p.grad_evals,
             num_divergent=p.total_div,
@@ -1309,10 +1862,14 @@ def _sample_fleet(
             max_rhat=p.max_rhat,
             history=p.history,
             _constrain_cache=constrain_cache,
+            failed=p.failed,
+            failed_reason=p.failed_reason,
+            lane_restarts=p.lane_restarts,
         )
         for p in probs
     ]
     total_grads = sum(p.grad_evals for p in probs)
+    lost = [p.pid for p in probs if p.failed]
     if trace.enabled:
         trace.emit(
             "run_end",
@@ -1324,6 +1881,8 @@ def _sample_fleet(
             compactions=compactions,
             fleet_grad_evals=total_grads,
             budget_exhausted=fleet_budget_exhausted,
+            degraded=bool(lost),
+            lost_problems=lost,
         )
     return FleetResult(
         results,
@@ -1353,7 +1912,7 @@ def _sample_fleet_sequential(
     chains, block_size, max_blocks, min_blocks, rhat_target, ess_target,
     seed, checkpoint_path, resume_from, metrics_path, draw_store_path,
     health_check, reseed, time_budget_s, stream_diag, diag_lags,
-    diag_components, trace,
+    diag_components, trace, problem_max_restarts=1,
     **cfg_kwargs,
 ) -> FleetResult:
     """The escape hatch: problems run one at a time through the
@@ -1368,13 +1927,74 @@ def _sample_fleet_sequential(
     quarantines the problem's orphaned draw store) — a supervised
     restart therefore continues the sweep from where the crash landed
     instead of re-running every problem from scratch.  B=1 passes the
-    caller's paths through untouched (the supervisor drives resume)."""
+    caller's paths through untouched (the supervisor drives resume).
+
+    Per-problem fault domains hold here too (B > 1): a
+    `ChainHealthError` out of one problem retries it under a far-shifted
+    seed (``_LANE_SEED_STRIDE`` — outside every neighbor's lattice) up
+    to its restart budget, then quarantines its artifacts and records it
+    ``failed:poisoned_state`` — the sweep continues either way.
+    Per-problem ``ess_target`` / ``deadline_s`` budgets are honored by
+    clamping each problem's gate target and time budget — re-derived per
+    attempt (retries included), with the sweep clock persisted across
+    supervised restarts in a ``<checkpoint_path>.sweep.json`` sidecar so
+    deadlines charge CUMULATIVE wall here too."""
     from .backends.jax_backend import JaxBackend
     from .runner import sample_until_converged
-    from .supervise import checkpoint_health, quarantine_path
+    from .supervise import (
+        ChainHealthError,
+        checkpoint_health,
+        quarantine_path,
+    )
 
     t0 = time.perf_counter()
     b = spec.num_problems
+    # cumulative sweep wall across supervised attempts: the vmapped path
+    # persists elapsed_wall_s in the fleet checkpoint; the hatch has no
+    # single checkpoint, so a sidecar next to checkpoint_path carries
+    # the sweep clock — per-problem deadline_s stays a contract on TOTAL
+    # wall under crash loops here too (the sweep-level time_budget_s
+    # needs no equivalent: the supervisor already hands each attempt the
+    # reduced remainder)
+    sweep_sidecar = (
+        checkpoint_path + ".sweep.json"
+        if (checkpoint_path and b > 1) else None
+    )
+    sweep_offset = 0.0
+    if sweep_sidecar and os.path.exists(sweep_sidecar):
+        # the clock only carries over into a sweep that actually RESUMES
+        # prior work (some per-problem checkpoint survives the crash) —
+        # otherwise the sidecar is stale state from an earlier sweep in
+        # this workdir and must not pre-charge fresh tenants' deadlines
+        resuming = any(
+            os.path.exists(_problem_path(checkpoint_path, pid, b))
+            for pid in spec.problem_ids
+        )
+        if resuming:
+            try:
+                with open(sweep_sidecar) as f:
+                    sweep_offset = float(
+                        json.load(f).get("elapsed_wall_s", 0.0)
+                    )
+            except (OSError, ValueError):
+                sweep_offset = 0.0
+        else:
+            try:
+                os.unlink(sweep_sidecar)
+            except OSError:
+                pass
+
+    def sweep_wall() -> float:
+        return time.perf_counter() - t0 + sweep_offset
+
+    def persist_sweep_wall() -> None:
+        if sweep_sidecar:
+            try:
+                with open(sweep_sidecar, "w") as f:
+                    json.dump({"elapsed_wall_s": sweep_wall()}, f)
+            except OSError as e:  # the clock is advisory, never fatal
+                log.warning("could not persist sweep clock: %s", e)
+
     # one backend across the whole sweep: the runner caches compiled
     # segments per (model, cfg) on the instance, so problems 2..B skip
     # the re-jit (the steady-state serving loop, and what keeps the
@@ -1384,14 +2004,41 @@ def _sample_fleet_sequential(
     constrain_cache: Dict[Any, Any] = {}
     budget_hit = False
     total_grads = 0
+    fm = flatten_model(spec.model)
+
+    def empty_result(pid, *, budget_exhausted=False, failed=None,
+                     failed_reason=None, lane_restarts=0):
+        return FleetProblemResult(
+            pid,
+            np.zeros((chains, 0, fm.ndim), np.float32),
+            fm,
+            converged=False,
+            budget_exhausted=budget_exhausted,
+            blocks=0,
+            grad_evals=0,
+            num_divergent=0,
+            min_ess=None,
+            max_rhat=None,
+            history=[],
+            _constrain_cache=constrain_cache,
+            failed=failed,
+            failed_reason=failed_reason,
+            lane_restarts=lane_restarts,
+        )
 
     for i, (pid, data_p) in enumerate(zip(spec.problem_ids, spec.datasets)):
-        remaining = None
-        if time_budget_s is not None:
-            remaining = time_budget_s - (time.perf_counter() - t0)
-            if remaining <= 0:
-                budget_hit = True
-                break
+        # checkpoint the sweep clock at problem granularity (the same
+        # unit the hatch's crash-resume accounts in)
+        persist_sweep_wall()
+        p_budget = spec.budget_for(i)
+        ess_i, deadline_i, mr_i = p_budget.resolve(
+            ess_target, problem_max_restarts
+        )
+        if time_budget_s is not None and (
+            time.perf_counter() - t0 >= time_budget_s
+        ):
+            budget_hit = True
+            break
         ckpt_p = _problem_path(checkpoint_path, pid, b)
         resume_p = _problem_path(resume_from, pid, b)
         store_p = _problem_path(draw_store_path, pid, b)
@@ -1403,7 +2050,7 @@ def _sample_fleet_sequential(
                 if healthy:
                     resume_p = ckpt_p
                 else:
-                    quarantine_path(ckpt_p)
+                    quarantine_path(ckpt_p, reason=_reason)
             if (
                 resume_p is None
                 and store_p
@@ -1422,31 +2069,126 @@ def _sample_fleet_sequential(
             # fixes on the vmapped path); spreading the problems keeps
             # every attempt bump inside a problem's private seed range
             seed_i = seed + i * _RESEED_STRIDE
-        res = sample_until_converged(
-            spec.model,
-            data_p,
-            backend=backend,
-            chains=chains,
-            block_size=block_size,
-            max_blocks=max_blocks,
-            min_blocks=min_blocks,
-            rhat_target=rhat_target,
-            ess_target=ess_target,
-            seed=seed_i,
-            checkpoint_path=ckpt_p,
-            resume_from=resume_p,
-            metrics_path=_problem_path(metrics_path, pid, b),
-            draw_store_path=store_p,
-            health_check=health_check,
-            reseed=reseed,
-            time_budget_s=remaining,
-            stream_diag=stream_diag,
-            diag_lags=diag_lags,
-            diag_components=diag_components,
-            adaptive_blocks=False,
-            trace=trace,
-            **cfg_kwargs,
-        )
+        res = None
+        fault_reason = None
+        faults_seen = 0
+        lane_restarts = 0
+        stopped = None  # "sweep" | "deadline" budget stop mid-retries
+        for r in range(mr_i + 1):
+            # the budget clamp is re-derived per ATTEMPT, retries
+            # included: a ChainHealthError retry must never re-grant a
+            # tenant its original deadline window (or outrun the sweep
+            # budget) — the clocks keep running across recovery
+            now = time.perf_counter() - t0
+            remaining = None
+            if time_budget_s is not None:
+                if time_budget_s - now <= 0:
+                    stopped = "sweep"
+                    break
+                remaining = time_budget_s - now
+            if deadline_i is not None:
+                # deadlines charge the CUMULATIVE sweep wall (restored
+                # from the sidecar), not this attempt's
+                dl_left = deadline_i - sweep_wall()
+                if dl_left <= 0:
+                    stopped = "deadline"
+                    break
+                remaining = dl_left if remaining is None else min(
+                    remaining, dl_left
+                )
+            try:
+                res = sample_until_converged(
+                    spec.model,
+                    data_p,
+                    backend=backend,
+                    chains=chains,
+                    block_size=block_size,
+                    max_blocks=max_blocks,
+                    min_blocks=min_blocks,
+                    rhat_target=rhat_target,
+                    ess_target=ess_i,
+                    seed=seed_i + r * _LANE_SEED_STRIDE,
+                    checkpoint_path=ckpt_p,
+                    resume_from=resume_p,
+                    metrics_path=_problem_path(metrics_path, pid, b),
+                    draw_store_path=store_p,
+                    health_check=health_check,
+                    reseed=reseed,
+                    time_budget_s=remaining,
+                    stream_diag=stream_diag,
+                    diag_lags=diag_lags,
+                    diag_components=diag_components,
+                    adaptive_blocks=False,
+                    trace=trace,
+                    **cfg_kwargs,
+                )
+                lane_restarts = r
+                break
+            except ChainHealthError as e:
+                if b == 1:
+                    # the supervisor owns the single-problem fault story
+                    raise
+                # per-problem fault domain on the sequential path too:
+                # quarantine the poisoned attempt's artifacts (the reason
+                # rides the forensic copy) and retry under a seed shifted
+                # far outside every neighbor's lattice
+                faults_seen = r + 1
+                fault_reason = str(e)
+                log.warning(
+                    "sequential fleet problem %s poisoned "
+                    "(restart %d/%d): %s", pid, r + 1, mr_i, e,
+                )
+                for path in (ckpt_p, store_p):
+                    if path and os.path.exists(path):
+                        quarantine_path(
+                            path,
+                            reason=f"{pid}: {_FAULT_POISONED}: {e}",
+                        )
+                resume_p = None
+                # same observable as the vmapped path's lane reseed:
+                # the collector's fleet_lane_reseeds_total / /status
+                # last_reseeded must move on the hatch too
+                if faults_seen <= mr_i and trace.enabled:
+                    trace.emit(
+                        "problem_reseeded",
+                        problem_id=pid,
+                        fault=_FAULT_POISONED,
+                        reason=fault_reason,
+                        lane_restarts=faults_seen,
+                        max_restarts=mr_i,
+                    )
+        if res is None:
+            if stopped == "deadline":
+                # the tenant's own clock ran out (possibly mid-retries):
+                # a budget outcome, NOT a quarantine — faults_seen keeps
+                # the honest count of restarts actually consumed
+                results.append(empty_result(
+                    pid, budget_exhausted=True,
+                    lane_restarts=faults_seen,
+                ))
+                continue
+            if stopped == "sweep":
+                # the FLEET budget cut this problem off before its retry
+                # budget was spent: the tail marks it (and every problem
+                # after it) budget_exhausted — never failed
+                budget_hit = True
+                break
+            # retries exhausted on faults: terminal quarantine, with the
+            # true fault count (every attempt faulted: mr_i + 1)
+            results.append(empty_result(
+                pid, failed=_FAULT_POISONED,
+                failed_reason=fault_reason, lane_restarts=faults_seen,
+            ))
+            if trace.enabled:
+                trace.emit(
+                    "problem_quarantined",
+                    problem_id=pid,
+                    status=f"failed:{_FAULT_POISONED}",
+                    fault=_FAULT_POISONED,
+                    reason=fault_reason,
+                    lane_restarts=faults_seen,
+                )
+            continue
         grad_evals = int(sum(
             r.get("block_grad_evals", 0)
             for r in res.history
@@ -1454,16 +2196,22 @@ def _sample_fleet_sequential(
         ))
         total_grads += grad_evals
         last = res.history[-1] if res.history else {}
+        n_blocks = len(
+            [r for r in res.history if r.get("event") == "block"]
+        )
         results.append(
             FleetProblemResult(
                 pid,
                 res.draws_flat,
                 res.flat_model,
                 converged=res.converged,
-                budget_exhausted=res.budget_exhausted,
-                blocks=len(
-                    [r for r in res.history if r.get("event") == "block"]
+                # max_blocks exhaustion IS a budget outcome (the vmapped
+                # path's taxonomy) — the single runner only flags TIME
+                # budget trips itself
+                budget_exhausted=res.budget_exhausted or (
+                    not res.converged and n_blocks >= max_blocks
                 ),
+                blocks=n_blocks,
                 grad_evals=grad_evals,
                 num_divergent=int(np.sum(
                     res.sample_stats.get("num_divergent", 0)
@@ -1472,31 +2220,24 @@ def _sample_fleet_sequential(
                 max_rhat=last.get("full_max_rhat", last.get("max_rhat")),
                 history=res.history,
                 _constrain_cache=constrain_cache,
+                lane_restarts=lane_restarts,
             )
         )
+    # the sweep RETURNED (converged, exhausted, or budget-stopped — all
+    # terminal): the clock has served its purpose, and leaving it would
+    # pre-charge the next logical sweep in this workdir
+    if sweep_sidecar and os.path.exists(sweep_sidecar):
+        try:
+            os.unlink(sweep_sidecar)
+        except OSError:
+            pass
     if len(results) < b:
         # budget stop mid-sweep: problems never attempted still appear in
         # the result (empty draws, budget_exhausted) — the fleet path
         # reports every problem, and converged_fraction must count the
         # unserved ones, not silently shrink its denominator
-        fm = flatten_model(spec.model)
         for pid in spec.problem_ids[len(results):]:
-            results.append(
-                FleetProblemResult(
-                    pid,
-                    np.zeros((chains, 0, fm.ndim), np.float32),
-                    fm,
-                    converged=False,
-                    budget_exhausted=True,
-                    blocks=0,
-                    grad_evals=0,
-                    num_divergent=0,
-                    min_ess=None,
-                    max_rhat=None,
-                    history=[],
-                    _constrain_cache=constrain_cache,
-                )
-            )
+            results.append(empty_result(pid, budget_exhausted=True))
     return FleetResult(
         results,
         wall_s=time.perf_counter() - t0,
@@ -1512,6 +2253,7 @@ def supervised_sample_fleet(
     spec: FleetSpec,
     *,
     workdir: str,
+    stall_timeout_s: Optional[float] = None,
     **kwargs,
 ) -> FleetResult:
     """Run `sample_fleet` under the PR 2 supervision machinery
@@ -1519,7 +2261,18 @@ def supervised_sample_fleet(
     restart budget, fault taxonomy, backoff, watchdog, checkpoint health
     gating.  A crash mid-fleet resumes the SURVIVING ACTIVE SET from the
     fleet checkpoint — finished problems' draws are already durable and
-    are never re-sampled."""
+    are never re-sampled, and QUARANTINED problems stay quarantined
+    (their terminal status rides the checkpoint meta).
+
+    ``stall_timeout_s`` arms the PR 2 watchdog around every fleet
+    attempt: the fleet's block loop feeds `telemetry.notify_progress`
+    beats from every warmup segment and every per-problem block record,
+    so a hung fleet dispatch is aborted (`StallError`) and restarted
+    like any other process-level fault — pick it larger than one
+    vmapped dispatch including compile.  Supervision restarts stay
+    WHOLE-FLEET by design (process-level faults); per-problem faults
+    are contained below, inside `sample_fleet`, and never reach the
+    supervisor."""
     from .supervise import supervised_sample
 
     def _runner(spec_, data_, **kw):
@@ -1527,5 +2280,6 @@ def supervised_sample_fleet(
         return sample_fleet(spec_, **kw)
 
     return supervised_sample(
-        spec, None, workdir=workdir, _runner=_runner, **kwargs
+        spec, None, workdir=workdir, stall_timeout_s=stall_timeout_s,
+        _runner=_runner, **kwargs
     )

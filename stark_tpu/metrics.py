@@ -379,11 +379,21 @@ class TraceCollector:
         self.fleet_problems_done = r.counter(
             f"{p}_fleet_problems_done_total",
             "fleet problems finished, by status label "
-            "(converged/budget_exhausted)",
+            "(converged/budget_exhausted/failed:<fault>)",
         )
         self.fleet_compactions = r.counter(
             f"{p}_fleet_compactions_total",
             "fleet batch compaction/refill events",
+        )
+        self.fleet_lane_reseeds = r.counter(
+            f"{p}_fleet_lane_reseeds_total",
+            "fleet lanes cold-restarted in place after a per-lane fault "
+            "(the contained form of poisoned_state)",
+        )
+        self.fleet_quarantined = r.counter(
+            f"{p}_fleet_problems_quarantined_total",
+            "fleet problems terminally quarantined past their restart "
+            "budget (the fleet completes degraded around them)",
         )
         self.device_idle_s = r.counter(
             f"{p}_device_idle_seconds_total",
@@ -462,6 +472,12 @@ class TraceCollector:
         self.g_fleet_converged = r.gauge(
             f"{p}_fleet_problems_converged",
             "fleet problems that passed full convergence validation",
+        )
+        self.g_fleet_degraded = r.gauge(
+            f"{p}_fleet_degraded",
+            "1 once any problem of the current fleet run was quarantined "
+            "(degraded completion; per-problem loss, NOT process "
+            "unhealth — /healthz stays 200)",
         )
         self.g_lane_occupancy = r.gauge(
             f"{p}_nuts_lane_occupancy",
@@ -550,8 +566,11 @@ class TraceCollector:
         else:
             # fresh run in this process (bench runs several legs): reset
             # attempt and clear the previous run's progress/health so
-            # /status never reports run A's draws as run B's
+            # /status never reports run A's draws as run B's (a restart
+            # retry keeps them — including degraded state: quarantines
+            # survive supervised restarts by design)
             self.g_attempt.set(1.0)
+            self.g_fleet_degraded.set(0.0)
             self._set_status(
                 phase="starting", run=rec.get("run", 0), meta=meta,
                 block=None, draws_per_chain=None, ess_forecast=None,
@@ -675,10 +694,58 @@ class TraceCollector:
         }
         with self._lock:
             self._status["fleet"]["last_done"] = done
-            self._status["fleet"]["problems_done"] = int(
-                self.fleet_problems_done.value(status="converged")
-                + self.fleet_problems_done.value(status="budget_exhausted")
+            self._status["fleet"]["problems_done"] = (
+                self._fleet_problems_done_total()
             )
+
+    def _on_problem_reseeded(self, rec: Dict[str, Any]) -> None:
+        """A lane fault was CONTAINED: one problem cold-restarted in
+        place.  Recovery, not unhealth — RunHealth never trips."""
+        self.fleet_lane_reseeds.inc()
+        seen = {
+            k: rec[k]
+            for k in ("problem_id", "fault", "lane_restarts",
+                      "max_restarts")
+            if rec.get(k) is not None
+        }
+        with self._lock:
+            self._status["fleet"]["last_reseeded"] = seen
+            self._status["fleet"]["lane_reseeds"] = int(
+                self.fleet_lane_reseeds.value()
+            )
+
+    def _on_problem_quarantined(self, rec: Dict[str, Any]) -> None:
+        """A problem was terminally lost: the fleet is DEGRADED but the
+        process is healthy — /healthz stays 200, /status carries the
+        loss (503 is reserved for process-level unhealth: stalls,
+        restarts in progress, budget exhaustion)."""
+        status = str(rec.get("status", "failed:unknown"))
+        self.fleet_problems_done.inc(status=status)
+        self.fleet_quarantined.inc()
+        self.g_fleet_degraded.set(1.0)
+        lost_rec = {
+            k: rec[k]
+            for k in ("problem_id", "fault", "reason", "lane_restarts",
+                      "quarantined_store")
+            if rec.get(k) is not None
+        }
+        with self._lock:
+            fl = self._status["fleet"]
+            fl["degraded"] = True
+            lost = fl.setdefault("lost_problems", [])
+            if rec.get("problem_id") is not None:
+                lost.append(rec["problem_id"])
+            fl["last_quarantined"] = lost_rec
+            fl["problems_done"] = self._fleet_problems_done_total()
+
+    def _fleet_problems_done_total(self) -> int:
+        """Every terminal outcome a fleet problem can reach — the ONE
+        sum both terminal-event handlers report as problems_done."""
+        return int(
+            self.fleet_problems_done.value(status="converged")
+            + self.fleet_problems_done.value(status="budget_exhausted")
+            + self.fleet_quarantined.value()
+        )
 
     def _on_fleet_compact(self, rec: Dict[str, Any]) -> None:
         self.fleet_compactions.inc()

@@ -19,7 +19,14 @@ Endpoints:
     in progress; recovers to 200 at the next attempt's ``run_start``;
     sticky 503 once the restart budget is exhausted.  The deadman logic
     lives in `metrics.RunHealth`, driven by the same trace events the
-    supervisor emits.
+    supervisor emits.  **Degraded-fleet policy**: a fleet that loses
+    problems (lane quarantines — ``problem_quarantined`` events) is a
+    PER-TENANT loss, not process unhealth — /healthz stays 200, and the
+    degradation is surfaced in ``/status``'s ``fleet`` sub-object
+    (``degraded``, ``lost_problems``, ``last_quarantined``) and the
+    ``*_fleet_degraded`` / ``*_fleet_problems_quarantined_total``
+    metrics; 503 stays reserved for process-level unhealth (stall,
+    restart in progress, restart budget exhausted).
   * ``GET /status``   — JSON snapshot: current phase, block index, ESS
     progress/forecast, attempt number, restart record, run metadata
     (model/kernel/chains + provenance).

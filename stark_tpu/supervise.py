@@ -202,16 +202,35 @@ class RestartBudget:
         return self.in_window(now) > self.max_restarts
 
 
-def quarantine_path(path: str) -> None:
+def quarantine_path(path: str, reason: Optional[str] = None) -> str:
     """Move a bad artifact aside as ``path.bad`` / ``path.badN``:
     numbered suffixes so a second quarantine in the same workdir never
-    overwrites the forensic copy of an earlier failure."""
+    overwrites the forensic copy of an earlier failure.
+
+    ``reason`` (optional) is persisted next to the forensic copy as
+    ``<dst>.reason.json`` — the fleet's per-problem quarantines use it so
+    WHY an artifact was discarded survives the process that discarded it
+    (the log and trace carry it too, but those are per-run).  Returns the
+    destination path."""
     dst = path + ".bad"
     n = 1
     while os.path.exists(dst):
         n += 1
         dst = f"{path}.bad{n}"
     os.replace(path, dst)
+    if reason is not None:
+        try:
+            with open(dst + ".reason.json", "w") as f:
+                json.dump(
+                    {"path": path, "quarantined_as": dst,
+                     "reason": reason, "ts": time.time()},
+                    f,
+                )
+                f.write("\n")
+        except OSError as e:  # noqa: PERF203 — forensics are best-effort
+            log.warning("could not persist quarantine reason for %s: %s",
+                        dst, e)
+    return dst
 
 
 def _ranks_agree(all_done) -> bool:

@@ -103,9 +103,16 @@ AUX_EVENT_TYPES = frozenset({"progress", "adapt", "budget", "collect",
 #: accounting); ``problem_converged`` — one problem finished (status
 #: "converged" or "budget_exhausted", with its per-problem totals);
 #: ``fleet_compact`` — converged lanes were compacted out of the batch
-#: (and the batch refilled from the pending queue)
+#: (and the batch refilled from the pending queue); ``problem_reseeded``
+#: — one problem's lane went non-finite and was cold-restarted in place
+#: with an attempt-folded key (the neighbors never notice);
+#: ``problem_quarantined`` — a problem exhausted its per-problem restart
+#: budget (or its persisted draws were corrupt on resume) and was masked
+#: out terminally, its artifacts quarantined with the reason — the fleet
+#: completes DEGRADED around it
 FLEET_EVENT_TYPES = frozenset({"fleet_block", "problem_converged",
-                               "fleet_compact"})
+                               "fleet_compact", "problem_reseeded",
+                               "problem_quarantined"})
 
 #: the complete WRITER registry: every emit()/phase() call in stark_tpu/
 #: must use one of these names (tools/lint_trace_schema.py enforces it)
@@ -645,7 +652,9 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                                                  # accounting, when emitted
          "fleet": {"problems", "blocks", "occupancy_last", "active_last",
                    "batch_last", "grad_evals", "problems_converged",
-                   "problems_budget_exhausted",
+                   "problems_budget_exhausted", "problems_quarantined",
+                   "lane_reseeds", "degraded",
+                   "lost_problems",
                    "compactions"} | {},          # fleet-sampling events
                                                  # (stark_tpu.fleet), when
                                                  # the run emitted them
@@ -744,10 +753,23 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                 else "problems_budget_exhausted"
             )
             fleet[key] = fleet.get(key, 0) + 1
+        elif ev == "problem_reseeded":
+            fleet["lane_reseeds"] = fleet.get("lane_reseeds", 0) + 1
+        elif ev == "problem_quarantined":
+            fleet["problems_quarantined"] = (
+                fleet.get("problems_quarantined", 0) + 1
+            )
+            fleet.setdefault("lost_problems", []).append(
+                e.get("problem_id")
+            )
         elif ev == "fleet_compact":
             fleet["compactions"] = fleet.get("compactions", 0) + 1
         elif ev == "run_start" and e.get("problems") is not None:
             fleet["problems"] = e["problems"]
+        elif ev == "run_end" and e.get("degraded") is not None and (
+            fleet or e.get("problems") is not None
+        ):
+            fleet["degraded"] = bool(e["degraded"])
         if ev == "sample_block":
             for k in ("t_host_hidden_s", "device_idle_s", "t_wait_s"):
                 if e.get(k) is not None:

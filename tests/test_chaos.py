@@ -22,8 +22,11 @@ def _clean_faults():
 
 
 # scenarios measured >= ~8s on the 1-core host (pytest.ini policy): they
-# ride the slow tier; the full matrix always runs via `chaos-drill`
-_SLOW = {"stall_watchdog", "shard_death_recovered"}
+# ride the slow tier; the full matrix always runs via `chaos-drill`.
+# fleet_stall_watchdog rides slow with its single-run twin (real stall +
+# watchdog deadline); the other fleet scenarios are sub-second once the
+# first has paid the shared fleet compile
+_SLOW = {"stall_watchdog", "shard_death_recovered", "fleet_stall_watchdog"}
 
 
 # every scenario is its own test so a matrix regression names the exact

@@ -137,6 +137,7 @@ def test_chees_runner_checkpoint_resume(tmp_path):
     assert post2.num_chains == 8
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_chees_kernel_mismatch_on_resume_rejected(tmp_path):
     ckpt = str(tmp_path / "c.npz")
     stark_tpu.sample_until_converged(

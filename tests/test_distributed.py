@@ -321,6 +321,7 @@ print("RESULT " + json.dumps({
 """
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_two_process_smoke(tmp_path):
     """DEFAULT-tier 2-process gloo smoke (VERDICT r4 weak #6): tiny
     shapes, one cross-process psum + draw allgather — keeps the

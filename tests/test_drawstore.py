@@ -70,6 +70,7 @@ def test_shape_validation(tmp_path):
             ds.append(np.zeros((7, 7, 7), np.float32))
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_runner_writes_draw_store(tmp_path):
     import jax.numpy as jnp
 

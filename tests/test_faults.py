@@ -120,3 +120,98 @@ def test_env_var_configures(monkeypatch):
         fail_point("env.site")
     faults.configure(None)
     assert not faults.active()
+
+
+# -- site retrofit: every compiled-in site is armable ----------------------
+# (tools/lint_failpoints.py requires each site to be exercised by a chaos
+# scenario or a test — these cover the sites the scenario matrix reaches
+# only as part of a larger flow, or not at all)
+
+
+def test_site_ckpt_slow_injects_latency(tmp_path):
+    """``ckpt.slow``: checkpoint-write latency injection fires inside
+    save_checkpoint without corrupting the artifact."""
+    import time
+
+    from stark_tpu.checkpoint import load_checkpoint, save_checkpoint
+
+    faults.configure("ckpt.slow=sleep(0.05)*1")
+    p = str(tmp_path / "c.npz")
+    t0 = time.perf_counter()
+    save_checkpoint(p, {"z": np.zeros((2, 2))}, {"blocks_done": 1})
+    assert time.perf_counter() - t0 >= 0.05
+    assert [f["site"] for f in faults.fired()] == ["ckpt.slow"]
+    arrays, meta = load_checkpoint(p)
+    np.testing.assert_array_equal(arrays["z"], np.zeros((2, 2)))
+    assert meta["blocks_done"] == 1
+
+
+def test_site_drawstore_append_crash(tmp_path):
+    """``drawstore.append``: a fault in the draw-persistence handoff
+    surfaces to the caller (the runner's supervision boundary) before
+    any bytes reach the async writer."""
+    from stark_tpu.drawstore import DrawStore, read_draws
+
+    faults.configure("drawstore.append=crash*1@1")
+    with DrawStore(str(tmp_path / "d.stkr"), 2, 3) as ds:
+        ds.append(np.zeros((2, 4, 3), np.float32))
+        with pytest.raises(InjectedFault):
+            ds.append(np.zeros((2, 4, 3), np.float32))
+        ds.flush()
+    draws, _, _ = read_draws(str(tmp_path / "d.stkr"))
+    assert draws.shape[0] == 4  # only the pre-fault block landed
+
+
+def test_site_supervise_attempt_crash_propagates(tmp_path, monkeypatch):
+    """``supervise.attempt`` fires at the supervisor's loop head —
+    OUTSIDE the attempt's try boundary, so it models a fault in the
+    supervisor's own scaffolding and propagates to the caller (the
+    restart machinery must not eat its own crashes).  With the count
+    exhausted, the next call supervises normally."""
+    import stark_tpu.runner
+    from stark_tpu.supervise import supervised_sample
+
+    def fake_runner(model, data=None, **kw):
+        return "ok"
+
+    monkeypatch.setattr(
+        stark_tpu.runner, "sample_until_converged", fake_runner
+    )
+    faults.configure("supervise.attempt=crash*1")
+    with pytest.raises(InjectedFault):
+        supervised_sample(
+            None, workdir=str(tmp_path / "wd"), max_restarts=2, seed=0,
+        )
+    assert [f["site"] for f in faults.fired()] == ["supervise.attempt"]
+    out = supervised_sample(
+        None, workdir=str(tmp_path / "wd"), max_restarts=2, seed=0,
+    )
+    assert out == "ok"
+
+
+def test_site_tempering_dispatch_crash():
+    """``tempering.dispatch``: the whole-ladder dispatch site raises to
+    the caller (tempered runs have no retry below caller supervision)."""
+    import jax.numpy as jnp
+
+    from stark_tpu.model import Model, ParamSpec
+    from stark_tpu.parallel.tempering import tempered_sample
+
+    class _Mean(Model):
+        def param_spec(self):
+            return {"x": ParamSpec((1,))}
+
+        def log_prior(self, p):
+            return -0.5 * jnp.sum(p["x"] ** 2)
+
+        def log_lik(self, p, data):
+            return -0.5 * jnp.sum((data["y"] - p["x"]) ** 2)
+
+    faults.configure("tempering.dispatch=crash*1")
+    with pytest.raises(InjectedFault):
+        tempered_sample(
+            _Mean(), {"y": np.zeros(4, np.float32)}, num_temps=2,
+            chains=1, num_warmup=5, num_samples=5, kernel="hmc",
+            num_leapfrog=2, seed=0,
+        )
+    assert [f["site"] for f in faults.fired()] == ["tempering.dispatch"]

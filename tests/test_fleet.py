@@ -26,10 +26,11 @@ import os
 import numpy as np
 import pytest
 
-from stark_tpu import faults
+from stark_tpu import faults, telemetry
 from stark_tpu.checkpoint import load_checkpoint
 from stark_tpu.fleet import (
     FleetSpec,
+    ProblemBudget,
     sample_fleet,
     supervised_sample_fleet,
 )
@@ -45,6 +46,13 @@ from stark_tpu.telemetry import (
 _TIMING_KEYS = ("wall_s", "t_dispatch_s", "t_diag_s")
 
 
+#: ONE model instance for every spec in this module: the fleet's
+#: compiled-parts cache is keyed on the model object, so tests that
+#: share a batch size reuse the jitted warmup/block parts instead of
+#: recompiling per test (the model is stateless — sharing is safe)
+_FLEET_MODEL = EightSchools()
+
+
 def _make_spec(n=3, seed=0):
     rng = np.random.default_rng(seed)
     y, sig = np.asarray(Y), np.asarray(SIGMA)
@@ -53,7 +61,7 @@ def _make_spec(n=3, seed=0):
          "sigma": sig}
         for _ in range(n)
     ]
-    return FleetSpec.from_problems(EightSchools(), datasets)
+    return FleetSpec.from_problems(_FLEET_MODEL, datasets)
 
 
 # gates chosen so (with seed 0) at least one problem converges at
@@ -162,6 +170,7 @@ def test_compaction_invariance(fleet_run):
         np.testing.assert_array_equal(a.draws_flat, c.draws_flat)
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_max_batch_refill(fleet_run):
     """A capacity-2 batch queues the third problem and swaps it in at a
     compaction boundary — same draws as the all-at-once batch."""
@@ -375,9 +384,12 @@ def _strip_timing(rec):
 def test_b1_bit_identity(tmp_path):
     """A one-problem fleet IS the single-problem runner: draws, metrics
     trail (modulo timing fields), and checkpoint arrays are identical,
-    and the artifacts land at the caller's paths unsuffixed."""
+    and the artifacts land at the caller's paths unsuffixed.  (hmc: the
+    pass-through contract is kernel-independent and the NUTS fleet/
+    single identity is already pinned by the straggler test.)"""
     spec = _make_spec(1)
-    kw = {**_KW, "max_blocks": 4, "ess_target": 30.0}
+    kw = {**_KW, "max_blocks": 4, "ess_target": 30.0,
+          "kernel": "hmc", "num_leapfrog": 12}
     fdir, sdir = tmp_path / "fleet", tmp_path / "single"
     fdir.mkdir(), sdir.mkdir()
     fres = sample_fleet(
@@ -492,6 +504,405 @@ def test_bench_fleet_leg_smoke():
     assert r.extra["seq_warm_ess_per_sec_est"] > 0
     assert r.extra["speedup_vs_sequential"] is not None
     assert 0.0 <= r.extra["converged_fraction"] <= 1.0
+    # degraded-completion evidence rides every row (satellite: ledger
+    # rows must account for quarantined/exhausted problems)
+    assert r.extra["degraded"] is False
+    assert r.extra["lost_problems"] == 0
+
+
+# --------------------------------------------------------------------------
+# per-problem fault domains (PR 9): lane quarantine, budgets, degraded
+# completion
+# --------------------------------------------------------------------------
+
+#: fast fault-domain settings (hmc: the containment contracts don't need
+#: NUTS trees; specs below reuse the module-shared _FLEET_MODEL so the
+#: compiled-parts cache stays one entry per batch shape)
+_FD_KW = dict(
+    chains=2, block_size=20, max_blocks=8, min_blocks=2, num_warmup=100,
+    ess_target=25.0, rhat_target=1.5, seed=0, kernel="hmc",
+    num_leapfrog=12,
+)
+
+
+def _fd_spec(n=8, budgets=None, jitter=2.0):
+    rng = np.random.default_rng(7)
+    y, sig = np.asarray(Y), np.asarray(SIGMA)
+    datasets = [
+        {"y": (y + rng.normal(0, jitter, y.shape)).astype(np.float32),
+         "sigma": sig}
+        for _ in range(n)
+    ]
+    return FleetSpec.from_problems(_FLEET_MODEL, datasets, budgets=budgets)
+
+
+@pytest.fixture(scope="module")
+def b8_ref():
+    """The uninjected B=8 reference fleet the fault-isolation identity
+    is measured against."""
+    spec = _fd_spec()
+    ref = sample_fleet(spec, health_check=True, **_FD_KW)
+    assert all(p.converged for p in ref.problems), [
+        p.status for p in ref.problems
+    ]
+    return spec, ref
+
+
+def test_problem_budget_validation():
+    good = {"y": np.zeros(8, np.float32), "sigma": np.ones(8, np.float32)}
+    with pytest.raises(ValueError, match="deadline_s"):
+        ProblemBudget(deadline_s=-1.0)
+    with pytest.raises(ValueError, match="max_restarts"):
+        ProblemBudget(max_restarts=-1)
+    with pytest.raises(ValueError, match="budgets"):
+        FleetSpec.from_problems(_FLEET_MODEL, [good, good], budgets=[None])
+    with pytest.raises(ValueError, match="ProblemBudget"):
+        FleetSpec.from_problems(_FLEET_MODEL, [good], budgets=[42])
+    spec = FleetSpec.from_problems(
+        _FLEET_MODEL, [good, good],
+        budgets=[None, ProblemBudget(ess_target=5.0)],
+    )
+    assert spec.budget_for(0) == ProblemBudget()
+    assert spec.budget_for(1).ess_target == 5.0
+
+
+def test_lane_quarantine_fault_isolation(b8_ref, tmp_path):
+    """THE fault-isolation identity (acceptance criterion): B=8 with
+    ``fleet.lane_nan`` armed on one lane — the poisoned lane is reseeded
+    once (budget 1), then quarantined with the reason persisted, and the
+    surviving B-1 problems' draws are BIT-IDENTICAL to the uninjected
+    fleet.  The same run's trace doubles as the schema/summary/report
+    coverage for the new events."""
+    spec, ref = b8_ref
+    store = str(tmp_path / "draws")
+    trace_path = str(tmp_path / "trace.jsonl")
+    pid = spec.problem_ids[5]
+    # @1: block 1 lands clean (the lane's store file exists), then every
+    # block poisons lane 5 — reseed at block 2, quarantine at block 3
+    faults.configure("fleet.lane_nan=nan(5)@1")
+    try:
+        res = sample_fleet(
+            spec, health_check=True, problem_max_restarts=1,
+            draw_store_path=store, trace=RunTrace(trace_path), **_FD_KW,
+        )
+    finally:
+        faults.reset()
+    assert res.degraded is True
+    assert res.lost_problems == [pid]
+    lane = res[pid]
+    assert lane.status == "failed:poisoned_state"
+    assert lane.lane_restarts == 2  # reseed #1, then the budget trip
+    assert lane.min_ess is None and lane.max_rhat is None
+    assert not lane.budget_exhausted  # failed, not exhausted
+    for a, b in zip(ref.problems, res.problems):
+        if a.problem_id == pid:
+            continue
+        assert b.converged, b.status
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+    # the quarantined store + its persisted reason
+    import glob as _glob
+
+    bad = _glob.glob(os.path.join(store, f"p_{pid}.stkr.bad*"))
+    reasons = [p for p in bad if p.endswith(".reason.json")]
+    assert reasons, f"no persisted quarantine reason ({bad})"
+    assert "poisoned_state" in json.load(open(reasons[0]))["reason"]
+    # trace coverage: the new events ride the registered schema,
+    # summarize into the fleet section, and render in trace_report
+    events = read_trace(trace_path)
+    names = {e["event"] for e in events}
+    assert {"problem_reseeded", "problem_quarantined"} <= names
+    assert names <= ALL_EVENT_TYPES | {"progress"}
+    s = summarize_trace(events)
+    assert s["fleet"]["lane_reseeds"] == 1
+    assert s["fleet"]["problems_quarantined"] == 1
+    assert s["fleet"]["lost_problems"] == [pid]
+    assert s["fleet"]["degraded"] is True
+    end = [e for e in events if e["event"] == "run_end"][-1]
+    assert end["degraded"] is True and end["lost_problems"] == [pid]
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec_ = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(root, "tools", "trace_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec_)
+    spec_.loader.exec_module(mod)
+    out = mod.render_run(events, events[-1].get("run", 1))
+    assert "failed:poisoned_state" in out
+    assert "lost problems" in out
+
+
+def test_quarantine_survives_supervised_crash_resume(b8_ref, tmp_path):
+    """Acceptance criterion, crash-resume leg: the supervisor crashes
+    MID-quarantine (after the lane's first reseed is checkpointed,
+    before the quarantine) — the resumed attempt continues the lane's
+    restart budget where it left off, quarantines it, and the surviving
+    lanes still finish bit-identical to the uninjected fleet."""
+    spec, ref = b8_ref
+    pid = spec.problem_ids[5]
+    wd = tmp_path / "wd"
+    # lane 5 poisoned from block 2 on; the process crashes at block 2's
+    # post boundary — the durable checkpoint carries lane_restarts=1
+    faults.configure("fleet.lane_nan=nan(5)@1; fleet.block.post=crash*1@1")
+    try:
+        res = supervised_sample_fleet(
+            spec, workdir=str(wd), max_restarts=2,
+            reseed_on_restart=False, problem_max_restarts=1, **_FD_KW,
+        )
+    finally:
+        faults.reset()
+    assert res.lost_problems == [pid]
+    assert res[pid].lane_restarts == 2
+    for a, b in zip(ref.problems, res.problems):
+        if a.problem_id == pid:
+            continue
+        assert b.converged
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+    restarts = [
+        json.loads(line)
+        for line in open(wd / "metrics.jsonl")
+        if '"restart"' in line
+    ]
+    assert len(restarts) == 1 and restarts[0]["fault"] == "transient"
+    # the checkpoint meta carries the terminal quarantine (a later
+    # resume must never resurrect the lane)
+    _arrays, meta = load_checkpoint(str(wd / "chain.ckpt.npz"))
+    assert meta["problems"][pid]["failed"] == "poisoned_state"
+    assert pid not in meta["active_ids"]
+
+
+def test_per_problem_ess_target_and_fleet_budget_pin(b8_ref):
+    """Per-problem ``ess_target`` budgets gate per tenant; and the PR 6
+    hardening pin — a problem that CONVERGED is never re-marked
+    ``budget_exhausted`` by a fleet-level time-budget trip."""
+    # B=8 like the fixture, so the compiled fleet parts are reused
+    spec = _fd_spec(
+        budgets=[ProblemBudget(ess_target=2.0),
+                 ProblemBudget(ess_target=1e8)] + [None] * 6,
+    )
+    kw = dict(_FD_KW, min_blocks=1, max_blocks=4)
+    res = sample_fleet(spec, **kw)
+    assert res.problems[0].converged
+    assert res.problems[1].status == "budget_exhausted"
+    assert res.problems[1].blocks == kw["max_blocks"]
+    assert res.problems[0].blocks < res.problems[1].blocks
+    assert res.degraded is False  # exhausted is policy, not a fault
+    # fleet time budget trips after block 1 — the converged problem's
+    # status survives, only the unconverged one is marked
+    res2 = sample_fleet(spec, time_budget_s=1e-4, **kw)
+    assert res2.budget_exhausted
+    assert res2.problems[0].converged
+    assert not res2.problems[0].budget_exhausted
+    assert res2.problems[1].budget_exhausted
+
+
+def test_fleet_blocks_emit_progress_beats():
+    """Satellite: the PR 2 watchdog covers fleet runs — every fleet
+    block (and warmup segment) feeds `telemetry.notify_progress`, the
+    same beat stream `supervised_sample_fleet(stall_timeout_s=...)`
+    arms the watchdog on."""
+    spec = _fd_spec()  # B=8: reuses the fixture's compiled parts
+    beats = []
+
+    def on_beat():
+        beats.append(1)
+
+    telemetry.add_progress_listener(on_beat)
+    try:
+        sample_fleet(spec, **dict(_FD_KW, max_blocks=2))
+    finally:
+        telemetry.remove_progress_listener(on_beat)
+    # at least one beat per warmup segment and per fleet block
+    assert len(beats) >= 3
+
+
+def test_metrics_collector_fault_domain_events():
+    """The collector consumes the new events: reseeds/quarantines
+    counted, degraded surfaced in /status — and a degraded fleet is NOT
+    process unhealth (healthz stays green)."""
+    from stark_tpu.metrics import TraceCollector
+
+    c = TraceCollector()
+    base = {"schema": 1, "ts": 0.0, "wall_s": 0.0, "run": 1}
+    c.on_event({**base, "event": "run_start", "entry": "sample_fleet",
+                "problems": 3, "chains": 2})
+    c.on_event({**base, "event": "problem_reseeded", "problem_id": "p1",
+                "fault": "poisoned_state", "lane_restarts": 1,
+                "max_restarts": 1})
+    c.on_event({**base, "event": "problem_quarantined",
+                "problem_id": "p1", "status": "failed:poisoned_state",
+                "fault": "poisoned_state", "reason": "nan z",
+                "lane_restarts": 2})
+    c.on_event({**base, "event": "problem_converged", "problem_id": "p0",
+                "status": "converged", "blocks": 2, "grad_evals": 600,
+                "draws_per_chain": 50})
+    assert c.fleet_lane_reseeds.value() == 1.0
+    assert c.fleet_quarantined.value() == 1.0
+    assert c.fleet_problems_done.value(
+        status="failed:poisoned_state") == 1.0
+    st = c.status()
+    assert st["fleet"]["degraded"] is True
+    assert st["fleet"]["lost_problems"] == ["p1"]
+    assert st["fleet"]["last_reseeded"]["problem_id"] == "p1"
+    assert st["fleet"]["last_quarantined"]["fault"] == "poisoned_state"
+    assert st["fleet"]["problems_done"] == 2  # converged + quarantined
+    # degraded fleet != unhealthy process: /healthz stays 200
+    assert c.health.check()[0] is True
+    rendered = c.registry.render()
+    assert "fleet_degraded 1" in rendered
+    assert "fleet_lane_reseeds_total" in rendered
+    assert 'status="failed:poisoned_state"' in rendered
+    # a FRESH run resets the degraded state
+    c.on_event({**base, "event": "run_end", "converged": True})
+    c.on_event({**base, "event": "run_start", "run": 2})
+    assert c.status()["fleet"] == {}
+    assert "fleet_degraded 0" in c.registry.render()
+
+
+def test_fleet_deadline_charged_across_supervised_restarts(tmp_path):
+    """A tenant's deadline_s is a contract on CUMULATIVE wall: the fleet
+    checkpoint persists elapsed_wall_s, and a resumed run charges
+    deadlines against it — a crash loop cannot re-grant the window."""
+    from stark_tpu.checkpoint import load_checkpoint, save_checkpoint
+
+    # problem 1 can never converge (unreachable ESS), so only its
+    # deadline can stop it — the honest signal for the clock test
+    # (a problem that CONVERGES at the same boundary keeps converged:
+    # finished work is delivered, not discarded)
+    spec = _fd_spec(budgets=[None, ProblemBudget(deadline_s=3600.0,
+                                                 ess_target=1e8)]
+                    + [None] * 6)
+    ck = str(tmp_path / "fleet.ckpt.npz")
+    faults.configure("fleet.block.post=crash@1")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            sample_fleet(spec, checkpoint_path=ck, **_FD_KW)
+    finally:
+        faults.reset()
+    arrays, meta = load_checkpoint(ck)
+    assert meta["elapsed_wall_s"] > 0.0
+    # simulate a long prior history: with the persisted wall past the
+    # deadline, the resumed attempt must trip problem 1's budget at its
+    # first block boundary even though the attempt itself is fresh
+    meta["elapsed_wall_s"] = 1e9
+    save_checkpoint(ck, arrays, meta)
+    res = sample_fleet(spec, checkpoint_path=ck, resume_from=ck, **_FD_KW)
+    assert res.problems[1].status == "budget_exhausted"
+    assert not res.problems[1].converged
+
+
+def test_sequential_hatch_deadline_clamps_poisoned_retries(monkeypatch):
+    """Sequential-hatch pins for the review findings: (1) a
+    ChainHealthError retry never re-grants the tenant its original
+    deadline window — the clamp is re-derived per attempt; (2) a
+    deadline stop mid-retries is recorded budget_exhausted with the
+    TRUE fault count, never misclassified as a quarantine."""
+    import time as _time
+
+    import stark_tpu.fleet as fleet_mod
+    from stark_tpu import runner as runner_mod
+    from stark_tpu.supervise import ChainHealthError
+
+    # the deadlined+poisoned problem runs FIRST (the deadline clock is
+    # the sweep clock)
+    spec = _fd_spec(n=2, budgets=[ProblemBudget(
+        deadline_s=0.3, max_restarts=5,
+    ), None])
+    monkeypatch.setenv("STARK_FLEET", "0")
+    real = runner_mod.sample_until_converged
+    budgets_seen = []
+
+    def poisoned_runner(model, data, **kw):
+        # problem 0's seed lattice (base seed 0 + retry strides)
+        if kw.get("seed", 0) % fleet_mod._LANE_SEED_STRIDE == 0:
+            budgets_seen.append(kw.get("time_budget_s"))
+            _time.sleep(0.2)
+            raise ChainHealthError("injected: non-finite state")
+        return real(model, data, **kw)
+
+    monkeypatch.setattr(
+        runner_mod, "sample_until_converged", poisoned_runner
+    )
+    res = sample_fleet(spec, **_FD_KW)
+    # the deadline cut the retries off long before max_restarts=5: a
+    # budget outcome with the honest restart count, not a quarantine
+    p0 = res.problems[0]
+    assert p0.status == "budget_exhausted"
+    assert not p0.failed
+    assert 1 <= p0.lane_restarts < 5
+    assert res.degraded is False
+    # every attempt's clamp shrank monotonically toward the deadline —
+    # no retry was re-granted the original 0.3 s window
+    assert budgets_seen == sorted(budgets_seen, reverse=True)
+    assert all(b <= 0.3 for b in budgets_seen)
+    assert res.problems[1].converged
+
+
+def test_sequential_hatch_deadline_survives_restart(tmp_path, monkeypatch):
+    """The hatch twin of the cumulative-deadline pin: the sweep clock
+    persists in a checkpoint-path sidecar, so a supervised restart does
+    not re-grant a tenant its deadline window on STARK_FLEET=0 either."""
+    spec = _fd_spec(n=2, budgets=[ProblemBudget(
+        deadline_s=3600.0, ess_target=1e8,
+    ), None])
+    monkeypatch.setenv("STARK_FLEET", "0")
+    ck = str(tmp_path / "chain.ckpt.npz")
+    with open(ck + ".sweep.json", "w") as f:
+        json.dump({"elapsed_wall_s": 1e9}, f)
+    # a surviving per-problem checkpoint marks this sweep as a RESUME —
+    # without one the sidecar is stale state and is discarded instead
+    # (drilled below)
+    with open(str(tmp_path / "chain.ckpt.p0000.npz"), "wb") as f:
+        f.write(b"junk")
+    res = sample_fleet(spec, checkpoint_path=ck, **_FD_KW)
+    p0 = res.problems[0]
+    assert p0.status == "budget_exhausted"
+    assert p0.blocks == 0  # never served: its deadline was pre-blown
+    assert res.problems[1].converged
+    # a COMPLETED sweep retires its clock (the next logical sweep in
+    # this workdir must not inherit it)...
+    assert not os.path.exists(ck + ".sweep.json")
+    # ...and a stale sidecar with NO surviving per-problem checkpoint is
+    # discarded: the fresh sweep's deadline clock starts from zero, so
+    # the unconvergeable problem runs its full block budget instead of
+    # being pre-charged into an instant deadline trip
+    ck2 = str(tmp_path / "fresh" / "chain.ckpt.npz")
+    os.makedirs(os.path.dirname(ck2))
+    with open(ck2 + ".sweep.json", "w") as f:
+        json.dump({"elapsed_wall_s": 1e9}, f)
+    fresh = sample_fleet(spec, checkpoint_path=ck2, **_FD_KW)
+    assert fresh.problems[0].status == "budget_exhausted"
+    assert fresh.problems[0].blocks == _FD_KW["max_blocks"]
+    assert not os.path.exists(ck2 + ".sweep.json")
+
+
+def test_sequential_hatch_contains_poisoned_problem(monkeypatch):
+    """STARK_FLEET=0 parity: a problem that raises ChainHealthError past
+    its restart budget is quarantined (failed:poisoned_state) and the
+    sweep COMPLETES around it."""
+    import stark_tpu.fleet as fleet_mod
+    from stark_tpu.supervise import ChainHealthError
+
+    spec = _fd_spec(n=3)
+    monkeypatch.setenv("STARK_FLEET", "0")
+    real = None
+
+    def poisoned_runner(model, data, **kw):
+        # problem 1 (identified by its seed lattice) always poisons
+        if kw.get("seed", 0) % fleet_mod._LANE_SEED_STRIDE == 1:
+            raise ChainHealthError("injected: non-finite state")
+        return real(model, data, **kw)
+
+    from stark_tpu import runner as runner_mod
+
+    real = runner_mod.sample_until_converged
+    monkeypatch.setattr(
+        runner_mod, "sample_until_converged", poisoned_runner
+    )
+    res = sample_fleet(spec, problem_max_restarts=1, **_FD_KW)
+    assert res.problems[1].status == "failed:poisoned_state"
+    assert res.degraded and res.lost_problems == [spec.problem_ids[1]]
+    assert res.problems[0].converged and res.problems[2].converged
 
 
 def test_metrics_collector_fleet_events():

@@ -13,6 +13,7 @@ from stark_tpu.models import (
 )
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_linear_regression_recovers_truth():
     data, true = synth_linreg_data(jax.random.PRNGKey(0), 2048, 4, noise=0.5)
     post = stark_tpu.sample(

@@ -163,6 +163,7 @@ def test_backend_applies_guard(monkeypatch):
     assert post.num_samples == 100
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_dispatch_recorded_in_sample_stats():
     """ADVICE r4: the effective dispatch bound (and whether the guard
     auto-chose it) is recorded in the result's sample stats, so the

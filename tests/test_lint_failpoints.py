@@ -1,0 +1,115 @@
+"""tools/lint_failpoints.py: every failpoint site compiled into
+stark_tpu/ must be exercised by a chaos scenario or a test — an
+undrilled site is a recovery path nobody has watched recover."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import lint_failpoints  # noqa: E402
+
+
+def test_repo_is_clean():
+    violations = lint_failpoints.lint_repo(REPO)
+    assert violations == [], "\n".join(violations)
+
+
+def test_collector_finds_known_sites():
+    """The AST collector must see every site family the harness is
+    threaded through — checkpointing, the runner block loop, the fleet's
+    per-problem fault domain, supervision, and the parallel drivers."""
+    sites = lint_failpoints.collect_sites(os.path.join(REPO, "stark_tpu"))
+    assert {
+        "ckpt.before_rename",
+        "ckpt.after_rename",
+        "ckpt.corrupt",
+        "ckpt.slow",
+        "runner.block.pre",
+        "runner.block.post",
+        "runner.carried_nan",
+        "runner.gate.optimistic",
+        "supervise.attempt",
+        "drawstore.append",
+        "consensus.shard_death",
+        "tempering.dispatch",
+        "fleet.block.pre",
+        "fleet.block.post",
+        "fleet.lane_nan",
+        "fleet.lane_stall",
+        "fleet.ckpt_corrupt_one",
+    } <= set(sites)
+
+
+@pytest.mark.parametrize(
+    "source,expect",
+    [
+        ('from .faults import fail_point\nfail_point("a.site")\n',
+         ["a.site"]),
+        ('from . import faults\n'
+         'x = faults.poison("p.site", tree)\n',
+         ["p.site"]),
+        ('import faults\nfaults.corrupt_file("c.site", path)\n',
+         ["c.site"]),
+        ('kill_shards("k.site", draws)\n', ["k.site"]),
+        # comments/docstrings must not satisfy (or trip) the collector
+        ('# fail_point("fake.site")\n"""fail_point("doc.site")"""\n', []),
+        # variable sites (faults.py internals) are not literals
+        ('def fail_point(site):\n    return site\nfail_point(name)\n', []),
+    ],
+)
+def test_find_site_calls(source, expect):
+    hits = lint_failpoints.find_site_calls(source, "<test>")
+    assert [s for _ln, s in hits] == expect
+
+
+def test_unexercised_site_fails(tmp_path):
+    """A site exercised by no scenario and no test is a violation; the
+    same site named in a test (or chaos.py) is clean."""
+    repo = tmp_path
+    pkg = repo / "stark_tpu"
+    pkg.mkdir()
+    (pkg / "newpath.py").write_text(
+        'from .faults import fail_point\nfail_point("newpath.pre")\n'
+    )
+    (pkg / "chaos.py").write_text("# no scenarios yet\n")
+    (repo / "tests").mkdir()
+    violations = lint_failpoints.lint_repo(str(repo))
+    assert len(violations) == 1 and "newpath.pre" in violations[0]
+    # a comment/docstring mention does NOT count as exercised (a deleted
+    # drill whose site name survives in prose must still fail)
+    (repo / "tests" / "test_newpath.py").write_text(
+        '"""arms newpath.pre"""\n# faults.configure("newpath.pre=crash")\n'
+    )
+    violations = lint_failpoints.lint_repo(str(repo))
+    assert len(violations) == 1 and "newpath.pre" in violations[0]
+    # coverage via a REAL arming call clears it
+    (repo / "tests" / "test_newpath.py").write_text(
+        'import faults\nfaults.configure("newpath.pre=crash*1")\n'
+    )
+    assert lint_failpoints.lint_repo(str(repo)) == []
+    # coverage via a chaos scenario clears it too
+    (repo / "tests" / "test_newpath.py").write_text("# moved\n")
+    (pkg / "chaos.py").write_text(
+        'import faults\nfaults.configure("newpath.pre=crash*1")\n'
+    )
+    assert lint_failpoints.lint_repo(str(repo)) == []
+
+
+def test_empty_package_reports_broken_collector(tmp_path):
+    (tmp_path / "stark_tpu").mkdir()
+    (tmp_path / "tests").mkdir()
+    violations = lint_failpoints.lint_repo(str(tmp_path))
+    assert violations and "collector itself is broken" in violations[0]
+
+
+def test_cli_exit_zero():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_failpoints.py")],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr

@@ -280,6 +280,7 @@ def test_fused_lmm_matches_plain_posterior():
         np.testing.assert_allclose(m_f, m_p, atol=0.5 * np.max(sd) + 1e-3)
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_fill_from_right_matches_bruteforce():
     """Property test for the associative fill-from-right primitive that
     both the local and the cross-shard CoxPH tie stitching build on."""

@@ -96,6 +96,7 @@ def _assert_equivalent(out):
     assert b_p == b_s
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_pipeline_matches_sync_nuts(tmp_path):
     out = _run_both_modes(
         tmp_path, chains=2, block_size=25, max_blocks=3, min_blocks=3,

@@ -212,6 +212,7 @@ def _coxph_tied_setup(n=2048, d=3, seed=0):
     return model, model.prepare_data(data)
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_coxph_sharded_potential_and_grad_match_unsharded():
     """Sequence-parallel CoxPH (r5): the cross-shard prefix-logsumexp +
     tie stitching in log_lik_sharded reproduces the unsharded Breslow

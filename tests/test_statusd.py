@@ -89,6 +89,37 @@ def test_healthz_flips_on_stall_and_recovers_on_restart(server):
     assert json.loads(body)["reason"] == "restart_budget_exhausted"
 
 
+def test_degraded_fleet_stays_200_with_status_surfaced(server):
+    """The degraded-fleet /healthz policy: lane quarantines are a
+    per-tenant loss, not process unhealth — /healthz stays 200 while
+    /status and /metrics surface the degradation; 503 stays reserved
+    for process-level events (a stall still flips it)."""
+    tr = telemetry.RunTrace(None)
+    tr.emit("run_start", entry="sample_fleet", problems=3, chains=2)
+    tr.emit("problem_reseeded", problem_id="p1", fault="poisoned_state",
+            lane_restarts=1, max_restarts=1)
+    tr.emit("problem_quarantined", problem_id="p1",
+            status="failed:poisoned_state", fault="poisoned_state",
+            reason="non-finite z", lane_restarts=2)
+    code, body = _get(server.port, "/healthz")
+    assert code == 200 and body == "ok\n"
+    code, body = _get(server.port, "/status")
+    snap = json.loads(body)
+    assert snap["healthy"] is True
+    assert snap["fleet"]["degraded"] is True
+    assert snap["fleet"]["lost_problems"] == ["p1"]
+    assert snap["fleet"]["last_quarantined"]["fault"] == "poisoned_state"
+    code, text = _get(server.port, "/metrics")
+    samples, _types = parse_exposition(text)
+    assert samples["stark_fleet_degraded"] == 1
+    assert samples["stark_fleet_lane_reseeds_total"] == 1
+    assert samples["stark_fleet_problems_quarantined_total"] == 1
+    # process-level unhealth still flips 503, degraded or not
+    tr.emit("chain_health", status="stall", deadline_s=3.0, idle_s=3.2,
+            stall_count=1)
+    assert _get(server.port, "/healthz")[0] == 503
+
+
 def test_off_by_default_no_thread_no_listener(monkeypatch):
     """The zero-cost contract: port unset → no server thread, no event
     listener, and a traced run writes byte-wise the same event shapes."""

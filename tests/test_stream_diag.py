@@ -254,6 +254,7 @@ def test_diag_bytes_constant_per_block(tmp_path):
     assert legacy[0] < legacy[1] < legacy[2], legacy
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_adaptive_reduces_draws_eight_schools():
     """Acceptance: at equal targets on eight schools, the ESS-forecast
     scheduler converges in FEWER post-warmup draws than the fixed march

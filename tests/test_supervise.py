@@ -289,6 +289,37 @@ def test_restart_budget_lifetime_and_window():
         assert not w2.exhausted(t)
 
 
+def test_restart_budget_window_boundary():
+    """The window edge is INCLUSIVE: a failure aged exactly
+    ``window_s`` seconds still counts; one tick past it ages out.  The
+    pruning is applied at query time, so the same budget object answers
+    both sides of the edge correctly."""
+    w = RestartBudget(1, window_s=10.0)
+    w.record_failure(0.0)
+    w.record_failure(10.0)  # exactly at the edge of failure #1's window
+    assert w.in_window(10.0) == 2
+    assert w.exhausted(10.0)
+    # one tick later the first failure leaves the window: back in budget
+    assert w.in_window(10.0 + 1e-6) == 1
+    assert not w.exhausted(10.0 + 1e-6)
+    # and the pruning is permanent — re-asking at the edge time cannot
+    # resurrect the aged-out failure
+    assert w.in_window(10.0) == 1
+
+    # window_s=None NEVER forgets, however far apart the failures land
+    inf = RestartBudget(1, window_s=None)
+    inf.record_failure(0.0)
+    assert not inf.exhausted(1e9)
+    inf.record_failure(1e9)
+    assert inf.exhausted(1e9)
+    assert inf.exhausted(1e12)  # still exhausted eons later
+
+    # max_restarts=0: the FIRST failure is terminal in any window
+    zero = RestartBudget(0, window_s=10.0)
+    zero.record_failure(5.0)
+    assert zero.exhausted(5.0)
+
+
 def test_backoff_delay_policy():
     # base 0 (the default) keeps restarts immediate
     assert backoff_delay("transient", 1, base_s=0.0) == 0.0

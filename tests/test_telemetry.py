@@ -218,6 +218,7 @@ def _run_eight_schools(trace):
         stark_tpu.sample(EightSchools(), eight_schools_data(), **kwargs)
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_eight_schools_trace_smoke(tmp_path):
     """The acceptance-shaped smoke: an eight_schools run under a trace
     produces run_start -> sample_block -> run_end IN ORDER, carries
@@ -276,6 +277,7 @@ def test_adaptive_runner_trace_events(tmp_path):
     assert end["blocks"] == len(post.history)
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_trace_report_renders_phase_and_health_table(tmp_path):
     """tools/trace_report.py renders a per-phase table including
     acceptance rate and divergence counts from a real trace."""

@@ -63,6 +63,7 @@ def test_geweke_flags_mismatched_generative():
     assert res.max_abs_z() > 6.0, res.zscores
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_sbc_ranks_uniform():
     res = sbc(
         NormalModel(), _sample_prior, _simulate, jax.random.PRNGKey(1),

@@ -282,6 +282,7 @@ def test_irt_grid_layout_refuses_row_split(monkeypatch):
     assert jax.tree.leaves(plain.data_row_axes(data)) == [0] * len(data)
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_sampling_smoke_fused_lmm(monkeypatch, tmp_path):
     """End-to-end: a fused-path model samples through the adaptive
     runner with finite draws, and the run_start + per-block grad-eval
@@ -314,6 +315,7 @@ def test_sampling_smoke_fused_lmm(monkeypatch, tmp_path):
         assert "fused" not in e
 
 
+@pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_fleet_smoke_fused_layout(monkeypatch):
     """One FleetSpec over a fused-layout model: per-problem prepare_data
     runs the fused transform before stacking, and every lane samples

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Repo lint: every failpoint site must be exercised by a drill or test.
+
+The fault-injection harness (`stark_tpu/faults.py`) only earns its keep
+when every *named site* compiled into the hot paths is actually fired by
+something — a chaos scenario or a test.  A site nothing exercises is a
+recovery path nobody has ever watched recover: the next refactor can
+break the containment behind it silently.  This lint closes the loop
+statically (mirroring ``tools/lint_fused_knobs.py``):
+
+1. AST-collect every site name passed as a string literal to a faults
+   call (``fail_point`` / ``poison`` / ``corrupt_file`` /
+   ``kill_shards``) under ``stark_tpu/``.
+2. Fail if a collected site is armed/fired by NO string literal inside
+   an arming call (``faults.configure`` / ``enable`` / a direct site
+   call / a ``STARK_FAILPOINTS`` ``setenv``) in ``stark_tpu/chaos.py``
+   (the scripted drill matrix) or under ``tests/`` — every site needs
+   at least one scenario or test that arms it by name.
+
+AST-based ON BOTH SIDES: site names in comments/docstrings neither trip
+the collector nor satisfy the exercise check (a deleted drill whose site
+name survives in prose must still fail the lint).  Imports nothing from
+the package, so it runs anywhere.  Run directly or via
+``tests/test_lint_failpoints.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+#: call names whose string-literal first argument is a failpoint site
+#: (the full faults.py site API: the control-flow entry plus the three
+#: data-directive helpers, each of which routes through fail_point)
+_SITE_FUNCS = frozenset({
+    "fail_point", "poison", "corrupt_file", "kill_shards",
+})
+
+#: call names whose string-literal arguments ARM sites in drills/tests —
+#: configure/enable take the ``site=action`` grammar, the site calls arm
+#: implicitly, and setenv covers STARK_FAILPOINTS-driven subprocles
+_ARM_FUNCS = _SITE_FUNCS | frozenset({"configure", "enable", "setenv"})
+
+
+def _call_name(node: ast.Call) -> str:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def find_site_calls(source: str, filename: str) -> List[Tuple[int, str]]:
+    """(lineno, site) for every literal-site faults call in a module."""
+    tree = ast.parse(source, filename=filename)
+    hits = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and _call_name(node) in _SITE_FUNCS
+            and node.args
+        ):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            hits.append((node.lineno, arg.value))
+    return hits
+
+
+def collect_sites(pkg_dir: str) -> Dict[str, List[str]]:
+    """site -> ["path:line", ...] across the package (faults.py itself
+    defines the helpers and passes the site through a variable, so it
+    contributes no literals — by construction, not by exclusion)."""
+    sites: Dict[str, List[str]] = {}
+    for root, _dirs, files in os.walk(pkg_dir):
+        if "__pycache__" in root:
+            continue
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            with open(path) as f:
+                source = f.read()
+            for lineno, site in find_site_calls(source, path):
+                sites.setdefault(site, []).append(f"{path}:{lineno}")
+    return sites
+
+
+def _arming_literals(source: str, filename: str) -> List[str]:
+    """Every string literal passed to an arming call — the text a site
+    name must appear in (as the site itself or inside a
+    ``site=action`` / env grammar string) to count as exercised."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError:
+        return []
+    lits = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call) and _call_name(node) in _ARM_FUNCS
+        ):
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                lits.append(arg.value)
+    return lits
+
+
+def _exercised_sites(paths: List[str], needles: Set[str]) -> Set[str]:
+    """Which sites appear inside an arming-call string literal in any of
+    the given .py files/trees."""
+    found: Set[str] = set()
+    for p in paths:
+        if os.path.isfile(p):
+            files = [p]
+        else:
+            files = [
+                os.path.join(root, name)
+                for root, _dirs, names in os.walk(p)
+                if "__pycache__" not in root
+                for name in names
+                if name.endswith(".py")
+            ]
+        for f in files:
+            with open(f) as fh:
+                source = fh.read()
+            for lit in _arming_literals(source, f):
+                found.update(n for n in needles if _site_in_literal(n, lit))
+            if found == needles:
+                return found
+    return found
+
+
+def _site_in_literal(site: str, lit: str) -> bool:
+    """True iff ``lit`` arms ``site`` — either the bare site name (a
+    direct site call) or ``site=action`` at a grammar boundary.  Bare
+    substring containment would let a site named as a PREFIX of another
+    armed site (``fleet.lane`` vs ``fleet.lane_nan=...``) pass with
+    zero coverage."""
+    if lit == site:
+        return True
+    return re.search(
+        rf"(^|[;,\s]){re.escape(site)}\s*=", lit
+    ) is not None
+
+
+def lint_repo(repo: str) -> List[str]:
+    """Violation strings for the whole repo; empty = clean."""
+    sites = collect_sites(os.path.join(repo, "stark_tpu"))
+    if not sites:
+        return ["no literal failpoint sites found under stark_tpu/ — "
+                "the collector itself is broken"]
+    exercised = _exercised_sites(
+        [os.path.join(repo, "stark_tpu", "chaos.py"),
+         os.path.join(repo, "tests")],
+        set(sites),
+    )
+    violations = []
+    for site in sorted(sites):
+        if site not in exercised:
+            violations.append(
+                f"{sites[site][0]}: failpoint site {site!r} is exercised "
+                "by no chaos scenario (stark_tpu/chaos.py) and no test "
+                "under tests/ — an undrilled recovery path; add a "
+                "scenario or test that arms it by name"
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = lint_repo(repo)
+    for v in violations:
+        print(v, file=sys.stderr)
+    if violations:
+        print(
+            f"{len(violations)} failpoint-coverage violation(s) — see "
+            "tools/lint_failpoints.py docstring",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

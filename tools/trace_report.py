@@ -174,6 +174,15 @@ def render_run(events, run) -> str:
             ("problems", fl.get("problems")),
             ("converged", fl.get("problems_converged")),
             ("budget exhausted", fl.get("problems_budget_exhausted")),
+            # per-problem fault domains: contained lane reseeds and
+            # terminal quarantines (the fleet completed DEGRADED around
+            # the lost problems — per-tenant loss, not process unhealth)
+            ("quarantined", fl.get("problems_quarantined")),
+            ("lane reseeds", fl.get("lane_reseeds")),
+            ("degraded", fl.get("degraded")),
+            ("lost problems",
+             ", ".join(str(p) for p in fl["lost_problems"])
+             if fl.get("lost_problems") else None),
             ("fleet blocks", fl.get("blocks")),
             ("compactions", fl.get("compactions")),
             ("last occupancy", fl.get("occupancy_last")),
@@ -189,7 +198,8 @@ def render_run(events, run) -> str:
         out.append("")
         done = [
             e for e in events
-            if e.get("run") == s["run"] and e["event"] == "problem_converged"
+            if e.get("run") == s["run"]
+            and e["event"] in ("problem_converged", "problem_quarantined")
         ]
         if done:
             rows = [
