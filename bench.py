@@ -528,6 +528,7 @@ def main():
     chees_converged = False
     chees_overlap = {}  # block-pipeline overlap from the supervised trace
     chees_diag = {}  # streaming-gate transfer + overshoot, same trace
+    chees_profile = {}  # span-timeline attribution, same trace (PR 11)
     # ChEES workload knobs, resolved ONCE: the sampling leg below and the
     # ledger config key both read these — two copies of the defaults
     # would let them drift, silently splitting the ledger's comparability
@@ -592,6 +593,7 @@ def main():
             )
             os.makedirs(workdir, exist_ok=True)
             run_trace = telemetry.RunTrace(trace_path)
+            span_rec = None  # installed inside the try below
             t0 = time.perf_counter()
 
             def on_progress(r):
@@ -677,6 +679,14 @@ def main():
                         file=sys.stderr,
                     )
             try:
+                # STARK_PROFILE_SPANS=1: record first-class span events
+                # into the bench trace (off by default — trace bytes
+                # unchanged).  Installed inside the try so the finally's
+                # uninstall is unskippable — a leaked recorder would
+                # re-emit every later leg's phases onto the closed trace
+                from stark_tpu import profiling as _profiling
+
+                span_rec = _profiling.maybe_record_spans(run_trace)
                 post = supervised_sample(
                     fused, data, workdir=workdir, chains=cc,
                     trace=run_trace,
@@ -700,6 +710,8 @@ def main():
             finally:
                 # the trace must close on the failure path too — the
                 # chees-leg except below otherwise leaks the handle
+                if span_rec is not None:
+                    span_rec.uninstall()
                 run_trace.close()
             wall = time.perf_counter() - t0
             budget_hit = getattr(post, "budget_exhausted", False)
@@ -728,6 +740,21 @@ def main():
             else:
                 chees_overlap = trace_summary.get("overlap") or {}
                 chees_diag = trace_summary.get("diag") or {}
+            # span-timeline attribution (stark_tpu.profiling): compile
+            # wall, retired device-dispatch count, and the attributed
+            # fraction of the run wall — recorded evidence in the final
+            # artifact + ledger row (null when the trace can't say,
+            # never 0.0, the PR 7/9 convention)
+            try:
+                from stark_tpu import profiling
+
+                chees_profile = (
+                    profiling.timeline_summary_from_file(trace_path) or {}
+                )
+            except Exception as e:  # noqa: BLE001 — evidence, not the metric
+                print(f"[bench] timeline summary failed: {e!r}",
+                      file=sys.stderr)
+                chees_profile = {}
         except Exception as e:  # noqa: BLE001 — after supervised retries
             print(f"[bench] chees path failed after retries: {e!r}",
                   file=sys.stderr)
@@ -765,7 +792,9 @@ def main():
         # workload — rows gate only against identical configs.  The
         # sampler axis matters because the value can come from a
         # fallback NUTS leg when ChEES failed/unconverged; its rows must
-        # never pollute the ChEES trailing median.
+        # never pollute the ChEES trailing median.  Profiling evidence
+        # (compile_s / dispatch_count / span_coverage_frac) rides as
+        # recorded, non-gated extra keys (skipped when null).
         append_ledger(
             f"flagship:n={n}:d={d}:g={groups}"
             f":cc={cc}:w={chees_warm}:s={chees_samp}"
@@ -773,6 +802,7 @@ def main():
             f":platform={platform}:fallback={fell_back}"
             f":sampler={sampler}",
             bench_dict,
+            extra_keys=_PROFILING_EXTRA_KEYS,
         )
 
     picked = select_result(results)
@@ -986,6 +1016,15 @@ def main():
                     if chees_diag.get("overshoot_draws") is not None
                     else {}
                 ),
+                # span-timeline profiling evidence (tools/
+                # timeline_report.py): null when the trace predates the
+                # field or no trace survived — never 0.0, so a missing
+                # attribution can't read as "instant compile"
+                "compile_s": chees_profile.get("compile_s"),
+                "dispatch_count": chees_profile.get("dispatch_count"),
+                "span_coverage_frac": chees_profile.get(
+                    "span_coverage_frac"
+                ),
                 **(
                     {"extra_evidence": extra_evidence}
                     if extra_evidence else {}
@@ -996,6 +1035,14 @@ def main():
     print(json.dumps(final), flush=True)
     append_ledger_row(final, sampler=sampler_tag)
 
+
+#: span-timeline profiling evidence (stark_tpu.profiling via the
+#: supervised trace) recorded for trend analysis; check/--strict gates
+#: only ledger.METRIC_SPECS, so these keys are NOT regression-gated —
+#: null-valued keys are skipped by append_ledger (never 0.0)
+_PROFILING_EXTRA_KEYS = (
+    "compile_s", "dispatch_count", "span_coverage_frac",
+)
 
 #: fused-vg evidence recorded for trend analysis; check/--strict gates
 #: only ledger.METRIC_SPECS, so these keys are NOT regression-gated

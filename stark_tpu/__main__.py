@@ -57,10 +57,19 @@ def _traced(args):
     if not path and server is None:
         yield None
         return
+    from .profiling import maybe_record_spans
     from .telemetry import RunTrace, use_trace
 
     with RunTrace(path if path else None) as tr, use_trace(tr):
-        yield tr
+        # STARK_PROFILE_SPANS=1: re-emit the derived timeline as
+        # first-class ``span`` events (tools/timeline_report.py reads
+        # them; off by default — traces stay byte-identical)
+        spans = maybe_record_spans(tr)
+        try:
+            yield tr
+        finally:
+            if spans is not None:
+                spans.uninstall()
     if path:
         log.info("trace written to %s", path)
 
@@ -194,12 +203,29 @@ def _cmd_chaos(args) -> int:
     return 0 if all(r["ok"] for r in results) else 1
 
 
+def _json_probe_envelope(endpoint: str, code: int, body: str) -> str:
+    """The ``status --json`` machine contract: ONE compact JSON line,
+    ``{"endpoint", "code", "body"}`` — ``body`` is the parsed response
+    when it was JSON (the /status snapshot, a 503 /healthz reason),
+    else the raw text (/metrics exposition, a 200 /healthz "ok")."""
+    try:
+        parsed = json.loads(body)
+    except (json.JSONDecodeError, ValueError):
+        parsed = body
+    return json.dumps(
+        {"endpoint": endpoint, "code": code, "body": parsed},
+        separators=(",", ":"), default=str,
+    )
+
+
 def _cmd_status(args) -> int:
     """Probe a running exporter's endpoints (stark_tpu.statusd).
 
-    Prints the response body; the exit code follows the probe —
-    ``--healthz`` exits 0 on 200 and 1 on 503 (the shell-scriptable
-    deadman check), any endpoint exits 2 when nothing is listening.
+    Prints the response body (or, with ``--json``, a single-line
+    machine-readable envelope — see `_json_probe_envelope`); the exit
+    code follows the probe — ``--healthz`` exits 0 on 200 and 1 on 503
+    (the shell-scriptable deadman check), any endpoint exits 2 when
+    nothing is listening.
     """
     import urllib.error
     import urllib.request
@@ -216,14 +242,32 @@ def _cmd_status(args) -> int:
     url = f"http://{args.host}:{port}/{endpoint}"
     try:
         with urllib.request.urlopen(url, timeout=args.timeout) as resp:
-            print(resp.read().decode(), end="")
-            return 0
+            body = resp.read().decode()
+            code = resp.status
     except urllib.error.HTTPError as e:
-        print(e.read().decode(), end="")
-        return 1 if e.code == 503 else 2
+        body = e.read().decode()
+        code = e.code
+        if args.json:
+            print(_json_probe_envelope(endpoint, code, body))
+        else:
+            print(body, end="")
+        return 1 if code == 503 else 2
     except OSError as e:
         log.error("no exporter at %s: %s", url, e)
+        if args.json:
+            # the one-line contract holds even with nothing listening:
+            # code null (no HTTP response), the error in the body slot
+            print(json.dumps(
+                {"endpoint": endpoint, "code": None,
+                 "body": None, "error": str(e)},
+                separators=(",", ":"), default=str,
+            ))
         return 2
+    if args.json:
+        print(_json_probe_envelope(endpoint, code, body))
+    else:
+        print(body, end="")
+    return 0
 
 
 def _cmd_list(args) -> int:
@@ -321,6 +365,11 @@ def main(argv=None) -> int:
     )
     probe.add_argument(
         "--metrics", action="store_true", help="dump /metrics text"
+    )
+    p_status.add_argument(
+        "--json", action="store_true",
+        help="print a single-line JSON envelope "
+        '{"endpoint","code","body"} instead of the raw response',
     )
     p_status.set_defaults(fn=_cmd_status)
 

@@ -731,46 +731,10 @@ def bench_fused_value_and_grad(
     )
 
 
-class _GradEvalProbe:
-    """Dispatch-count probe for the NUTS block loops (jit trace
-    instrumentation — ROADMAP item 3's "profile the NUTS tree-building
-    scan for dispatch-bound segments").  Wraps a FlatModel's bound
-    potential so every EXECUTED fused value-and-grad — including the
-    ones vmap's batched ``while_loop``s run for already-finished (masked)
-    lanes, which never show up in ``num_grad_evals`` — bumps a host
-    counter via ``jax.debug.callback``.  ``calls`` / the calibration in
-    `bench_nuts_sched` turn that into executed-batched-evaluation counts,
-    the denominator of the lane-occupancy numbers the trace events only
-    estimate from the carry."""
-
-    def __init__(self, fm):
-        self._fm = fm
-        self.calls = 0
-
-    def bind(self, data=None):
-        from .model import Potential
-        from .kernels.base import value_and_grad_of
-
-        inner = self._fm.bind(data)
-        vag = value_and_grad_of(inner)
-
-        def counting(z):
-            v, g = vag(z)
-            jax.debug.callback(self._bump, jnp.zeros((), jnp.int32))
-            return v, g
-
-        return Potential(lambda z: inner(z), counting)
-
-    def _bump(self, _x):
-        self.calls += 1
-
-    def snapshot(self) -> int:
-        """Drain pending callback effects, then read the counter —
-        ``jax.block_until_ready`` waits only for OUTPUT buffers, not for
-        debug-callback side effects, so every probe read must cross this
-        barrier or risk undercounting."""
-        jax.effects_barrier()
-        return self.calls
+# dispatch-count probe: promoted to `profiling.DispatchProbe` (PR 11 —
+# installable on any jitted entry, with a process registry); re-exported
+# under the historical name for the nutssched microbench and its tests
+from .profiling import DispatchProbe as _GradEvalProbe  # noqa: E402
 
 
 def bench_nuts_sched(

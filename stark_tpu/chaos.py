@@ -34,6 +34,16 @@ Scenario matrix (`SCENARIOS`):
                          bit-identical to an uninjected run
   clean_identity         failpoints disarmed: two runs are bit-identical
                          (the harness is a no-op when off)
+  recorder_clean_identity  flight recorder on vs off, no anomaly: draws
+                         bit-identical, traces identical in every
+                         non-timing field, no postmortem bundle — the
+                         recorder only reads
+
+The postmortem flight recorder (telemetry.FlightRecorder) is drilled by
+the anomaly scenarios themselves: nan_poison (supervised restart),
+stall_watchdog (watchdog stall), fleet_lane_quarantine (lost tenant),
+and fleet_problem_deadline (blown per-tenant deadline) each assert a
+bundle whose ring ends with the triggering event.
 
 Fleet fault-domain scenarios (per-PROBLEM containment — stark_tpu.fleet):
 
@@ -148,6 +158,25 @@ def _restarts(lines) -> List[Dict[str, Any]]:
     return [l for l in lines if l.get("event") == "restart"]
 
 
+def _postmortems(workdir: str, trigger: str = "") -> List[str]:
+    """Postmortem bundle dirs under ``workdir`` whose trigger slug
+    contains ``trigger`` (flight-recorder layout: postmortem/pmNNN-<slug>)."""
+    slug = trigger.replace(":", "_")
+    return sorted(
+        p for p in glob.glob(os.path.join(workdir, "postmortem", "pm*"))
+        if os.path.isdir(p) and slug in os.path.basename(p)
+    )
+
+
+def _bundle(path: str):
+    """(meta, events) of one postmortem bundle."""
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    with open(os.path.join(path, "events.jsonl")) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    return meta, events
+
+
 def _first_block_after_restart(lines) -> Optional[int]:
     """The block ordinal of the first block record AFTER the first restart
     — 1 means the retry cold-started, blocks_done+1 means it resumed."""
@@ -210,7 +239,18 @@ def nan_poison(workdir: str) -> Dict[str, Any]:
     assert np.isfinite(res.draws_flat).all(), "poison leaked into the result"
     bad = glob.glob(os.path.join(workdir, "chain.ckpt.npz.bad*"))
     assert not bad, f"poisoned state reached disk: {bad}"
-    return {"restarts": 1, "fault": rs[0]["fault"]}
+    # the supervised restart left a postmortem bundle whose final ring
+    # entry IS the triggering restart record (flight recorder contract)
+    pms = _postmortems(workdir, "restart:poisoned_state")
+    assert pms, "no postmortem bundle for the supervised restart"
+    meta, events = _bundle(pms[-1])
+    assert meta["trigger"] == "restart:poisoned_state"
+    trig = events[-1]
+    assert trig.get("event") == "chain_health"
+    assert trig.get("status") == "restart"
+    assert trig.get("fault") == "poisoned_state"
+    return {"restarts": 1, "fault": rs[0]["fault"],
+            "postmortem": os.path.basename(pms[-1])}
 
 
 @_scenario("corrupt_checkpoint")
@@ -251,7 +291,19 @@ def stall_watchdog(workdir: str) -> Dict[str, Any]:
     assert res.converged
     assert len(rs) == 1 and rs[0]["fault"] == "stall", rs
     assert wall < 45.0, f"watchdog did not break the 60s stall (wall {wall:.0f}s)"
-    return {"restarts": 1, "wall_s": round(wall, 1)}
+    # the watchdog's own stall detection dumped a bundle the moment it
+    # fired (before the abort), and the supervisor's restart dumped a
+    # second — both must name the stall
+    stall_pms = _postmortems(workdir, "stall")
+    assert stall_pms, "no postmortem bundle for the watchdog stall"
+    meta, events = _bundle(stall_pms[0])
+    assert "stall" in meta["trigger"]
+    assert any(
+        e.get("event") == "chain_health" and e.get("status") == "stall"
+        for e in events
+    ), "stall bundle does not contain the triggering stall event"
+    return {"restarts": 1, "wall_s": round(wall, 1),
+            "postmortems": len(stall_pms)}
 
 
 _CONSENSUS_KW = dict(
@@ -450,6 +502,9 @@ def fleet_lane_quarantine(workdir: str) -> Dict[str, Any]:
     ref = sample_fleet(
         spec, draw_store_path=os.path.join(workdir, "ref_draws"), **kw
     )
+    # recorder enabled, no anomaly: the clean reference fleet leaves NO
+    # postmortem bundle behind
+    assert not _postmortems(workdir), "clean fleet run dumped a postmortem"
     faults.reset()
     # @1: block 1 lands cleanly (the lane's store file exists before the
     # poison), then every later block poisons the lane — reseed at block
@@ -481,7 +536,17 @@ def fleet_lane_quarantine(workdir: str) -> Dict[str, Any]:
     with open(reasons[0]) as f:
         reason = json.load(f)
     assert "poisoned_state" in reason["reason"]
-    return {"lost": res.lost_problems, "survivors_bit_identical": True}
+    # the quarantine dumped a postmortem bundle naming the lost tenant,
+    # with the triggering problem_quarantined record as its final entry
+    pms = _postmortems(workdir, "quarantine:p0001")
+    assert pms, "no postmortem bundle for the lane quarantine"
+    meta, events = _bundle(pms[-1])
+    trig = events[-1]
+    assert trig.get("event") == "problem_quarantined"
+    assert trig.get("problem_id") == "p0001"
+    assert meta["trigger_event"]["problem_id"] == "p0001"
+    return {"lost": res.lost_problems, "survivors_bit_identical": True,
+            "postmortem": os.path.basename(pms[-1])}
 
 
 @_scenario("fleet_problem_deadline")
@@ -510,7 +575,19 @@ def fleet_problem_deadline(workdir: str) -> Dict[str, Any]:
             and r.get("problem_id") == "p0000"]
     assert done and done[0]["status"] == "budget_exhausted"
     assert done[0].get("deadline_s") == 0.05
-    return {"exhausted": "p0000", "degraded": False}
+    # the blown deadline is a per-tenant SLO failure: the flight
+    # recorder captured it (trigger deadline:<pid>, the terminal
+    # problem record with its headroom accounting as trigger event)
+    pms = _postmortems(workdir, "deadline:p0000")
+    assert pms, "no postmortem bundle for the blown deadline"
+    meta, events = _bundle(pms[-1])
+    trig = events[-1]
+    assert trig.get("event") == "problem_converged"
+    assert trig.get("status") == "budget_exhausted"
+    assert trig.get("deadline_headroom_s") is not None
+    assert trig["deadline_headroom_s"] < 0, "missed deadline, positive headroom"
+    return {"exhausted": "p0000", "degraded": False,
+            "postmortem": os.path.basename(pms[-1])}
 
 
 @_scenario("fleet_ckpt_corrupt_one")
@@ -575,6 +652,69 @@ def fleet_stall_watchdog(workdir: str) -> Dict[str, Any]:
         f"watchdog did not break the 60s fleet stall (wall {wall:.0f}s)"
     )
     return {"restarts": 1, "wall_s": round(wall, 1)}
+
+
+#: envelope/timing keys that legitimately differ between two identical
+#: runs (clocks, measured walls, per-run artifact paths) — everything
+#: ELSE in a trace must be bit-equal for the recorder-off/on pair
+_TIMING_KEYS = frozenset({
+    "ts", "wall_s", "dur_s", "device_idle_s", "backoff_s", "idle_s",
+    "path", "elapsed_s", "ess_rate", "deadline_headroom_s",
+})
+
+
+def _is_timing_key(k: str) -> bool:
+    # t_*: the runner's per-block wall decompositions (t_dispatch_s,
+    # t_wait_s, t_diag_s, t_host_hidden_s, ...)
+    return k in _TIMING_KEYS or k.startswith("t_")
+
+
+@_scenario("recorder_clean_identity")
+def recorder_clean_identity(workdir: str) -> Dict[str, Any]:
+    """Flight recorder enabled vs disabled, no anomaly: the recorder
+    only ever READS the event stream, so the two supervised runs must
+    produce bit-identical draws and trace files identical in every
+    non-timing field — and neither leaves a postmortem bundle."""
+    from . import telemetry
+    from .supervise import supervised_sample
+    from .telemetry import FLIGHT_RECORDER_ENV, RunTrace, read_trace, use_trace
+
+    def run(tag: str, recorder_off: bool):
+        sub = os.path.join(workdir, tag)
+        trace_path = os.path.join(workdir, f"{tag}.jsonl")
+        prev = os.environ.get(FLIGHT_RECORDER_ENV)
+        if recorder_off:
+            os.environ[FLIGHT_RECORDER_ENV] = "0"
+        try:
+            with RunTrace(trace_path) as tr, use_trace(tr):
+                res = supervised_sample(
+                    _StdNormal(), workdir=sub, seed=0, **_SUP_KW
+                )
+        finally:
+            if recorder_off:
+                if prev is None:
+                    os.environ.pop(FLIGHT_RECORDER_ENV, None)
+                else:
+                    os.environ[FLIGHT_RECORDER_ENV] = prev
+        assert not _postmortems(sub), f"clean run ({tag}) dumped a postmortem"
+        return res, read_trace(trace_path)
+
+    res_off, ev_off = run("recorder_off", recorder_off=True)
+    res_on, ev_on = run("recorder_on", recorder_off=False)
+    np.testing.assert_array_equal(res_off.draws_flat, res_on.draws_flat)
+
+    def shape(events):
+        return [
+            {k: v for k, v in e.items() if not _is_timing_key(k)}
+            for e in events
+        ]
+
+    a, b = shape(ev_off), shape(ev_on)
+    assert a == b, "recorder on/off changed the trace event stream"
+    assert not any(e["event"] == "span" for e in ev_on), (
+        "span events leaked into a default (STARK_PROFILE_SPANS unset) trace"
+    )
+    return {"events": len(ev_on), "trace_identical": True}
 
 
 @_scenario("clean_identity")

@@ -607,6 +607,16 @@ def _resolve_fleet_flag(fleet: Optional[bool]) -> bool:
     return os.environ.get(FLEET_ENV, "1") != "0"
 
 
+def _fleet_workdir(*paths: Optional[str]) -> Optional[str]:
+    """Directory the flight recorder drops postmortem bundles into: the
+    parent of the first persisted fleet artifact (None for a fully
+    in-memory run — no artifacts, no forensics destination)."""
+    for p in paths:
+        if p:
+            return os.path.dirname(os.path.abspath(p))
+    return None
+
+
 class _ProblemState:
     """Host-side bookkeeping for one problem (device state lives stacked
     in the batch arrays; this is everything per-problem the gate,
@@ -851,6 +861,15 @@ def _sample_fleet(
     model = spec.model
     fm, _parts_cached = _fleet_parts_for(model, cfg)
     B = spec.num_problems
+    # postmortem flight recorder: per-problem quarantines and deadline
+    # blows dump a forensic bundle next to the fleet's own artifacts
+    # (under a supervisor the workdir is already set to the same
+    # directory — and the supervisor's scoped install is what feeds the
+    # ring; an unsupervised fleet still dumps its triggering records)
+    recorder = telemetry.flight_recorder()
+    recorder.set_workdir(
+        _fleet_workdir(checkpoint_path, metrics_path, draw_store_path)
+    )
     if trace.enabled:
         trace.emit(
             "run_start",
@@ -1078,20 +1097,26 @@ def _sample_fleet(
             "fault": fault,
             "reason": reason,
             "lane_restarts": p.lane_restarts,
+            "max_restarts": p.max_restarts,
             "blocks": p.blocks_done,
             "quarantined_store": quarantined_as,
             "wall_s": time.perf_counter() - t_start,
         })
-        if trace.enabled:
-            trace.emit(
-                "problem_quarantined",
-                problem_id=p.pid,
-                status=p.status,
-                fault=fault,
-                reason=reason,
-                lane_restarts=p.lane_restarts,
-                quarantined_store=quarantined_as,
-            )
+        # a lost tenant is exactly what the postmortem bundle exists
+        # for: emit the quarantine and dump the flight recorder with it
+        # as the trigger
+        recorder.record_anomaly(
+            f"quarantine:{p.pid}",
+            trace,
+            "problem_quarantined",
+            problem_id=p.pid,
+            status=p.status,
+            fault=fault,
+            reason=reason,
+            lane_restarts=p.lane_restarts,
+            max_restarts=p.max_restarts,
+            quarantined_store=quarantined_as,
+        )
 
     def reseed_problem(p: _ProblemState, fault: str, reason: str,
                        quarantined_as: Optional[str] = None) -> bool:
@@ -1146,12 +1171,19 @@ def _sample_fleet(
     def finish_problem(p: _ProblemState, **extra):
         """A problem reached a NON-FAULT terminal status (converged /
         budget_exhausted): close its store file (no masked lane ever
-        appends again) and announce it."""
+        appends again) and announce it — including the per-tenant SLO
+        accounting (ESS rate over the cumulative wall, deadline
+        headroom, restart burn) the control-plane gauges scrape.
+        Returns the announced record (the trace record when tracing is
+        on) so callers can hand it to the flight recorder."""
         if store is not None:
             store.close_problem(p.pid)
         status = p.status
-        emit({
-            "event": "problem_done",
+        # SLO rollup on the CUMULATIVE wall (the same clock deadlines
+        # charge): what the tenant got, per second, and how much of its
+        # deadline / restart budget the run consumed
+        elapsed = time.perf_counter() - t_start + wall_offset
+        fields = {
             "problem_id": p.pid,
             "status": status,
             "blocks": p.blocks_done,
@@ -1159,20 +1191,26 @@ def _sample_fleet(
             "grad_evals": p.grad_evals,
             "min_ess": p.min_ess,
             "max_rhat": p.max_rhat,
-            **extra,
-        })
-        if trace.enabled:
-            trace.emit(
-                "problem_converged",
-                problem_id=p.pid,
-                status=status,
-                blocks=p.blocks_done,
-                draws_per_chain=int(p.suff.count[0]),
-                grad_evals=p.grad_evals,
-                min_ess=p.min_ess,
-                max_rhat=p.max_rhat,
-                **extra,
-            )
+            "elapsed_s": round(elapsed, 4),
+            "ess_rate": (
+                round(p.min_ess / elapsed, 4)
+                if p.min_ess is not None and elapsed > 0 else None
+            ),
+            "deadline_s": p.deadline_s,
+            "deadline_headroom_s": (
+                round(p.deadline_s - elapsed, 4)
+                if p.deadline_s is not None else None
+            ),
+            "lane_restarts": p.lane_restarts,
+            "max_restarts": p.max_restarts,
+        }
+        fields.update(extra)
+        emit({"event": "problem_done", **fields})
+        emitted = (
+            trace.emit("problem_converged", **fields)
+            if trace.enabled else None
+        )
+        return emitted or {"event": "problem_converged", **fields}
 
     def poison_lane_site(st):
         """``fleet.lane_nan`` (action ``nan``, arg = problem ordinal,
@@ -1707,9 +1745,14 @@ def _sample_fleet(
                 ):
                     # the tenant's own gate target tripped: it exits
                     # budget_exhausted, masked like a converged problem
-                    # — it never poisons (or restarts) its neighbors
+                    # — it never poisons (or restarts) its neighbors.
+                    # A blown deadline is a per-tenant SLO failure: the
+                    # flight recorder captures the moment
                     p.budget_exhausted = True
-                    finish_problem(p, deadline_s=p.deadline_s)
+                    rec_done = finish_problem(p, deadline_s=p.deadline_s)
+                    recorder.note_anomaly(
+                        f"deadline:{p.pid}", rec_done
+                    )
             n_active = sum(probs[i].active for i in order)
             occupancy = n_active / max(len(order), 1)
             occupancy_trail.append(occupancy)
@@ -1949,6 +1992,12 @@ def _sample_fleet_sequential(
 
     t0 = time.perf_counter()
     b = spec.num_problems
+    # same forensics destination rule as the vmapped path: bundles land
+    # next to the sweep's own artifacts
+    recorder = telemetry.flight_recorder()
+    recorder.set_workdir(
+        _fleet_workdir(checkpoint_path, metrics_path, draw_store_path)
+    )
     # cumulative sweep wall across supervised attempts: the vmapped path
     # persists elapsed_wall_s in the fleet checkpoint; the hatch has no
     # single checkpoint, so a sidecar next to checkpoint_path carries
@@ -2161,11 +2210,26 @@ def _sample_fleet_sequential(
             if stopped == "deadline":
                 # the tenant's own clock ran out (possibly mid-retries):
                 # a budget outcome, NOT a quarantine — faults_seen keeps
-                # the honest count of restarts actually consumed
+                # the honest count of restarts actually consumed.  Same
+                # forensic parity as the vmapped path: a blown per-
+                # tenant deadline dumps a postmortem bundle
                 results.append(empty_result(
                     pid, budget_exhausted=True,
                     lane_restarts=faults_seen,
                 ))
+                recorder.record_anomaly(
+                    f"deadline:{pid}",
+                    trace,
+                    "problem_converged",
+                    problem_id=pid,
+                    status="budget_exhausted",
+                    deadline_s=deadline_i,
+                    deadline_headroom_s=round(
+                        deadline_i - sweep_wall(), 4
+                    ),
+                    lane_restarts=faults_seen,
+                    max_restarts=mr_i,
+                )
                 continue
             if stopped == "sweep":
                 # the FLEET budget cut this problem off before its retry
@@ -2179,15 +2243,17 @@ def _sample_fleet_sequential(
                 pid, failed=_FAULT_POISONED,
                 failed_reason=fault_reason, lane_restarts=faults_seen,
             ))
-            if trace.enabled:
-                trace.emit(
-                    "problem_quarantined",
-                    problem_id=pid,
-                    status=f"failed:{_FAULT_POISONED}",
-                    fault=_FAULT_POISONED,
-                    reason=fault_reason,
-                    lane_restarts=faults_seen,
-                )
+            recorder.record_anomaly(
+                f"quarantine:{pid}",
+                trace,
+                "problem_quarantined",
+                problem_id=pid,
+                status=f"failed:{_FAULT_POISONED}",
+                fault=_FAULT_POISONED,
+                reason=fault_reason,
+                lane_restarts=faults_seen,
+                max_restarts=mr_i,
+            )
             continue
         grad_evals = int(sum(
             r.get("block_grad_evals", 0)

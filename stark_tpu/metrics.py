@@ -46,11 +46,17 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "RunHealth",
+    "STATUS_SCHEMA",
     "TraceCollector",
     "METRIC_PREFIX",
 ]
 
 METRIC_PREFIX = "stark"
+
+#: version of the ``/status`` JSON contract (stamped as its ``schema``
+#: field): bump when a consumer-visible key changes shape.  2 = PR 11
+#: (schema/uptime_s/last_postmortem + per-problem SLO gauges).
+STATUS_SCHEMA = 2
 
 #: default histogram buckets (seconds) — block/checkpoint walls span
 #: ~10 ms (tiny CPU drills) to minutes (compile-inclusive first blocks)
@@ -106,6 +112,15 @@ class _Metric:
         """(suffix, labels, value) rows for render()."""
         with self._lock:
             return [("", dict(k), v) for k, v in sorted(self._series.items())]
+
+    def clear(self) -> None:
+        """Drop every labeled series of this family (renders nothing
+        until the next write).  Counters stay process-monotone by
+        policy — ``clear`` exists for per-run gauges (the per-problem
+        SLO rollups) that must reset on a fresh ``run_start`` so run
+        B never scrapes run A's tenants."""
+        with self._lock:
+            self._series.clear()
 
 
 class Counter(_Metric):
@@ -178,6 +193,11 @@ class Histogram(_Metric):
                     row[i] += 1
             row[-2] += value
             row[-1] += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._counts.clear()
 
     def samples(self) -> List[Tuple[str, Dict[str, str], float]]:
         out = []
@@ -484,6 +504,24 @@ class TraceCollector:
             "ragged-NUTS useful-gradient fraction of the last block "
             "(STARK_RAGGED_NUTS; 1.0 = no lane-sync waste)",
         )
+        # -- per-tenant SLO rollups (fleet problem_* events; labeled by
+        # -- problem id, reset on a fresh run_start) --
+        self.g_problem_ess_rate = r.gauge(
+            f"{p}_problem_ess_rate",
+            "per-problem min-ESS per cumulative wall second at its "
+            "terminal event — the tenant's delivered sampling rate",
+        )
+        self.g_problem_headroom = r.gauge(
+            f"{p}_problem_deadline_headroom_s",
+            "per-problem deadline minus elapsed wall at its terminal "
+            "event (negative = the tenant's deadline was missed); only "
+            "problems with a deadline budget appear",
+        )
+        self.g_problem_restart_burn = r.gauge(
+            f"{p}_problem_restart_burn",
+            "fraction of the per-problem restart budget consumed "
+            "(1.0 = the next lane fault quarantines the tenant)",
+        )
         self.g_healthy = r.gauge(
             f"{p}_healthy", "1 when /healthz reports 200, else 0"
         )
@@ -568,9 +606,14 @@ class TraceCollector:
             # attempt and clear the previous run's progress/health so
             # /status never reports run A's draws as run B's (a restart
             # retry keeps them — including degraded state: quarantines
-            # survive supervised restarts by design)
+            # survive supervised restarts by design).  The per-problem
+            # SLO gauges reset with the run: run B's scrape must never
+            # serve run A's tenants
             self.g_attempt.set(1.0)
             self.g_fleet_degraded.set(0.0)
+            self.g_problem_ess_rate.clear()
+            self.g_problem_headroom.clear()
+            self.g_problem_restart_burn.clear()
             self._set_status(
                 phase="starting", run=rec.get("run", 0), meta=meta,
                 block=None, draws_per_chain=None, ess_forecast=None,
@@ -677,6 +720,39 @@ class TraceCollector:
         self._set_status(phase="sample", block=rec.get("block"))
         self._sample_device_memory()
 
+    def _set_slo_gauges(self, rec: Dict[str, Any]) -> None:
+        """Per-tenant SLO rollups from a fleet ``problem_*`` event:
+        ESS rate and deadline headroom ride the terminal events'
+        precomputed fields; restart burn is derivable from any event
+        carrying the lane-restart pair."""
+        pid = rec.get("problem_id")
+        if pid is None:
+            return
+        if isinstance(rec.get("ess_rate"), (int, float)):
+            self.g_problem_ess_rate.set(
+                float(rec["ess_rate"]), problem=str(pid)
+            )
+        if isinstance(rec.get("deadline_headroom_s"), (int, float)):
+            self.g_problem_headroom.set(
+                float(rec["deadline_headroom_s"]), problem=str(pid)
+            )
+        restarts = rec.get("lane_restarts")
+        if isinstance(restarts, (int, float)):
+            budget = rec.get("max_restarts")
+            if isinstance(budget, (int, float)):
+                # max_restarts=0 is a valid budget meaning NO headroom:
+                # the next lane fault quarantines the tenant — burn 1.0,
+                # exactly the gauge's definition
+                burn = (
+                    1.0 if budget <= 0
+                    else min(float(restarts) / float(budget), 1.0)
+                )
+            else:
+                # unknown budget (older writers): any consumed restart
+                # reads as fully burnt, none as untouched
+                burn = 1.0 if restarts > 0 else 0.0
+            self.g_problem_restart_burn.set(burn, problem=str(pid))
+
     def _on_problem_converged(self, rec: Dict[str, Any]) -> None:
         status = str(rec.get("status", "converged"))
         self.fleet_problems_done.inc(status=status)
@@ -684,12 +760,14 @@ class TraceCollector:
             self.g_fleet_converged.set(
                 self.fleet_problems_done.value(status="converged")
             )
+        self._set_slo_gauges(rec)
         # /status carries the per-problem identity of the latest finisher
         # so an operator can see WHICH posterior just completed
         done = {
             k: rec[k]
             for k in ("problem_id", "status", "blocks", "draws_per_chain",
-                      "grad_evals", "min_ess", "max_rhat")
+                      "grad_evals", "min_ess", "max_rhat", "ess_rate",
+                      "deadline_headroom_s")
             if rec.get(k) is not None
         }
         with self._lock:
@@ -702,6 +780,7 @@ class TraceCollector:
         """A lane fault was CONTAINED: one problem cold-restarted in
         place.  Recovery, not unhealth — RunHealth never trips."""
         self.fleet_lane_reseeds.inc()
+        self._set_slo_gauges(rec)
         seen = {
             k: rec[k]
             for k in ("problem_id", "fault", "lane_restarts",
@@ -723,6 +802,7 @@ class TraceCollector:
         self.fleet_problems_done.inc(status=status)
         self.fleet_quarantined.inc()
         self.g_fleet_degraded.set(1.0)
+        self._set_slo_gauges(rec)
         lost_rec = {
             k: rec[k]
             for k in ("problem_id", "fault", "reason", "lane_restarts",
@@ -874,6 +954,7 @@ class TraceCollector:
         if attempt is not None:
             snap["attempt"] = int(attempt)
         snap.update(
+            schema=STATUS_SCHEMA,
             healthy=healthy,
             health_detail=detail,
             beat_age_s=round(time.monotonic() - self._last_beat, 3),
@@ -883,5 +964,9 @@ class TraceCollector:
                 + self.blocks.value(phase="warmup")
             ),
             draws_total=int(self.draws.value()),
+            # most recent postmortem bundle this process dumped (the
+            # flight recorder's {path, trigger, ts}; null when none) —
+            # the operator's jump-link from "it restarted" to forensics
+            last_postmortem=telemetry.last_postmortem(),
         )
         return snap

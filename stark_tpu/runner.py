@@ -880,14 +880,23 @@ def _sample_until_converged(
             elif adapt_export_path:
                 emit({"event": "adapt_export_skipped", "reason": "imported"})
         else:
-            if init_params is not None:
-                z0 = jnp.broadcast_to(
-                    fm.unconstrain(init_params), (chains, fm.ndim)
-                )
-            else:
-                z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
-            z0 = ap.put_chains(z0)
-            warm_keys = ap.put_chains(jax.random.split(key_warm, chains))
+            # chain-position init is the first real dispatch of the
+            # per-chain path (vmapped init_flat compiles here): a
+            # compile-stage phase covers it so the span timeline
+            # (profiling.spans_from_events) attributes it instead of
+            # reporting pre-warmup slack
+            with trace.phase("compile", stage="chain_init"):
+                if init_params is not None:
+                    z0 = jnp.broadcast_to(
+                        fm.unconstrain(init_params), (chains, fm.ndim)
+                    )
+                else:
+                    z0 = jax.vmap(fm.init_flat)(
+                        jax.random.split(key_init, chains)
+                    )
+                z0 = ap.put_chains(z0)
+                warm_keys = ap.put_chains(jax.random.split(key_warm, chains))
+                jax.block_until_ready(z0)
             # the segmented warmup driver reads the ambient trace, which
             # the public wrapper pinned to THIS run's trace
             state, step_size, inv_mass, n_div = seg_warmup(

@@ -27,9 +27,17 @@ Endpoints:
     ``*_fleet_degraded`` / ``*_fleet_problems_quarantined_total``
     metrics; 503 stays reserved for process-level unhealth (stall,
     restart in progress, restart budget exhausted).
-  * ``GET /status``   — JSON snapshot: current phase, block index, ESS
-    progress/forecast, attempt number, restart record, run metadata
-    (model/kernel/chains + provenance).
+  * ``GET /status``   — JSON snapshot: ``schema`` (contract version —
+    `metrics.STATUS_SCHEMA`; consumers key on it before trusting the
+    shape), ``uptime_s`` (exporter uptime), current phase, block index,
+    ESS progress/forecast, attempt number, restart record, run metadata
+    (model/kernel/chains + provenance), per-problem fleet state, and
+    ``last_postmortem`` — the most recent flight-recorder bundle this
+    process dumped (``{path, trigger, ts}``; null when none).
+
+Probe contract: ``python -m stark_tpu status --json`` prints ONE
+machine-parseable line ``{"endpoint", "code", "body"}`` for any of the
+three endpoints (body parsed when the response was JSON).
 
 The server is **process-scoped, not attempt-scoped**: `supervise` may
 restart the run many times, the daemon (and the monotone counters behind

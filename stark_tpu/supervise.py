@@ -408,6 +408,13 @@ def supervised_sample(
     store_path = kwargs.get("draw_store_path")
     budget = RestartBudget(max_restarts, restart_window_s)
 
+    # postmortem flight recorder: capture the run's recent events for
+    # the duration of supervision and dump a forensic bundle into the
+    # workdir on every restart (on_failure) / stall (watchdog) — scoped
+    # install so the zero-listener contract holds outside runs
+    recorder = telemetry.flight_recorder(workdir)
+    recorder.install()
+
     attempt = 0
 
     def on_failure(e: BaseException, fault: str, resumed: bool) -> None:
@@ -440,102 +447,110 @@ def supervised_sample(
         )
         if metrics_path:  # caller may disable metrics with None
             _append_record(metrics_path, rec)
-        if trace.enabled:
-            # the failure-detection record, in the trace's vocabulary:
-            # a chain-health transition, not a new run.  Budget state
-            # rides along so live observers (/status, /metrics) can show
-            # how much supervision headroom remains without re-deriving
-            # the sliding window from the restart history.
-            trace.emit(
-                "chain_health",
-                status="restart",
-                attempt=attempt,
-                fault=fault,
-                error=f"{type(e).__name__}: {e}",
-                resumed_from_checkpoint=resumed,
-                backoff_s=round(delay, 3),
-                restarts_in_window=budget.in_window(),
-                max_restarts=budget.max_restarts,
-            )
+        # the failure-detection record, in the trace's vocabulary:
+        # a chain-health transition, not a new run.  Budget state
+        # rides along so live observers (/status, /metrics) can show
+        # how much supervision headroom remains without re-deriving
+        # the sliding window from the restart history.
+        # the restart documents a crash: the flight recorder dumps the
+        # postmortem bundle (recent events + snapshots) into workdir
+        # whether or not tracing was on
+        recorder.record_anomaly(
+            f"restart:{fault}",
+            trace,
+            "chain_health",
+            status="restart",
+            attempt=attempt,
+            fault=fault,
+            error=f"{type(e).__name__}: {e}",
+            resumed_from_checkpoint=resumed,
+            backoff_s=round(delay, 3),
+            restarts_in_window=budget.in_window(),
+            max_restarts=budget.max_restarts,
+        )
         if exhausted:
-            if trace.enabled:
-                trace.emit(
-                    "chain_health",
-                    status="restart_budget_exhausted",
-                    restarts_in_window=budget.in_window(),
-                    window_s=restart_window_s,
-                )
+            recorder.record_anomaly(
+                "restart_budget_exhausted",
+                trace,
+                "chain_health",
+                status="restart_budget_exhausted",
+                restarts_in_window=budget.in_window(),
+                window_s=restart_window_s,
+            )
             raise e
         if delay > 0:
             time.sleep(delay)
 
-    while True:
-        fail_point("supervise.attempt")
-        resume: Optional[str] = None
-        if os.path.exists(ckpt_path):
-            healthy, reason = checkpoint_health(ckpt_path)
-            if healthy:
-                resume = ckpt_path
-            else:
-                # corrupt/poisoned checkpoint: quarantine it (keeping the
-                # forensic copy) and cold-start — NEVER silently: the
-                # reason lands in the log and the trace
-                log.warning("quarantining %s: %s", ckpt_path, reason)
-                if trace.enabled:
-                    trace.emit(
-                        "chain_health", status="quarantine",
-                        path=ckpt_path, reason=reason,
-                    )
-                quarantine_path(ckpt_path)
-        resume = agree_resume(resume, quarantine=quarantine_path, trace=trace)
-        if resume is None and store_path and os.path.exists(store_path):
-            # cold start: draws persisted by a discarded run must not mix
-            # into this run's store (a later resume reads the whole store)
-            quarantine_path(store_path)
-        wd: Optional[Watchdog] = None
-        try:
-            remaining = (
-                # floor at 1s: with the deadline already blown the attempt
-                # still runs (resuming its checkpoint) and the runner stops
-                # it at the first completed block — partial > nothing
-                max(deadline - time.monotonic(), 1.0)
-                if deadline is not None
-                else None
-            )
-            # ambient install: the runner and the drivers below it pick up
-            # this supervisor's trace even though only ``trace=`` was given
-            with telemetry.use_trace(trace):
-                if stall_timeout_s is not None:
-                    wd = Watchdog(
-                        stall_timeout_s, trace=trace, label="supervise"
-                    ).start()
-                try:
-                    return _runner(
-                        model,
-                        data,
-                        seed=seed + attempt if reseed_on_restart else seed,
-                        checkpoint_path=ckpt_path,
-                        resume_from=resume,
-                        metrics_path=metrics_path,
-                        reseed=attempt if (attempt and reseed_on_restart) else None,
-                        time_budget_s=remaining,
-                        trace=trace,
-                        **kwargs,
-                    )
-                finally:
-                    if wd is not None:
-                        wd.stop()
-        except KeyboardInterrupt:
-            # ONLY a watchdog-fired interrupt is a stall; a user Ctrl-C
-            # (no stall flag) propagates untouched — supervision must
-            # never eat a genuine interrupt
-            if wd is not None and wd.consume_stall():
-                e = StallError(
-                    f"no progress beat within {stall_timeout_s}s "
-                    "(watchdog aborted the attempt)"
+    try:
+        while True:
+            fail_point("supervise.attempt")
+            resume: Optional[str] = None
+            if os.path.exists(ckpt_path):
+                healthy, reason = checkpoint_health(ckpt_path)
+                if healthy:
+                    resume = ckpt_path
+                else:
+                    # corrupt/poisoned checkpoint: quarantine it (keeping the
+                    # forensic copy) and cold-start — NEVER silently: the
+                    # reason lands in the log and the trace
+                    log.warning("quarantining %s: %s", ckpt_path, reason)
+                    if trace.enabled:
+                        trace.emit(
+                            "chain_health", status="quarantine",
+                            path=ckpt_path, reason=reason,
+                        )
+                    quarantine_path(ckpt_path)
+            resume = agree_resume(resume, quarantine=quarantine_path, trace=trace)
+            if resume is None and store_path and os.path.exists(store_path):
+                # cold start: draws persisted by a discarded run must not mix
+                # into this run's store (a later resume reads the whole store)
+                quarantine_path(store_path)
+            wd: Optional[Watchdog] = None
+            try:
+                remaining = (
+                    # floor at 1s: with the deadline already blown the attempt
+                    # still runs (resuming its checkpoint) and the runner stops
+                    # it at the first completed block — partial > nothing
+                    max(deadline - time.monotonic(), 1.0)
+                    if deadline is not None
+                    else None
                 )
-                on_failure(e, FAULT_STALL, resume is not None)
-            else:
-                raise
-        except Exception as e:  # noqa: BLE001 — supervision boundary
-            on_failure(e, classify_fault(e), resume is not None)
+                # ambient install: the runner and the drivers below it pick up
+                # this supervisor's trace even though only ``trace=`` was given
+                with telemetry.use_trace(trace):
+                    if stall_timeout_s is not None:
+                        wd = Watchdog(
+                            stall_timeout_s, trace=trace, label="supervise"
+                        ).start()
+                    try:
+                        return _runner(
+                            model,
+                            data,
+                            seed=seed + attempt if reseed_on_restart else seed,
+                            checkpoint_path=ckpt_path,
+                            resume_from=resume,
+                            metrics_path=metrics_path,
+                            reseed=attempt if (attempt and reseed_on_restart) else None,
+                            time_budget_s=remaining,
+                            trace=trace,
+                            **kwargs,
+                        )
+                    finally:
+                        if wd is not None:
+                            wd.stop()
+            except KeyboardInterrupt:
+                # ONLY a watchdog-fired interrupt is a stall; a user Ctrl-C
+                # (no stall flag) propagates untouched — supervision must
+                # never eat a genuine interrupt
+                if wd is not None and wd.consume_stall():
+                    e = StallError(
+                        f"no progress beat within {stall_timeout_s}s "
+                        "(watchdog aborted the attempt)"
+                    )
+                    on_failure(e, FAULT_STALL, resume is not None)
+                else:
+                    raise
+            except Exception as e:  # noqa: BLE001 — supervision boundary
+                on_failure(e, classify_fault(e), resume is not None)
+    finally:
+        recorder.uninstall()

@@ -114,9 +114,18 @@ FLEET_EVENT_TYPES = frozenset({"fleet_block", "problem_converged",
                                "fleet_compact", "problem_reseeded",
                                "problem_quarantined"})
 
+#: profiling event types (stark_tpu.profiling): ``span`` — one
+#: attributed slice of the run timeline (``kind`` in
+#: `profiling.SPAN_KINDS`, ``start_s``/``end_s``/``dur_s`` on the
+#: trace's wall clock) derived from the phase events by an opt-in
+#: `profiling.SpanRecorder` (STARK_PROFILE_SPANS=1; default traces
+#: carry none and stay byte-identical)
+PROFILING_EVENT_TYPES = frozenset({"span"})
+
 #: the complete WRITER registry: every emit()/phase() call in stark_tpu/
 #: must use one of these names (tools/lint_trace_schema.py enforces it)
-ALL_EVENT_TYPES = EVENT_TYPES | AUX_EVENT_TYPES | FLEET_EVENT_TYPES
+ALL_EVENT_TYPES = (EVENT_TYPES | AUX_EVENT_TYPES | FLEET_EVENT_TYPES
+                   | PROFILING_EVENT_TYPES)
 
 #: envelope keys every event must carry (validate_event)
 ENVELOPE_KEYS = ("schema", "event", "ts", "wall_s", "run")
@@ -619,6 +628,304 @@ def iter_trace(path: str, *, strict: bool = True) -> Iterator[Dict[str, Any]]:
 
 def read_trace(path: str, *, strict: bool = True) -> List[Dict[str, Any]]:
     return list(iter_trace(path, strict=strict))
+
+
+# ---------------------------------------------------------------------------
+# postmortem flight recorder
+# ---------------------------------------------------------------------------
+
+#: ring capacity (events) — STARK_FLIGHT_RING overrides
+FLIGHT_RING_ENV = "STARK_FLIGHT_RING"
+#: STARK_FLIGHT_RECORDER=0 disables capture AND dumps (the repo-wide
+#: ``=0 opts out`` env convention); checked at use time so a drill can
+#: toggle it without rebuilding the process singleton
+FLIGHT_RECORDER_ENV = "STARK_FLIGHT_RECORDER"
+#: how many postmortem bundles to keep per workdir (oldest pruned) —
+#: a crash-looping run must not fill the disk with forensics
+POSTMORTEM_KEEP_ENV = "STARK_POSTMORTEM_KEEP"
+
+_POSTMORTEM_SCHEMA = 1
+
+
+class FlightRecorder:
+    """Always-on, zero-dependency postmortem capture.
+
+    A bounded in-memory ring of the most recent trace events plus
+    derived aggregates (per-type counts), installed as an event
+    listener for the duration of any supervised / fleet / watchdog-
+    armed run (refcounted — the zero-listener contract holds outside
+    runs), and a ``dump_postmortem`` that writes a forensic bundle to
+    the workdir the moment an anomaly fires: supervised restart,
+    watchdog stall, fleet lane quarantine, per-problem deadline blow.
+    The recorder only ever READS the trace stream — with it enabled
+    and no anomaly, trace files are byte-identical to historical
+    behavior and nothing lands on disk.
+
+    Bundle layout (``<workdir>/postmortem/pmNNN-<trigger>/``)::
+
+        events.jsonl   — ring contents (the last ~256 events, oldest
+                         first; the triggering event is the final line)
+        meta.json      — schema, trigger, unix ts, the triggering
+                         event, `provenance()`, active config (the
+                         STARK_*/JAX_*/BENCH_* environment), per-type
+                         event counts
+        status.json    — the live /status snapshot (only when a status
+                         daemon is running in-process)
+        metrics.prom   — the metrics exposition (same condition)
+
+    Dumps never raise into the run (forensics must not kill the thing
+    they document) and old bundles are pruned past
+    ``STARK_POSTMORTEM_KEEP`` (default 16).
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get(FLIGHT_RING_ENV, "") or 256)
+            except ValueError:
+                capacity = 256
+        from collections import deque
+
+        self._ring: Any = deque(maxlen=max(int(capacity), 16))
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._workdir: Optional[str] = None
+        self._refs = 0
+        self._listening = False
+        self._last: Optional[Dict[str, Any]] = None
+        self._seq = 0
+
+    @property
+    def enabled(self) -> bool:
+        return os.environ.get(FLIGHT_RECORDER_ENV, "1") != "0"
+
+    def set_workdir(self, workdir: Optional[str]) -> None:
+        """Where bundles land; the supervising entry point sets it."""
+        with self._lock:
+            self._workdir = workdir
+
+    # -- capture -----------------------------------------------------------
+
+    def install(self) -> "FlightRecorder":
+        """Refcounted listener subscribe: nested supervision layers
+        (supervisor + watchdog + fleet) each install/uninstall and the
+        listener is registered exactly once, removed at zero.  The ref
+        is taken even when disabled (install/uninstall stay paired);
+        only the listener registration is gated on ``enabled`` — and
+        re-checked on EVERY install, so a recorder re-enabled between
+        nested installs starts capturing at the next one instead of
+        staying deaf until the refcount drains."""
+        with self._lock:
+            self._refs += 1
+            subscribe = self.enabled and not self._listening
+            if subscribe:
+                self._listening = True
+        if subscribe:
+            add_event_listener(self._on_event)
+        return self
+
+    def uninstall(self) -> None:
+        with self._lock:
+            if self._refs == 0:
+                return
+            self._refs -= 1
+            last = self._refs == 0
+            if last:
+                self._listening = False
+        if last:
+            # no-op when the listener was never registered (disabled)
+            remove_event_listener(self._on_event)
+
+    def _on_event(self, rec: Dict[str, Any]) -> None:
+        ev = rec.get("event")
+        if ev == "span":
+            # pure re-derivations of phase events already in the ring
+            # (profiling.SpanRecorder): ringing them would shrink the
+            # forensic window ~4x under STARK_PROFILE_SPANS=1
+            return
+        with self._lock:
+            self._ring.append(rec)
+            if isinstance(ev, str):
+                self._counts[ev] = self._counts.get(ev, 0) + 1
+
+    def aggregates(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "events_by_type": dict(self._counts),
+                "ring_len": len(self._ring),
+                "ring_capacity": self._ring.maxlen,
+                "workdir": self._workdir,
+            }
+
+    def last_postmortem(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._last) if self._last else None
+
+    # -- dumps -------------------------------------------------------------
+
+    def record_anomaly(self, trigger: str, trace, event: str,
+                       **fields) -> Optional[str]:
+        """The one anomaly idiom every wiring site uses: emit the event
+        on ``trace`` when tracing is on (the listener rings the emitted
+        record), fall back to a synthetic record when it isn't, and
+        dump the postmortem bundle either way.  Returns the bundle
+        path (None when disabled or no workdir is known)."""
+        emitted = trace.emit(event, **fields) if trace.enabled else None
+        return self.note_anomaly(
+            trigger, emitted or {"event": event, **fields}
+        )
+
+    def note_anomaly(
+        self,
+        trigger: str,
+        rec: Optional[Dict[str, Any]] = None,
+        workdir: Optional[str] = None,
+    ) -> Optional[str]:
+        """One anomaly happened: make sure its record is in the ring,
+        then dump a bundle.  ``rec`` is the already-emitted trace
+        record when tracing was on (the listener has it — compared by
+        content, never duplicated) or a synthetic record the caller
+        built when it wasn't.  Returns the bundle path (None when
+        disabled or no workdir is known)."""
+        if not self.enabled:
+            return None
+        if rec is not None:
+            rec = dict(rec) if "ts" in rec else {"ts": time.time(), **rec}
+            with self._lock:
+                # when tracing is on the listener already ringed the
+                # emitted record; the copy above breaks identity, so
+                # dedup by content against the ring tail
+                if not self._ring or self._ring[-1] != rec:
+                    self._ring.append(rec)
+                    ev = rec.get("event")
+                    if isinstance(ev, str):
+                        self._counts[ev] = self._counts.get(ev, 0) + 1
+        return self.dump_postmortem(trigger, trigger_event=rec,
+                                    workdir=workdir)
+
+    def dump_postmortem(
+        self,
+        trigger: str,
+        trigger_event: Optional[Dict[str, Any]] = None,
+        workdir: Optional[str] = None,
+    ) -> Optional[str]:
+        """Write one bundle; returns its path (None when disabled, no
+        workdir, or the write failed — never raises)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            wd = workdir or self._workdir
+        if not wd:
+            return None
+        import logging
+        import re
+
+        log = logging.getLogger("stark_tpu.telemetry")
+        slug = re.sub(r"[^A-Za-z0-9_.-]+", "_", trigger)[:60] or "anomaly"
+        try:
+            root = os.path.join(wd, "postmortem")
+            os.makedirs(root, exist_ok=True)
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+                ring = list(self._ring)
+                counts = dict(self._counts)
+            d = os.path.join(root, f"pm{seq:03d}-{slug}")
+            while os.path.exists(d):
+                seq += 1
+                d = os.path.join(root, f"pm{seq:03d}-{slug}")
+            os.makedirs(d)
+            with open(os.path.join(d, "events.jsonl"), "w") as f:
+                for rec in ring:
+                    f.write(json.dumps(rec, default=str) + "\n")
+            config = {
+                k: v for k, v in sorted(os.environ.items())
+                if k.startswith(("STARK_", "JAX_", "BENCH_"))
+            }
+            meta = {
+                "schema": _POSTMORTEM_SCHEMA,
+                "trigger": trigger,
+                "ts": time.time(),
+                "trigger_event": trigger_event,
+                "provenance": provenance(),
+                "config": config,
+                "events_by_type": counts,
+                "ring_len": len(ring),
+            }
+            with open(os.path.join(d, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=1, default=str)
+                f.write("\n")
+            # live /status + /metrics snapshots ride along when a status
+            # daemon is up in-process (lazy import: statusd -> metrics ->
+            # telemetry is safe at call time, and absent otherwise)
+            try:
+                from . import statusd
+
+                srv = statusd.get_server()
+                if srv is not None:
+                    with open(os.path.join(d, "status.json"), "w") as f:
+                        json.dump(srv.collector.status(), f, indent=1,
+                                  default=str)
+                        f.write("\n")
+                    with open(os.path.join(d, "metrics.prom"), "w") as f:
+                        f.write(srv.registry.render())
+            except Exception:  # noqa: BLE001 — snapshots are best-effort
+                pass
+            self._prune(root)
+            info = {"path": d, "trigger": trigger, "ts": meta["ts"]}
+            with self._lock:
+                self._last = info
+            log.warning("postmortem bundle written: %s (%s)", d, trigger)
+            return d
+        except Exception as e:  # noqa: BLE001 — forensics must not kill the run
+            log.warning("postmortem dump failed (%s): %s",
+                        type(e).__name__, e)
+            return None
+
+    def _prune(self, root: str) -> None:
+        try:
+            keep = int(os.environ.get(POSTMORTEM_KEEP_ENV, "") or 16)
+        except ValueError:
+            keep = 16
+        try:
+            bundles = sorted(
+                e for e in os.listdir(root)
+                if e.startswith("pm")
+                and os.path.isdir(os.path.join(root, e))
+            )
+            import shutil
+
+            for stale in bundles[:-keep] if keep > 0 else []:
+                shutil.rmtree(os.path.join(root, stale),
+                              ignore_errors=True)
+        except OSError:
+            pass
+
+
+#: process flight-recorder singleton (built on first supervised /
+#: fleet / watchdog-armed run; never from a pure read like /status)
+_FLIGHT: Optional[FlightRecorder] = None
+_FLIGHT_LOCK = threading.Lock()
+
+
+def flight_recorder(workdir: Optional[str] = None) -> FlightRecorder:
+    """The process flight recorder (created on first call).  ``workdir``
+    (when given) becomes the bundle destination for subsequent dumps."""
+    global _FLIGHT
+    with _FLIGHT_LOCK:
+        if _FLIGHT is None:
+            _FLIGHT = FlightRecorder()
+    if workdir is not None:
+        _FLIGHT.set_workdir(workdir)
+    return _FLIGHT
+
+
+def last_postmortem() -> Optional[Dict[str, Any]]:
+    """{path, trigger, ts} of the most recent bundle this process wrote
+    (None if none) — surfaced as ``/status.last_postmortem``.  A pure
+    peek: never creates the recorder."""
+    rec = _FLIGHT
+    return rec.last_postmortem() if rec is not None else None
 
 
 def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None

@@ -160,6 +160,10 @@ class Watchdog:
         self._target = threading.current_thread()
         self.beat()
         self._stop.clear()
+        # flight-recorder capture window: a watchdog-armed run is one
+        # whose stalls must leave a postmortem (scoped install — the
+        # zero-listener contract holds while no watchdog is armed)
+        self._recorder = telemetry.flight_recorder().install()
         telemetry.add_progress_listener(self.beat)
         self._thread = threading.Thread(
             target=self._watch, name=f"stark-watchdog-{self.label}", daemon=True
@@ -172,6 +176,9 @@ class Watchdog:
     def stop(self) -> None:
         self._stop.set()
         telemetry.remove_progress_listener(self.beat)
+        rec, self._recorder = getattr(self, "_recorder", None), None
+        if rec is not None:
+            rec.uninstall()
         with _ACTIVE_LOCK:
             if self in _ACTIVE:
                 _ACTIVE.remove(self)
@@ -186,15 +193,20 @@ class Watchdog:
                 continue
             self.stall_count += 1
             self._stalled.set()
-            if self._trace.enabled:
-                self._trace.emit(
-                    "chain_health",
-                    status="stall",
-                    label=self.label,
-                    deadline_s=self.deadline_s,
-                    idle_s=round(idle, 3),
-                    stall_count=self.stall_count,
-                )
+            # the stall IS the forensic moment: emit the stall event and
+            # dump the postmortem bundle before firing the abort (the
+            # workdir was set by whoever supervises this run; no
+            # workdir → recorded only)
+            telemetry.flight_recorder().record_anomaly(
+                "stall",
+                self._trace,
+                "chain_health",
+                status="stall",
+                label=self.label,
+                deadline_s=self.deadline_s,
+                idle_s=round(idle, 3),
+                stall_count=self.stall_count,
+            )
             try:
                 if self.on_stall is not None:
                     self.on_stall()

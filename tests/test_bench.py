@@ -124,6 +124,9 @@ _TINY_BENCH_ENV = {
     "BENCH_ADAPT_REUSE": "0",
     # judged-scale extra-evidence legs don't belong in tiny-scale tests
     "BENCH_EXTRA_EVIDENCE": "0",
+    # ...and neither do tiny-scale rows in the committed perf ledger
+    # (the documented =0 opt-out for exactly this case)
+    "STARK_PERF_LEDGER": "0",
     "JAX_PLATFORMS": "cpu",
     "PALLAS_AXON_POOL_IPS": "",
     "BENCH_N": "400",
@@ -174,6 +177,15 @@ def test_bench_emits_partials_and_respects_budget(tmp_path):
     assert not final.get("partial")
     assert final["unit"] == "ess/sec/chip"
     assert final["budget_exhausted"] is True
+    # profiling evidence rides the final line (PR 11): measured from the
+    # supervised leg's trace here, and by contract null — never 0.0 —
+    # when a trace can't say
+    for k in ("compile_s", "dispatch_count", "span_coverage_frac"):
+        assert k in final
+        assert final[k] is None or final[k] > 0
+    assert final["span_coverage_frac"] is None or (
+        final["span_coverage_frac"] <= 1.0
+    )
     # every line is independently parseable and carries the contract keys
     for l in lines:
         assert {"metric", "value", "unit", "vs_baseline"} <= set(l)

@@ -244,6 +244,115 @@ def test_trace_events_and_summary(fleet_run):
     assert s["fleet"]["grad_evals"] == res.total_grad_evals
 
 
+def test_sequential_deadline_dumps_postmortem(tmp_path, monkeypatch):
+    """Forensic parity on the escape hatch: a blown per-problem
+    deadline under STARK_FLEET=0 dumps a postmortem bundle naming the
+    tenant, exactly like the vmapped path (pre-blown deadlines, so the
+    sweep never compiles a kernel)."""
+    import glob
+    import json as _json
+
+    from stark_tpu.fleet import ProblemBudget
+
+    monkeypatch.setenv("STARK_FLEET", "0")
+    budgets = [ProblemBudget(deadline_s=0.0)] * 3
+    spec = FleetSpec.from_problems(
+        _FLEET_MODEL,
+        [dict(y=np.asarray(Y, np.float32),
+              sigma=np.asarray(SIGMA, np.float32))] * 3,
+        budgets=budgets,
+    )
+    res = sample_fleet(
+        spec, metrics_path=str(tmp_path / "m.jsonl"),
+        checkpoint_path=str(tmp_path / "f.ckpt.npz"), **_KW,
+    )
+    assert all(p.status == "budget_exhausted" for p in res.problems)
+    pms = sorted(glob.glob(str(tmp_path / "postmortem" / "pm*")))
+    assert pms, "hatch deadline blow left no postmortem bundle"
+    assert any("deadline_p0000" in p for p in pms)
+    with open(os.path.join(pms[0], "events.jsonl")) as f:
+        events = [_json.loads(l) for l in f if l.strip()]
+    assert events[-1]["event"] == "problem_converged"
+    assert events[-1]["status"] == "budget_exhausted"
+    assert events[-1]["deadline_headroom_s"] <= 0
+
+
+def test_trace_report_renders_quarantine_reason_and_bad_path():
+    """The per-problem fleet table names WHY a problem was lost and
+    where its forensic store copy went (PR 9 fields) — and stays
+    n/a-safe on rows (and whole traces) that predate or lack them."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec_ = importlib.util.spec_from_file_location(
+        "trace_report_q", os.path.join(root, "tools", "trace_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec_)
+    spec_.loader.exec_module(mod)
+
+    def ev(event, **fields):
+        return {"schema": 1, "event": event, "ts": 0.0, "wall_s": 0.0,
+                "run": 1, **fields}
+
+    events = [
+        ev("run_start", entry="sample_fleet", fleet=True, problems=2),
+        ev("problem_converged", problem_id="p0", status="converged",
+           blocks=3, min_ess=80.0),  # no reason/store: renders n/a
+        ev("problem_quarantined", problem_id="p1",
+           status="failed:poisoned_state", fault="poisoned_state",
+           reason="non-finite z after reseed", lane_restarts=2,
+           quarantined_store="/w/draws/p_p1.stkr.bad"),
+    ]
+    out = mod.render_run(events, 1)
+    assert "non-finite z after reseed" in out
+    assert "p_p1.stkr.bad" in out
+    assert "quarantined store" in out
+    assert "n/a" in out  # the converged row's empty forensic columns
+
+
+def test_slo_fields_and_gauges_from_real_fleet_events(fleet_run):
+    """PR 11 per-tenant SLO plumbing, end to end on a real fleet run:
+    terminal problem events carry the rollup fields, the collector
+    turns them into labeled gauges during the run, and a fresh
+    run_start resets the per-problem series."""
+    from stark_tpu.metrics import TraceCollector
+
+    spec, res, _td, trace_path = fleet_run
+    events = read_trace(trace_path)
+    done = [e for e in events if e["event"] == "problem_converged"]
+    assert done
+    for e in done:
+        assert e["elapsed_s"] > 0
+        assert e["ess_rate"] == pytest.approx(
+            e["min_ess"] / e["elapsed_s"], rel=1e-3
+        )
+        # no budgets on this spec: deadline fields are null, never 0.0
+        assert e["deadline_s"] is None
+        assert e["deadline_headroom_s"] is None
+        assert e["lane_restarts"] == 0
+        assert e["max_restarts"] >= 1
+    collector = TraceCollector()
+    for e in events:
+        collector.on_event(e)
+    text = collector.registry.render()
+    for e in done:
+        assert (
+            f'stark_problem_ess_rate{{problem="{e["problem_id"]}"}}' in text
+        )
+        assert (
+            f'stark_problem_restart_burn{{problem="{e["problem_id"]}"}}'
+            in text
+        )
+    # deadline-free tenants register no headroom series
+    assert "stark_problem_deadline_headroom_s{" not in text
+    # fresh run_start -> per-tenant series reset
+    collector.on_event({"event": "run_end", "run": 1, "dur_s": 1.0,
+                        "converged": True})
+    collector.on_event({"event": "run_start", "run": 2, "fleet": True,
+                        "problems": 1})
+    assert "stark_problem_ess_rate{" not in collector.registry.render()
+
+
 def test_trace_report_renders_fleet_table(fleet_run):
     import importlib.util
     import sys

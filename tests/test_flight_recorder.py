@@ -1,0 +1,228 @@
+"""Postmortem flight recorder (telemetry.FlightRecorder): ring capture,
+bundle dumps, scoped listener install, and the supervised wiring.
+
+The contract: every anomaly (supervised restart, watchdog stall, fleet
+quarantine, blown deadline) leaves a bundle whose events.jsonl ends
+with the triggering event; with the recorder enabled and no anomaly,
+nothing lands on disk and traces are untouched; the listener is scoped
+(zero listeners outside runs); STARK_FLIGHT_RECORDER=0 disables it all.
+"""
+
+import json
+import os
+
+import pytest
+
+from stark_tpu import telemetry
+from stark_tpu.telemetry import (
+    FLIGHT_RECORDER_ENV,
+    FlightRecorder,
+    RunTrace,
+)
+
+
+@pytest.fixture(autouse=True)
+def _recorder_enabled(monkeypatch):
+    monkeypatch.delenv(FLIGHT_RECORDER_ENV, raising=False)
+
+
+def _bundle(path):
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    with open(os.path.join(path, "events.jsonl")) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    return meta, events
+
+
+def test_ring_is_bounded_and_counts_aggregate():
+    rec = FlightRecorder(capacity=16)
+    for i in range(50):
+        rec._on_event({"event": "sample_block", "block": i})
+    agg = rec.aggregates()
+    assert agg["ring_len"] == 16
+    assert agg["ring_capacity"] == 16
+    assert agg["events_by_type"]["sample_block"] == 50
+
+
+def test_dump_without_workdir_is_none():
+    rec = FlightRecorder()
+    assert rec.note_anomaly("stall", {"event": "chain_health"}) is None
+    assert rec.last_postmortem() is None
+
+
+def test_note_anomaly_dumps_bundle_with_trigger_event(tmp_path):
+    rec = FlightRecorder(capacity=32)
+    rec.set_workdir(str(tmp_path))
+    rec._on_event({"event": "run_start", "model": "M"})
+    rec._on_event({"event": "sample_block", "block": 1})
+    trig = {"event": "chain_health", "status": "restart",
+            "fault": "transient"}
+    path = rec.note_anomaly("restart:transient", trig)
+    assert path is not None and os.path.isdir(path)
+    assert "restart_transient" in os.path.basename(path)
+    meta, events = _bundle(path)
+    assert meta["schema"] == 1
+    assert meta["trigger"] == "restart:transient"
+    assert meta["trigger_event"]["fault"] == "transient"
+    assert meta["provenance"].keys() >= {"git_sha", "jax_version"}
+    assert isinstance(meta["config"], dict)
+    assert events[0]["event"] == "run_start"
+    assert events[-1]["event"] == "chain_health"
+    assert events[-1]["status"] == "restart"
+    last = rec.last_postmortem()
+    assert last["path"] == path and last["trigger"] == "restart:transient"
+
+
+def test_trace_emitted_trigger_not_duplicated_in_ring(tmp_path):
+    """When tracing is on, the listener already ringed the emitted
+    record — note_anomaly must not append it twice."""
+    rec = FlightRecorder()
+    rec.set_workdir(str(tmp_path))
+    rec.install()
+    try:
+        with RunTrace(str(tmp_path / "t.jsonl")) as tr:
+            emitted = tr.emit("chain_health", status="stall", idle_s=9.9)
+            path = rec.note_anomaly("stall", emitted)
+    finally:
+        rec.uninstall()
+    _meta, events = _bundle(path)
+    stalls = [e for e in events if e.get("status") == "stall"]
+    assert len(stalls) == 1
+
+
+def test_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(FLIGHT_RECORDER_ENV, "0")
+    rec = FlightRecorder()
+    rec.set_workdir(str(tmp_path))
+    rec.install()
+    try:
+        assert not telemetry._EVENT_LISTENERS
+        assert rec.note_anomaly("stall", {"event": "chain_health"}) is None
+    finally:
+        rec.uninstall()
+    assert not os.path.exists(tmp_path / "postmortem")
+
+
+def test_install_is_refcounted():
+    rec = FlightRecorder()
+    rec.install()
+    rec.install()
+    assert telemetry._EVENT_LISTENERS.count(rec._on_event) == 1
+    rec.uninstall()
+    assert rec._on_event in telemetry._EVENT_LISTENERS
+    rec.uninstall()
+    assert rec._on_event not in telemetry._EVENT_LISTENERS
+    rec.uninstall()  # over-uninstall is a no-op
+    assert not telemetry._EVENT_LISTENERS
+
+
+def test_reenabled_recorder_subscribes_on_next_install(monkeypatch):
+    """The env knob is checked at use time: a recorder installed while
+    disabled starts capturing at the NEXT install after re-enable —
+    nested installs must not leave it deaf until the refcount drains."""
+    monkeypatch.setenv(FLIGHT_RECORDER_ENV, "0")
+    rec = FlightRecorder()
+    rec.install()  # disabled: ref taken, no listener
+    assert rec._on_event not in telemetry._EVENT_LISTENERS
+    monkeypatch.delenv(FLIGHT_RECORDER_ENV)
+    rec.install()  # re-enabled: the nested install subscribes
+    assert telemetry._EVENT_LISTENERS.count(rec._on_event) == 1
+    rec.uninstall()
+    assert rec._on_event in telemetry._EVENT_LISTENERS
+    rec.uninstall()
+    assert not telemetry._EVENT_LISTENERS
+
+
+def test_record_anomaly_emits_and_dumps_once(tmp_path):
+    """The shared wiring idiom: with tracing on, record_anomaly emits
+    the event, the ring holds it exactly once, and the bundle's final
+    entry is the emitted record; with tracing off, a synthetic record
+    stands in."""
+    rec = FlightRecorder()
+    rec.set_workdir(str(tmp_path))
+    rec.install()
+    try:
+        with RunTrace(str(tmp_path / "t.jsonl")) as tr:
+            path = rec.record_anomaly(
+                "stall", tr, "chain_health", status="stall", idle_s=4.2
+            )
+    finally:
+        rec.uninstall()
+    _meta, events = _bundle(path)
+    assert [e for e in events if e.get("status") == "stall"] == [events[-1]]
+    assert events[-1]["idle_s"] == 4.2
+    # tracing off: the synthetic fallback still dumps with the trigger
+    path2 = rec.record_anomaly(
+        "stall", telemetry.NULL_TRACE, "chain_health", status="stall"
+    )
+    meta2, events2 = _bundle(path2)
+    assert meta2["trigger_event"]["event"] == "chain_health"
+    assert events2[-1]["status"] == "stall"
+
+
+def test_bundle_pruning_keeps_most_recent(tmp_path, monkeypatch):
+    monkeypatch.setenv("STARK_POSTMORTEM_KEEP", "3")
+    rec = FlightRecorder()
+    rec.set_workdir(str(tmp_path))
+    for i in range(6):
+        rec.note_anomaly(f"restart:t{i}", {"event": "chain_health"})
+    bundles = sorted(os.listdir(tmp_path / "postmortem"))
+    assert len(bundles) == 3
+    assert any("t5" in b for b in bundles)
+    assert not any("t0" in b for b in bundles)
+
+
+def test_status_snapshot_carries_last_postmortem(tmp_path):
+    from stark_tpu.metrics import STATUS_SCHEMA, TraceCollector
+
+    rec = telemetry.flight_recorder(str(tmp_path))
+    path = rec.note_anomaly("stall", {"event": "chain_health",
+                                      "status": "stall"})
+    snap = TraceCollector().status()
+    assert snap["schema"] == STATUS_SCHEMA
+    assert snap["uptime_s"] >= 0
+    assert snap["last_postmortem"]["path"] == path
+    assert snap["last_postmortem"]["trigger"] == "stall"
+
+
+def test_supervised_restart_dumps_bundle(tmp_path):
+    """End-to-end wiring: a supervised run that restarts once leaves a
+    postmortem bundle in the workdir with the restart as trigger, and
+    the listener table is empty again afterwards."""
+    import jax.numpy as jnp
+
+    from stark_tpu import faults
+    from stark_tpu.model import Model, ParamSpec
+    from stark_tpu.supervise import supervised_sample
+
+    class _Std(Model):
+        def param_spec(self):
+            return {"x": ParamSpec((2,))}
+
+        def log_prior(self, p):
+            return -0.5 * jnp.sum(p["x"] ** 2)
+
+        def log_lik(self, p, data):
+            return jnp.zeros(())
+
+    faults.reset()
+    faults.configure("runner.carried_nan=nan*1")
+    try:
+        res = supervised_sample(
+            _Std(), workdir=str(tmp_path), seed=0, chains=2,
+            block_size=25, max_blocks=8, min_blocks=2, rhat_target=10.0,
+            ess_target=1.0, num_warmup=40, kernel="hmc", num_leapfrog=8,
+        )
+    finally:
+        faults.reset()
+    assert res.converged
+    assert not telemetry._EVENT_LISTENERS
+    bundles = sorted(
+        d for d in os.listdir(tmp_path / "postmortem")
+        if "restart_poisoned_state" in d
+    )
+    assert bundles, os.listdir(tmp_path / "postmortem")
+    meta, events = _bundle(str(tmp_path / "postmortem" / bundles[-1]))
+    assert meta["trigger"] == "restart:poisoned_state"
+    assert events[-1]["event"] == "chain_health"
+    assert events[-1]["fault"] == "poisoned_state"

@@ -1,0 +1,89 @@
+"""tools/lint_metrics_docs.py: every metric registered in
+stark_tpu/metrics.py must appear in the README metric table — the
+operator-facing scrape contract (mirrors lint_trace_schema /
+lint_fused_knobs).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import lint_metrics_docs  # noqa: E402
+
+
+def test_repo_is_clean():
+    violations = lint_metrics_docs.lint_repo(REPO)
+    assert violations == [], "\n".join(violations)
+
+
+def test_collector_resolves_fstring_and_plain_names():
+    src = (
+        "p = 'stark'\n"
+        "class C:\n"
+        "    def __init__(self, r):\n"
+        "        self.a = r.counter(f'{p}_ops_total', 'ops')\n"
+        "        self.b = r.gauge('other_gauge', 'g')\n"
+        "        self.c = r.histogram(f'{p}_wall_seconds', 'w')\n"
+        "        self.d = r.counter(f'{p}_{dynamic}_total', 'nope')\n"
+    )
+    names = {n for _l, n in lint_metrics_docs.find_metric_names(
+        src, "<mem>", prefix="stark")}
+    assert names == {"stark_ops_total", "other_gauge", "stark_wall_seconds"}
+    # the dynamic interpolation is non-static: skipped, not guessed
+
+
+def test_collector_sees_the_real_registry():
+    path = os.path.join(REPO, "stark_tpu", "metrics.py")
+    with open(path) as f:
+        names = {n for _l, n in lint_metrics_docs.find_metric_names(
+            f.read(), path)}
+    assert {
+        "stark_trace_events_total",
+        "stark_draws_total",
+        "stark_fleet_problems_quarantined_total",
+        "stark_problem_ess_rate",
+        "stark_problem_deadline_headroom_s",
+        "stark_problem_restart_burn",
+        "stark_sample_block_seconds",
+    } <= names
+
+
+def test_synthetic_violation_detected(tmp_path):
+    """A registered-but-undocumented metric fails; documenting it in
+    the README clears the violation."""
+    repo = tmp_path
+    (repo / "stark_tpu").mkdir()
+    (repo / "stark_tpu" / "metrics.py").write_text(
+        "p = 'stark'\n"
+        "def build(r):\n"
+        "    return r.counter(f'{p}_shiny_total', 'shiny things')\n"
+    )
+    (repo / "README.md").write_text("no metrics here\n")
+    violations = lint_metrics_docs.lint_repo(str(repo))
+    assert len(violations) == 1 and "stark_shiny_total" in violations[0]
+    (repo / "README.md").write_text(
+        "| `stark_shiny_total` | counter | shiny |\n"
+    )
+    assert lint_metrics_docs.lint_repo(str(repo)) == []
+
+
+def test_broken_collector_reported(tmp_path):
+    (tmp_path / "stark_tpu").mkdir()
+    (tmp_path / "stark_tpu" / "metrics.py").write_text("x = 1\n")
+    (tmp_path / "README.md").write_text("")
+    violations = lint_metrics_docs.lint_repo(str(tmp_path))
+    assert violations and "collector itself is broken" in violations[0]
+
+
+@pytest.mark.parametrize("rc_expect", [0])
+def test_cli_exit_code(rc_expect):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_metrics_docs.py")],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == rc_expect, proc.stderr

@@ -359,3 +359,86 @@ def test_fresh_run_clears_stale_status_snapshot(collector):
     assert snap["ess_forecast"] is None
     assert snap["health"] == {} and snap["restarts"] == {}
     assert snap["attempt"] == 1
+
+
+# ---------------------------------------------------------------------------
+# per-tenant SLO gauges (PR 11: fleet problem_* events -> labeled gauges)
+# ---------------------------------------------------------------------------
+
+
+def test_slo_gauges_populate_from_terminal_problem_events(collector):
+    """The per-problem SLO rollups scrape during a fleet run: each
+    terminal problem event sets its tenant's labeled gauges."""
+    tr = telemetry.RunTrace(None)
+    tr.emit("run_start", entry="sample_fleet", fleet=True, problems=3,
+            chains=2)
+    tr.emit("problem_converged", problem_id="p0000", status="converged",
+            min_ess=120.0, elapsed_s=10.0, ess_rate=12.0,
+            deadline_s=60.0, deadline_headroom_s=50.0,
+            lane_restarts=0, max_restarts=2)
+    tr.emit("problem_converged", problem_id="p0001",
+            status="budget_exhausted", min_ess=4.0, elapsed_s=20.0,
+            ess_rate=0.2, deadline_s=15.0, deadline_headroom_s=-5.0,
+            lane_restarts=1, max_restarts=2)
+    samples, _ = parse_exposition(collector.registry.render())
+    assert samples['stark_problem_ess_rate{problem="p0000"}'] == 12.0
+    assert (
+        samples['stark_problem_deadline_headroom_s{problem="p0000"}'] == 50.0
+    )
+    assert samples['stark_problem_restart_burn{problem="p0000"}'] == 0.0
+    assert samples['stark_problem_ess_rate{problem="p0001"}'] == 0.2
+    assert (
+        samples['stark_problem_deadline_headroom_s{problem="p0001"}'] == -5.0
+    )
+    assert samples['stark_problem_restart_burn{problem="p0001"}'] == 0.5
+    # /status mirrors the latest finisher's SLO numbers
+    assert collector.status()["fleet"]["last_done"]["ess_rate"] == 0.2
+
+
+def test_slo_restart_burn_moves_on_reseed_and_quarantine(collector):
+    tr = telemetry.RunTrace(None)
+    tr.emit("run_start", entry="sample_fleet", fleet=True, problems=2,
+            chains=2)
+    tr.emit("problem_reseeded", problem_id="p0001",
+            fault="poisoned_state", reason="non-finite z",
+            lane_restarts=1, max_restarts=2)
+    samples, _ = parse_exposition(collector.registry.render())
+    assert samples['stark_problem_restart_burn{problem="p0001"}'] == 0.5
+    tr.emit("problem_quarantined", problem_id="p0001",
+            status="failed:poisoned_state", fault="poisoned_state",
+            reason="non-finite z", lane_restarts=3, max_restarts=2)
+    samples, _ = parse_exposition(collector.registry.render())
+    # burn saturates at 1.0 (the budget was exceeded, not 1.5x consumed)
+    assert samples['stark_problem_restart_burn{problem="p0001"}'] == 1.0
+    # a quarantine without a max_restarts field still reports full burn
+    tr.emit("problem_quarantined", problem_id="p0002",
+            status="failed:poisoned_state", fault="poisoned_state",
+            reason="boom", lane_restarts=2)
+    samples, _ = parse_exposition(collector.registry.render())
+    assert samples['stark_problem_restart_burn{problem="p0002"}'] == 1.0
+
+
+def test_slo_gauges_reset_on_fresh_run_start(collector):
+    """Run B's scrape must never serve run A's tenants: the labeled SLO
+    series clear on a fresh run_start (a restart retry keeps them)."""
+    tr = telemetry.RunTrace(None)
+    tr.emit("run_start", entry="sample_fleet", fleet=True, problems=1,
+            chains=2)
+    tr.emit("problem_converged", problem_id="p0000", status="converged",
+            ess_rate=5.0, deadline_headroom_s=1.0, lane_restarts=1,
+            max_restarts=2)
+    assert "stark_problem_ess_rate" in collector.registry.render()
+    # a supervised RESTART's run_start keeps the tenants' gauges
+    tr.emit("chain_health", status="restart", attempt=1, fault="transient")
+    tr.emit("run_start", entry="sample_fleet", fleet=True, problems=1,
+            chains=2)
+    samples, _ = parse_exposition(collector.registry.render())
+    assert samples['stark_problem_ess_rate{problem="p0000"}'] == 5.0
+    # a FRESH run's run_start clears all three SLO families
+    tr.emit("run_end", dur_s=1.0, converged=True)
+    tr.emit("run_start", entry="sample_fleet", fleet=True, problems=1,
+            chains=2)
+    text = collector.registry.render()
+    assert "stark_problem_ess_rate{" not in text
+    assert "stark_problem_deadline_headroom_s{" not in text
+    assert "stark_problem_restart_burn{" not in text

@@ -1,0 +1,402 @@
+"""Run timeline profiler (stark_tpu/profiling.py): span attribution,
+the ``span`` event family, and the promoted dispatch-count probe.
+
+The acceptance contract under test: a fresh eight-schools trace must
+decompose into non-overlapping spans covering >=95% of the run wall
+(``tools/timeline_report.py``), ``span`` is a registered event type
+(schema lint green), pre-PR-11 traces degrade to ``n/a`` — never an
+error — and `profiling.DispatchProbe` is the PR 8 `_GradEvalProbe`
+promoted (same counting semantics, re-exported under the old name for
+the nutssched microbench).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from stark_tpu import profiling, telemetry
+from stark_tpu.profiling import (
+    DispatchProbe,
+    SpanRecorder,
+    deregister_probe,
+    probe_counts,
+    register_probe,
+    spans_from_events,
+    timeline_summary,
+)
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+)
+
+
+def _ev(event, wall_s, run=1, **fields):
+    return {"schema": 1, "event": event, "ts": 0.0, "wall_s": wall_s,
+            "run": run, **fields}
+
+
+def _synthetic_trace():
+    """A hand-built run: compile 1s, warmup 2s, two draw blocks (one
+    with overlap fields), one checkpoint, collect — tiling 10s."""
+    return [
+        _ev("run_start", 0.0, model="M", kernel="nuts", chains=2),
+        _ev("compile", 1.0, dur_s=1.0, stage="build"),
+        _ev("warmup_block", 3.0, dur_s=2.0),
+        # block 1: 2s, 0.5s host hidden + 0.25s device idle
+        _ev("sample_block", 5.0, dur_s=2.0, block=1,
+            t_host_hidden_s=0.5, device_idle_s=0.25),
+        _ev("checkpoint", 5.5, dur_s=0.5, block=1),
+        # block 2: no overlap fields (pre-PR-3 shape) -> one dispatch span
+        _ev("sample_block", 8.5, dur_s=3.0, block=2),
+        _ev("collect", 10.0, dur_s=1.5),
+        _ev("run_end", 10.0, dur_s=10.0, converged=True),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# span synthesis
+# ---------------------------------------------------------------------------
+
+
+def test_spans_tile_and_never_overlap():
+    tl = spans_from_events(_synthetic_trace())
+    assert tl["synthesized"] is True
+    assert tl["wall_s"] == pytest.approx(10.0)
+    spans = tl["spans"]
+    # strictly non-overlapping, sorted
+    for a, b in zip(spans, spans[1:]):
+        assert a["end"] <= b["start"] + 1e-9
+    covered = sum(sp["dur"] for sp in spans)
+    assert covered == pytest.approx(10.0, abs=1e-6)
+    kinds = {sp["kind"] for sp in spans}
+    assert {"compile", "warmup", "dispatch", "host_hidden",
+            "device_idle", "checkpoint", "host"} == kinds
+
+
+def test_block_overlap_decomposition_sums_to_block_wall():
+    spans = [
+        sp for sp in spans_from_events(_synthetic_trace())["spans"]
+        if sp.get("block") == 1 and sp["src"] == "sample_block"
+    ]
+    by_kind = {sp["kind"]: sp["dur"] for sp in spans}
+    assert by_kind["host_hidden"] == pytest.approx(0.5)
+    assert by_kind["device_idle"] == pytest.approx(0.25)
+    assert by_kind["dispatch"] == pytest.approx(1.25)
+    assert sum(by_kind.values()) == pytest.approx(2.0)
+
+
+def test_nested_phase_keeps_inner_attribution():
+    """The fleet nests warmup_block phases inside a compile setup phase:
+    the inner (earlier-emitted) spans keep their interval, the outer
+    keeps only the unclaimed remainder — no double counting."""
+    events = [
+        _ev("run_start", 0.0),
+        _ev("warmup_block", 2.0, dur_s=1.0),   # inner [1, 2]
+        _ev("compile", 3.0, dur_s=3.0),        # outer [0, 3]
+        _ev("run_end", 3.0, dur_s=3.0),
+    ]
+    tl = spans_from_events(events)
+    by_kind = {}
+    for sp in tl["spans"]:
+        by_kind[sp["kind"]] = by_kind.get(sp["kind"], 0.0) + sp["dur"]
+    assert by_kind["warmup"] == pytest.approx(1.0)
+    assert by_kind["compile"] == pytest.approx(2.0)  # [0,1] + [2,3]
+    assert sum(by_kind.values()) == pytest.approx(3.0)
+
+
+def test_overlap_estimates_clipped_to_block_wall():
+    """An overshooting device-idle estimate can never attribute more
+    time than the block's own measured wall."""
+    events = [
+        _ev("run_start", 0.0),
+        _ev("sample_block", 1.0, dur_s=1.0, block=1,
+            t_host_hidden_s=2.0, device_idle_s=2.0),
+        _ev("run_end", 1.0, dur_s=1.0),
+    ]
+    spans = spans_from_events(events)["spans"]
+    assert sum(sp["dur"] for sp in spans) == pytest.approx(1.0)
+
+
+def test_summary_fields_and_null_conventions():
+    s = timeline_summary(_synthetic_trace())
+    assert s["compile_s"] == pytest.approx(1.0)
+    assert s["dispatch_count"] == 3  # warmup + 2 draw blocks
+    assert s["span_coverage_frac"] == pytest.approx(1.0)
+    # a trace with no phase events: every field null, never 0.0
+    bare = timeline_summary([_ev("run_start", 0.0), _ev("run_end", 1.0)])
+    assert bare["compile_s"] is None
+    assert bare["dispatch_count"] is None
+    assert bare["span_coverage_frac"] is None
+    empty = timeline_summary([])
+    assert empty["span_coverage_frac"] is None
+
+
+def test_summary_picks_last_run_by_default():
+    events = _synthetic_trace() + [
+        _ev("run_start", 11.0, run=2),
+        _ev("compile", 13.0, run=2, dur_s=2.0),
+        _ev("run_end", 13.0, run=2, dur_s=2.0),
+    ]
+    s = timeline_summary(events)
+    assert s["run"] == 2
+    assert s["compile_s"] == pytest.approx(2.0)
+    assert timeline_summary(events, run=1)["dispatch_count"] == 3
+
+
+# ---------------------------------------------------------------------------
+# span event family (SpanRecorder)
+# ---------------------------------------------------------------------------
+
+
+def test_span_event_registered_in_schema():
+    assert "span" in telemetry.ALL_EVENT_TYPES
+    assert "span" in telemetry.PROFILING_EVENT_TYPES
+
+
+def test_span_recorder_emits_literal_span_events(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    # no run_start here: these synthetic dur_s values predate the trace
+    # clock, and the run window would (correctly) clip them — the span
+    # content is what's under test
+    with telemetry.RunTrace(path) as tr:
+        rec = SpanRecorder(tr).install()
+        try:
+            tr.emit("sample_block", dur_s=2.0, block=1,
+                    t_host_hidden_s=0.5, device_idle_s=0.25)
+        finally:
+            rec.uninstall()
+        tr.emit("checkpoint", dur_s=0.1)  # after uninstall: no span
+    events = telemetry.read_trace(path)
+    spans = [e for e in events if e["event"] == "span"]
+    assert {e["kind"] for e in spans} == {"dispatch", "host_hidden",
+                                          "device_idle"}
+    for e in spans:
+        assert e["src"] == "sample_block"
+        assert e["end_s"] - e["start_s"] == pytest.approx(e["dur_s"],
+                                                          abs=1e-3)
+        telemetry.validate_event(e)
+    assert not any(
+        e["event"] == "span" and e.get("src") == "checkpoint"
+        for e in events
+    )
+    # the read path prefers literal spans over synthesis
+    tl = spans_from_events(events)
+    assert tl["synthesized"] is False
+    assert {sp["kind"] for sp in tl["spans"]} == {"dispatch",
+                                                  "host_hidden",
+                                                  "device_idle"}
+
+
+def test_span_recorder_gap_attribution_matches_synthesis(tmp_path):
+    """Turning the recorder ON must not lower coverage: the literal
+    span stream carries the same block-loop gap attribution the
+    synthesized read path applies (the pipelined runner's out-of-line
+    enqueue wall)."""
+    # pipelined shape: block 2's [end-dur, end] leaves a gap after
+    # block 1 (its enqueue ran while block 1 computed)
+    phase_events = [
+        ("sample_block", dict(dur_s=1.0, block=1)),
+        ("sample_block", dict(dur_s=1.0, block=2)),
+    ]
+    path = str(tmp_path / "t.jsonl")
+    import time as _time
+
+    with telemetry.RunTrace(path) as tr:
+        rec = SpanRecorder(tr).install()
+        try:
+            for ev, fields in phase_events:
+                _time.sleep(1.2)  # real wall gap between completions
+                tr.emit(ev, **fields)
+        finally:
+            rec.uninstall()
+    events = telemetry.read_trace(path)
+    literal = spans_from_events(events)
+    assert literal["synthesized"] is False
+    gap_spans = [sp for sp in literal["spans"] if sp.get("gap")]
+    assert gap_spans and gap_spans[0]["kind"] == "dispatch"
+    # the literal timeline covers the inter-block wall like the
+    # synthesized one would
+    synth = spans_from_events(
+        [e for e in events if e["event"] != "span"]
+    )
+    lit_cov = sum(sp["dur"] for sp in literal["spans"])
+    syn_cov = sum(sp["dur"] for sp in synth["spans"])
+    assert lit_cov == pytest.approx(syn_cov, rel=0.05)
+
+
+def test_maybe_record_spans_env_gate(tmp_path, monkeypatch):
+    monkeypatch.delenv("STARK_PROFILE_SPANS", raising=False)
+    with telemetry.RunTrace(str(tmp_path / "a.jsonl")) as tr:
+        assert profiling.maybe_record_spans(tr) is None
+    monkeypatch.setenv("STARK_PROFILE_SPANS", "1")
+    assert profiling.maybe_record_spans(telemetry.NULL_TRACE) is None
+    with telemetry.RunTrace(str(tmp_path / "b.jsonl")) as tr:
+        rec = profiling.maybe_record_spans(tr)
+        assert rec is not None
+        rec.uninstall()
+    assert not telemetry._EVENT_LISTENERS
+
+
+# ---------------------------------------------------------------------------
+# dispatch probe (promoted _GradEvalProbe)
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_probe_counts_executed_calls():
+    import jax
+    import jax.numpy as jnp
+
+    probe = DispatchProbe(label="unit")
+    f = jax.jit(probe.wrap(lambda x: x * 2.0))
+    for _ in range(3):
+        jax.block_until_ready(f(jnp.ones(4)))
+    assert probe.snapshot() == 3
+    probe.reset()
+    assert probe.snapshot() == 0
+
+
+def test_dispatch_probe_counts_masked_lane_evals_too():
+    """The probe's reason to exist: a while_loop iteration evaluates
+    every lane, finished or not — executed counts exceed 'useful'."""
+    import jax
+    import jax.numpy as jnp
+
+    probe = DispatchProbe(label="loop")
+    g = probe.wrap(lambda x: x + 1.0)
+
+    @jax.jit
+    def run(x):
+        return jax.lax.fori_loop(0, 5, lambda i, v: g(v), x)
+
+    jax.block_until_ready(run(jnp.zeros(2)))
+    assert probe.snapshot() == 5
+
+
+def test_probe_registry_roundtrip():
+    probe = register_probe(DispatchProbe(label="reg_demo"))
+    try:
+        assert probe_counts(drain=False)["reg_demo"] == 0
+        probe.calls = 7
+        assert probe_counts()["reg_demo"] == 7
+    finally:
+        deregister_probe("reg_demo")
+    assert "reg_demo" not in probe_counts(drain=False)
+
+
+def test_benchmarks_reexports_probe_under_historical_name():
+    from stark_tpu.benchmarks import _GradEvalProbe
+
+    assert _GradEvalProbe is DispatchProbe
+
+
+def test_probe_bind_matches_model_potential():
+    """The FlatModel-compatible bind: same values/grads as the unprobed
+    potential, calls counted per executed evaluation."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from stark_tpu.model import flatten_model, prepare_model_data
+    from stark_tpu.models import Logistic, synth_logistic_data
+
+    model = Logistic(num_features=3)
+    data, _ = synth_logistic_data(jax.random.PRNGKey(0), 64, 3)
+    fm = flatten_model(model)
+    pdata = prepare_model_data(model, data)
+    probe = DispatchProbe(fm)
+    z = 0.1 * jnp.ones(fm.ndim)
+    v_ref, g_ref = fm.bind(pdata).value_and_grad(z)
+    pot = probe.bind(pdata)
+    v, g = jax.jit(pot.value_and_grad)(z)
+    jax.block_until_ready((v, g))
+    np.testing.assert_allclose(np.asarray(v), np.asarray(v_ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-6)
+    assert probe.snapshot() >= 1
+
+
+# ---------------------------------------------------------------------------
+# timeline_report tool + the eight-schools coverage acceptance
+# ---------------------------------------------------------------------------
+
+
+def _timeline_report_main():
+    import timeline_report
+
+    return timeline_report.main
+
+
+def test_timeline_report_json_on_synthetic(tmp_path, capsys):
+    path = tmp_path / "t.jsonl"
+    with open(path, "w") as f:
+        for e in _synthetic_trace():
+            f.write(json.dumps(e) + "\n")
+    assert _timeline_report_main()([str(path), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["span_coverage_frac"] == pytest.approx(1.0)
+    assert out["dispatch_count"] == 3
+    assert _timeline_report_main()([str(path), "--spans"]) == 0
+    assert "dispatch" in capsys.readouterr().out
+
+
+def test_timeline_report_na_safe_on_pre_pr11_trace(tmp_path, capsys):
+    """A PR-1-era trace shape (no overlap fields, no collect, no
+    run_end dur): renders n/a where it can't attribute, never raises."""
+    path = tmp_path / "old.jsonl"
+    events = [
+        _ev("run_start", 0.0, model="M"),
+        _ev("sample_block", 1.0, dur_s=1.0, block=1),
+        _ev("chain_health", 1.1, max_rhat=1.01),
+    ]
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    assert _timeline_report_main()([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "dispatch" in out
+    # and an event-free run renders the no-spans note
+    path2 = tmp_path / "bare.jsonl"
+    with open(path2, "w") as f:
+        f.write(json.dumps(_ev("run_start", 0.0)) + "\n")
+    assert _timeline_report_main()([str(path2)]) == 0
+    assert "n/a" in capsys.readouterr().out
+
+
+def test_timeline_report_missing_or_empty_file_fails_cleanly(tmp_path):
+    # missing file: exit 1 with a message, not a traceback
+    assert _timeline_report_main()([str(tmp_path / "absent.jsonl")]) == 1
+    (tmp_path / "empty.jsonl").write_text("")
+    assert _timeline_report_main()([str(tmp_path / "empty.jsonl")]) == 1
+
+
+def test_eight_schools_trace_coverage_at_least_95pct(tmp_path, capsys):
+    """The acceptance criterion: a fresh eight-schools trace attributes
+    >=95% of the run wall to non-overlapping spans."""
+    from stark_tpu.models.eight_schools import EightSchools, eight_schools_data
+    from stark_tpu.runner import sample_until_converged
+
+    path = str(tmp_path / "es.jsonl")
+    with telemetry.use_trace(telemetry.RunTrace(path)) as tr:
+        sample_until_converged(
+            EightSchools(), eight_schools_data(),
+            chains=2, block_size=50, max_blocks=4, min_blocks=2,
+            rhat_target=10.0, ess_target=1.0, num_warmup=100,
+            kernel="hmc", num_leapfrog=8, seed=0,
+        )
+        tr.close()
+    events = telemetry.read_trace(path)
+    s = timeline_summary(events)
+    assert s["span_coverage_frac"] is not None
+    assert s["span_coverage_frac"] >= 0.95, s
+    assert s["compile_s"] is not None and s["compile_s"] > 0
+    assert s["dispatch_count"] is not None and s["dispatch_count"] >= 3
+    # spans are non-overlapping by construction — verify on real data
+    spans = spans_from_events(events)["spans"]
+    for a, b in zip(spans, spans[1:]):
+        assert a["end"] <= b["start"] + 1e-9
+    # and the report renders it
+    assert _timeline_report_main()([path]) == 0
+    out = capsys.readouterr().out
+    assert "attributed" in out and "compile" in out

@@ -120,6 +120,89 @@ def test_degraded_fleet_stays_200_with_status_surfaced(server):
     assert _get(server.port, "/healthz")[0] == 503
 
 
+def test_status_contract_schema_and_uptime(server):
+    """The /status machine contract (PR 11): a ``schema`` version
+    stamp, a monotone ``uptime_s``, and a ``last_postmortem`` slot —
+    consumers key on ``schema`` before trusting the rest."""
+    from stark_tpu.metrics import STATUS_SCHEMA
+
+    code, body = _get(server.port, "/status")
+    assert code == 200
+    snap = json.loads(body)
+    assert snap["schema"] == STATUS_SCHEMA == 2
+    assert isinstance(snap["uptime_s"], (int, float))
+    assert snap["uptime_s"] >= 0
+    assert "last_postmortem" in snap
+    time.sleep(0.05)
+    later = json.loads(_get(server.port, "/status")[1])
+    assert later["uptime_s"] > snap["uptime_s"]
+
+
+def test_status_cli_json_envelope(server, capsys):
+    """``stark_tpu status --json``: one machine-parseable line,
+    {"endpoint", "code", "body"} with the body parsed when it was JSON
+    — for /status, /healthz (both polarities), and /metrics."""
+    from stark_tpu.__main__ import main as cli_main
+
+    port = str(server.port)
+    assert cli_main(["status", "--port", port, "--json"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("\n") == 1
+    env = json.loads(out)
+    assert env["endpoint"] == "status" and env["code"] == 200
+    assert env["body"]["schema"] == 2
+
+    assert cli_main(["status", "--port", port, "--healthz", "--json"]) == 0
+    env = json.loads(capsys.readouterr().out)
+    assert env["endpoint"] == "healthz" and env["code"] == 200
+    assert env["body"] == "ok\n"
+
+    # flip unhealthy: the 503 body is JSON and must arrive parsed
+    telemetry.RunTrace(None).emit(
+        "chain_health", status="stall", deadline_s=1.0, idle_s=2.0,
+        stall_count=1,
+    )
+    assert cli_main(["status", "--port", port, "--healthz", "--json"]) == 1
+    env = json.loads(capsys.readouterr().out)
+    assert env["code"] == 503
+    assert env["body"]["healthy"] is False
+    # recover for other tests sharing the fixture pattern
+    telemetry.RunTrace(None).emit("run_start", entry="t")
+
+    assert cli_main(["status", "--port", port, "--metrics", "--json"]) == 0
+    env = json.loads(capsys.readouterr().out)
+    assert env["endpoint"] == "metrics"
+    assert isinstance(env["body"], str) and "stark_" in env["body"]
+
+    # without --json the raw body contract is unchanged
+    assert cli_main(["status", "--port", port]) == 0
+    assert json.loads(capsys.readouterr().out)["schema"] == 2
+
+
+def test_status_cli_json_envelope_when_nothing_listens(capsys):
+    """The one-line contract holds with no exporter: code null, the
+    error in its own slot, exit 2 unchanged."""
+    from stark_tpu.__main__ import main as cli_main
+
+    # an ephemeral bound-then-closed port: guaranteed refusal
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = s.getsockname()[1]
+    s.close()
+    assert cli_main(["status", "--port", str(dead), "--json"]) == 2
+    out = capsys.readouterr().out
+    assert out.count("\n") == 1
+    env = json.loads(out)
+    assert env["endpoint"] == "status"
+    assert env["code"] is None and env["body"] is None
+    assert env["error"]
+    # without --json: stdout stays empty (the historical contract)
+    assert cli_main(["status", "--port", str(dead)]) == 2
+    assert capsys.readouterr().out == ""
+
+
 def test_off_by_default_no_thread_no_listener(monkeypatch):
     """The zero-cost contract: port unset → no server thread, no event
     listener, and a traced run writes byte-wise the same event shapes."""

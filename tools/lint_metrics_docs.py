@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Repo lint: every registered metric name must be documented in the README.
+
+The metrics registry (``stark_tpu/metrics.py``) is the operator-facing
+scrape contract — dashboards and alert rules are written against the
+names it exposes at ``/metrics``.  A metric registered in code but
+missing from the README metric table is invisible to operators exactly
+like an undocumented env knob (the gap ``lint_fused_knobs.py`` closes
+for knobs, and ``lint_trace_schema.py`` for event names).  This lint
+closes it for metrics: AST-collect every name passed to a
+``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``
+registration call in ``stark_tpu/metrics.py`` — including the
+f-string form ``f"{p}_name"`` where ``p`` is the ``METRIC_PREFIX``
+binding — and fail if any collected name does not appear in
+``README.md`` (the metric table in the Observability section).
+
+AST-based (names in comments or help strings can't trip it);
+`stark_tpu.metrics` is imported only for ``METRIC_PREFIX`` (no jax),
+so the lint runs anywhere.  Run directly
+(``python tools/lint_metrics_docs.py``) or via the test suite
+(``tests/test_lint_metrics_docs.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stark_tpu.metrics import METRIC_PREFIX  # noqa: E402
+
+#: registration attribute names whose first positional argument is the
+#: metric name
+_REGISTER_METHODS = frozenset({"counter", "gauge", "histogram"})
+
+
+def _resolve_name(arg: ast.expr, prefix: str) -> Optional[str]:
+    """The metric name a registration call's first argument denotes.
+
+    Handles the two idioms the registry file uses: a plain string
+    constant, and an f-string whose interpolations are simple names
+    (the ``{p}`` / ``{METRIC_PREFIX}`` prefix binding) — any other
+    interpolation makes the name non-static and returns None."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        parts: List[str] = []
+        for v in arg.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif (
+                isinstance(v, ast.FormattedValue)
+                and isinstance(v.value, ast.Name)
+                and v.value.id in ("p", "METRIC_PREFIX")
+            ):
+                # the prefix binding: f"{p}_..." / f"{METRIC_PREFIX}_..."
+                parts.append(prefix)
+            else:
+                return None
+        return "".join(parts)
+    return None
+
+
+def find_metric_names(source: str, filename: str,
+                      prefix: str = METRIC_PREFIX) -> List[Tuple[int, str]]:
+    """(lineno, metric_name) of every static registration call."""
+    tree = ast.parse(source, filename=filename)
+    hits = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _REGISTER_METHODS
+            and node.args
+        ):
+            continue
+        name = _resolve_name(node.args[0], prefix)
+        if name is not None:
+            hits.append((node.lineno, name))
+    return hits
+
+
+def lint_repo(repo: str) -> List[str]:
+    """Violation strings for the whole repo; empty = clean."""
+    metrics_path = os.path.join(repo, "stark_tpu", "metrics.py")
+    with open(metrics_path) as f:
+        names = find_metric_names(f.read(), metrics_path)
+    if not names:
+        return ["no metric registrations found in stark_tpu/metrics.py — "
+                "the collector itself is broken"]
+    readme_path = os.path.join(repo, "README.md")
+    readme = open(readme_path).read() if os.path.exists(readme_path) else ""
+    # the contract is the metric TABLE, not any prose mention: a name
+    # that only survives in a curl example must still fail, so the
+    # search is restricted to markdown table rows
+    table_rows = "\n".join(
+        line for line in readme.splitlines() if line.lstrip().startswith("|")
+    )
+    violations = []
+    for lineno, name in sorted(set(names)):
+        if name not in table_rows:
+            violations.append(
+                f"{metrics_path}:{lineno}: metric {name!r} is registered "
+                "but missing from the README metric table — document it "
+                "(a table row in the Observability section; prose or "
+                "example mentions don't count)"
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = lint_repo(repo)
+    for v in violations:
+        print(v, file=sys.stderr)
+    if violations:
+        print(
+            f"{len(violations)} undocumented metric(s) — see "
+            "tools/lint_metrics_docs.py docstring",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

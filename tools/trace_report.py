@@ -202,6 +202,9 @@ def render_run(events, run) -> str:
             and e["event"] in ("problem_converged", "problem_quarantined")
         ]
         if done:
+            # quarantine forensics (PR 9 fields): WHY a problem was lost
+            # and where its store's forensic copy went — n/a on older
+            # traces and on rows that were never quarantined
             rows = [
                 (
                     e.get("problem_id"),
@@ -211,13 +214,16 @@ def render_run(events, run) -> str:
                     e.get("grad_evals"),
                     e.get("min_ess"),
                     e.get("max_rhat"),
+                    e.get("reason"),
+                    e.get("quarantined_store"),
                 )
                 for e in done
             ]
             out.append(_table(
                 rows,
                 ("problem", "status", "blocks", "draws/chain",
-                 "grad evals", "min ESS", "max R-hat"),
+                 "grad evals", "min ESS", "max R-hat", "reason",
+                 "quarantined store"),
             ))
             out.append("")
 
