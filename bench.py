@@ -871,6 +871,15 @@ def main():
              lambda: bmarks.bench_fused_value_and_grad("ordinal"), 25.0),
             ("fused_vg_robust",
              lambda: bmarks.bench_fused_value_and_grad("robust"), 15.0),
+            # quantized-X legs (ops/quantize.py): keep the int8/fp8
+            # ledger series fed with bytes-accounting evidence on every
+            # full bench round, own :x=<dtype> config keys
+            ("fused_vg_lmm_int8",
+             lambda: bmarks.bench_fused_value_and_grad(
+                 "lmm", x_dtype="int8"), 90.0),
+            ("fused_vg_irt_fp8e4m3",
+             lambda: bmarks.bench_fused_value_and_grad(
+                 "irt", x_dtype="fp8e4m3"), 30.0),
             ("bnn_sghmc", bmarks.bench_bnn_sghmc, 130.0),
             (
                 "consensus_logistic",
@@ -1025,6 +1034,14 @@ def main():
                 "span_coverage_frac": chees_profile.get(
                     "span_coverage_frac"
                 ),
+                # quantized/bf16 X streaming (ops/quantize.py): the
+                # resolved stream dtype + design-slab bytes one fused
+                # value-and-grad evaluation reads — with dispatch_count
+                # this makes the bandwidth claim measured arithmetic in
+                # the artifact, not an assertion.  Omitted entirely on
+                # plain f32 runs (knob-off artifact/ledger rows stay
+                # byte-identical to the historical shape)
+                **_flagship_x_stream_fields(n, d),
                 **(
                     {"extra_evidence": extra_evidence}
                     if extra_evidence else {}
@@ -1042,12 +1059,43 @@ def main():
 #: null-valued keys are skipped by append_ledger (never 0.0)
 _PROFILING_EXTRA_KEYS = (
     "compile_s", "dispatch_count", "span_coverage_frac",
+    # quantized X streaming evidence (absent from the artifact — and so
+    # from the row — on plain f32 runs; append_ledger skips nulls)
+    "x_dtype", "x_bytes_per_grad",
 )
 
+def _flagship_x_stream_fields(n, d):
+    """{"x_dtype", "x_bytes_per_grad"} for the flagship artifact/ledger
+    row when STARK_FUSED_X_DTYPE is non-f32; {} otherwise (the knob-off
+    artifact must stay byte-identical).  Bytes are the (D, N) slab at
+    the resolved storage width plus the f32 scale vector for packed
+    dtypes — the per-evaluation X stream of the one-pass kernels."""
+    try:
+        from stark_tpu.ops.precision import x_stream_config
+        from stark_tpu.ops.quantize import predict_x_bytes
+
+        xcfg = x_stream_config()
+        if xcfg == "f32":
+            return {}
+        return {
+            "x_dtype": xcfg,
+            "x_bytes_per_grad": predict_x_bytes(n, d, xcfg),
+        }
+    except Exception:  # noqa: BLE001 — evidence, never a bench failure
+        return {}
+
+
 #: fused-vg evidence recorded for trend analysis; check/--strict gates
-#: only ledger.METRIC_SPECS, so these keys are NOT regression-gated
+#: only ledger.METRIC_SPECS, so these keys are NOT regression-gated.
+#: The x_* keys are the quantized data-plane's bytes accounting
+#: (ops/quantize.py): x_bytes_per_grad is the slab one fused evaluation
+#: streams, x_traffic_reduction its ratio vs f32 storage, and
+#: speedup_vs_f32x the honest does-quantization-pay number (null when
+#: the leg ran plain f32)
 _FUSEDVG_EXTRA_KEYS = (
     "autodiff_evals_per_sec", "speedup_vs_autodiff", "grad_parity_rel",
+    "x_dtype", "x_bytes_per_grad", "x_bytes_per_grad_f32",
+    "x_traffic_reduction", "fused_f32x_evals_per_sec", "speedup_vs_f32x",
 )
 
 #: nutssched evidence recorded for trend analysis (same non-gated rule);
@@ -1132,48 +1180,68 @@ def append_ledger(config, bench_dict, extra_keys=(), label="perf",
 def fusedvg_config_key(row, platform):
     """Ledger series key for a fused-op microbench row — shared by the
     in-bench extra-evidence path and the standalone `microbench`
-    subcommand so both append to the SAME trailing-median series."""
-    return (
+    subcommand so both append to the SAME trailing-median series.
+    Non-f32 X-dtype legs (bf16 / int8 / fp8*) get their own
+    ``:x=<dtype>`` series — a different streamed workload must never
+    share a trailing median with the f32 baseline series."""
+    key = (
         f"fusedvg:{row.get('family')}"
         f":n={row.get('n', row.get('persons'))}"
         f":d={row.get('d', row.get('items'))}"
         f":platform={platform}"
     )
+    x_dtype = row.get("x_dtype")
+    if x_dtype and x_dtype != "f32":
+        key += f":x={x_dtype}"
+    return key
 
 
 def run_fused_microbench(argv):
-    """`python bench.py microbench [lmm irt ordinal robust nutssched]` —
-    run the per-op microbench legs standalone (no flagship run), print
-    one strict-JSON row per leg, and append each to the perf ledger
-    under its own config key (``fusedvg:*`` for the fused value-and-grad
-    families, ``nutssched:*`` for the ragged-NUTS scheduling leg).  The
-    cheap way to (re)baseline a series after a kernel change;
+    """`python bench.py microbench [logistic lmm[:x_dtype] irt ordinal
+    robust nutssched]` — run the per-op microbench legs standalone (no
+    flagship run), print one strict-JSON row per leg, and append each
+    to the perf ledger under its own config key (``fusedvg:*`` for the
+    fused value-and-grad families, with ``:x=<dtype>`` suffixes for
+    non-f32 X-stream legs like ``lmm:int8`` or ``irt:fp8e4m3``;
+    ``nutssched:*`` for the ragged-NUTS scheduling leg).  The cheap way
+    to (re)baseline a series after a kernel change;
     `tools/perf_ledger.py check` then gates the next round against it."""
     import jax
 
     from stark_tpu import benchmarks as bmarks
+    from stark_tpu.ops.precision import X_DTYPE_NAMES
 
-    known = ("lmm", "irt", "ordinal", "robust", "nutssched")
-    unknown = [a for a in argv if a not in known]
+    known = ("logistic", "lmm", "irt", "ordinal", "robust", "nutssched")
+    legs, unknown = [], []
+    for a in argv:
+        fam, _, xdt = a.partition(":")
+        if fam not in known or (xdt and xdt not in X_DTYPE_NAMES) or (
+            xdt and fam == "nutssched"
+        ):
+            unknown.append(a)
+        else:
+            legs.append((fam, xdt or None))
     if unknown:
         # fail fast: a typo'd family silently falling back to the full
         # default set would bench for minutes and append unintended rows
         # to the ledger series being re-baselined
         print(
-            f"[bench] microbench: unknown families {unknown!r}; "
-            f"choose from {', '.join(known)}",
+            f"[bench] microbench: unknown legs {unknown!r}; "
+            f"choose from {', '.join(known)}, with an optional "
+            f":<x_dtype> suffix from {'|'.join(X_DTYPE_NAMES)} on the "
+            "fused families",
             file=sys.stderr,
         )
         return 2
-    fams = list(argv) or list(known)
+    legs = legs or [(f, None) for f in known]
     platform = jax.devices()[0].platform
     failed = False
-    for fam in fams:
+    for fam, xdt in legs:
         try:
             r = (
                 bmarks.bench_nuts_sched()
                 if fam == "nutssched"
-                else bmarks.bench_fused_value_and_grad(fam)
+                else bmarks.bench_fused_value_and_grad(fam, x_dtype=xdt)
             )
         except Exception as e:  # noqa: BLE001 — one broken family must
             # not hide the others' measurements

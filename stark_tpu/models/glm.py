@@ -15,7 +15,10 @@ import jax.scipy.stats as jstats
 
 from ..bijectors import Exp
 from ..model import Model, ParamSpec
-from .logistic import TransposedXMixin as _TransposedXMixin
+from .logistic import (
+    TransposedXMixin as _TransposedXMixin,
+    _fold_scale,
+)
 
 
 class LinearRegression(Model):
@@ -53,7 +56,9 @@ class FusedLinearRegression(_TransposedXMixin, LinearRegression):
     def log_lik(self, p, data):
         from ..ops.logistic_fused import gaussian_loglik
 
-        return gaussian_loglik(p["beta"], data["xT"], data["y"], p["sigma"])
+        return gaussian_loglik(
+            _fold_scale(p["beta"], data), data["xT"], data["y"], p["sigma"]
+        )
 
 
 class PoissonRegression(Model):
@@ -94,14 +99,19 @@ class FusedPoissonRegression(_TransposedXMixin, PoissonRegression):
 
     def log_lik(self, p, data):
         from ..ops.glm_fused import fused_glm_enabled, poisson_loglik
+        from ..ops.quantize import dequant_dot, stream_slab
 
         if not fused_glm_enabled():
-            log_rate = jnp.clip(p["beta"] @ data["xT"], -30.0, 30.0)
+            if "xT_scale" in data:
+                eta = dequant_dot(p["beta"], stream_slab(data))
+            else:
+                eta = p["beta"] @ data["xT"]
+            log_rate = jnp.clip(eta, -30.0, 30.0)
             y = data["y"]
             return jnp.sum(
                 y * log_rate - jnp.exp(log_rate) - jax.lax.lgamma(y + 1.0)
             )
-        return poisson_loglik(p["beta"], data["xT"], data["y"])
+        return poisson_loglik(p["beta"], stream_slab(data), data["y"])
 
 
 def synth_linreg_data(key, n, d, *, noise=0.5, dtype=jnp.float32):

@@ -116,13 +116,16 @@ class FusedIRT2PL(IRT2PL):
                     p["theta"], p["a"], p["b"], data["y_grid"]
                 )
             # knob flipped off after a grid prepare: autodiff on the
-            # same layout
+            # same layout (upcasting a packed int8/fp8 grid — exact for
+            # binary responses)
             from .logistic import _bernoulli_logit_loglik
 
             logits = p["a"][None, :] * (
                 p["theta"][:, None] - p["b"][None, :]
             )
-            return _bernoulli_logit_loglik(logits, data["y_grid"])
+            return _bernoulli_logit_loglik(
+                logits, data["y_grid"].astype(jnp.float32)
+            )
         if not fused_irt_enabled():
             return super().log_lik(p, data)
         return irt_loglik(

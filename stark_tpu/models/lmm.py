@@ -25,6 +25,7 @@ from ..model import Model, ParamSpec
 from .logistic import (
     KnobGatedFusedMixin,
     TransposedXMixin as _TransposedXMixin,
+    _fold_scale,
 )
 
 
@@ -56,9 +57,11 @@ class LinearMixedModel(Model):
         return jnp.sum(self.log_lik_rows(p, data))
 
     def log_lik_rows(self, p, data):
+        from ..ops.quantize import dequant_rows
+
         u = p["u_raw"] * p["tau"][None, :]  # (G, Q) non-centered
-        x = data["x"] if "x" in data else data["xT"].T
-        z = data["z"] if "z" in data else data["zT"].T
+        x = data["x"] if "x" in data else dequant_rows(data)
+        z = data["z"] if "z" in data else dequant_rows(data, key="zT")
         mu = (
             p["intercept"]
             + x @ p["beta"]
@@ -102,11 +105,12 @@ class FusedLMM(KnobGatedFusedMixin, LinearMixedModel):
 
     def _fused_log_lik(self, p, data):
         from ..ops.lmm_fused import lmm_loglik
+        from ..ops.quantize import stream_slab
 
         u = p["u_raw"] * p["tau"][None, :]  # (G, Q) non-centered
         return lmm_loglik(
             p["beta"], u, p["intercept"], p["sigma"],
-            data["xT"], data["z"], data["g"], data["y"],
+            stream_slab(data), data["z"], data["g"], data["y"],
         )
 
 
@@ -130,7 +134,8 @@ class FusedLinearMixedModel(_TransposedXMixin, LinearMixedModel):
         u = p["u_raw"] * p["tau"][None, :]  # (G, Q) non-centered
         offsets = p["intercept"] + jnp.sum(data["z"] * u[data["g"]], axis=-1)
         return gaussian_offset_loglik(
-            p["beta"], offsets, data["xT"], data["y"], p["sigma"]
+            _fold_scale(p["beta"], data), offsets,
+            data["xT"], data["y"], p["sigma"],
         )
 
 
@@ -177,6 +182,7 @@ class FusedLinearMixedModelGrouped(LinearMixedModel):
 
     def log_lik(self, p, data):
         u = p["u_raw"] * p["tau"][None, :]  # (G, Q) non-centered
+        beta = _fold_scale(p["beta"], data)
         if "gl" not in data:  # fallback: offset path
             from ..ops.logistic_fused import gaussian_offset_loglik
 
@@ -184,12 +190,16 @@ class FusedLinearMixedModelGrouped(LinearMixedModel):
                 data["z"] * u[data["g"]], axis=-1
             )
             return gaussian_offset_loglik(
-                p["beta"], offsets, data["xT"], data["y"], p["sigma"]
+                beta, offsets, data["xT"], data["y"], p["sigma"]
             )
         from ..ops.hier_fused import lmm_grouped_loglik
 
+        # the z slab's quant scales fold into u the same way xT's fold
+        # into beta: mu's j-th term is (u_q-window @ onehot) * z_j, so
+        # (s_z[j] * u[:, j]) against packed z equals u against s_z * z
+        u = _fold_scale(u, data, key="zT_scale")
         return lmm_grouped_loglik(
-            p["beta"], u, p["intercept"], p["sigma"], data["xT"],
+            beta, u, p["intercept"], p["sigma"], data["xT"],
             data["zT"], data["y"], data["gl"], data["first_gid"],
             data["k_loc"], data["lt128"],
         )

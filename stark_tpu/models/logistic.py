@@ -31,8 +31,14 @@ def _bernoulli_logit_loglik(logits, y):
 
 
 def _rows_x(data):
-    """(N, D) design from either layout (prepare_data may have transposed)."""
-    return data["x"] if "x" in data else data["xT"].T
+    """(N, D) design from either layout (prepare_data may have
+    transposed — and, under a quantized STARK_FUSED_X_DTYPE, packed:
+    the cold-path reconstruction dequantizes)."""
+    if "x" in data:
+        return data["x"]
+    from ..ops.quantize import dequant_rows
+
+    return dequant_rows(data)
 
 
 class Logistic(Model):
@@ -103,11 +109,20 @@ def _transpose_x(data):
     if "xT" in data:
         return data
     from ..ops.logistic_fused import _x_stream_dtype
+    from ..ops.quantize import is_packed_dtype, pack_slab
 
     out = {k: v for k, v in data.items() if k != "x"}
-    # storage dtype per STARK_FUSED_X_DTYPE (bf16 halves the X stream;
-    # kernels cast back to f32 in-register — see ops/logistic_fused.py)
-    out["xT"] = jnp.asarray(data["x"]).T.astype(_x_stream_dtype())
+    # storage dtype per STARK_FUSED_X_DTYPE (bf16 halves the X stream,
+    # int8/fp8 quarter it; kernels cast back to f32 in-register and the
+    # quantized dtypes calibrate per-column scales at pack time — see
+    # ops/quantize.py)
+    xdt = _x_stream_dtype()
+    if is_packed_dtype(xdt):
+        out["xT"], out["xT_scale"] = pack_slab(
+            jnp.asarray(data["x"]).T.astype(jnp.float32), xdt
+        )
+    else:
+        out["xT"] = jnp.asarray(data["x"]).T.astype(xdt)
     return out
 
 
@@ -123,9 +138,25 @@ def _row_axes_xt(data):
     def ax(k, v):
         if np.ndim(v) == 0 or np.shape(v)[0] == 0:
             return -1
+        if k.endswith("_scale"):
+            # per-COLUMN quant scales (ops/quantize.py) carry no rows:
+            # replicate them so every row shard dequantizes its slice of
+            # the packed slab against the same global calibration
+            return -1
         return 1 if k == "xT" else 0
 
     return {k: ax(k, v) for k, v in data.items()}
+
+
+def _fold_scale(beta, data, key="xT_scale"):
+    """Quant-scale epilogue fold for the Pallas fused kernels: with a
+    packed slab, ``(s ⊙ q)·beta == q·(s ⊙ beta)`` — pre-scaling the
+    (D,) parameter operand is algebraically the dequant epilogue, so
+    the kernel streams the packed bytes untouched and autodiff chains
+    the scale back through the custom_vjp beta-gradient (a second (D,)
+    multiply).  No-op (same array) when the slab isn't quantized."""
+    s = data.get(key)
+    return beta if s is None else beta * s
 
 
 class TransposedXMixin:
@@ -185,8 +216,10 @@ class KnobGatedFusedMixin:
 
     def _fallback_log_lik(self, p, data):
         # knob flipped off after a fused-layout prepare: autodiff on the
-        # de-transposed matrix
-        x = data["xT"].T.astype(jnp.float32)
+        # de-transposed (and, for a packed slab, dequantized) matrix
+        from ..ops.quantize import dequant_rows
+
+        x = dequant_rows(data, dtype=jnp.float32)
         return super().log_lik(p, {**data, "x": x})
 
     def _fused_log_lik(self, p, data):
@@ -206,7 +239,9 @@ class FusedLogistic(TransposedXMixin, Logistic):
     def log_lik(self, p, data):
         from ..ops.logistic_fused import logistic_loglik
 
-        return logistic_loglik(p["beta"], data["xT"], data["y"])
+        return logistic_loglik(
+            _fold_scale(p["beta"], data), data["xT"], data["y"]
+        )
 
 
 class FusedHierLogistic(TransposedXMixin, HierLogistic):
@@ -222,7 +257,8 @@ class FusedHierLogistic(TransposedXMixin, HierLogistic):
 
         alpha = p["alpha0"] + p["sigma_alpha"] * p["alpha_raw"]
         return logistic_offset_loglik(
-            p["beta"], alpha[data["g"]], data["xT"], data["y"]
+            _fold_scale(p["beta"], data), alpha[data["g"]],
+            data["xT"], data["y"],
         )
 
 
@@ -270,16 +306,17 @@ class FusedHierLogisticGrouped(HierLogistic):
 
     def log_lik(self, p, data):
         alpha = p["alpha0"] + p["sigma_alpha"] * p["alpha_raw"]
+        beta = _fold_scale(p["beta"], data)
         if "gl" not in data:  # fallback layout
             from ..ops.logistic_fused import logistic_offset_loglik
 
             return logistic_offset_loglik(
-                p["beta"], alpha[data["g"]], data["xT"], data["y"]
+                beta, alpha[data["g"]], data["xT"], data["y"]
             )
         from ..ops.hier_fused import hier_logistic_loglik
 
         return hier_logistic_loglik(
-            p["beta"], alpha, data["xT"], data["y"], data["gl"],
+            beta, alpha, data["xT"], data["y"], data["gl"],
             data["first_gid"], data["k_loc"], data["lt128"],
         )
 

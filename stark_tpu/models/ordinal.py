@@ -85,9 +85,10 @@ class FusedOrderedLogistic(KnobGatedFusedMixin, OrderedLogistic):
 
     def _fused_log_lik(self, p, data):
         from ..ops.ordinal_fused import ordinal_loglik
+        from ..ops.quantize import stream_slab
 
         return ordinal_loglik(
-            p["beta"], p["cutpoints"], data["xT"], data["y"]
+            p["beta"], p["cutpoints"], stream_slab(data), data["y"]
         )
 
 

@@ -12,13 +12,23 @@ from .precision import (
     fused_knob,
     fused_value_and_grad,
     precision_statics,
+    x_stream_config,
     x_stream_dtype,
+)
+from .quantize import (
+    dequant_dot,
+    fake_quant,
+    pack_slab,
+    stream_slab,
+    x_bytes_per_grad,
 )
 from .robust_fused import studentt_loglik, studentt_loglik_value_and_grad
 
 __all__ = [
     "clip_band",
+    "dequant_dot",
     "dot_precision",
+    "fake_quant",
     "fused_knob",
     "fused_value_and_grad",
     "irt_loglik",
@@ -30,8 +40,12 @@ __all__ = [
     "logistic_offset_loglik",
     "ordinal_loglik",
     "ordinal_loglik_value_and_grad",
+    "pack_slab",
     "precision_statics",
+    "stream_slab",
     "studentt_loglik",
     "studentt_loglik_value_and_grad",
+    "x_bytes_per_grad",
+    "x_stream_config",
     "x_stream_dtype",
 ]

@@ -32,8 +32,9 @@ from .precision import (
     clip_band,
     dot_precision as _dot_precision,
     fused_knob,
-    x_stream_dtype as _x_stream_dtype,
+    precision_statics,
 )
+from .quantize import dequant_dot
 
 #: clip bound for the log-link rate, matching models.glm.PoissonRegression
 #: (a warmup excursion must not overflow float32 through exp)
@@ -50,22 +51,23 @@ def fused_glm_enabled() -> bool:
 def _poisson_vg(beta, xt, y):
     """(ll, dll/dbeta) of y ~ Poisson(exp(clip(X beta))) in one X pass.
 
-    beta: (D,), xt: (D, N) — X TRANSPOSED — y: (N,) counts (float).
+    beta: (D,), xt: (D, N) — X TRANSPOSED, plain f32/bf16 or the packed
+    ``(q, scale)`` pair from ops/quantize.py — y: (N,) counts (float).
     The gradient masks rows whose linear predictor sits outside the clip
     band, matching autodiff through ``jnp.clip`` (zero sensitivity at a
     saturated rate), so the fused and autodiff paths agree everywhere the
     posterior actually lives.
     """
     prec = _dot_precision()
-    # a bf16 X still streams from HBM at half width — XLA fuses this
-    # upcast into the dot's operand read, it never materializes f32 X
-    xs = xt.astype(jnp.float32)
-    eta_raw = jnp.dot(beta, xs, precision=prec)
+    # a bf16/int8/fp8 X still streams from HBM at reduced width —
+    # dequant_dot fuses the upcast into the dot's operand read and folds
+    # any quant scales into the epilogue; it never materializes f32 X
+    eta_raw = dequant_dot(beta, xt, precision=prec)
     eta, inside = clip_band(eta_raw, _LOG_RATE_CLIP)
     mu = jnp.exp(eta)
     ll = jnp.sum(y * eta - mu - jax.lax.lgamma(y + 1.0))
     resid = (y - mu) * inside
-    grad = jnp.dot(xs, resid, precision=prec)
+    grad = dequant_dot(xt, resid, precision=prec)
     return ll, grad
 
 
@@ -83,10 +85,7 @@ def _poisson_vg_jit(beta, xt, y, *, _precision, _x_dtype):
 
 def poisson_loglik_value_and_grad(beta, xt, y):
     """-> (ll scalar, dll/dbeta (D,)) in one pass over xt."""
-    return _poisson_vg_jit(
-        beta, xt, y,
-        _precision=_dot_precision(), _x_dtype=_x_stream_dtype(),
-    )
+    return _poisson_vg_jit(beta, xt, y, **precision_statics())
 
 
 @jax.custom_vjp

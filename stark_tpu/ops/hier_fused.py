@@ -141,8 +141,20 @@ def prepare_grouped(data, d_eff, transpose_keys=("x",)):
         if k not in transpose_keys
     }
     xdt = _x_stream_dtype()
+    from .quantize import is_packed_dtype, pack_slab
+
     for k in transpose_keys:
-        out[k + "T"] = jnp.asarray(np.asarray(data[k])[order].T).astype(xdt)
+        slab = jnp.asarray(np.asarray(data[k])[order].T)
+        if is_packed_dtype(xdt):
+            # per-column calibrated scales ride next to each packed slab
+            # (ops/quantize.py); the models fold them into the parameter
+            # operands (beta for xT, the u windows for zT), so the
+            # kernel streams packed bytes untouched
+            out[k + "T"], out[k + "T_scale"] = pack_slab(
+                slab.astype(jnp.float32), xdt
+            )
+        else:
+            out[k + "T"] = slab.astype(xdt)
     out["gl"] = jnp.asarray(gl)
     out["first_gid"] = jnp.asarray(first_gid)
     # static window size and lane tile ride in SHAPES (never values)

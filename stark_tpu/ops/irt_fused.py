@@ -84,8 +84,13 @@ def _irt_grid_vg(theta, a, b, y):
 
     theta: (P,); a, b: (I,); y: (P, I) in {0, 1}.  No gathers, no
     scatters: the residual matrix feeds two matvecs and a column sum.
+    The grid may be stored packed (int8/fp8 under a quantized
+    STARK_FUSED_X_DTYPE — exact for binary responses, no scale vector):
+    the upcast fuses into the elementwise link, so the slab streams at
+    packed width.
     """
     prec = dot_precision()
+    y = y.astype(jnp.float32)
     gap = theta[:, None] - b[None, :]
     logits = a[None, :] * gap
     ll = jnp.sum(
@@ -134,6 +139,15 @@ def prepare_grid(data, num_persons: int, num_items: int):
     ):
         return data
     y = jnp.asarray(data["y"]).reshape(num_persons, num_items)
+    from .precision import x_stream_dtype
+    from .quantize import is_packed_dtype
+
+    xdt = x_stream_dtype()
+    if is_packed_dtype(xdt):
+        # the (P, I) grid IS this family's streamed slab; binary
+        # responses pack EXACTLY into int8/fp8 (no scale vector), so a
+        # quantized STARK_FUSED_X_DTYPE quarters its bytes error-free
+        y = y.astype(xdt)
     out = {k: v for k, v in data.items() if k not in ("person", "item", "y")}
     out["y_grid"] = y
     return out

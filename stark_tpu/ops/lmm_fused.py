@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from .logistic_fused import _LOG_2PI
 from .precision import dot_precision, fused_knob, fused_value_and_grad
+from .quantize import dequant_dot
 
 
 def fused_lmm_enabled() -> bool:
@@ -42,15 +43,17 @@ def _lmm_vg(beta, u, intercept, sigma, xt, z, g, y):
     """(ll, (d/dbeta, d/du, d/dintercept, d/dsigma)) in one pass.
 
     beta: (D,); u: (G, Q) constrained random effects; xt: (D, N) — X
-    TRANSPOSED — z: (N, Q); g: (N,) int32 group ids; y: (N,).
+    TRANSPOSED, either a plain f32/bf16 slab or the packed ``(q, scale)``
+    pair from ops/quantize.py — z: (N, Q); g: (N,) int32 group ids;
+    y: (N,).
     ``ll = sum_i Normal(y_i | intercept + x_i beta + z_i . u[g_i], sigma)``.
     """
     prec = dot_precision()
-    # a bf16 X still streams at half width — XLA fuses the upcast into
-    # the dot's operand read, it never materializes an f32 copy
-    xs = xt.astype(jnp.float32)
+    # a bf16/int8/fp8 X still streams at reduced width — dequant_dot
+    # fuses the upcast into the dot's operand read and folds any quant
+    # scales into the epilogue; it never materializes an f32 copy
     eta = (
-        jnp.dot(beta, xs, precision=prec)
+        dequant_dot(beta, xt, precision=prec)
         + intercept
         + jnp.sum(z * u[g], axis=-1)
     )
@@ -59,7 +62,7 @@ def _lmm_vg(beta, u, intercept, sigma, xt, z, g, y):
     n = y.shape[-1]
     val = -0.5 * ssr / sigma**2 - n * jnp.log(sigma) - 0.5 * n * _LOG_2PI
     inv2 = 1.0 / (sigma * sigma)
-    g_beta = inv2 * jnp.dot(xs, resid, precision=prec)
+    g_beta = inv2 * dequant_dot(xt, resid, precision=prec)
     # the (G, Q) random-effect gradient, one 1-D segment_sum PER COLUMN
     # (Q is static and tiny): XLA:CPU lowers a (N, Q) scatter-add ~10x
     # slower than Q contiguous 1-D ones (measured) — and the (N, Q)

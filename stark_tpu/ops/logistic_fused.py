@@ -46,7 +46,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from .precision import dot_precision, stream_arg, x_stream_dtype
+from .precision import (
+    dot_precision,
+    precision_statics,
+    stream_arg,
+    x_stream_dtype,
+)
 
 # The precision/knob machinery lives in ops/precision.py (shared by every
 # fused op); these aliases keep this module's historical private names —
@@ -407,7 +412,7 @@ def logistic_loglik_value_and_grad(
     """
     return _loglik_vg_jit(
         beta, xt, y, lane_tile=lane_tile, interpret=interpret,
-        _precision=_dot_precision(), _x_dtype=_x_stream_dtype(),
+        **precision_statics(),
     )
 
 

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from .precision import dot_precision, fused_knob, fused_value_and_grad
+from .quantize import dequant_dot
 
 #: the stable-form clamp floor on log(1 - e^{l-u}); matches
 #: models.ordinal.OrderedLogistic exactly (parity depends on it)
@@ -45,11 +46,12 @@ def _ordinal_vg(beta, cutpoints, xt, y):
     """(ll, (d/dbeta, d/dcutpoints)) in one pass over xt.
 
     beta: (D,); cutpoints: (K-1,) strictly increasing (constrained
-    space); xt: (D, N) — X TRANSPOSED — y: (N,) categories in {0..K-1}.
+    space); xt: (D, N) — X TRANSPOSED, plain f32/bf16 or the packed
+    ``(q, scale)`` pair from ops/quantize.py — y: (N,) categories in
+    {0..K-1}.
     """
     prec = dot_precision()
-    xs = xt.astype(jnp.float32)
-    eta = jnp.dot(beta, xs, precision=prec)
+    eta = dequant_dot(beta, xt, precision=prec)
     big = jnp.asarray(1e9, eta.dtype)
     cpad = jnp.concatenate([-big[None], cutpoints, big[None]])  # (K+1,)
     yi = y.astype(jnp.int32)
@@ -71,7 +73,7 @@ def _ordinal_vg(beta, cutpoints, xt, y):
     d_lower = -jax.nn.sigmoid(lower) - r
     # d eta/d(upper,lower) = -1 each; the r terms cancel in the sum
     d_eta = -(d_upper + d_lower)
-    g_beta = jnp.dot(xs, d_eta, precision=prec)
+    g_beta = dequant_dot(xt, d_eta, precision=prec)
     # both cutpoint scatters in ONE segment_sum over the padded vector;
     # the ±big pad entries (indices 0 and K) absorb the gradients that
     # autodiff drops at the concatenated constants — the slice discards

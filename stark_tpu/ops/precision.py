@@ -50,12 +50,15 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
+    "X_DTYPE_NAMES",
     "clip_band",
     "dot_precision",
     "fused_knob",
     "fused_value_and_grad",
     "precision_statics",
+    "quant_percentile",
     "stream_arg",
+    "x_stream_config",
     "x_stream_dtype",
 ]
 
@@ -87,49 +90,133 @@ def dot_precision():
         ) from None
 
 
+#: canonical STARK_FUSED_X_DTYPE values, ordered by bytes per element —
+#: the single source the resolver's error message, the README coverage
+#: table, and the parity sweep's dtype axis all derive from (so adding
+#: a dtype here is the ONE place the accepted set changes; a test pins
+#: the error message to exactly this tuple so they can't drift apart
+#: again).
+X_DTYPE_NAMES = ("f32", "bf16", "int8", "fp8e4m3", "fp8e5m2")
+
+_X_DTYPES = {
+    "f32": jnp.float32,
+    "float32": jnp.float32,
+    "bf16": jnp.bfloat16,
+    "bfloat16": jnp.bfloat16,
+    # quantized storage dtypes (ops/quantize.py): prepare_data packs X
+    # with per-column calibrated scales; kernels fold the dequant into
+    # the matvec epilogue, accumulation stays f32
+    "int8": jnp.int8,
+    "fp8e4m3": jnp.float8_e4m3fn,
+    "float8_e4m3fn": jnp.float8_e4m3fn,
+    "fp8e5m2": jnp.float8_e5m2,
+    "float8_e5m2": jnp.float8_e5m2,
+}
+
+
 def x_stream_dtype():
     """HBM storage dtype for the streamed design matrix
-    (STARK_FUSED_X_DTYPE: f32 default | bf16).
+    (STARK_FUSED_X_DTYPE: f32 default | bf16 | int8 | fp8e4m3 |
+    fp8e5m2).
 
     The X stream is the dominant HBM traffic of every fused kernel
     (~94% of the grouped kernel's bytes at the flagship shape); bf16
-    halves it — the stream-side lever that compounds with the MXU-side
-    `dot_precision` lever once the kernel stops being pass-bound.
-    Opt-in because it changes the DATA, not just the arithmetic: X is
-    rounded to bf16 ONCE at prepare time, and the posterior is exactly
-    that of the rounded design matrix (kernels cast back to f32
-    in-register, so all accumulation stays f32).  Adopt via the same
-    parity gate as the precision knob (tools/precision_parity.py, which
-    sweeps the whole zoo over both knobs).  Adaptation-artifact
-    fingerprints key on the CALLER's raw data, so warm starts port
-    across X dtypes — the touch-up re-equilibrates and the convergence
-    gate still validates.
+    halves it and the quantized dtypes quarter it — the stream-side
+    lever that compounds with the MXU-side `dot_precision` lever once
+    the kernel stops being pass-bound.  Opt-in because it changes the
+    DATA, not just the arithmetic: X is rounded (bf16) or packed with
+    per-column calibrated scales (int8/fp8, ops/quantize.py) ONCE at
+    prepare time, and the posterior is exactly that of the
+    rounded/dequantized design matrix (kernels cast back to f32
+    in-register and fold the scales into the matvec epilogue, so all
+    accumulation stays f32).  Adopt via the same parity gate as the
+    precision knob (tools/precision_parity.py, which sweeps the whole
+    zoo over both knobs).  Adaptation-artifact fingerprints key on the
+    CALLER's raw data, so warm starts port across X dtypes — the
+    touch-up re-equilibrates and the convergence gate still validates.
     """
     name = os.environ.get("STARK_FUSED_X_DTYPE", "f32").lower()
     try:
-        return {
-            "f32": jnp.float32,
-            "float32": jnp.float32,
-            "bf16": jnp.bfloat16,
-            "bfloat16": jnp.bfloat16,
-        }[name]
+        return _X_DTYPES[name]
     except KeyError:
+        # enumerate EXACTLY the canonical accepted set: the README table
+        # and this message once listed only f32|bf16 while drifting
+        # independently — both now derive from X_DTYPE_NAMES
         raise ValueError(
-            f"STARK_FUSED_X_DTYPE={name!r}: use f32|bf16"
+            f"STARK_FUSED_X_DTYPE={name!r}: use {'|'.join(X_DTYPE_NAMES)}"
         ) from None
+
+
+def quant_percentile():
+    """Outlier-percentile calibration knob (STARK_QUANT_PCT): None
+    (unset or 100) -> plain absmax calibration; a float in (0, 100) ->
+    each design-matrix column's scale maps its p-th absolute percentile
+    (not its max) onto the packed dtype's range, clipping the outlier
+    tail symmetrically in exchange for bulk resolution.  Only consulted
+    when STARK_FUSED_X_DTYPE resolves to a quantized dtype."""
+    val = os.environ.get("STARK_QUANT_PCT")
+    if val is None:
+        return None
+    try:
+        pct = float(val)
+    except ValueError:
+        raise ValueError(
+            f"STARK_QUANT_PCT={val!r}: need a percentile in (0, 100]"
+        ) from None
+    if not 0.0 < pct <= 100.0:
+        raise ValueError(
+            f"STARK_QUANT_PCT={val!r}: need a percentile in (0, 100]"
+        )
+    return None if pct == 100.0 else pct
+
+
+def x_stream_config() -> str:
+    """The RESOLVED X-stream config as one hashable jit cache-key
+    token: the canonical dtype name, plus the calibration percentile
+    when a quantized dtype is active (``"int8@p99.9"``) — so flipping
+    EITHER the dtype knob or a STARK_QUANT_* calibration knob
+    mid-process changes the key and retraces (ADVICE r5 extended to
+    the quant config)."""
+    dt = jnp.dtype(x_stream_dtype())
+    name = {
+        jnp.dtype(jnp.float32): "f32",
+        jnp.dtype(jnp.bfloat16): "bf16",
+        jnp.dtype(jnp.int8): "int8",
+        jnp.dtype(jnp.float8_e4m3fn): "fp8e4m3",
+        jnp.dtype(jnp.float8_e5m2): "fp8e5m2",
+    }[dt]
+    if name in ("int8", "fp8e4m3", "fp8e5m2"):
+        pct = quant_percentile()
+        if pct is not None:
+            name += f"@p{pct:g}"
+    return name
+
+
+#: dtypes a kernel streams AS STORED (everything else normalizes to f32)
+_STREAM_DTYPES = frozenset(
+    jnp.dtype(d)
+    for d in (jnp.bfloat16, jnp.int8, jnp.float8_e4m3fn, jnp.float8_e5m2)
+)
 
 
 def stream_arg(xt):
     """Pass a design-matrix slab to a kernel in its storage dtype (bf16
-    streams halve HBM traffic; kernels cast back to f32 in-register);
-    anything else is normalized to f32."""
-    if xt.dtype == jnp.bfloat16:
+    streams halve HBM traffic, int8/fp8 quarter it; kernels cast back
+    to f32 in-register); anything else is normalized to f32.  Accepts
+    the packed ``(q, scale)`` pair (ops/quantize.py): the kernel sees
+    the packed slab, while the scale rides the caller's pytree to the
+    epilogue fold (Pallas kernels never see scales — the model folds
+    them into the parameter operand, which is algebraically the same
+    epilogue)."""
+    if isinstance(xt, (tuple, list)):
+        xt = xt[0]
+    if xt.dtype in _STREAM_DTYPES:
         return xt
     return xt.astype(jnp.float32)
 
 
 def precision_statics():
-    """The two resolved precision knobs as jit cache-key statics.
+    """The resolved precision knobs as jit cache-key statics.
 
     Pass ``**precision_statics()`` into a jit whose ``static_argnames``
     include ``("_precision", "_x_dtype")`` and whose body re-reads the
@@ -137,9 +224,11 @@ def precision_statics():
     values is what forces a retrace when a knob changes mid-process —
     a module-level jit otherwise reuses the stale executable for
     same-shape calls, silently violating the "numerics never change
-    silently" contract (ADVICE r5).
+    silently" contract (ADVICE r5).  ``_x_dtype`` is the full
+    `x_stream_config` token (dtype + quant calibration), so flipping a
+    STARK_QUANT_* knob retraces too.
     """
-    return {"_precision": dot_precision(), "_x_dtype": x_stream_dtype()}
+    return {"_precision": dot_precision(), "_x_dtype": x_stream_config()}
 
 
 def fused_knob(name: str, *, default: bool = False) -> bool:

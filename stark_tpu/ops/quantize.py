@@ -1,0 +1,314 @@
+"""Quantized design-matrix streaming: int8 / fp8 X with calibrated
+per-column scales and epilogue-folded dequantization.
+
+The fused value-and-grad zoo is memory-bandwidth-bound on exactly one
+tensor: the streamed design matrix (~94% of the grouped kernel's bytes
+at the flagship shape).  ``ops/precision.py`` proved the stream-side
+lever at bf16 (STARK_FUSED_X_DTYPE halving the slab); this module
+extends the ladder to the quantized dtypes — ``int8``, ``fp8e4m3``
+(float8_e4m3fn), ``fp8e5m2`` — a 4x traffic cut with f32 accumulation
+throughout.
+
+Contract (the bf16 rounded-X convention, extended):
+
+* **Calibration at prepare time.**  ``pack_slab`` computes ONE symmetric
+  scale per design-matrix column (per row of the transposed (D, N)
+  slab): ``s_d = amax_d / qmax`` with ``amax_d`` the column's absolute
+  maximum — or, under ``STARK_QUANT_PCT=<p>``, its p-th absolute
+  percentile, which sacrifices the outlier tail of a heavy-tailed
+  column for resolution in its bulk (values past the band clip
+  symmetrically).  Packing is deterministic (round-half-even for int8,
+  IEEE nearest-even casts for fp8), so a fixed dataset + knob config
+  packs to identical bytes every time.
+
+* **Rounded-X reference semantics.**  The posterior sampled is EXACTLY
+  the model on the dequantized matrix ``X_q = s * q``: quantization is
+  a data change made once, not an arithmetic error made per step.
+  Draws are reproducible bit-for-bit for a fixed packed dataset, and
+  the parity gate (tools/precision_parity.py) compares the fused path
+  against the autodiff reference on the SAME dequantized X.
+
+* **Fused dequant — no f32 copy of X, ever.**  ``dequant_dot`` folds
+  the scale vector into the matvec epilogue: when the scaled axis is
+  contracted (the forward eta-dot) the scales pre-multiply the SMALL
+  operand (``(beta * s) @ q``); when it survives (the backward
+  grad-dot) they post-multiply the (D,) output (``s * (q @ resid)``).
+  The packed->f32 element conversion fuses into the dot's operand read
+  (XLA never materializes the converted slab), so HBM traffic is the
+  packed bytes.  The Pallas kernels get the mathematically identical
+  fold one level up: the model pre-scales beta (``(s*q)·beta ==
+  q·(s*beta)``) and autodiff chains the scale back through the
+  custom_vjp gradient — same epilogue algebra, zero kernel changes.
+
+* **Scale transport.**  The scale vector rides the data pytree as
+  ``xT_scale`` next to the packed ``xT`` (``<k>T_scale`` for any packed
+  slab), replicated — never row-sharded — by the data sharder: scales
+  are per-column global statistics, so row shards of q plus the full
+  scale vector reproduce the dequantized shard exactly.  Fleet stacking
+  (`FleetSpec`) adds its problem axis to both leaves, giving each
+  problem its own calibration.
+
+The IRT grid layout has no design matrix; its streamed slab is the
+binary (P, I) response grid, which packs to int8/fp8 EXACTLY (0/1 are
+representable in every packed dtype), so the same knob quarters its
+bytes with zero quantization error and no scale vector.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from .precision import quant_percentile
+
+__all__ = [
+    "PACKED_DTYPES",
+    "dequant",
+    "dequant_dot",
+    "dequant_rows",
+    "fake_quant",
+    "is_packed_dtype",
+    "pack_slab",
+    "predict_x_bytes",
+    "quant_column_error",
+    "stream_slab",
+    "x_bytes_per_grad",
+]
+
+#: canonical knob name -> packed storage dtype
+PACKED_DTYPES = {
+    "int8": jnp.int8,
+    "fp8e4m3": jnp.float8_e4m3fn,
+    "fp8e5m2": jnp.float8_e5m2,
+}
+
+#: largest representable magnitude per packed dtype (the symmetric
+#: calibration maps each column's absmax/percentile onto it).  int8 uses
+#: 127 (not 128) so the grid stays symmetric; the fp8 values are the
+#: formats' max finite magnitudes.
+_QMAX = {
+    jnp.dtype(jnp.int8): 127.0,
+    jnp.dtype(jnp.float8_e4m3fn): 448.0,
+    jnp.dtype(jnp.float8_e5m2): 57344.0,
+}
+
+
+def is_packed_dtype(dtype) -> bool:
+    """True for the quantized storage dtypes (int8 / fp8)."""
+    return jnp.dtype(dtype) in _QMAX
+
+
+def pack_slab(xt, dtype, pct: Optional[float] = None):
+    """Pack a (D, N) f32 slab -> ``(q, scale)`` with per-row scales.
+
+    Rows of the transposed slab are design-matrix COLUMNS, so this is
+    the per-column symmetric calibration: ``scale[d] = amax_d / qmax``
+    (``amax_d`` = abs-max of row d, or its ``pct``-th absolute
+    percentile when given — defaulting to the STARK_QUANT_PCT knob),
+    ``q = round/cast(xt / scale)`` clipped to the dtype's symmetric
+    range.  All-zero rows get scale 1.0 (q is exactly zero there).
+    Deterministic for a fixed input + config.
+    """
+    dtype = jnp.dtype(dtype)
+    qmax = _QMAX[dtype]
+    if pct is None:
+        pct = quant_percentile()
+    xt = jnp.asarray(xt).astype(jnp.float32)
+    ax = jnp.abs(xt)
+    amax = jnp.max(ax, axis=-1)
+    if pct is not None:
+        # a SPARSE column (mostly zeros, a few signal values) can put
+        # its pct-th absolute percentile at exactly 0 — calibrating on
+        # that would zero the entire column (and the rounded-X
+        # reference would hide it from the parity gate).  A zero
+        # percentile carries no calibration information, so such
+        # columns fall back to their true absmax.
+        pmax = jnp.percentile(ax, pct, axis=-1)
+        amax = jnp.where(pmax > 0, pmax, amax)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0).astype(jnp.float32)
+    v = jnp.clip(xt / scale[..., None], -qmax, qmax)
+    q = jnp.round(v).astype(dtype) if dtype == jnp.int8 else v.astype(dtype)
+    return q, scale
+
+
+def dequant(q, scale):
+    """Materialize the f32 slab ``scale[..., None] * q`` — the COLD path
+    (fallbacks, references, validation).  Hot paths use `dequant_dot`,
+    which never builds this array."""
+    return scale[..., None] * q.astype(jnp.float32)
+
+
+def _split(operand) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """(array, scale-or-None) from a packed pair or a plain array."""
+    if isinstance(operand, (tuple, list)):
+        q, s = operand
+        return q, s
+    return operand, None
+
+
+def _f32(x):
+    return x if x.dtype == jnp.float32 else x.astype(jnp.float32)
+
+
+def dequant_dot(a, b, *, precision=None):
+    """``jnp.dot(a, b)`` where either operand may be a quantized
+    ``(q, scale)`` pair, with the scales folded into the epilogue.
+
+    Convention: the packed operand is the (D, N) transposed design
+    matrix with ``scale`` indexing its axis 0.  Two cases cover the
+    fused ops' whole data plane:
+
+    * forward eta-dot ``dequant_dot(beta, (q, s))`` — the scaled axis is
+      CONTRACTED, so the scales fold into the small operand:
+      ``(beta * s) @ q`` — a (D,) multiply, not a (D, N) dequant;
+    * backward grad-dot ``dequant_dot((q, s), resid)`` — the scaled axis
+      SURVIVES, so the scales fold into the (D,)-shaped output:
+      ``s * (q @ resid)``.
+
+    Plain (f32/bf16) operands upcast to f32 exactly as the ops always
+    did (``xt.astype(float32)`` fused into the dot's operand read); a
+    packed q upcasts the same way, so no f32 copy of X is ever
+    materialized either way.
+    """
+    a, sa = _split(a)
+    b, sb = _split(b)
+    if sa is not None and sb is not None:
+        raise ValueError("dequant_dot: only one operand may carry scales")
+    out = jnp.dot(
+        _f32(a) if sb is None else _f32(a) * sb,
+        _f32(b),
+        precision=precision,
+    )
+    if sa is None:
+        return out
+    return out * sa if out.ndim <= 1 else out * sa[:, None]
+
+
+def stream_slab(data, key: str = "xT"):
+    """The design-matrix argument for a fused op: the packed
+    ``(q, scale)`` pair when the slab was quantized at prepare time
+    (``<key>_scale`` present), else the raw array — so op signatures
+    are dtype-agnostic and a knob flip never re-prepares data."""
+    scale = data.get(key + "_scale")
+    slab = data[key]
+    return (slab, scale) if scale is not None else slab
+
+
+def dequant_rows(data, key: str = "xT", dtype=None):
+    """Reconstruct the (N, D) row matrix from a prepared slab — the
+    COLD path shared by every fallback/validation consumer (knob-off
+    log_lik, ``log_lik_rows``, de-transposed autodiff).  Packed slabs
+    dequantize to f32; plain slabs return the historical ``.T`` view
+    (cast to ``dtype`` when given), bit-identical to the pre-quant
+    behavior."""
+    scale = data.get(key + "_scale")
+    if scale is not None:
+        return dequant(data[key], scale).T
+    rows = data[key].T
+    return rows if dtype is None else rows.astype(dtype)
+
+
+def fake_quant(x, name: str, pct: Optional[float] = None):
+    """Quantize-dequantize roundtrip of an (N, D) row matrix through the
+    SAME calibration/packing path the prepare hook uses — the rounded-X
+    reference for parity sweeps and tests (columns of ``x`` are scaled,
+    matching `pack_slab` on the transposed slab)."""
+    q, scale = pack_slab(jnp.asarray(x).T, PACKED_DTYPES[name], pct=pct)
+    return dequant(q, scale).T
+
+
+def quant_column_error(x, name: str, pct: Optional[float] = None) -> float:
+    """Max per-column relative quantization error of packing ``x`` —
+    the calibration-quality artifact column: ``max_d (max_n |x - x_q|
+    / max_n |x|)`` over columns with any signal."""
+    import numpy as np
+
+    x = np.asarray(x, np.float64)
+    xq = np.asarray(fake_quant(x.astype(np.float32), name, pct=pct),
+                    np.float64)
+    amax = np.max(np.abs(x), axis=0)
+    err = np.max(np.abs(x - xq), axis=0)
+    live = amax > 0
+    if not np.any(live):
+        return 0.0
+    return float(np.max(err[live] / amax[live]))
+
+
+def predict_x_bytes(n: int, d: int, xcfg: Optional[str] = None) -> int:
+    """Predicted per-evaluation stream bytes of an (n, d) row matrix
+    prepared under X-stream config ``xcfg`` (default: the resolved
+    env config): the (D, N) slab at its storage width plus the f32
+    per-column scale vector for packed dtypes.  The ONE copy of this
+    arithmetic — telemetry tags and the bench's flagship stamping both
+    call it, so a new dtype can't skew one ledger and not the other."""
+    if xcfg is None:
+        from .precision import x_stream_config
+
+        xcfg = x_stream_config()
+    name = xcfg.split("@")[0]
+    itemsize = {"f32": 4, "bf16": 2}.get(name, 1)
+    nbytes = n * d * itemsize
+    if name in PACKED_DTYPES:
+        nbytes += d * 4  # the f32 scale vector
+    return int(nbytes)
+
+
+def x_stream_tags(fused_tag, data) -> dict:
+    """``run_start`` telemetry fields for a non-f32 X stream:
+    ``x_dtype`` (the resolved `x_stream_config` token) and
+    ``x_bytes_per_grad`` (the per-evaluation slab bytes — measured from
+    the prepared data when it carries one, predicted from the raw row
+    matrix's shape otherwise).  Empty for plain models and for f32
+    streams, so knob-off traces stay byte-identical to the historical
+    schema."""
+    if not fused_tag or not hasattr(data, "get"):
+        return {}
+    from .precision import x_stream_config
+
+    try:
+        xcfg = x_stream_config()
+    except ValueError:
+        return {}
+    if xcfg == "f32":
+        return {}
+    out = {"x_dtype": xcfg}
+    nbytes = x_bytes_per_grad(data)
+    if nbytes is None and data.get("x") is not None:
+        import numpy as np
+
+        shape = np.shape(data["x"])
+        if len(shape) == 2:
+            nbytes = predict_x_bytes(
+                int(shape[0]), int(shape[1]), xcfg
+            )
+    if nbytes is not None:
+        out["x_bytes_per_grad"] = int(nbytes)
+    return out
+
+
+def x_bytes_per_grad(data) -> Optional[int]:
+    """Bytes of the streamed slab one fused value-and-grad evaluation
+    reads (the one-pass contract: exactly one pass over the packed X —
+    or the packed response grid for the grid IRT layout), scale vector
+    included.  None when the data carries no prepared slab — a missing
+    measurement must read as missing, never 0 (the ledger's
+    null-not-0.0 rule)."""
+    if not hasattr(data, "get"):
+        return None
+    for key in ("xT", "y_grid"):
+        slab = data.get(key)
+        if slab is None:
+            continue
+        size = 1
+        for dim in slab.shape:
+            size *= int(dim)
+        total = size * jnp.dtype(slab.dtype).itemsize
+        scale = data.get(key + "_scale")
+        if scale is not None:
+            ssize = 1
+            for dim in scale.shape:
+                ssize *= int(dim)
+            total += ssize * jnp.dtype(scale.dtype).itemsize
+        return int(total)
+    return None

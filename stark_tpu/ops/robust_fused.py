@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.scipy.special import digamma, gammaln
 
 from .precision import dot_precision, fused_knob, fused_value_and_grad
+from .quantize import dequant_dot
 
 _LOG_PI = 1.1447298858494002
 
@@ -39,12 +40,12 @@ def _studentt_vg(beta, sigma, nu, xt, y):
     """(ll, (d/dbeta, d/dsigma, d/dnu)) in one pass over xt.
 
     beta: (D,); sigma, nu: positive scalars (constrained space);
-    xt: (D, N) — X TRANSPOSED — y: (N,).
+    xt: (D, N) — X TRANSPOSED, plain f32/bf16 or the packed
+    ``(q, scale)`` pair from ops/quantize.py — y: (N,).
     ``ll = sum_i StudentT(y_i | nu, x_i beta, sigma)``.
     """
     prec = dot_precision()
-    xs = xt.astype(jnp.float32)
-    mu = jnp.dot(beta, xs, precision=prec)
+    mu = dequant_dot(beta, xt, precision=prec)
     n = y.shape[-1]
     z = (y - mu) / sigma
     z2 = z * z
@@ -58,7 +59,7 @@ def _studentt_vg(beta, sigma, nu, xt, y):
     # tail weight: w = (nu+1)/(nu+z^2); d ll/d mu_i = w_i z_i / sigma
     w = (nu + 1.0) / (nu + z2)
     wz = w * z
-    g_beta = jnp.dot(xs, wz, precision=prec) / sigma
+    g_beta = dequant_dot(xt, wz, precision=prec) / sigma
     g_sigma = (jnp.sum(w * z2) - n) / sigma
     # d/dnu: row-constant digamma/1/nu terms evaluated once, plus the
     # per-row log1p and weighted-quadratic corrections

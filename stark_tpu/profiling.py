@@ -318,6 +318,11 @@ def timeline_summary(
                                          # block events)
          "span_coverage_frac": float | None,  # attributed fraction of
                                               # the run wall
+         "x_dtype": str | None,          # resolved X-stream dtype when
+                                         # the run streamed a non-f32
+                                         # design slab (run_start tag)
+         "x_bytes_per_grad": int | None, # that slab's bytes per
+                                         # gradient evaluation
          "synthesized": bool}
     """
     tl = spans_from_events(events, run=run)
@@ -339,6 +344,8 @@ def timeline_summary(
     saw_dispatch = False
     comp = 0.0
     saw_comp = False
+    x_dtype = None
+    x_bytes = None
     for e in evs:
         ev = e.get("event")
         if ev == "compile" and isinstance(e.get("dur_s"), (int, float)):
@@ -347,6 +354,13 @@ def timeline_summary(
         elif ev in _DISPATCH_EVENTS:
             n_dispatch += 1
             saw_dispatch = True
+        elif ev == "run_start":
+            # quantized/bf16 X streaming tags (ops/quantize.py): carried
+            # into the summary so dispatch_count x x_bytes_per_grad
+            # turns the bandwidth claim into measured arithmetic; None
+            # (never 0) on f32 runs and pre-quant traces
+            x_dtype = e.get("x_dtype", x_dtype)
+            x_bytes = e.get("x_bytes_per_grad", x_bytes)
     if saw_comp:
         compile_s = round(comp, 4)
     if saw_dispatch:
@@ -361,6 +375,8 @@ def timeline_summary(
         "compile_s": compile_s,
         "dispatch_count": dispatch_count,
         "span_coverage_frac": coverage,
+        "x_dtype": x_dtype,
+        "x_bytes_per_grad": x_bytes,
         "synthesized": tl["synthesized"],
     }
 

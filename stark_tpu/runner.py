@@ -47,6 +47,7 @@ import numpy as np
 
 from . import diagnostics, faults, telemetry
 from .kernels.base import HMCState
+from .ops import quantize as _quantize
 from .model import Model
 from .sampler import Posterior, SamplerConfig, _constrain_draws
 
@@ -351,6 +352,11 @@ def _sample_until_converged(
             entry="sample_until_converged",
             model=type(model).__name__,
             **({"fused": fused_tag} if fused_tag else {}),
+            # quantized/bf16 X streaming (ops/quantize.py): resolved
+            # stream dtype + slab bytes per gradient evaluation, so the
+            # timeline/ledger can turn dispatch counts into measured
+            # bandwidth; absent on f32 runs (trace byte-identity)
+            **_quantize.x_stream_tags(fused_tag, data),
             kernel=cfg.kernel,
             chains=chains,
             block_size=block_size,

@@ -760,11 +760,16 @@ def sample(
             fused_tag = (
                 model.fused_tag() if hasattr(model, "fused_tag") else None
             )
+            from .ops.quantize import x_stream_tags
+
             trace.emit(
                 "run_start",
                 entry="sample",
                 model=type(model).__name__,
                 **({"fused": fused_tag} if fused_tag else {}),
+                # resolved X-stream dtype + slab bytes (absent on f32
+                # runs — trace byte-identity; see ops/quantize.py)
+                **x_stream_tags(fused_tag, data),
                 kernel=cfg.kernel,
                 chains=chains,
                 num_warmup=cfg.num_warmup,

@@ -94,16 +94,70 @@ def test_fusedvg_config_key_stable(bench):
     )
 
 
+def test_fusedvg_config_key_x_dtype_series(bench):
+    """Non-f32 X legs get their own :x=<dtype> series; an explicit f32
+    leg keeps the historical key (series continuity)."""
+    row = {"family": "lmm", "n": 200000, "d": 32, "x_dtype": "int8"}
+    assert bench.fusedvg_config_key(row, "cpu") == (
+        "fusedvg:lmm:n=200000:d=32:platform=cpu:x=int8"
+    )
+    row["x_dtype"] = "f32"
+    assert bench.fusedvg_config_key(row, "cpu") == (
+        "fusedvg:lmm:n=200000:d=32:platform=cpu"
+    )
+
+
+@pytest.fixture(scope="module")
+def micro_quant_result():
+    os.environ["BENCH_FUSEDVG_SCALE"] = "0.02"
+    try:
+        from stark_tpu.benchmarks import bench_fused_value_and_grad
+
+        yield bench_fused_value_and_grad(
+            "irt", x_dtype="int8", reps=5, rounds=1
+        )
+    finally:
+        os.environ.pop("BENCH_FUSEDVG_SCALE", None)
+
+
+def test_microbench_x_dtype_axis(micro_quant_result):
+    """A quantized leg records the bytes-accounting evidence: packed
+    slab bytes, the f32 comparison, a >=2x traffic reduction, and the
+    does-quantization-pay rate against the f32-X fused variant."""
+    r = micro_quant_result
+    x = r.extra
+    assert x["x_dtype"] == "int8"
+    assert os.environ.get("STARK_FUSED_X_DTYPE") is None  # env restored
+    assert x["x_bytes_per_grad"] and x["x_bytes_per_grad_f32"]
+    assert x["x_traffic_reduction"] >= 2.0
+    assert x["fused_f32x_evals_per_sec"] is not None
+    assert x["speedup_vs_f32x"] is None or x["speedup_vs_f32x"] > 0
+    # the IRT grid packs exactly, so parity is f32-tight even quantized
+    assert x["grad_parity_rel"] < 1e-3
+
+
+def test_microbench_f32_leg_has_bytes_but_no_quant_extras(micro_result):
+    x = micro_result.extra
+    assert x["x_dtype"] == "f32"
+    assert x["x_bytes_per_grad"] == x["x_bytes_per_grad_f32"]
+    assert x["x_traffic_reduction"] == 1.0
+    assert x["fused_f32x_evals_per_sec"] is None
+    assert x["speedup_vs_f32x"] is None
+
+
 def test_microbench_speedup_recorded(micro_result):
     sp = micro_result.extra["speedup_vs_autodiff"]
     assert sp is None or (np.isfinite(sp) and sp > 0)
 
 
 def test_microbench_rejects_unknown_family(bench, capsys):
-    """A typo'd family must fail fast (exit 2), not silently fall back
-    to benching the full default set and appending unintended ledger
-    rows to the series being re-baselined."""
+    """A typo'd family — or a bogus :x_dtype suffix — must fail fast
+    (exit 2), not silently fall back to benching the full default set
+    and appending unintended ledger rows to the series being
+    re-baselined."""
     rc = bench.run_fused_microbench(["ordnial"])
     assert rc == 2
     err = capsys.readouterr().err
-    assert "unknown families" in err and "ordnial" in err
+    assert "unknown legs" in err and "ordnial" in err
+    assert bench.run_fused_microbench(["lmm:f16"]) == 2  # bad dtype
+    assert bench.run_fused_microbench(["nutssched:int8"]) == 2  # no axis

@@ -34,6 +34,7 @@ def test_collector_finds_all_knob_families():
         "STARK_FUSED_ORDINAL",
         "STARK_FUSED_ROBUST",
         "STARK_RAGGED_NUTS",
+        "STARK_QUANT_PCT",
     } <= set(knobs)
 
 
@@ -54,6 +55,9 @@ def test_collector_finds_all_knob_families():
         # the scheduler knob IS covered
         ('import os\nos.environ.get("STARK_RAGGED_NUTS", "0")\n',
          ["STARK_RAGGED_NUTS"]),
+        # the quant-calibration knob family IS covered
+        ('import os\nos.environ.get("STARK_QUANT_CALIB_NEW")\n',
+         ["STARK_QUANT_CALIB_NEW"]),
     ],
 )
 def test_find_knob_reads(source, expect):

@@ -96,6 +96,48 @@ def test_nutssched_rows_committed():
     )
 
 
+def test_quantized_fusedvg_rows_committed():
+    """The quantized data-plane's ledger evidence: committed
+    ``fusedvg:*:x=int8`` and ``:x=fp8e4m3`` rows exist for the
+    memory-bound families (lmm, irt, logistic), each carrying the
+    bytes-accounting columns with the >=2x traffic reduction; at least
+    one of lmm/irt holds the >=1.3x value-and-grad gate under a
+    quantized X; and any gate-failing quantized row follows the
+    null-not-0.0 rule (honest parity, never a hidden regression)."""
+    rows = [json.loads(l) for l in open(_LEDGER) if l.strip()]
+    quant = [
+        r for r in rows
+        if r["config"].startswith("fusedvg:")
+        and (":x=int8" in r["config"] or ":x=fp8e4m3" in r["config"])
+    ]
+    for fam in ("lmm", "irt", "logistic"):
+        for dt in ("int8", "fp8e4m3"):
+            series = [
+                r for r in quant
+                if r["config"].startswith(f"fusedvg:{fam}:")
+                and r["config"].endswith(f":x={dt}")
+            ]
+            assert series, (
+                f"committed ledger must carry a fusedvg:{fam}:…:x={dt} row"
+            )
+            newest = series[-1]
+            assert newest["x_bytes_per_grad"] is not None
+            assert newest["x_traffic_reduction"] >= 2.0
+            if newest["converged"] is not True:
+                # the null-not-0.0 rule: a quantized leg that loses its
+                # gate records missing data, never a measured zero
+                assert newest["ess_per_sec"] is None
+    gated = [
+        r for r in quant
+        if r["config"].split(":", 2)[1] in ("lmm", "irt")
+        and r["converged"] is True
+    ]
+    assert any(r["speedup_vs_autodiff"] >= 1.3 for r in gated), (
+        "at least one memory-bound family must hold the >=1.3x "
+        "value-and-grad gate under a quantized X stream"
+    )
+
+
 def test_fresh_config_passes(tmp_path):
     """A config with no history must not fail CI (fresh ledgers pass)."""
     path = tmp_path / "ledger.jsonl"

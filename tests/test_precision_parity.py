@@ -45,11 +45,19 @@ def test_band_resolution(parity):
     assert parity.band_for("bf16", "high") == "mid"
     assert parity.band_for("f32", "default") == "wide"
     assert parity.band_for("bf16", "default") == "wide"
+    # quantized X columns: their own wide-band tier at EITHER precision
+    for q in parity.QUANT_X_DTYPES:
+        assert parity.band_for(q, "high") == "quant"
+        assert parity.band_for(q, "default") == "quant"
+    assert set(parity.X_DTYPES) == {
+        "f32", "bf16", "int8", "fp8e4m3", "fp8e5m2"
+    }
 
 
 def test_full_sweep_passes(parity):
-    """The whole grid at smoke scale — every cell inside its band, and
-    the env knobs restored afterwards."""
+    """The whole grid at smoke scale — every op x {f32, bf16, int8,
+    fp8e4m3, fp8e5m2} x {default, high} cell inside its band, and the
+    env knobs restored afterwards."""
     prior_env = {
         k: os.environ.get(k)
         for k in ("STARK_FUSED_PRECISION", "STARK_FUSED_X_DTYPE",
@@ -57,12 +65,29 @@ def test_full_sweep_passes(parity):
     }
     rows, ok = parity.run_sweep()
     assert ok, [r for r in rows if not r["ok"]]
-    assert len(rows) == len(parity.zoo_cases()) * 4
+    assert len(rows) == len(parity.zoo_cases()) * 2 * len(parity.X_DTYPES)
     for k, v in prior_env.items():
         assert os.environ.get(k) == v
     # the knob-gated ops actually exercised their fused path: parity
     # deltas must be nonzero somewhere (fused != reference computation)
     assert any(r["grad_rel"] > 0 for r in rows if r["op"] == "lmm")
+    # quantized cells carry the calibration-quality artifact column for
+    # every op that streams a design matrix; f32/bf16 cells never do
+    for r in rows:
+        if r["x_dtype"] in parity.QUANT_X_DTYPES and r["op"] != "irt":
+            assert r["quant_col_err"] is not None and r["quant_col_err"] > 0
+        else:
+            assert r["quant_col_err"] is None
+    # int8's uniform grid calibrates tighter than fp8e5m2's 2-bit
+    # mantissa on the same gaussian columns
+    err = {
+        q: max(
+            r["quant_col_err"] for r in rows
+            if r["x_dtype"] == q and r["quant_col_err"] is not None
+        )
+        for q in ("int8", "fp8e5m2")
+    }
+    assert err["int8"] < err["fp8e5m2"]
 
 
 def test_broken_op_fails_cell(parity):

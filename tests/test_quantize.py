@@ -1,0 +1,426 @@
+"""The quantized data-plane (ops/quantize.py + the STARK_FUSED_X_DTYPE
+int8/fp8 ladder): calibration/packing determinism, epilogue-folded
+dequant dots, zoo parity against the dequantized-X reference, knob-off
+bit-identity, the knob-flip lifecycle (packed data keeps working after
+either knob flips), fleet stacking over quant-prepared data, sharding
+row axes for the scale vector, and the bytes-accounting / telemetry
+tags.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import stark_tpu
+from stark_tpu import telemetry
+from stark_tpu.model import flatten_model, prepare_model_data
+from stark_tpu.models import (
+    FusedIRT2PL,
+    FusedLMM,
+    FusedLogistic,
+    FusedPoissonRegression,
+    IRT2PL,
+    LinearMixedModel,
+    Logistic,
+    PoissonRegression,
+    synth_irt_data,
+    synth_lmm_data,
+    synth_logistic_data,
+    synth_poisson_data,
+)
+from stark_tpu.ops import quantize
+from stark_tpu.ops.precision import (
+    X_DTYPE_NAMES,
+    quant_percentile,
+    x_stream_config,
+    x_stream_dtype,
+)
+
+KEY = jax.random.PRNGKey(0)
+QUANT_NAMES = ("int8", "fp8e4m3", "fp8e5m2")
+
+
+# --- the dtype knob + error-message contract (the README/message pair
+# once drifted: both now derive from X_DTYPE_NAMES) -------------------
+
+
+def test_x_dtype_error_enumerates_exactly_the_accepted_set(monkeypatch):
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "f16")
+    with pytest.raises(ValueError) as e:
+        x_stream_dtype()
+    msg = str(e.value)
+    # the message's enumerated set IS the canonical tuple — no more, no
+    # less — so the next dtype addition can't drift them apart again
+    listed = msg.split("use ")[-1].split("|")
+    assert tuple(listed) == X_DTYPE_NAMES
+    for name in X_DTYPE_NAMES:
+        monkeypatch.setenv("STARK_FUSED_X_DTYPE", name)
+        x_stream_dtype()  # every advertised name resolves
+
+
+def test_readme_documents_every_accepted_dtype():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    readme = open(os.path.join(repo, "README.md")).read()
+    for name in X_DTYPE_NAMES:
+        assert name in readme, (
+            f"README must document STARK_FUSED_X_DTYPE={name} (the table "
+            "and the resolver error message update together)"
+        )
+
+
+def test_quant_pct_knob_validation(monkeypatch):
+    monkeypatch.delenv("STARK_QUANT_PCT", raising=False)
+    assert quant_percentile() is None
+    monkeypatch.setenv("STARK_QUANT_PCT", "99.5")
+    assert quant_percentile() == 99.5
+    monkeypatch.setenv("STARK_QUANT_PCT", "100")
+    assert quant_percentile() is None  # 100th pct == absmax
+    for bad in ("0", "-1", "101", "abc"):
+        monkeypatch.setenv("STARK_QUANT_PCT", bad)
+        with pytest.raises(ValueError):
+            quant_percentile()
+
+
+def test_x_stream_config_keys_on_quant_config(monkeypatch):
+    monkeypatch.delenv("STARK_FUSED_X_DTYPE", raising=False)
+    monkeypatch.delenv("STARK_QUANT_PCT", raising=False)
+    assert x_stream_config() == "f32"
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "int8")
+    assert x_stream_config() == "int8"
+    monkeypatch.setenv("STARK_QUANT_PCT", "99.9")
+    assert x_stream_config() == "int8@p99.9"
+    # the pct only keys quantized configs (it has no effect elsewhere)
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "bf16")
+    assert x_stream_config() == "bf16"
+
+
+def test_quant_config_flip_retraces(monkeypatch):
+    """Flipping STARK_QUANT_PCT mid-process must retrace the fused jits
+    (the resolved quant config is in the cache key), mirroring the
+    ADVICE-r5 precision-knob contract."""
+    from stark_tpu.ops.ordinal_fused import (
+        ordinal_loglik_value_and_grad as vg,
+    )
+
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "int8")
+    monkeypatch.delenv("STARK_QUANT_PCT", raising=False)
+    x = jax.random.normal(KEY, (64, 4))
+    q, s = quantize.pack_slab(x.T, jnp.int8)
+    y = jnp.zeros((64,))
+    beta, cuts = jnp.zeros((4,)), jnp.linspace(-1.0, 1.0, 3)
+    vg(beta, cuts, (q, s), y)
+    before = vg._jit._cache_size()
+    monkeypatch.setenv("STARK_QUANT_PCT", "99.0")
+    vg(beta, cuts, (q, s), y)
+    assert vg._jit._cache_size() == before + 1  # new static key
+
+
+# --- calibration + packing ------------------------------------------
+
+
+@pytest.mark.parametrize("name", QUANT_NAMES)
+def test_pack_roundtrip_error_bounds_and_determinism(name):
+    dtype = quantize.PACKED_DTYPES[name]
+    x = jax.random.normal(KEY, (6, 500)) * jnp.array(
+        [[0.01], [1.0], [100.0], [1e-4], [3.0], [0.0]]  # mixed col scales
+    )
+    q, s = quantize.pack_slab(x, dtype)
+    assert q.shape == x.shape and q.dtype == jnp.dtype(dtype)
+    assert s.shape == (6,) and s.dtype == jnp.float32
+    xq = quantize.dequant(q, s)
+    # per-row (per design-column) relative error bounded by the dtype's
+    # resolution; the all-zero row is exact with scale 1.0
+    err = np.max(np.abs(np.asarray(x - xq)), axis=1)
+    amax = np.max(np.abs(np.asarray(x)), axis=1)
+    bound = {"int8": 1.0 / 127, "fp8e4m3": 1.0 / 8, "fp8e5m2": 1.0 / 2}[name]
+    live = amax > 0
+    assert np.all(err[live] <= bound * amax[live] + 1e-12)
+    assert float(s[5]) == 1.0 and not np.any(np.asarray(xq[5]))
+    # determinism: identical bytes on a repack
+    q2, s2 = quantize.pack_slab(x, dtype)
+    assert np.asarray(q).tobytes() == np.asarray(q2).tobytes()
+    assert np.asarray(s).tobytes() == np.asarray(s2).tobytes()
+
+
+def test_percentile_calibration_clips_outliers(monkeypatch):
+    """STARK_QUANT_PCT spends the packed range on the bulk: the scale
+    shrinks to the percentile and the outlier clips to the band edge."""
+    x = jnp.concatenate([jnp.linspace(-1, 1, 999), jnp.array([1000.0])])
+    x = x[None, :]
+    q_abs, s_abs = quantize.pack_slab(x, jnp.int8)
+    monkeypatch.setenv("STARK_QUANT_PCT", "99.0")
+    q_pct, s_pct = quantize.pack_slab(x, jnp.int8)
+    assert float(s_pct[0]) < float(s_abs[0])  # bulk resolution recovered
+    xq = quantize.dequant(q_pct, s_pct)
+    # the outlier clipped to the top of the band...
+    assert float(xq[0, -1]) == pytest.approx(127 * float(s_pct[0]))
+    # ...and the bulk is far more accurate than under absmax
+    bulk_err_pct = float(jnp.max(jnp.abs(xq[0, :-1] - x[0, :-1])))
+    bulk_err_abs = float(
+        jnp.max(jnp.abs(quantize.dequant(q_abs, s_abs)[0, :-1] - x[0, :-1]))
+    )
+    assert bulk_err_pct < bulk_err_abs / 50
+
+
+def test_percentile_calibration_survives_sparse_columns(monkeypatch):
+    """A mostly-zero column whose pct-th absolute percentile is exactly
+    0 must fall back to absmax calibration — a zero percentile carries
+    no information, and calibrating on it would zero the entire column
+    (invisibly to the parity gate, which sees the same rounded X)."""
+    monkeypatch.setenv("STARK_QUANT_PCT", "99.0")
+    x = jnp.zeros((1, 1000)).at[0, :5].set(0.4)  # 99.5% zeros
+    q, s = quantize.pack_slab(x, jnp.int8)
+    xq = quantize.dequant(q, s)
+    np.testing.assert_allclose(
+        np.asarray(xq[0, :5]), 0.4, rtol=1.0 / 127
+    )
+    # and the all-zero-column fallback is untouched
+    q0, s0 = quantize.pack_slab(jnp.zeros((1, 100)), jnp.int8)
+    assert float(s0[0]) == 1.0 and not np.any(np.asarray(q0))
+
+
+def test_dequant_dot_epilogue_matches_materialized():
+    x = jax.random.normal(KEY, (8, 300))
+    q, s = quantize.pack_slab(x, jnp.int8)
+    xq = quantize.dequant(q, s)
+    beta = jax.random.normal(jax.random.PRNGKey(1), (8,))
+    resid = jax.random.normal(jax.random.PRNGKey(2), (300,))
+    # forward: scaled axis contracted -> scales fold into beta
+    np.testing.assert_allclose(
+        np.asarray(quantize.dequant_dot(beta, (q, s))),
+        np.asarray(jnp.dot(beta, xq)),
+        rtol=1e-5, atol=1e-5,
+    )
+    # backward: scaled axis survives -> scales fold into the output
+    np.testing.assert_allclose(
+        np.asarray(quantize.dequant_dot((q, s), resid)),
+        np.asarray(jnp.dot(xq, resid)),
+        rtol=1e-5, atol=1e-5,
+    )
+    # plain arrays pass through bit-identically to the historical path
+    f32 = x.astype(jnp.float32)
+    assert (
+        np.asarray(quantize.dequant_dot(beta, f32)).tobytes()
+        == np.asarray(jnp.dot(beta, f32)).tobytes()
+    )
+    with pytest.raises(ValueError):
+        quantize.dequant_dot((q, s), (q, s))
+
+
+# --- knob-off bit-identity + lifecycle ------------------------------
+
+
+def test_knob_off_prepare_is_bit_identical():
+    """STARK_FUSED_X_DTYPE unset: prepare emits the historical f32 xT,
+    no scale key — packed layout appears ONLY under the quant knob."""
+    assert os.environ.get("STARK_FUSED_X_DTYPE") is None
+    data, _ = synth_logistic_data(KEY, 200, 4)
+    df = prepare_model_data(FusedLogistic(4), data)
+    assert "xT_scale" not in df
+    assert df["xT"].dtype == jnp.float32
+    assert (
+        np.asarray(df["xT"]).tobytes()
+        == np.asarray(jnp.asarray(data["x"]).T).tobytes()
+    )
+
+
+@pytest.mark.parametrize("name", ("int8", "fp8e4m3"))
+def test_fused_matches_dequantized_reference(name, monkeypatch):
+    """The rounded-X convention at f32 tolerance: the fused path on the
+    packed slab equals autodiff on the SAME dequantized matrix."""
+    data, _ = synth_lmm_data(KEY, 500, 5, 30)
+    monkeypatch.setenv("STARK_FUSED_LMM", "1")
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", name)
+    fused = FusedLMM(5, 30)
+    fm_f = flatten_model(fused)
+    df = prepare_model_data(fused, data)
+    assert df["xT"].dtype == jnp.dtype(quantize.PACKED_DTYPES[name])
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "f32")
+    plain = LinearMixedModel(5, 30)
+    fm_p = flatten_model(plain)
+    dp = prepare_model_data(
+        plain, {**data, "x": quantize.fake_quant(data["x"], name)}
+    )
+    z = 0.3 * jax.random.normal(jax.random.PRNGKey(5), (fm_p.ndim,))
+    vp, gp = fm_p.potential_and_grad(z, dp)
+    vf, gf = fm_f.potential_and_grad(z, df)
+    np.testing.assert_allclose(vp, vf, rtol=1e-5, atol=1e-4)
+    scale = float(jnp.max(jnp.abs(gp))) + 1e-6
+    np.testing.assert_allclose(
+        np.asarray(gf) / scale, np.asarray(gp) / scale,
+        rtol=1e-4, atol=2e-5,
+    )
+
+
+def test_knob_flip_lifecycle_packed_data_keeps_working(monkeypatch):
+    """Satellite contract: pack under x=int8, then flip knobs
+    mid-process — the packed data must keep evaluating correctly
+    through every path (warm starts / resumes / fleet stacking hand
+    already-prepared data to later code that may see different env)."""
+    data, _ = synth_lmm_data(KEY, 400, 4, 20)
+    monkeypatch.setenv("STARK_FUSED_LMM", "1")
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "int8")
+    m = FusedLMM(4, 20)
+    fm = flatten_model(m)
+    df = prepare_model_data(m, data)
+    z = 0.2 * jax.random.normal(jax.random.PRNGKey(11), (fm.ndim,))
+    v_int8, g_int8 = fm.potential_and_grad(z, df)
+    # 1) x-dtype knob flips back to f32: the packed slab still routes
+    #    through the fused op bit-identically (the data, not the env,
+    #    carries the layout)
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "f32")
+    v_flip, g_flip = fm.potential_and_grad(z, df)
+    assert np.asarray(v_int8).tobytes() == np.asarray(v_flip).tobytes()
+    assert np.asarray(g_int8).tobytes() == np.asarray(g_flip).tobytes()
+    # 2) family knob flips off after the quantized prepare: the autodiff
+    #    fallback dequantizes the same matrix (value matches at f32 tol)
+    monkeypatch.setenv("STARK_FUSED_LMM", "0")
+    v_fb, g_fb = fm.potential_and_grad(z, df)
+    np.testing.assert_allclose(v_fb, v_int8, rtol=1e-5, atol=1e-4)
+    scale = float(jnp.max(jnp.abs(g_int8))) + 1e-6
+    np.testing.assert_allclose(
+        np.asarray(g_fb) / scale, np.asarray(g_int8) / scale,
+        rtol=1e-4, atol=2e-5,
+    )
+    # 3) re-prepare of already-packed data is a no-op (the resume path)
+    monkeypatch.setenv("STARK_FUSED_LMM", "1")
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "int8")
+    df2 = prepare_model_data(m, df)
+    assert df2["xT"] is df["xT"] or (
+        np.asarray(df2["xT"]).tobytes() == np.asarray(df["xT"]).tobytes()
+    )
+
+
+def test_irt_grid_packs_exactly(monkeypatch):
+    """Binary response grids pack losslessly (no scale vector), and the
+    knob-off fallback upcasts the packed grid transparently."""
+    data, _ = synth_irt_data(KEY, 30, 10)
+    monkeypatch.setenv("STARK_FUSED_IRT", "1")
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "int8")
+    m = FusedIRT2PL(30, 10)
+    fm = flatten_model(m)
+    df = prepare_model_data(m, data)
+    assert df["y_grid"].dtype == jnp.int8
+    assert "y_grid_scale" not in df
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "f32")
+    plain = IRT2PL(30, 10)
+    dp = prepare_model_data(plain, data)
+    fm_p = flatten_model(plain)
+    z = 0.3 * jax.random.normal(jax.random.PRNGKey(2), (fm.ndim,))
+    vp, gp = fm_p.potential_and_grad(z, dp)
+    vf, gf = fm.potential_and_grad(z, df)  # fused on packed grid: exact data
+    np.testing.assert_allclose(vp, vf, rtol=1e-5, atol=1e-4)
+    # knob off after the packed-grid prepare: autodiff on the same slab
+    monkeypatch.setenv("STARK_FUSED_IRT", "0")
+    v_fb, _ = fm.potential_and_grad(z, df)
+    np.testing.assert_allclose(v_fb, vp, rtol=1e-5, atol=1e-4)
+
+
+def test_fleet_stacking_over_quant_prepared_data(monkeypatch):
+    """FleetSpec stacks packed slabs + per-problem scale vectors along
+    the problem axis, and the vmapped potential matches the per-problem
+    sequential evaluations."""
+    from stark_tpu.fleet import FleetSpec
+
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "int8")
+    monkeypatch.setenv("STARK_FUSED_GLM", "1")
+    m = FusedPoissonRegression(4)
+    dsets = [
+        synth_poisson_data(jax.random.PRNGKey(i), 300, 4)[0]
+        for i in range(3)
+    ]
+    spec = FleetSpec.from_problems(m, dsets)
+    st = spec.prepared_stacked()
+    assert st["xT"].dtype == jnp.int8 and st["xT"].shape[0] == 3
+    assert st["xT_scale"].shape == (3, 4)
+    fm = flatten_model(m)
+    z = 0.1 * jax.random.normal(jax.random.PRNGKey(9), (fm.ndim,))
+    per = [
+        float(fm.potential(z, prepare_model_data(m, d))) for d in dsets
+    ]
+    vm = jax.vmap(lambda dd: fm.potential(z, dd))(st)
+    np.testing.assert_allclose(np.asarray(vm), np.asarray(per), rtol=1e-6)
+
+
+def test_scale_vector_row_axis_is_replicated(monkeypatch):
+    """The data sharder must replicate xT_scale (a per-column global
+    statistic), never row-shard it alongside the packed slab."""
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "int8")
+    data, _ = synth_logistic_data(KEY, 200, 4)
+    m = FusedLogistic(4)
+    df = m.prepare_data(data)
+    axes = m.data_row_axes(df)
+    assert axes["xT"] == 1
+    assert axes["xT_scale"] == -1  # replicated
+    assert axes["y"] == 0
+
+
+# --- bytes accounting + telemetry tags ------------------------------
+
+
+def test_x_bytes_per_grad(monkeypatch):
+    data, _ = synth_logistic_data(KEY, 100, 8)
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "int8")
+    df = FusedLogistic(8).prepare_data(data)
+    assert quantize.x_bytes_per_grad(df) == 100 * 8 * 1 + 8 * 4
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "f32")
+    df32 = FusedLogistic(8).prepare_data(data)
+    assert quantize.x_bytes_per_grad(df32) == 100 * 8 * 4
+    assert quantize.x_bytes_per_grad({"y": jnp.ones((4,))}) is None
+
+
+def test_x_stream_tags(monkeypatch):
+    data, _ = synth_logistic_data(KEY, 100, 8)
+    monkeypatch.delenv("STARK_FUSED_X_DTYPE", raising=False)
+    # plain f32 / untagged models: NO fields (trace byte-identity)
+    assert quantize.x_stream_tags("logistic", data) == {}
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "int8")
+    assert quantize.x_stream_tags(None, data) == {}
+    # raw data: bytes predicted from the row-matrix shape
+    tags = quantize.x_stream_tags("logistic", data)
+    assert tags["x_dtype"] == "int8"
+    assert tags["x_bytes_per_grad"] == 100 * 8 * 1 + 8 * 4
+    # prepared data: bytes measured from the packed slab itself
+    df = FusedLogistic(8).prepare_data(data)
+    assert quantize.x_stream_tags("logistic", df) == tags
+
+
+def test_run_start_carries_x_stream_tags(monkeypatch):
+    """An in-memory-traced sampling run under x=int8 stamps x_dtype +
+    x_bytes_per_grad into run_start, and timeline_summary surfaces
+    them; a knob-off run carries neither key."""
+    from stark_tpu.profiling import timeline_summary
+
+    data, _ = synth_poisson_data(KEY, 200, 4)
+    events = []
+    telemetry.add_event_listener(events.append)
+    try:
+        monkeypatch.setenv("STARK_FUSED_GLM", "1")
+        monkeypatch.setenv("STARK_FUSED_X_DTYPE", "int8")
+        stark_tpu.sample(
+            FusedPoissonRegression(4), data, chains=2, kernel="hmc",
+            num_warmup=10, num_samples=10, seed=0,
+            trace=telemetry.RunTrace(path=None),
+        )
+        starts = [e for e in events if e.get("event") == "run_start"]
+        assert starts and starts[0]["x_dtype"] == "int8"
+        assert starts[0]["x_bytes_per_grad"] == 200 * 4 * 1 + 4 * 4
+        ts = timeline_summary(events)
+        assert ts["x_dtype"] == "int8"
+        assert ts["x_bytes_per_grad"] == starts[0]["x_bytes_per_grad"]
+        # knob-off: the keys are ABSENT (not null) — trace byte-identity
+        events.clear()
+        monkeypatch.setenv("STARK_FUSED_X_DTYPE", "f32")
+        stark_tpu.sample(
+            FusedPoissonRegression(4), data, chains=2, kernel="hmc",
+            num_warmup=10, num_samples=10, seed=0,
+            trace=telemetry.RunTrace(path=None),
+        )
+        s2 = [e for e in events if e.get("event") == "run_start"][0]
+        assert "x_dtype" not in s2 and "x_bytes_per_grad" not in s2
+        assert timeline_summary(events)["x_dtype"] is None
+    finally:
+        telemetry.remove_event_listener(events.append)

@@ -2,16 +2,18 @@
 """Repo lint: every kernel-execution env knob must be documented + tested.
 
 The fused-op layer grew a family of env knobs (the shared precision pair
-plus one boolean per likelihood family), and the kernel scheduler added
-``STARK_RAGGED_NUTS`` — each changes which executable evaluates every
-gradient (or how the batched loops schedule them) for a run.  An
-undocumented knob is invisible to operators; an untested one can
+plus one boolean per likelihood family), the kernel scheduler added
+``STARK_RAGGED_NUTS``, and the quantized data-plane added the
+``STARK_QUANT_*`` calibration knobs (ops/quantize.py) — each changes
+which executable evaluates every gradient (or how the batched loops
+schedule them, or what bytes the packed design matrix holds) for a run.
+An undocumented knob is invisible to operators; an untested one can
 silently lose its fallback path.  This lint closes both loops
 statically:
 
-1. AST-collect every covered knob string literal (``STARK_FUSED_<NAME>``
-   or ``STARK_RAGGED_NUTS``) passed to an env-read call
-   (``os.environ.get`` / ``os.getenv`` / ``environ.pop`` /
+1. AST-collect every covered knob string literal (``STARK_FUSED_<NAME>``,
+   ``STARK_RAGGED_NUTS``, or ``STARK_QUANT_<NAME>``) passed to an
+   env-read call (``os.environ.get`` / ``os.getenv`` / ``environ.pop`` /
    ``precision.fused_knob``) under ``stark_tpu/``.
 2. Fail if a collected knob is missing from the README (the
    operator-facing contract — the zoo-coverage table for fused knobs,
@@ -35,9 +37,12 @@ from typing import Dict, List, Set, Tuple
 #: call names whose string-literal argument is an env-knob read
 _READ_FUNCS = frozenset({"get", "getenv", "pop", "fused_knob"})
 
-#: covered knobs: the fused-op family plus the kernel-scheduler knob —
-#: extend the alternation when a new execution-path knob family lands
-_KNOB_RE = re.compile(r"^STARK_(?:FUSED_[A-Z0-9_]+|RAGGED_NUTS)$")
+#: covered knobs: the fused-op family, the kernel-scheduler knob, and
+#: the quant-calibration family — extend the alternation when a new
+#: execution-path knob family lands
+_KNOB_RE = re.compile(
+    r"^STARK_(?:FUSED_[A-Z0-9_]+|RAGGED_NUTS|QUANT_[A-Z0-9_]+)$"
+)
 
 
 def _call_name(node: ast.Call) -> str:
@@ -103,8 +108,9 @@ def lint_repo(repo: str) -> List[str]:
     """Violation strings for the whole repo; empty = clean."""
     knobs = collect_knobs(os.path.join(repo, "stark_tpu"))
     if not knobs:
-        return ["no STARK_FUSED_*/STARK_RAGGED_NUTS env reads found under "
-                "stark_tpu/ — the collector itself is broken"]
+        return ["no STARK_FUSED_*/STARK_RAGGED_NUTS/STARK_QUANT_* env "
+                "reads found under stark_tpu/ — the collector itself is "
+                "broken"]
     violations = []
     readme_path = os.path.join(repo, "README.md")
     readme = open(readme_path).read() if os.path.exists(readme_path) else ""

@@ -4,24 +4,34 @@
 Two modes:
 
 SWEEP (default: ``python tools/precision_parity.py`` or ``... sweep``)
-    Every fused op in the zoo x {f32, bf16} X-stream dtype x
-    {default, high} MXU dot precision, each compared against the
-    autodiff reference — the PLAIN model evaluated at f32/HIGHEST on
-    the same rounded design matrix the fused path streams (bf16
-    rounds X once at prepare time; the posterior is exactly that of
-    the rounded matrix, so the reference must see it too).  Per cell
-    the potential value and full gradient are compared at several
-    parameter points and gated against the documented tolerance band:
+    Every fused op in the zoo x {f32, bf16, int8, fp8e4m3, fp8e5m2}
+    X-stream dtype x {default, high} MXU dot precision, each compared
+    against the autodiff reference — the PLAIN model evaluated at
+    f32/HIGHEST on the same rounded design matrix the fused path
+    streams (bf16 rounds X once at prepare time; the quantized dtypes
+    pack X with per-column calibrated scales, ops/quantize.py, and the
+    reference sees the dequantized matrix; the posterior is exactly
+    that of the rounded/dequantized matrix, so the reference must see
+    it too).  Per cell the potential value and full gradient are
+    compared at several parameter points and gated against the
+    documented tolerance band:
 
       tight  f32 x high            val 1e-4, grad 1e-3
       mid    bf16 x high           val 5e-3, grad 2e-2
       wide   anything x default    val 2e-2, grad 5e-2
+      quant  int8/fp8 x anything   val 2e-2, grad 5e-2
 
-    (On the CPU container f32 dots are exact at every precision, so
-    measured deltas sit orders of magnitude inside the bands — the
-    sweep there validates the HARNESS and the bf16 rounding path; the
-    bands are sized for the TPU MXU's bf16-pass emulation, where
-    ``default`` truncates dot inputs to bf16.)  Writes
+    (the quant band is wide-by-construction: the rounding itself is
+    IN the reference, so the band only absorbs the epilogue-fold
+    reordering — ``(beta*s)@q`` vs ``beta@(s*q)`` — plus the MXU's
+    bf16-pass emulation at ``default``).  Quantized cells additionally
+    carry a calibration-quality artifact column ``quant_col_err`` (max
+    per-column relative quantization error of the packed X — how much
+    data the calibration threw away, distinct from the parity delta,
+    which measures the kernel).  On the CPU container f32 dots are
+    exact at every precision, so measured deltas sit orders of
+    magnitude inside the bands — the sweep there validates the
+    HARNESS and the rounding/packing paths.  Writes
     tools/precision_parity_zoo.json (``_zoo_smoke.json`` on CPU) and
     exits non-zero if any cell fails — the acceptance gate for every
     STARK_FUSED_* knob and for adopting a cheaper precision setting.
@@ -31,8 +41,8 @@ SAMPLING (legacy: ``python tools/precision_parity.py high|default``)
     model sampled at ``highest`` vs a candidate precision (same seed,
     same data), reporting posterior-mean deltas in posterior-sd units.
     Adoption rule unchanged: max mean-delta < 0.1 sd and both runs
-    converged.  ``PARITY_X_DTYPE=bf16`` additionally streams the
-    candidate's X in bf16.
+    converged.  ``PARITY_X_DTYPE=bf16`` (or int8/fp8e4m3/fp8e5m2)
+    additionally streams the candidate's X at that storage dtype.
 
 Env: PARITY_SWEEP_N / _G / _D (sweep scale), PARITY_N / _D / _G /
 _CHAINS / _WARMUP / _SAMPLES (sampling scale).
@@ -61,10 +71,23 @@ TOLERANCE_BANDS = {
     "tight": (1e-4, 1e-3),
     "mid": (5e-3, 2e-2),
     "wide": (2e-2, 5e-2),
+    # quantized X: the rounding is in the reference (rounded-X
+    # convention), so this band only absorbs the epilogue-fold
+    # reordering + dot-pass emulation — wide-sized to stay honest on
+    # the TPU MXU, though CPU measures it orders of magnitude tighter
+    "quant": (2e-2, 5e-2),
 }
+
+#: quantized X-stream dtypes (ops/quantize.py packed storage)
+QUANT_X_DTYPES = ("int8", "fp8e4m3", "fp8e5m2")
+
+#: the full sweep dtype axis — mirrors precision.X_DTYPE_NAMES
+X_DTYPES = ("f32", "bf16") + QUANT_X_DTYPES
 
 
 def band_for(x_dtype: str, precision: str) -> str:
+    if x_dtype in QUANT_X_DTYPES:
+        return "quant"
     if precision == "default":
         return "wide"
     return "mid" if x_dtype == "bf16" else "tight"
@@ -167,10 +190,12 @@ def reference_points(plain, data, x_dtype):
     """The autodiff reference evals for one (op, x_dtype).
 
     The reference sees the SAME rounded design matrix the fused path
-    streams: bf16 rounding is a data change (by contract), not an
-    arithmetic difference the gate should flag.  Independent of the
-    `precision` axis, so `run_sweep` computes it once per (op, x_dtype)
-    and shares it across that op's precision cells.
+    streams: bf16 rounding — and int8/fp8 quantize-dequantize through
+    the very calibration path `prepare_data` packs with — is a data
+    change (by contract), not an arithmetic difference the gate should
+    flag.  Independent of the `precision` axis, so `run_sweep` computes
+    it once per (op, x_dtype) and shares it across that op's precision
+    cells.
     """
     import jax
     import jax.numpy as jnp
@@ -178,11 +203,16 @@ def reference_points(plain, data, x_dtype):
     from stark_tpu.model import flatten_model, prepare_model_data
 
     ref_data = dict(data)
-    if x_dtype == "bf16" and "x" in ref_data:
-        ref_data["x"] = (
-            jnp.asarray(ref_data["x"]).astype(jnp.bfloat16)
-            .astype(jnp.float32)
-        )
+    if "x" in ref_data:
+        if x_dtype == "bf16":
+            ref_data["x"] = (
+                jnp.asarray(ref_data["x"]).astype(jnp.bfloat16)
+                .astype(jnp.float32)
+            )
+        elif x_dtype in QUANT_X_DTYPES:
+            from stark_tpu.ops.quantize import fake_quant
+
+            ref_data["x"] = fake_quant(ref_data["x"], x_dtype)
     with _env(STARK_FUSED_PRECISION="highest", STARK_FUSED_X_DTYPE="f32"):
         with jax.default_matmul_precision("highest"):
             fm_p = flatten_model(plain)
@@ -219,6 +249,15 @@ def sweep_cell(name, plain, fused, data, knob, x_dtype, precision,
         )
     band = band_for(x_dtype, precision)
     tol_v, tol_g = TOLERANCE_BANDS[band]
+    quant_col_err = None
+    if x_dtype in QUANT_X_DTYPES and "x" in data:
+        # calibration-quality artifact: how much of X the packing threw
+        # away (max per-column relative quant error) — the DATA-side
+        # number the parity delta (kernel-side, vs the same dequantized
+        # X) deliberately excludes
+        from stark_tpu.ops.quantize import quant_column_error
+
+        quant_col_err = quant_column_error(data["x"], x_dtype)
     return {
         "op": name,
         "knob": knob,
@@ -229,11 +268,12 @@ def sweep_cell(name, plain, fused, data, knob, x_dtype, precision,
         "grad_rel": grad_rel,
         "tol_val": tol_v,
         "tol_grad": tol_g,
+        "quant_col_err": quant_col_err,
         "ok": bool(val_rel <= tol_v and grad_rel <= tol_g),
     }
 
 
-def run_sweep(x_dtypes=("f32", "bf16"), precisions=("default", "high"),
+def run_sweep(x_dtypes=X_DTYPES, precisions=("default", "high"),
               cases=None):
     """The full fused-op x dtype x precision grid -> (rows, all_ok)."""
     rows = []
@@ -246,10 +286,15 @@ def run_sweep(x_dtypes=("f32", "bf16"), precisions=("default", "high"),
                     ref=ref,
                 )
                 rows.append(row)
+                qerr = (
+                    f" qerr={row['quant_col_err']:.2e}"
+                    if row.get("quant_col_err") is not None
+                    else ""
+                )
                 print(
-                    f"[parity] {name:22s} x={x_dtype:4s} prec={precision:7s}"
+                    f"[parity] {name:22s} x={x_dtype:7s} prec={precision:7s}"
                     f" band={row['band']:5s} val={row['val_rel']:.2e}"
-                    f" grad={row['grad_rel']:.2e}"
+                    f" grad={row['grad_rel']:.2e}{qerr}"
                     f" {'ok' if row['ok'] else 'FAIL'}",
                     file=sys.stderr,
                 )

@@ -81,6 +81,13 @@ def render_run(events, run, show_spans=False) -> str:
         f"compile {_fmt(s['compile_s'])}s, "
         f"device dispatches {_fmt(s['dispatch_count'])}"
     )
+    if s.get("x_dtype") is not None:
+        # quantized/bf16 X streaming (ops/quantize.py); n/a-safe on
+        # pre-quant traces (the key is simply absent there)
+        out.append(
+            f"x stream {s['x_dtype']}, "
+            f"{_fmt(s.get('x_bytes_per_grad'))} bytes per gradient eval"
+        )
     out.append("")
     by_kind = s["by_kind"]
     if not by_kind:
