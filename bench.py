@@ -855,6 +855,10 @@ def main():
                 ),
                 240.0,
             ),
+            # churn-heavy streaming fleet (STARK_FLEET_SLOTS): slotted
+            # vs legacy compaction at equal problem sets, own
+            # fleet:stream:* ledger series per scheduler variant
+            ("fleet_stream", bmarks.bench_fleet_stream, 420.0),
             # ragged-vs-legacy NUTS scheduling leg (STARK_RAGGED_NUTS):
             # lane occupancy + occupancy-adjusted throughput on the
             # mixed-depth synthetic, own nutssched:* ledger series
@@ -929,7 +933,8 @@ def main():
                 row = res_row(r)
                 if (
                     leg_name.startswith("fused_vg_")
-                    or leg_name in ("nutssched", "fleet_eight_schools")
+                    or leg_name in ("nutssched", "fleet_eight_schools",
+                                    "fleet_stream")
                 ) and not row["converged"]:
                     # a fused leg that fails its gate (broken kernel,
                     # lost speedup) must record null ess/s, NEVER 0.0 —
@@ -945,6 +950,8 @@ def main():
                 extra_evidence.append(row)
                 if leg_name == "fleet_eight_schools":
                     append_fleet_ledger_row(row)
+                elif leg_name == "fleet_stream":
+                    append_fleet_stream_ledger_rows(row, platform)
                 elif leg_name.startswith("fused_vg_"):
                     append_fusedvg_ledger_row(row)
                 elif leg_name == "nutssched":
@@ -1134,6 +1141,97 @@ def fleet_config_key(row, platform):
     if row.get("sched") == "ragged":
         key += f":sched=ragged:depth={row.get('max_tree_depth')}"
     return key
+
+
+#: streaming-fleet evidence keys (the churn-heavy slotted-vs-compaction
+#: leg): compile counts + admission/occupancy accounting per variant,
+#: warm-start savings with the honest-null speedup
+_FLEET_STREAM_EXTRA_KEYS = (
+    "converged_fraction", "block_scan_compiles", "compactions",
+    "admissions", "occupancy_streaming", "speedup_vs_compaction",
+    "warmup_draws_saved", "warmstart_speedup", "degraded",
+    "lost_problems", "sched", "max_tree_depth",
+)
+
+
+def fleet_stream_config_key(row, platform, sched):
+    """Ledger series key for one streaming-fleet variant — slotted,
+    legacy compaction, and warm-started rows are separate series (a
+    different scheduler is a different workload; trailing medians must
+    not mix)."""
+    return (
+        f"fleet:stream:eight_schools:B={row.get('problems')}"
+        f":cap={row.get('max_batch')}"
+        f":chains={row.get('chains')}"
+        f":sched={sched}"
+        f":platform={platform}"
+    )
+
+
+def append_fleet_stream_ledger_rows(row, platform):
+    """Commit the streaming-fleet leg as one ledger row PER VARIANT
+    (slots / compact / slots_warmstart) so `perf_ledger.py check`
+    ratchets each scheduler independently.  The compact and warm-start
+    variants' evidence rides the slotted row's ``legacy`` /
+    ``warmstart`` sub-dicts; each becomes its own row here."""
+    slots_row = {k: row.get(k) for k in row
+                 if k not in ("legacy", "warmstart")}
+    append_ledger(
+        fleet_stream_config_key(row, platform, "slots"), slots_row,
+        extra_keys=_FLEET_STREAM_EXTRA_KEYS, label="fleet-stream",
+    )
+    legacy = row.get("legacy")
+    if legacy:
+        leg_row = {
+            "problems": row.get("problems"), "chains": row.get("chains"),
+            "max_batch": row.get("max_batch"), "sched": "compact",
+            "max_tree_depth": row.get("max_tree_depth"),
+            "value": legacy.get("ess_per_sec"),
+            "wall_s": legacy.get("wall_s"),
+            "max_rhat": legacy.get("max_rhat", row.get("max_rhat")),
+            # the legacy variant's own gate is just convergence — the
+            # compile-count expectation (>=2) is the SLOTS row's gate
+            "converged": (legacy.get("converged_fraction") or 0) >= 0.95,
+            **{k: legacy.get(k) for k in (
+                "converged_fraction", "block_scan_compiles",
+                "compactions", "admissions", "occupancy_streaming",
+            )},
+        }
+        if not leg_row["converged"]:
+            # per-variant honest null: a gate-losing variant's value
+            # column must not poison its trailing-median series
+            leg_row["value"] = None
+        append_ledger(
+            fleet_stream_config_key(row, platform, "compact"), leg_row,
+            extra_keys=_FLEET_STREAM_EXTRA_KEYS, label="fleet-stream",
+        )
+    ws = row.get("warmstart")
+    if ws:
+        ws_row = {
+            "problems": row.get("problems"), "chains": row.get("chains"),
+            "max_batch": row.get("max_batch"), "sched": "slots_warmstart",
+            "max_tree_depth": row.get("max_tree_depth"),
+            "value": ws.get("ess_per_sec"),
+            "wall_s": ws.get("wall_s"),
+            "max_rhat": ws.get("max_rhat", row.get("max_rhat")),
+            "converged": (ws.get("converged_fraction") or 0) >= 0.95,
+            **{k: ws.get(k) for k in (
+                "converged_fraction", "block_scan_compiles",
+                "compactions", "admissions", "occupancy_streaming",
+                "warmup_draws_saved", "warmstart_speedup",
+            )},
+        }
+        if not ws_row["converged"]:
+            # same null-not-0.0 rule as the compact row: losing the
+            # gate records missing data, never a measured zero (and
+            # a claimed speedup dies with it)
+            ws_row["value"] = None
+            ws_row["warmstart_speedup"] = None
+        append_ledger(
+            fleet_stream_config_key(row, platform, "slots_warmstart"),
+            ws_row, extra_keys=_FLEET_STREAM_EXTRA_KEYS,
+            label="fleet-stream",
+        )
 
 
 def nutssched_config_key(row, platform):

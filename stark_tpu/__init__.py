@@ -38,6 +38,7 @@ from . import bijectors, compare, diagnostics
 from .model import Model, ParamSpec, flatten_model, prepare_model_data
 from .chees import chees_sample
 from .fleet import (
+    FleetFeed,
     FleetSpec,
     ProblemBudget,
     sample_fleet,
@@ -62,6 +63,7 @@ __all__ = [
     "chees_sample",
     "supervised_sample",
     "supervised_sample_fleet",
+    "FleetFeed",
     "FleetSpec",
     "ProblemBudget",
     "ChainHealthError",

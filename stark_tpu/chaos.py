@@ -69,6 +69,18 @@ Fleet fault-domain scenarios (per-PROBLEM containment — stark_tpu.fleet):
                          and the supervisor resumes the surviving active
                          set — whole-fleet restart stays reserved for
                          process-level faults like this one
+  fleet_admit_crash      crash at a block boundary with streamed
+                         submissions in the pending queue: the fleet
+                         checkpoint persisted the queue, so the
+                         supervised resume replays the admission order
+                         bit-identically (draws, slots, statuses equal
+                         to an uninjected run) without re-submission
+  fleet_warmstart_poison a NaN'd completed problem tries to poison the
+                         warm-start donor pool: the pool's finite
+                         validation rejects it at the boundary, later
+                         clean donors still seed admissions, and every
+                         admitted problem stays finite — poisoned
+                         adaptation state never propagates
 
 The drill models are tiny on purpose: the contracts under test are
 supervision mechanics, not posterior quality — every scenario finishes in
@@ -652,6 +664,116 @@ def fleet_stall_watchdog(workdir: str) -> Dict[str, Any]:
         f"watchdog did not break the 60s fleet stall (wall {wall:.0f}s)"
     )
     return {"restarts": 1, "wall_s": round(wall, 1)}
+
+
+@_scenario("fleet_admit_crash")
+def fleet_admit_crash(workdir: str) -> Dict[str, Any]:
+    """Crash with streamed submissions in the pending queue
+    (``fleet.admit_pending`` fires after the checkpoint that persisted
+    the queue): the supervised resume must rebuild the submitted
+    problems FROM THE CHECKPOINT — no re-submission — and replay the
+    admission order bit-identically: same slots, same statuses, same
+    draws as an uninjected fleet."""
+    import numpy as np
+
+    from .fleet import FleetFeed, FleetSpec, sample_fleet, \
+        supervised_sample_fleet
+
+    big = _fleet_spec(5)
+    spec = FleetSpec.from_problems(big.model, big.datasets[:2])
+
+    def make_feed():
+        f = FleetFeed()
+        for d in big.datasets[2:]:
+            f.submit(d)
+        f.close()
+        return f
+
+    kw = dict(_FLEET_KW, seed=0, slots=True, max_batch=2)
+    ref = sample_fleet(
+        spec, feed=make_feed(),
+        metrics_path=os.path.join(workdir, "ref_metrics.jsonl"), **kw,
+    )
+    faults.reset()
+    faults.configure("fleet.admit_pending=crash*1")
+    res = supervised_sample_fleet(
+        spec, workdir=workdir, max_restarts=2, reseed_on_restart=False,
+        feed=make_feed(), slots=True, max_batch=2, seed=0, **_FLEET_KW,
+    )
+    rs = _restarts(_metrics(workdir))
+    assert len(rs) == 1 and rs[0]["fault"] == "transient", rs
+    assert [p.problem_id for p in res.problems] == [
+        p.problem_id for p in ref.problems
+    ]
+    for a, b in zip(ref.problems, res.problems):
+        assert a.status == b.status, (a.problem_id, a.status, b.status)
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+
+    def admissions(lines):
+        return [
+            (r["problem_id"], r["slot"])
+            for r in lines if r.get("event") == "problem_admitted"
+        ]
+
+    with open(os.path.join(workdir, "ref_metrics.jsonl")) as f:
+        ref_adm = admissions([json.loads(l) for l in f if l.strip()])
+    # the crash fired BEFORE any admission (queue persisted, none
+    # consumed), so the resumed attempt replays the FULL admission
+    # sequence — identical problems into identical slots
+    got_adm = admissions(_metrics(workdir))
+    assert got_adm == ref_adm, (got_adm, ref_adm)
+    assert ref_adm, "drill never exercised the admission path"
+    return {"restarts": 1, "admissions_replayed": len(got_adm),
+            "bit_identical": True}
+
+
+@_scenario("fleet_warmstart_poison")
+def fleet_warmstart_poison(workdir: str) -> Dict[str, Any]:
+    """Donor-pool poisoning: the FIRST completed problem's adaptation
+    summary is NaN'd (``fleet.warmstart_poison``) before it reaches the
+    warm-start pool.  The pool's finite validation must reject it —
+    later clean donors still seed admissions, every admitted problem's
+    draws stay finite, and every warm-started convergence passed the
+    full validation gate (nothing failed, nothing NaN)."""
+    import numpy as np
+
+    from .fleet import ProblemBudget, sample_fleet
+
+    # two easy problems converge first (the donor supply — the first
+    # donation is the poisoned one), two queued problems admit behind
+    # them with warm-start on
+    spec = _fleet_spec(4, budgets=[
+        ProblemBudget(ess_target=5.0), ProblemBudget(ess_target=5.0),
+        None, None,
+    ])
+    faults.configure("fleet.warmstart_poison=nan*1")
+    res = sample_fleet(
+        spec, seed=0, slots=True, warmstart=True, max_batch=2,
+        metrics_path=os.path.join(workdir, "fleet_metrics.jsonl"),
+        **_FLEET_KW,
+    )
+    assert len(faults.fired()) == 1, faults.fired()
+    for p in res.problems:
+        assert p.failed is None, (p.problem_id, p.status)
+        assert np.isfinite(p.draws_flat).all(), (
+            f"{p.problem_id}: poisoned donor state propagated"
+        )
+    lines = _fleet_metrics(workdir)
+    admitted = [r for r in lines if r.get("event") == "problem_admitted"]
+    assert admitted, "drill never exercised the admission path"
+    warm = [r for r in admitted if r.get("warmstart")]
+    assert warm, (
+        "no warm-started admission: the clean donor never reached the "
+        "pool (over-rejection) or admissions beat the donors"
+    )
+    # a warm-started problem that converged did so through the full
+    # split-R-hat/ESS validation pass (the gate is unchanged)
+    for r in warm:
+        p = res[r["problem_id"]]
+        if p.converged:
+            assert p.max_rhat is not None and np.isfinite(p.max_rhat)
+    return {"admissions": len(admitted), "warm_started": len(warm),
+            "poisoned_donors_rejected": 1}
 
 
 #: envelope/timing keys that legitimately differ between two identical

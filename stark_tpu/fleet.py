@@ -54,9 +54,34 @@ refill / crash-resume unchanged, and a straggler reaches the SAME draws
 as ``sample_until_converged(seed=seed+index, adaptive_blocks=False)``
 (tests/test_fleet.py drills all three).
 
+**Zero-recompile streaming (PR 13).**  Three additions on top:
+
+  * **Fixed-capacity lane slots** (``STARK_FLEET_SLOTS=1``, default
+    off): the compiled batch shape is pinned for the whole run — no
+    compaction; a terminal lane's slot is handed to a queued problem IN
+    PLACE (state/diag/data scattered, warmup padded to full batch
+    width so the compiled warmup is reused too), so steady-state churn
+    triggers zero batched-scan re-specializations after the first
+    compile.  Knob-off preserves the compaction path bit-identically —
+    except the PR 13 top-up bugfix: the legacy path now admits queued
+    problems into masked slots in place when riding at/above
+    ``refill_occupancy`` instead of stranding the queue.
+  * **Streaming admission** (`FleetFeed`): ``feed.submit`` hands
+    problems to a RUNNING fleet (thread-safe, consumed at block
+    boundaries, ``seed + arrival-index`` streams, queue persisted in
+    the fleet checkpoint so crash-resume replays admissions
+    bit-identically) — `sample_fleet` becomes a long-lived serving
+    loop, the ROADMAP item 2 refill API under the item-1 control plane.
+  * **Warm-start adaptation transfer** (``STARK_FLEET_WARMSTART=1``,
+    default off): admitted problems seed step size + mass diagonal
+    from a finite-validated `DonorPool` of completed problems and run
+    a short adapt-confirm warmup; the full split-R-hat/ESS validation
+    still gates every stop.
+
 Escape hatches: ``STARK_FLEET=0`` (or ``fleet=False``) runs the problems
-SEQUENTIALLY through the unmodified single-problem runner — and a
-one-problem fleet always takes that path, so B=1 is bit-identical to
+SEQUENTIALLY through the unmodified single-problem runner (honoring the
+same `FleetFeed` API) — and a one-problem feed-less fleet always takes
+that path, so B=1 is bit-identical to
 `runner.sample_until_converged` by construction (draws, metrics trail,
 checkpoint arrays), the same flags-off discipline as PRs 3–4.
 
@@ -80,6 +105,7 @@ import dataclasses
 import json
 import logging
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -88,7 +114,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import diagnostics, faults, telemetry
-from .adaptation import build_warmup_schedule
+from .adaptation import DualAveragingState, build_warmup_schedule
 from .kernels.base import STREAM_DIAG_LAGS, HMCState, StreamDiagState
 from .model import Model, flatten_model, prepare_model_data
 from .sampler import SamplerConfig, make_block_runner, make_warmup_parts
@@ -225,22 +251,8 @@ class FleetSpec:
                         f"budgets[{i}] is {type(b).__name__}, expected "
                         "ProblemBudget or None"
                     )
-        ref = jax.tree.structure(self.datasets[0])
-        ref_shapes = [np.shape(a) for a in jax.tree.leaves(self.datasets[0])]
         for i, d in enumerate(self.datasets[1:], start=1):
-            if jax.tree.structure(d) != ref:
-                raise ValueError(
-                    f"problem {self.problem_ids[i]!r}: data pytree "
-                    "structure differs from problem 0 (fleet batching "
-                    "needs identical structure and leaf shapes)"
-                )
-            shapes = [np.shape(a) for a in jax.tree.leaves(d)]
-            if shapes != ref_shapes:
-                raise ValueError(
-                    f"problem {self.problem_ids[i]!r}: data leaf shapes "
-                    f"{shapes} differ from problem 0's {ref_shapes} "
-                    "(fleet batching stacks along a new leading axis)"
-                )
+            check_problem_data(self.datasets[0], d, self.problem_ids[i])
 
     @classmethod
     def from_problems(
@@ -295,6 +307,216 @@ class FleetSpec:
         return jax.tree.map(lambda *leaves: jnp.stack(leaves), *prepared)
 
 
+def _check_finite_submission(data: PyTree, pid: str) -> None:
+    """Streamed submissions must carry FINITE data: a NaN/Inf leaf
+    passes the shape check but poisons its lane's warmup inside an
+    already-compiled (and health-checked) batch — one hostile tenant
+    must be rejected at the admission boundary, never escalated into a
+    whole-fleet ChainHealthError.  Scoped to FleetFeed submissions: the
+    spec path keeps its historical behavior (operator data is not
+    tenant data)."""
+    for leaf in jax.tree.leaves(data):
+        arr = np.asarray(leaf)
+        if (
+            np.issubdtype(arr.dtype, np.floating)
+            and not np.all(np.isfinite(arr))
+        ):
+            raise ValueError(f"problem {pid!r}: non-finite data leaf")
+
+
+def check_problem_data(ref: PyTree, d: PyTree, pid: str) -> None:
+    """The ONE batched-data admission check (`FleetSpec` construction and
+    `FleetFeed` streaming submissions share it): ``d`` must match the
+    reference dataset's pytree structure and leaf shapes exactly, or it
+    cannot share the fleet's stacked device layout."""
+    if jax.tree.structure(d) != jax.tree.structure(ref):
+        raise ValueError(
+            f"problem {pid!r}: data pytree structure differs from "
+            "problem 0 (fleet batching needs identical structure and "
+            "leaf shapes)"
+        )
+    ref_shapes = [np.shape(a) for a in jax.tree.leaves(ref)]
+    shapes = [np.shape(a) for a in jax.tree.leaves(d)]
+    if shapes != ref_shapes:
+        raise ValueError(
+            f"problem {pid!r}: data leaf shapes {shapes} differ from "
+            f"problem 0's {ref_shapes} (fleet batching stacks along a "
+            "new leading axis)"
+        )
+
+
+# --------------------------------------------------------------------------
+# streaming admission (the ROADMAP item 2 "refill API": problems arriving
+# WHILE the fleet runs — sample_fleet becomes a long-lived serving loop)
+# --------------------------------------------------------------------------
+
+
+class FleetFeed:
+    """Thread-safe streaming admission queue for a live ``sample_fleet``.
+
+    ``submit(data, problem_id=..., budget=...)`` may be called from ANY
+    thread while the fleet runs; submissions are handed off to the fleet
+    at block boundaries (the same unit every other fleet decision is made
+    in), validated against the spec's batched-data contract, seeded with
+    the next global problem index (the existing ``seed + i`` discipline —
+    a submitted problem's draws are bit-identical to its unbatched run
+    and independent of WHEN it was submitted relative to the batch), and
+    queued for in-place admission.  ``close()`` marks the feed complete:
+    the fleet drains the queue and returns once every problem (spec +
+    submitted) is terminal.  An open feed keeps ``sample_fleet`` alive as
+    a serving loop even when every current problem has finished.
+
+    Durability: consumed submissions are persisted in the fleet
+    checkpoint (data leaves + budget + arrival order), so a supervised
+    crash-resume replays the admission order bit-identically without the
+    caller re-submitting.  The sequential ``STARK_FLEET=0`` hatch honors
+    the same API (submissions run through the single-problem runner after
+    the spec sweep, same seed discipline).
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items: List[Tuple[Optional[str], PyTree,
+                                Optional[ProblemBudget]]] = []
+        self._closed = False
+        self._seq = 0
+
+    def submit(self, data: PyTree, problem_id: Optional[str] = None,
+               budget: Optional[ProblemBudget] = None) -> str:
+        """Queue one problem; returns its problem_id (``s####`` when not
+        given).  Raises once the feed is closed."""
+        if budget is not None and not isinstance(budget, ProblemBudget):
+            raise ValueError(
+                f"budget is {type(budget).__name__}, expected "
+                "ProblemBudget or None"
+            )
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("FleetFeed is closed")
+            if problem_id is None:
+                problem_id = f"s{self._seq:04d}"
+            self._seq += 1
+            pid = str(problem_id)
+            self._items.append((pid, data, budget))
+            self._cond.notify_all()
+        return pid
+
+    def close(self) -> None:
+        """No more submissions: the fleet finishes once the queue drains."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    def drain(self) -> List[Tuple[str, PyTree, Optional[ProblemBudget]]]:
+        """Pop every queued submission (the fleet's block-boundary
+        consumption point)."""
+        with self._cond:
+            items, self._items = self._items, []
+            return items
+
+    def requeue(
+        self, items: List[Tuple[str, PyTree, Optional[ProblemBudget]]]
+    ) -> None:
+        """Return consumed submissions to the FRONT of the queue — the
+        fleet's crash-recovery path for items drained but not yet
+        persisted in a checkpoint (the drain->checkpoint window).
+        Allowed on a closed feed: the items were legitimately submitted
+        before close, and the supervised retry must see them again."""
+        with self._cond:
+            self._items[:0] = list(items)
+            self._cond.notify_all()
+
+    def wait(self, timeout_s: float) -> bool:
+        """Block until a submission or close arrives (or the timeout);
+        True when there is anything to act on.  The fleet's idle-serving
+        wait — callers must keep feeding progress beats around it."""
+        with self._cond:
+            if self._items or self._closed:
+                return True
+            self._cond.wait(timeout_s)
+            return bool(self._items) or self._closed
+
+
+# --------------------------------------------------------------------------
+# warm-start adaptation transfer (STARK_FLEET_WARMSTART=1)
+# --------------------------------------------------------------------------
+
+
+class DonorPool:
+    """Running moment pool of completed problems' adaptation state, keyed
+    by model tag — the donor side of warm-start admission transfer.
+
+    A CONVERGED problem donates ``mean(log step_size)`` and its
+    mass-matrix diagonal (both averaged over chains); an admitted problem
+    seeds from the pool mean.  Every donation AND every summary read is
+    validated finite — a NaN'd completed problem (the
+    ``fleet.warmstart_poison`` drill) is rejected at the pool boundary
+    and can never propagate into an admitted lane's warmup.  The pool
+    state rides the fleet checkpoint so crash-resume replays warm-started
+    admissions deterministically."""
+
+    def __init__(self):
+        # tag -> {"count": int, "log_step_sum": float,
+        #         "inv_mass_sum": np.ndarray (d,)}
+        self._by_tag: Dict[str, Dict[str, Any]] = {}
+
+    def add(self, tag: str, step_size: np.ndarray,
+            inv_mass: np.ndarray) -> bool:
+        """Fold one completed problem's (chains,) step sizes and
+        (chains, d) mass diagonal into the pool; False (rejected) when
+        any summary stat is non-finite."""
+        step_size = np.asarray(step_size, np.float64)
+        inv_mass = np.asarray(inv_mass, np.float64)
+        log_step = float(np.mean(np.log(step_size))) if step_size.size \
+            else float("nan")
+        im = np.mean(inv_mass.reshape(-1, inv_mass.shape[-1]), axis=0)
+        if not (np.isfinite(log_step) and np.all(np.isfinite(im))):
+            return False
+        ent = self._by_tag.setdefault(
+            tag, {"count": 0, "log_step_sum": 0.0,
+                  "inv_mass_sum": np.zeros_like(im)},
+        )
+        ent["count"] += 1
+        ent["log_step_sum"] += log_step
+        ent["inv_mass_sum"] = ent["inv_mass_sum"] + im
+        return True
+
+    def summary(self, tag: str) -> Optional[Tuple[float, np.ndarray, int]]:
+        """(step_size, inv_mass_diag (d,), donor_count) pool mean, or
+        None when the pool is empty or the mean is non-finite (a reader-
+        side guard on top of the add-side one)."""
+        ent = self._by_tag.get(tag)
+        if not ent or ent["count"] <= 0:
+            return None
+        n = ent["count"]
+        step = float(np.exp(ent["log_step_sum"] / n))
+        im = np.asarray(ent["inv_mass_sum"]) / n
+        if not (np.isfinite(step) and step > 0 and np.all(np.isfinite(im))):
+            return None
+        return step, im, n
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            tag: {"count": e["count"], "log_step_sum": e["log_step_sum"],
+                  "inv_mass_sum": np.asarray(e["inv_mass_sum"]).tolist()}
+            for tag, e in self._by_tag.items()
+        }
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        self._by_tag = {
+            tag: {"count": int(e["count"]),
+                  "log_step_sum": float(e["log_step_sum"]),
+                  "inv_mass_sum": np.asarray(e["inv_mass_sum"],
+                                             np.float64)}
+            for tag, e in (state or {}).items()
+        }
+
+
 # --------------------------------------------------------------------------
 # results
 # --------------------------------------------------------------------------
@@ -313,7 +535,8 @@ class FleetProblemResult:
     def __init__(self, problem_id, draws_flat, fm, *, converged,
                  budget_exhausted, blocks, grad_evals, num_divergent,
                  min_ess, max_rhat, history, _constrain_cache,
-                 failed=None, failed_reason=None, lane_restarts=0):
+                 failed=None, failed_reason=None, lane_restarts=0,
+                 warmstarted=False, warmup_draws_saved=0):
         self.problem_id = problem_id
         self.draws_flat = draws_flat  # (chains, n, d) unconstrained
         self.flat_model = fm
@@ -328,6 +551,11 @@ class FleetProblemResult:
         self.failed = failed
         self.failed_reason = failed_reason
         self.lane_restarts = lane_restarts
+        # warm-start admission transfer (STARK_FLEET_WARMSTART): whether
+        # this problem's warmup was donor-seeded, and how many warmup
+        # draws per chain the shortened schedule skipped
+        self.warmstarted = warmstarted
+        self.warmup_draws_saved = warmup_draws_saved
         self._cache = _constrain_cache
         self._draws = None
 
@@ -363,7 +591,9 @@ class FleetResult:
 
     def __init__(self, problems: List[FleetProblemResult], *, wall_s,
                  blocks_dispatched, compactions, occupancy_trail,
-                 total_grad_evals, budget_exhausted=False):
+                 total_grad_evals, budget_exhausted=False,
+                 block_scan_compiles=0, admissions=0, slot_recycles=0,
+                 dispatch_occupancy_trail=None):
         self.problems = problems
         self.wall_s = wall_s
         self.blocks_dispatched = blocks_dispatched
@@ -371,6 +601,21 @@ class FleetResult:
         self.occupancy_trail = occupancy_trail
         self.total_grad_evals = total_grad_evals
         self.budget_exhausted = budget_exhausted
+        # batched-scan specializations this run dispatched (distinct
+        # batch widths the compiled block scan saw): the zero-recompile
+        # evidence — 1 on a slot-scheduler run, >= 1 + compaction sizes
+        # on the legacy path.  0 on the sequential hatch (no batched
+        # scan at all).
+        self.block_scan_compiles = block_scan_compiles
+        # in-place admissions (slot scheduler or legacy top-up) and the
+        # slots they recycled
+        self.admissions = admissions
+        self.slot_recycles = slot_recycles
+        # (occupancy_at_dispatch, queue_depth_at_dispatch) per fleet
+        # block: occupancy as the DEVICE saw it — measured after the
+        # boundary's admissions, unlike occupancy_trail's post-block
+        # pre-admission reading
+        self.dispatch_occupancy_trail = dispatch_occupancy_trail or []
         self._by_id = {p.problem_id: p for p in problems}
 
     def __getitem__(self, problem_id: str) -> FleetProblemResult:
@@ -407,6 +652,12 @@ class FleetResult:
         problems carry ``min_ess=None`` and contribute nothing."""
         vals = [p.min_ess for p in self.problems if p.min_ess is not None]
         return float(np.nansum(vals)) if vals else float("nan")
+
+    @property
+    def warmup_draws_saved(self) -> int:
+        """Total warmup draws per chain skipped by warm-start admission
+        transfer across the fleet (0 on cold runs)."""
+        return sum(p.warmup_draws_saved for p in self.problems)
 
 
 # --------------------------------------------------------------------------
@@ -554,32 +805,43 @@ def _fleet_parts_for(model: Model, cfg: SamplerConfig):
     return hit
 
 
-def _fleet_warmup(parts: _FleetParts, cfg, warm_keys, z0, data, seg, trace):
+def _fleet_warmup(parts: _FleetParts, cfg, warm_keys, z0, data, seg, trace,
+                  num_warmup: Optional[int] = None, seed_hook=None):
     """The fleet twin of `sampler.drive_segmented_warmup`: identical key
     layout and schedule slicing per problem (so each lane's warmup is
     bit-identical to the single-problem driver's), with the problem axis
     leading every carried array.  Any schedule or key-discipline change
     in `drive_segmented_warmup` must be mirrored here — the bit-identity
-    tests in tests/test_fleet.py are the drift alarm."""
+    tests in tests/test_fleet.py are the drift alarm.
+
+    ``num_warmup`` overrides ``cfg.num_warmup`` (the warm-start
+    adapt-confirm window); ``seed_hook(state, da, welford, inv_mass) ->
+    same tuple`` runs right after the carry init — the donor-transfer
+    injection point.  Both default to the cold-path behavior exactly."""
+    nw = cfg.num_warmup if num_warmup is None else int(num_warmup)
     with trace.phase("compile", stage="fleet_warmup_init"):
         kinit = jax.vmap(jax.vmap(lambda k: jax.random.split(k, 2)))(warm_keys)
         state, da, welford, inv_mass = jax.block_until_ready(
             parts.v_init(kinit[:, :, 0], z0, data)
         )
-        schedule = build_warmup_schedule(cfg.num_warmup)
+        if seed_hook is not None:
+            state, da, welford, inv_mass = seed_hook(
+                state, da, welford, inv_mass
+            )
+        schedule = build_warmup_schedule(nw)
         aflags = np.asarray(schedule.adapt_mass)
         wflags = np.asarray(schedule.window_end)
         # (problems, num_warmup, chains, 2) step keys — the per-problem
         # transpose of the single-problem driver's (num_warmup, chains, 2)
         wkeys = jnp.transpose(
             jax.vmap(
-                jax.vmap(lambda k: jax.random.split(k, max(cfg.num_warmup, 1)))
+                jax.vmap(lambda k: jax.random.split(k, max(nw, 1)))
             )(kinit[:, :, 1]),
             (0, 2, 1, 3),
         )
     warm_div = None
-    for s in range(0, cfg.num_warmup, seg):
-        e = min(s + seg, cfg.num_warmup)
+    for s in range(0, nw, seg):
+        e = min(s + seg, nw)
         with trace.phase("warmup_block", start=s, end=e,
                          fleet=int(z0.shape[0])):
             state, da, welford, inv_mass, ndiv = jax.block_until_ready(
@@ -605,6 +867,25 @@ def _resolve_fleet_flag(fleet: Optional[bool]) -> bool:
     if fleet is not None:
         return bool(fleet)
     return os.environ.get(FLEET_ENV, "1") != "0"
+
+
+def _resolve_slots_flag(slots: Optional[bool]) -> bool:
+    """Default-off knob: "1" pins the compiled batch shape for the whole
+    run (fixed-capacity lane slots with in-place admission); off
+    preserves the legacy compaction path bit-identically.  The literal
+    knob name keeps it collectable by tools/lint_fused_knobs.py."""
+    if slots is not None:
+        return bool(slots)
+    return os.environ.get("STARK_FLEET_SLOTS", "0") == "1"
+
+
+def _resolve_warmstart_flag(warmstart: Optional[bool]) -> bool:
+    """Default-off knob: "1" donor-seeds admitted problems' adaptation
+    state and shrinks their warmup to an adapt-confirm window (slots
+    path only); the full stop validation is unchanged either way."""
+    if warmstart is not None:
+        return bool(warmstart)
+    return os.environ.get("STARK_FLEET_WARMSTART", "0") == "1"
 
 
 def _fleet_workdir(*paths: Optional[str]) -> Optional[str]:
@@ -633,12 +914,13 @@ class _ProblemState:
         "next_full_check", "grad_evals", "total_div", "converged",
         "budget_exhausted", "history", "min_ess", "max_rhat",
         "ess_target", "deadline_s", "max_restarts", "lane_restarts",
-        "failed", "failed_reason",
+        "failed", "failed_reason", "submitted", "warmstarted",
+        "warmup_draws_saved",
     )
 
     def __init__(self, idx: int, pid: str, key, chains: int, ndim: int, *,
                  ess_target: float, deadline_s: Optional[float],
-                 max_restarts: int):
+                 max_restarts: int, submitted: bool = False):
         self.idx = idx
         self.pid = pid
         self.key = key
@@ -649,6 +931,12 @@ class _ProblemState:
         self.failed: Optional[str] = None
         self.failed_reason: Optional[str] = None
         self.history: List[Dict[str, Any]] = []
+        # streaming/warm-start accounting: whether the problem arrived
+        # through a FleetFeed, whether its warmup was donor-seeded, and
+        # the warmup draws/chain the shortened schedule skipped
+        self.submitted = submitted
+        self.warmstarted = False
+        self.warmup_draws_saved = 0
         self._reset(chains, ndim)
 
     def _reset(self, chains: int, ndim: int) -> None:
@@ -670,6 +958,10 @@ class _ProblemState:
         is the one counter a reseed must NOT reset — it is the budget."""
         self.key = key
         self._reset(chains, ndim)
+        # a reseeded lane re-warms COLD (full schedule, fresh stream):
+        # any donor transfer it got at admission is gone with the lane
+        self.warmstarted = False
+        self.warmup_draws_saved = 0
 
     @property
     def active(self) -> bool:
@@ -688,8 +980,17 @@ class _ProblemState:
         # only the LAST block record rides in the checkpoint: the full
         # per-problem trail is already durable in the metrics JSONL, and
         # serializing O(blocks) history per problem per checkpoint would
-        # make fleet checkpoints O(B*blocks^2) over a run
+        # make fleet checkpoints O(B*blocks^2) over a run.  The
+        # streaming/warm-start keys ride ONLY when set (a knob-off run's
+        # checkpoint stays byte-identical to pre-slot-scheduler files).
+        extra = {}
+        if self.submitted:
+            extra["submitted"] = True
+        if self.warmstarted:
+            extra["warmstarted"] = True
+            extra["warmup_draws_saved"] = self.warmup_draws_saved
         return {
+            **extra,
             "blocks_done": self.blocks_done,
             "draws": self.hist.rows,
             "next_full_check": self.next_full_check,
@@ -721,6 +1022,9 @@ class _ProblemState:
         self.lane_restarts = int(m.get("lane_restarts", 0))
         self.failed = m.get("failed")
         self.failed_reason = m.get("failed_reason")
+        self.submitted = bool(m.get("submitted", self.submitted))
+        self.warmstarted = bool(m.get("warmstarted", False))
+        self.warmup_draws_saved = int(m.get("warmup_draws_saved", 0))
 
 
 def sample_fleet(spec: FleetSpec, data: Any = None, **kwargs) -> FleetResult:
@@ -763,6 +1067,10 @@ def _sample_fleet(
     stream_diag: Optional[bool] = None,
     diag_lags: Optional[int] = None,
     diag_components: int = 64,
+    feed: Optional[FleetFeed] = None,
+    slots: Optional[bool] = None,
+    warmstart: Optional[bool] = None,
+    warmstart_warmup: Optional[int] = None,
     trace: Optional[Any] = None,
     **cfg_kwargs,
 ) -> FleetResult:
@@ -817,6 +1125,43 @@ def _sample_fleet(
     containment are honored there too, but a reseeded lane's retry
     stream differs from the vmapped path's fold — reseeds are a recovery
     path, not part of the identity contract).
+
+    **Fixed-capacity lane slots** (``slots=True`` / ``STARK_FLEET_SLOTS=1``,
+    default OFF — off preserves the compaction path bit-identically).
+    The compiled batch shape is pinned for the whole run: the batch is
+    never compacted, and when a lane goes terminal (converged /
+    quarantined / budget-exhausted) a queued problem is admitted IN
+    PLACE — its stacked data, fresh PRNG lane (the same ``seed + i``
+    discipline, so draws stay batch-composition-independent), warmup
+    carry, and `StreamDiagState` are scattered into the freed slot
+    inside the already-compiled dispatch.  Steady-state churn therefore
+    triggers ZERO batched-scan re-specializations after the first
+    compile (`FleetResult.block_scan_compiles`; ``compile`` trace
+    phases with ``stage="fleet_block_scan"`` are the span evidence).
+    Admission waves re-run the SAME full-width compiled warmup (freed
+    slots padded with discarded dummy lanes), so the warmup program is
+    not re-specialized either; only the rare lane-fault rewarm path
+    still compiles at cohort width.
+
+    **Streaming admission** (``feed=FleetFeed()``): problems submitted
+    while the fleet runs are drained at block boundaries, validated
+    against the spec's batched-data contract, seeded ``seed + i`` with
+    ``i`` their global arrival index, and queued for admission.  An open
+    feed keeps the loop alive (a long-lived serving loop); consumed
+    submissions are persisted in the fleet checkpoint so crash-resume
+    replays the admission order bit-identically.  PR 9 fault domains
+    (budgets, quarantine, deadlines) apply to admitted problems
+    unchanged.
+
+    **Warm-start adaptation transfer** (``warmstart=True`` /
+    ``STARK_FLEET_WARMSTART=1``, default OFF; slot-scheduler path only).
+    An admitted problem seeds its step size and mass-matrix diagonal
+    from the `DonorPool` mean of COMPLETED problems (keyed by model
+    tag; donor summaries validated finite on write and read) and runs a
+    short adapt-confirm warmup (``warmstart_warmup``, default
+    ``max(50, num_warmup // 4)``) instead of the full schedule.  The
+    full split-R-hat/ESS validation pass still gates every stop, so
+    warm-start can only change WHEN a problem converges.
     """
     cfg = SamplerConfig(**cfg_kwargs)
     if cfg.kernel == "chees":
@@ -841,7 +1186,12 @@ def _sample_fleet(
 
     ragged = ragged_nuts_enabled(cfg)
 
-    use_fleet = _resolve_fleet_flag(fleet) and spec.num_problems > 1
+    # a feed implies fleet semantics even at B=1: the batch grows as
+    # submissions arrive, so the vmapped path owns the run whenever the
+    # fleet flag is on and a feed is attached
+    use_fleet = _resolve_fleet_flag(fleet) and (
+        spec.num_problems > 1 or feed is not None
+    )
     if not use_fleet:
         return _sample_fleet_sequential(
             spec, chains=chains, block_size=block_size,
@@ -853,8 +1203,10 @@ def _sample_fleet(
             time_budget_s=time_budget_s, stream_diag=stream_diag,
             diag_lags=diag_lags, diag_components=diag_components,
             problem_max_restarts=problem_max_restarts,
-            trace=trace, **cfg_kwargs,
+            feed=feed, trace=trace, **cfg_kwargs,
         )
+    slots_on = _resolve_slots_flag(slots)
+    warmstart_on = slots_on and _resolve_warmstart_flag(warmstart)
 
     trace = telemetry.resolve_trace(trace)
     t_start = time.perf_counter()
@@ -941,9 +1293,11 @@ def _sample_fleet(
         return jax.random.fold_in(k, restarts)
 
     def _budget_for(i: int):
-        ess, deadline, mr = spec.budget_for(i).resolve(
-            ess_target, problem_max_restarts
-        )
+        if i < B:
+            b = spec.budget_for(i)
+        else:
+            b = submitted_budgets.get(all_ids[i]) or _DEFAULT_BUDGET
+        ess, deadline, mr = b.resolve(ess_target, problem_max_restarts)
         return dict(ess_target=ess, deadline_s=deadline, max_restarts=mr)
 
     probs = [
@@ -953,6 +1307,36 @@ def _sample_fleet(
         )
         for i in range(B)
     ]
+
+    # dynamic problem registry: streamed submissions (FleetFeed) extend
+    # the spec's problem list at block boundaries.  ``all_ids[i]`` is
+    # problem i's id for EVERY global index; submitted problems keep
+    # their raw datasets around so the fleet checkpoint can persist the
+    # queue (crash-resume replays the admission order bit-identically).
+    all_ids: List[str] = list(spec.problem_ids)
+    submitted_raw: Dict[str, PyTree] = {}
+    submitted_order: List[str] = []
+    submitted_budgets: Dict[str, Optional[ProblemBudget]] = {}
+    submitted_leaves: Dict[str, int] = {}
+    # submitted pids the LAST persisted checkpoint covers: anything
+    # outside this set is requeued to the feed on an abnormal exit, so
+    # the drain->checkpoint window can never lose a submission
+    last_ckpt_pids: set = set()
+
+    # warm-start adaptation transfer: donor summaries of completed
+    # problems, keyed by model tag; the adapt-confirm window replaces
+    # the full warmup schedule for donor-seeded admissions
+    donor_pool = DonorPool() if warmstart_on else None
+    donor_tag = getattr(model, "tag", type(model).__name__)
+    # adapt-confirm window: long enough that the schedule's slow window
+    # re-estimates the mass matrix from a usable sample count (a too-
+    # short window hands the lane a 20-sample metric and the gate then
+    # rightly refuses to converge it — measured, not hypothetical)
+    ws_window = (
+        min(cfg.num_warmup, max(50, cfg.num_warmup // 4))
+        if warmstart_warmup is None
+        else min(cfg.num_warmup, max(int(warmstart_warmup), 1))
+    )
 
     # cumulative sampling wall carried ACROSS supervised attempts (the
     # fleet checkpoint persists it): per-problem deadline_s budgets are a
@@ -970,6 +1354,14 @@ def _sample_fleet(
     occupancy_trail: List[float] = []
     blocks_dispatched = 0
     fleet_budget_exhausted = False
+    # zero-recompile accounting: every DISTINCT batch width the compiled
+    # block scan dispatches is one XLA specialization — the slot
+    # scheduler's whole point is to hold this at 1
+    seen_widths: set = set()
+    block_scan_compiles = 0
+    n_admissions = 0
+    n_slot_recycles = 0
+    dispatch_occupancy_trail: List[Tuple[float, int]] = []
 
     def batch_data(indices: List[int]):
         ix = jnp.asarray(indices)
@@ -1057,6 +1449,203 @@ def _sample_fleet(
                 diag = concat_batches(diag, dg)
         order = order + list(indices)
         bdata = batch_data(order)
+        flush_metrics()
+
+    def _add_problem(pid: str, data: PyTree,
+                     budget: Optional[ProblemBudget]) -> int:
+        """Register one streamed submission as a full fleet problem:
+        validate against the batched-data contract, append its prepared
+        data to the stacked slab, and mint its `_ProblemState` under the
+        ``seed + i`` discipline (i = global arrival index)."""
+        nonlocal fdata_all
+        if pid in set(all_ids):
+            raise ValueError(f"problem id {pid!r} already exists")
+        check_problem_data(spec.datasets[0], data, pid)
+        _check_finite_submission(data, pid)
+        # EVERY fallible step runs before the first registry mutation
+        # (prepare_data runs arbitrary model code, and a grouped/fused
+        # layout's prepared shapes can be value-dependent): a rejected
+        # tenant must leave the registry exactly as it found it
+        prepared = prepare_model_data(model, data)
+        new_slab = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, jnp.asarray(b)[None]]),
+            fdata_all, prepared,
+        )
+        i = len(probs)
+        all_ids.append(pid)
+        submitted_raw[pid] = data
+        submitted_order.append(pid)
+        submitted_budgets[pid] = budget
+        submitted_leaves[pid] = len(jax.tree.leaves(data))
+        fdata_all = new_slab
+        probs.append(_ProblemState(
+            i, pid, _cold_key(i), chains, fm.ndim, submitted=True,
+            **_budget_for(i),
+        ))
+        return i
+
+    def _drain_feed() -> int:
+        """Consume queued FleetFeed submissions (block-boundary handoff).
+        A malformed submission is rejected with a logged reason — one bad
+        tenant must not kill the serving loop."""
+        if feed is None:
+            return 0
+        n = 0
+        for pid, data, budget in feed.drain():
+            try:
+                pending.append(_add_problem(pid, data, budget))
+                n += 1
+            except Exception as e:  # noqa: BLE001 — a bad tenant must
+                # not kill the serving loop: the shape check catches
+                # structural mistakes (ValueError), but the model's own
+                # prepare_data hook runs arbitrary code over the
+                # submitted leaves and may raise anything
+                log.warning("fleet feed submission %r rejected: %s", pid, e)
+                emit({
+                    "event": "problem_rejected",
+                    "problem_id": pid,
+                    "reason": str(e),
+                    "wall_s": time.perf_counter() - t_start,
+                })
+        return n
+
+    def _scatter_lanes(ix, sub, st, ss, im, idxs: List[int]) -> None:
+        """Scatter warmed lanes ``sub`` of (st, ss, im) into batch slots
+        ``ix`` — the in-place admission write (same ``.at[ix].set``
+        pattern as the lane-fault rewarm, so every other lane's arrays
+        are untouched)."""
+        nonlocal state, step_size, inv_mass, diag
+        state = jax.tree.map(lambda a, b: a.at[ix].set(b[sub]), state, st)
+        step_size = step_size.at[ix].set(ss[sub])
+        inv_mass = inv_mass.at[ix].set(im[sub])
+        if stream_diag:
+            dg = init_diag_for(
+                idxs, [probs[i].hist for i in idxs], st.z.dtype
+            )
+            diag = jax.tree.map(lambda a, b: a.at[ix].set(b), diag, dg)
+
+    def _warm_slots_padded(pairs: List[Tuple[int, int]], donor) -> None:
+        """Full-batch-width warmup for an admitted cohort (slot
+        scheduler): admitted problems ride their TARGET slots, every
+        other lane is a dummy (zero key, zero z0 — vmap lanes are
+        independent, outputs discarded), so the shapes match the initial
+        cohort warmup exactly and the compiled warmup parts are reused
+        with zero re-specialization.  ``donor`` (step, inv_mass_diag,
+        count or None) seeds the dual-averaging state and mass diagonal
+        and shrinks the schedule to the adapt-confirm window."""
+        js = [j for j, _ in pairs]
+        for j, i in pairs:
+            p = probs[i]
+            p.key, key_init, key_warm = jax.random.split(p.key, 3)
+            # placed first so the fill lanes can zeros_like a real lane
+            p_z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
+            p_wk = jax.random.split(key_warm, chains)
+            if j == js[0]:
+                z0_l = [jnp.zeros_like(p_z0)] * len(order)
+                wk_l = [jnp.zeros_like(p_wk)] * len(order)
+            z0_l[j] = p_z0
+            wk_l[j] = p_wk
+        z0 = jnp.stack(z0_l)
+        warm_keys = jnp.stack(wk_l)
+        nw = None
+        hook = None
+        if donor is not None:
+            d_step, d_im, _n_donors = donor
+            nw = ws_window
+            ix_w = jnp.asarray(js, dtype=jnp.int32)
+
+            def hook(h_st, h_da, h_wf, h_im):
+                # anchor the dual-averaging stream AT the donor step
+                # (mu=log(step), the adaptation.da_init re-tuning form)
+                # and hand the lane the donor mass diagonal; the confirm
+                # window re-tunes both from there
+                ls = jnp.log(jnp.asarray(d_step, h_da.log_step.dtype))
+                h_da = DualAveragingState(
+                    log_step=h_da.log_step.at[ix_w].set(ls),
+                    log_avg_step=h_da.log_avg_step.at[ix_w].set(ls),
+                    h_avg=h_da.h_avg.at[ix_w].set(0.0),
+                    mu=h_da.mu.at[ix_w].set(ls),
+                    count=h_da.count,
+                )
+                h_im = h_im.at[ix_w].set(jnp.asarray(d_im, h_im.dtype))
+                return h_st, h_da, h_wf, h_im
+
+        st, ss, im, wdiv = _fleet_warmup(
+            parts, cfg, warm_keys, z0, bdata, block_size, trace,
+            num_warmup=nw, seed_hook=hook,
+        )
+        wdiv = np.asarray(wdiv)
+        for j, i in pairs:
+            p = probs[i]
+            if donor is not None:
+                p.warmstarted = True
+                p.warmup_draws_saved = max(cfg.num_warmup - ws_window, 0)
+            emit({
+                "event": "warmup_done",
+                "problem_id": p.pid,
+                "num_divergent": int(wdiv[j].sum()),
+                "warmstart": donor is not None,
+                "wall_s": time.perf_counter() - t_start,
+            })
+        ix = jnp.asarray(js, dtype=jnp.int32)
+        _scatter_lanes(ix, ix, st, ss, im, [i for _, i in pairs])
+
+    def admit_into_slots(slot_js: List[int], indices: List[int]) -> None:
+        """In-place admission: hand freed (masked) batch slots to queued
+        problems WITHOUT reshaping the batch.  On the slot-scheduler
+        path the cohort warms at full batch width (padded — compiled
+        warmup reused); on the legacy top-up path it warms at cohort
+        width (legacy never promised pinned shapes) and scatters the
+        same way."""
+        nonlocal bdata, n_admissions, n_slot_recycles
+        for j, i in zip(slot_js, indices):
+            old = probs[order[j]]
+            n_slot_recycles += 1
+            fields = dict(
+                slot=j, from_problem=old.pid, from_status=old.status,
+                to_problem=probs[i].pid,
+            )
+            if trace.enabled:
+                trace.emit("slot_recycled", **fields)
+            emit({
+                "event": "slot_recycled", **fields,
+                "wall_s": time.perf_counter() - t_start,
+            })
+            order[j] = i
+        bdata = batch_data(order)
+        if slots_on:
+            # one padded full-width warmup wave; the donor summary is
+            # read ONCE per wave (one tag per fleet) — checkpoint-replay
+            # determinism rides on the pool state, and the pool is
+            # persisted
+            donor = (
+                donor_pool.summary(donor_tag)
+                if donor_pool is not None else None
+            )
+            _warm_slots_padded(list(zip(slot_js, indices)), donor)
+        else:
+            st, ss, im = warm_cohort(indices)
+            ix = jnp.asarray(slot_js, dtype=jnp.int32)
+            sub = jnp.arange(len(indices), dtype=jnp.int32)
+            _scatter_lanes(ix, sub, st, ss, im, list(indices))
+        for j, i in zip(slot_js, indices):
+            p = probs[i]
+            n_admissions += 1
+            fields = dict(
+                problem_id=p.pid,
+                slot=j,
+                block=blocks_dispatched,
+                queue_depth=len(pending),
+                warmstart=p.warmstarted,
+                warmup_draws_saved=p.warmup_draws_saved,
+                source="feed" if p.submitted else "spec",
+            )
+            if trace.enabled:
+                trace.emit("problem_admitted", **fields)
+            emit({
+                "event": "problem_admitted", **fields,
+                "wall_s": time.perf_counter() - t_start,
+            })
         flush_metrics()
 
     def quarantine_problem(p: _ProblemState, fault: str, reason: str,
@@ -1204,6 +1793,11 @@ def _sample_fleet(
             "lane_restarts": p.lane_restarts,
             "max_restarts": p.max_restarts,
         }
+        if p.warmstarted:
+            # warm-start accounting rides only donor-seeded problems, so
+            # cold runs' terminal records stay byte-identical
+            fields["warmstart"] = True
+            fields["warmup_draws_saved"] = p.warmup_draws_saved
         fields.update(extra)
         emit({"event": "problem_done", **fields})
         emitted = (
@@ -1287,10 +1881,47 @@ def _sample_fleet(
                         f"{field}={current!r}"
                     )
             saved_ids = list(meta["problem_ids"])
-            if saved_ids != list(spec.problem_ids):
+            nspec = len(spec.problem_ids)
+            if saved_ids[:nspec] != list(spec.problem_ids):
                 raise ValueError(
                     "checkpointed problem_ids differ from this FleetSpec"
                 )
+            # streamed submissions consumed before the crash: rebuild
+            # them (data leaves + budget, in arrival order) so the
+            # resumed run replays the admission order bit-identically —
+            # the caller does not re-submit what the checkpoint owns
+            saved_submitted = list(meta.get("submitted", []))
+            if saved_ids[nspec:] != [s["pid"] for s in saved_submitted]:
+                raise ValueError(
+                    "checkpointed submitted problems are inconsistent "
+                    "with its problem_ids"
+                )
+            ref_struct = jax.tree.structure(spec.datasets[0])
+            for s in saved_submitted:
+                pid = s["pid"]
+                if s.get("data", True):
+                    leaves = [
+                        arrays[f"feed_{pid}_{k}"]
+                        for k in range(int(s["leaves"]))
+                    ]
+                    data = jax.tree.unflatten(ref_struct, leaves)
+                else:
+                    # terminal before the crash: its draws are durable
+                    # and a terminal problem is never re-sampled (a
+                    # corrupt store quarantines it, never re-serves it),
+                    # so a zero placeholder keeps the index space dense
+                    # without carrying dead data
+                    data = jax.tree.map(np.zeros_like, spec.datasets[0])
+                budget = (
+                    ProblemBudget(**s["budget"])
+                    if s.get("budget") is not None else None
+                )
+                _add_problem(pid, data, budget)
+            # checkpoint-born submissions are by definition covered by a
+            # durable checkpoint: never requeued to the feed on a crash
+            last_ckpt_pids.update(s["pid"] for s in saved_submitted)
+            if donor_pool is not None and meta.get("donor_pool"):
+                donor_pool.load_state(meta["donor_pool"])
             from .supervise import quarantine_path
 
             wall_offset = float(meta.get("elapsed_wall_s", 0.0))
@@ -1408,6 +2039,14 @@ def _sample_fleet(
             block_size, diag_lags=diag_lags if stream_diag else None,
             ragged=ragged,
         )
+        # registered DispatchProbe (profiling): a harness that registers
+        # "fleet_block_scan" counts every EXECUTED batched-scan dispatch
+        # — paired with the fleet_block_scan compile spans it separates
+        # "dispatched N times" from "specialized K times"
+        from . import profiling as _profiling
+
+        _probe = _profiling.get_probe("fleet_block_scan")
+        v_dispatch = _probe.wrap(v_block) if _probe is not None else v_block
     except BaseException:
         flush_metrics()
         if metrics_f:
@@ -1540,6 +2179,39 @@ def _sample_fleet(
                 {k: arrays[k] for k in
                  ("z", "pe", "grad", "step_size", "inv_mass")}
             )
+        # streaming/slot/warm-start state rides ONLY when in play — a
+        # knob-off, feed-less run's checkpoint stays byte-identical to
+        # the pre-slot-scheduler schema
+        stream_meta: Dict[str, Any] = {}
+        if submitted_order:
+            stream_meta["submitted"] = []
+            by_pid = {p.pid: p for p in probs}
+            for pid in submitted_order:
+                # data leaves ride the checkpoint only while the problem
+                # could still need them (queued or sampling): a TERMINAL
+                # submission's draws are already durable and it is never
+                # re-sampled, so a long-lived serving loop's checkpoint
+                # stays O(live problems), not O(total submissions) —
+                # and the host-side raw copy is dropped with it (the
+                # stacked device slab still grows with submissions; a
+                # documented bound for very-long-lived loops)
+                has_data = bool(by_pid[pid].active)
+                if has_data:
+                    for k, leaf in enumerate(
+                        jax.tree.leaves(submitted_raw[pid])
+                    ):
+                        arrays[f"feed_{pid}_{k}"] = np.asarray(leaf)
+                b = submitted_budgets.get(pid)
+                stream_meta["submitted"].append({
+                    "pid": pid,
+                    "leaves": submitted_leaves[pid],
+                    "data": has_data,
+                    "budget": dataclasses.asdict(b) if b else None,
+                })
+        if slots_on:
+            stream_meta["slots"] = True
+        if donor_pool is not None:
+            stream_meta["donor_pool"] = donor_pool.state_dict()
         save_checkpoint(
             path,
             arrays,
@@ -1549,7 +2221,7 @@ def _sample_fleet(
                 "model": type(model).__name__,
                 "chains": chains,
                 "block_size": block_size,
-                "problem_ids": list(spec.problem_ids),
+                "problem_ids": list(all_ids),
                 "active_ids": active_ids,
                 "problems": {p.pid: p.meta() for p in probs},
                 # cumulative wall including prior attempts: what resumed
@@ -1557,8 +2229,16 @@ def _sample_fleet(
                 "elapsed_wall_s": (
                     time.perf_counter() - t_start + wall_offset
                 ),
+                **stream_meta,
             },
         )
+        # the checkpoint is durable: every consumed submission is now
+        # replayable from it (nothing to requeue on a crash), and
+        # terminal submissions' host-side raw data can be dropped
+        last_ckpt_pids.update(submitted_order)
+        for s in stream_meta.get("submitted", ()):
+            if not s["data"]:
+                submitted_raw.pop(s["pid"], None)
         if trace.enabled:
             trace.emit(
                 "checkpoint",
@@ -1576,7 +2256,76 @@ def _sample_fleet(
     v_split_chains = jax.vmap(lambda k: jax.random.split(k, chains))
 
     try:
-        while any(probs[i].active for i in order):
+        while True:
+            # --- next cohort / serve the feed / done ----------------------
+            if not any(probs[i].active for i in order):
+                if feed is not None:
+                    _drain_feed()
+                pending = [i for i in pending if probs[i].active]
+                if pending:
+                    if slots_on and order:
+                        # pinned batch shape: the next cohort enters IN
+                        # PLACE (every slot is free here) — the compiled
+                        # scan keeps its width
+                        free_js = [
+                            j for j, i in enumerate(order)
+                            if not probs[i].active
+                        ]
+                        k = min(len(free_js), len(pending))
+                        nxt, pending = pending[:k], pending[k:]
+                        admit_into_slots(free_js[:k], nxt)
+                        if (
+                            pending and max_batch is not None
+                            and len(order) < max_batch
+                        ):
+                            # same under-capacity growth as the in-loop
+                            # boundary: append toward max_batch
+                            room = max_batch - len(order)
+                            nxt, pending = pending[:room], pending[room:]
+                            admit(nxt)
+                    else:
+                        # legacy: start the next cohort fresh (e.g. the
+                        # whole batch finished without triggering a
+                        # refill under refill_occupancy=0)
+                        state = step_size = inv_mass = diag = bdata = None
+                        order = []
+                        room = (
+                            max_batch if max_batch is not None
+                            else len(pending)
+                        )
+                        nxt, pending = pending[:room], pending[room:]
+                        admit(nxt)
+                elif feed is not None and not feed.closed:
+                    # long-lived serving loop: every problem is terminal
+                    # but the feed is open — wait for the next
+                    # submission, feeding the watchdog while idle.  The
+                    # fleet time budget still bounds the wait: an idle
+                    # serving loop must not outlive it.
+                    if (
+                        time_budget_s is not None
+                        and time.perf_counter() - t_start > time_budget_s
+                    ):
+                        fleet_budget_exhausted = True
+                        # same observables as the block-path expiry: the
+                        # telemetry trail must say WHY the serving loop
+                        # closed, idle or not
+                        emit({
+                            "event": "budget_exhausted",
+                            "time_budget_s": float(time_budget_s),
+                            "wall_s": time.perf_counter() - t_start,
+                        })
+                        if trace.enabled:
+                            trace.emit(
+                                "budget",
+                                time_budget_s=float(time_budget_s),
+                                blocks=blocks_dispatched,
+                            )
+                        break
+                    telemetry.notify_progress()
+                    feed.wait(0.2)
+                    continue
+                else:
+                    break
             # --- dispatch one fleet block over the CURRENT batch ---------
             act_lanes = [i for i in order if probs[i].active]
             blk_key: Dict[int, Any] = {}
@@ -1595,15 +2344,40 @@ def _sample_fleet(
             )
             t_enq = time.perf_counter()
             lane_iters = None
+            width = len(order)
+            new_width = width not in seen_widths
+            if new_width:
+                seen_widths.add(width)
+                block_scan_compiles += 1
+            # occupancy AS DISPATCHED (post-admission): the number the
+            # device actually runs at, vs occupancy_trail's post-block
+            # pre-admission reading
+            dispatch_occupancy_trail.append(
+                (len(act_lanes) / max(width, 1), len(pending))
+            )
+            args = (
+                (bkeys, state, diag, step_size, inv_mass, bdata)
+                if stream_diag
+                else (bkeys, state, step_size, inv_mass, bdata)
+            )
+            if new_width:
+                # first dispatch at this batch width: the batched scan
+                # re-specializes.  A compile phase claims the wall so
+                # the timeline bills it as compile (not dispatch) — and
+                # the span count IS the zero-recompile evidence the
+                # slot scheduler is gated on (exactly one per run)
+                with trace.phase("compile", stage="fleet_block_scan",
+                                 batch=width):
+                    out = jax.block_until_ready(v_dispatch(*args))
+            else:
+                out = v_dispatch(*args)
             if stream_diag:
-                out = v_block(bkeys, state, diag, step_size, inv_mass, bdata)
                 if ragged:
                     (state, diag, zs, accept, divergent, _energy, ngrad,
                      lane_iters) = out
                 else:
                     state, diag, zs, accept, divergent, _energy, ngrad = out
             else:
-                out = v_block(bkeys, state, step_size, inv_mass, bdata)
                 if ragged:
                     (state, zs, accept, divergent, _energy, ngrad,
                      lane_iters) = out
@@ -1655,6 +2429,7 @@ def _sample_fleet(
                         poisoned.append((j, i, str(e)))
             poisoned_idx = {i for _j, i, _r in poisoned}
             block_grads_active = 0
+            new_donors: List[Tuple[int, _ProblemState]] = []
             for j, i in enumerate(order):
                 p = probs[i]
                 if not p.active or i in poisoned_idx:
@@ -1669,6 +2444,25 @@ def _sample_fleet(
                 )
                 gate_and_record(p, zs[j], divergent_h[j], blk_grads,
                                 diag_lane)
+                if donor_pool is not None and p.converged:
+                    new_donors.append((j, p))
+            if new_donors:
+                # warm-start donors: a CONVERGED problem's final step
+                # size + mass diagonal joins the pool — validated finite
+                # at the boundary (``fleet.warmstart_poison`` drills a
+                # NaN'd donor; it must be rejected here, never seeded)
+                ss_h2 = np.asarray(step_size)
+                im_h2 = np.asarray(inv_mass)
+                for j, p in new_donors:
+                    d_ss, d_im = ss_h2[j], im_h2[j]
+                    act = faults.fail_point("fleet.warmstart_poison")
+                    if act is not None and act.kind == "nan":
+                        d_ss = np.full_like(d_ss, np.nan)
+                    if not donor_pool.add(donor_tag, d_ss, d_im):
+                        log.warning(
+                            "fleet warm-start donor %s rejected "
+                            "(non-finite adaptation summary)", p.pid,
+                        )
 
             # --- lane containment -----------------------------------------
             if poisoned:
@@ -1768,6 +2562,11 @@ def _sample_fleet(
                 sched_fields = lane_occupancy_fields(
                     lane_iters, useful=block_grads_active
                 )
+            # queue-depth accounting rides ONLY slot-scheduler / streaming
+            # runs (knob-off, feed-less fleet_block events stay byte-
+            # identical to pre-PR traces)
+            if slots_on or feed is not None:
+                sched_fields = dict(sched_fields, queue_depth=len(pending))
             if trace.enabled:
                 trace.emit(
                     "fleet_block",
@@ -1795,12 +2594,42 @@ def _sample_fleet(
                 "wall_s": time.perf_counter() - t_start,
             })
 
-            # --- compaction / refill at the block boundary ----------------
-            # strictly threshold-gated (the documented contract): a batch
-            # riding above refill_occupancy keeps its masked lanes even
-            # when a queue waits, so refills stay cohort-sized instead of
-            # paying a vmapped warmup dispatch per single convergence
-            if (
+            # --- scheduling at the block boundary -------------------------
+            # feed submissions land here (the same unit every other fleet
+            # decision is made in), then one of three paths runs:
+            #   slots on    — recycle freed slots in place, never reshape
+            #   legacy      — threshold-gated compaction + refill
+            #   legacy top-up (PR 13 bugfix, documented behavior change) —
+            #     a batch riding AT/ABOVE refill_occupancy used to strand
+            #     its queue even with masked lanes free; now queued
+            #     problems are admitted into the masked slots in place
+            #     (no reshape, so no batched-scan re-specialization)
+            if feed is not None:
+                _drain_feed()
+            pending = [i for i in pending if probs[i].active]
+            free_js = [
+                j for j, i in enumerate(order) if not probs[i].active
+            ]
+            if slots_on:
+                if pending and free_js:
+                    k = min(len(free_js), len(pending))
+                    nxt, pending = pending[:k], pending[k:]
+                    admit_into_slots(free_js[:k], nxt)
+                if (
+                    pending and max_batch is not None
+                    and len(order) < max_batch
+                ):
+                    # under configured capacity (a feed grew a small
+                    # spec): APPEND toward max_batch — one batched-scan
+                    # specialization per growth wave, pinned again once
+                    # at capacity.  Growth is the legacy cohort-append
+                    # admission (no slot to recycle), so it carries the
+                    # fleet_compact-free warmup path, not
+                    # problem_admitted events.
+                    room = max_batch - len(order)
+                    nxt, pending = pending[:room], pending[room:]
+                    admit(nxt)
+            elif (
                 n_active < len(order)
                 and occupancy < refill_occupancy
                 and refill_occupancy > 0.0
@@ -1843,10 +2672,27 @@ def _sample_fleet(
                     "pending": len(pending),
                     "wall_s": time.perf_counter() - t_start,
                 })
+            elif pending and free_js and refill_occupancy > 0.0:
+                # legacy top-up: queued work + free masked slots, but the
+                # batch rides at/above the compaction threshold — drain
+                # the queue into the masked slots without compacting.
+                # refill_occupancy=0.0 keeps its documented meaning (the
+                # batch is NEVER touched mid-run; the queue starts fresh
+                # cohorts only once the whole batch drains)
+                k = min(len(free_js), len(pending))
+                nxt, pending = pending[:k], pending[k:]
+                admit_into_slots(free_js[:k], nxt)
 
             flush_metrics()  # one write+fsync per fleet block (see emit)
             if checkpoint_path:
                 save_fleet_checkpoint(checkpoint_path)
+            if pending:
+                # crash-with-queued-work drill point: the checkpoint just
+                # persisted the queue (spec indices and streamed
+                # submissions alike), so a crash HERE must replay the
+                # admission order bit-identically on resume
+                # (chaos ``fleet_admit_crash``)
+                faults.fail_point("fleet.admit_pending")
             faults.fail_point("fleet.block.post")
 
             if (
@@ -1866,17 +2712,27 @@ def _sample_fleet(
                     )
                 break
 
-            if not any(probs[i].active for i in order) and pending:
-                # whole batch finished without triggering a refill (e.g.
-                # refill_occupancy=0): start the next cohort fresh
-                pending = [i for i in pending if probs[i].active]
-                if not pending:
-                    break
-                state = step_size = inv_mass = diag = bdata = None
-                order = []
-                room = max_batch if max_batch is not None else len(pending)
-                nxt, pending = pending[:room], pending[room:]
-                admit(nxt)
+            # (next-cohort admission moved to the loop head: the same
+            # boundary also serves streamed submissions and the slots
+            # path's in-place cohort swap)
+    except BaseException:
+        # the drain->checkpoint window must not LOSE submissions: any
+        # consumed submission the last durable checkpoint does not cover
+        # goes back to the front of the feed, so the supervised retry
+        # (same process, same feed object) re-drains it in order
+        if feed is not None:
+            lost = [
+                (pid, submitted_raw[pid], submitted_budgets.get(pid))
+                for pid in submitted_order
+                if pid not in last_ckpt_pids and pid in submitted_raw
+            ]
+            if lost:
+                log.warning(
+                    "requeueing %d un-checkpointed feed submission(s) "
+                    "after abnormal fleet exit", len(lost),
+                )
+                feed.requeue(lost)
+        raise
     finally:
         flush_metrics()
         if metrics_f:
@@ -1908,17 +2764,26 @@ def _sample_fleet(
             failed=p.failed,
             failed_reason=p.failed_reason,
             lane_restarts=p.lane_restarts,
+            warmstarted=p.warmstarted,
+            warmup_draws_saved=p.warmup_draws_saved,
         )
         for p in probs
     ]
     total_grads = sum(p.grad_evals for p in probs)
     lost = [p.pid for p in probs if p.failed]
     if trace.enabled:
+        # streaming/slot accounting rides run_end only on knob-on /
+        # fed runs, keeping knob-off trace files byte-identical
+        stream_end = (
+            dict(admissions=n_admissions, slot_recycles=n_slot_recycles,
+                 block_scan_compiles=block_scan_compiles)
+            if (slots_on or feed is not None or n_admissions) else {}
+        )
         trace.emit(
             "run_end",
             dur_s=round(wall, 4),
             converged=all(p.converged for p in probs),
-            problems=B,
+            problems=len(probs),
             converged_problems=sum(p.converged for p in probs),
             blocks=blocks_dispatched,
             compactions=compactions,
@@ -1926,6 +2791,7 @@ def _sample_fleet(
             budget_exhausted=fleet_budget_exhausted,
             degraded=bool(lost),
             lost_problems=lost,
+            **stream_end,
         )
     return FleetResult(
         results,
@@ -1935,6 +2801,10 @@ def _sample_fleet(
         occupancy_trail=occupancy_trail,
         total_grad_evals=total_grads,
         budget_exhausted=fleet_budget_exhausted,
+        block_scan_compiles=block_scan_compiles,
+        admissions=n_admissions,
+        slot_recycles=n_slot_recycles,
+        dispatch_occupancy_trail=dispatch_occupancy_trail,
     )
 
 
@@ -1955,7 +2825,7 @@ def _sample_fleet_sequential(
     chains, block_size, max_blocks, min_blocks, rhat_target, ess_target,
     seed, checkpoint_path, resume_from, metrics_path, draw_store_path,
     health_check, reseed, time_budget_s, stream_diag, diag_lags,
-    diag_components, trace, problem_max_restarts=1,
+    diag_components, trace, problem_max_restarts=1, feed=None,
     **cfg_kwargs,
 ) -> FleetResult:
     """The escape hatch: problems run one at a time through the
@@ -1981,7 +2851,18 @@ def _sample_fleet_sequential(
     clamping each problem's gate target and time budget — re-derived per
     attempt (retries included), with the sweep clock persisted across
     supervised restarts in a ``<checkpoint_path>.sweep.json`` sidecar so
-    deadlines charge CUMULATIVE wall here too."""
+    deadlines charge CUMULATIVE wall here too.
+
+    The streaming `FleetFeed` API is honored on the hatch: submissions
+    drain at problem boundaries (after the spec sweep, and whenever the
+    work queue runs dry while the feed is open), run through the same
+    single-problem runner with seed ``seed + i`` (``i`` their global
+    arrival index — identical streams to their vmapped-fleet lanes),
+    and the loop stays alive until the feed closes.  Queue durability
+    is the vmapped path's checkpointed-queue feature; here a completed
+    submission's artifacts are durable per problem, and unconsumed
+    submissions stay in the caller's feed across a supervised restart
+    (same process, same feed object)."""
     from .backends.jax_backend import JaxBackend
     from .runner import sample_until_converged
     from .supervise import (
@@ -1992,6 +2873,10 @@ def _sample_fleet_sequential(
 
     t0 = time.perf_counter()
     b = spec.num_problems
+    # "multi-problem" layout decision: a feed can grow a B=1 sweep past
+    # one problem, so per-problem artifact paths + fault containment
+    # engage whenever a feed is attached, not just when B > 1
+    multi = b if feed is None else max(b, 2)
     # same forensics destination rule as the vmapped path: bundles land
     # next to the sweep's own artifacts
     recorder = telemetry.flight_recorder()
@@ -2007,7 +2892,7 @@ def _sample_fleet_sequential(
     # reduced remainder)
     sweep_sidecar = (
         checkpoint_path + ".sweep.json"
-        if (checkpoint_path and b > 1) else None
+        if (checkpoint_path and multi > 1) else None
     )
     sweep_offset = 0.0
     if sweep_sidecar and os.path.exists(sweep_sidecar):
@@ -2016,7 +2901,7 @@ def _sample_fleet_sequential(
         # otherwise the sidecar is stale state from an earlier sweep in
         # this workdir and must not pre-charge fresh tenants' deadlines
         resuming = any(
-            os.path.exists(_problem_path(checkpoint_path, pid, b))
+            os.path.exists(_problem_path(checkpoint_path, pid, multi))
             for pid in spec.problem_ids
         )
         if resuming:
@@ -2075,220 +2960,294 @@ def _sample_fleet_sequential(
             lane_restarts=lane_restarts,
         )
 
-    for i, (pid, data_p) in enumerate(zip(spec.problem_ids, spec.datasets)):
-        # checkpoint the sweep clock at problem granularity (the same
-        # unit the hatch's crash-resume accounts in)
-        persist_sweep_wall()
-        p_budget = spec.budget_for(i)
-        ess_i, deadline_i, mr_i = p_budget.resolve(
-            ess_target, problem_max_restarts
-        )
-        if time_budget_s is not None and (
-            time.perf_counter() - t0 >= time_budget_s
-        ):
-            budget_hit = True
-            break
-        ckpt_p = _problem_path(checkpoint_path, pid, b)
-        resume_p = _problem_path(resume_from, pid, b)
-        store_p = _problem_path(draw_store_path, pid, b)
-        if b > 1:
-            if not (resume_p and os.path.exists(resume_p)):
-                resume_p = None
-            if resume_p is None and ckpt_p and os.path.exists(ckpt_p):
-                healthy, _reason = checkpoint_health(ckpt_p)
-                if healthy:
-                    resume_p = ckpt_p
-                else:
-                    quarantine_path(ckpt_p, reason=_reason)
-            if (
-                resume_p is None
-                and store_p
-                and os.path.exists(store_p)
+    # FIFO work queue: the spec's problems up front, streamed submissions
+    # appended as they drain — every problem's global index i (and so its
+    # seed + i stream) is its arrival position, exactly like the vmapped
+    # path's dynamic registry
+    work: List[Tuple[int, str, Any, ProblemBudget]] = [
+        (i, pid, d, spec.budget_for(i))
+        for i, (pid, d) in enumerate(zip(spec.problem_ids, spec.datasets))
+    ]
+    seen_ids = set(spec.problem_ids)
+    next_idx = b
+    # every ACCEPTED feed submission in arrival order: on an abnormal
+    # exit the WHOLE list is requeued, so the supervised retry re-drains
+    # them in the same order and reassigns the same global indices (and
+    # therefore the same seed + i streams); already-completed ones
+    # resume their per-problem checkpoints and re-report cheaply
+    drained_feed: List[Tuple[str, Any, Optional[ProblemBudget]]] = []
+
+    try:
+        while True:
+            if not work:
+                if feed is not None:
+                    for f_pid, f_data, f_budget in feed.drain():
+                        try:
+                            if f_pid in seen_ids:
+                                raise ValueError(
+                                    f"problem id {f_pid!r} already exists"
+                                )
+                            check_problem_data(spec.datasets[0], f_data, f_pid)
+                            _check_finite_submission(f_data, f_pid)
+                        except Exception as e:  # noqa: BLE001 — same
+                            # reject-don't-die contract as the vmapped path
+                            log.warning(
+                                "fleet feed submission %r rejected: %s",
+                                f_pid, e,
+                            )
+                            continue
+                        seen_ids.add(f_pid)
+                        drained_feed.append((f_pid, f_data, f_budget))
+                        work.append((
+                            next_idx, f_pid, f_data,
+                            f_budget if f_budget is not None else _DEFAULT_BUDGET,
+                        ))
+                        next_idx += 1
+                if not work:
+                    if feed is None or feed.closed:
+                        break
+                    if time_budget_s is not None and (
+                        time.perf_counter() - t0 >= time_budget_s
+                    ):
+                        # the sweep budget bounds the idle serving wait too
+                        budget_hit = True
+                        break
+                    # serving loop: stay alive for the next submission
+                    telemetry.notify_progress()
+                    feed.wait(0.2)
+                    continue
+            i, pid, data_p, p_budget = work.pop(0)
+            # checkpoint the sweep clock at problem granularity (the same
+            # unit the hatch's crash-resume accounts in)
+            persist_sweep_wall()
+            ess_i, deadline_i, mr_i = p_budget.resolve(
+                ess_target, problem_max_restarts
+            )
+            if time_budget_s is not None and (
+                time.perf_counter() - t0 >= time_budget_s
             ):
-                # cold start: a discarded attempt's draws must not mix
-                # into this run's store (supervisor discipline, applied
-                # per problem)
-                quarantine_path(store_p)
-        seed_i = seed + i
-        if reseed is not None and b > 1:
-            # reseeded restart: the single runner folds `reseed` only
-            # into RESUMED keys, so a cold-started problem would replay
-            # a neighbor's attempt-0 stream (seed+attempt+i aliases
-            # seed+(i+attempt) — the same lattice collision `_cold_key`
-            # fixes on the vmapped path); spreading the problems keeps
-            # every attempt bump inside a problem's private seed range
-            seed_i = seed + i * _RESEED_STRIDE
-        res = None
-        fault_reason = None
-        faults_seen = 0
-        lane_restarts = 0
-        stopped = None  # "sweep" | "deadline" budget stop mid-retries
-        for r in range(mr_i + 1):
-            # the budget clamp is re-derived per ATTEMPT, retries
-            # included: a ChainHealthError retry must never re-grant a
-            # tenant its original deadline window (or outrun the sweep
-            # budget) — the clocks keep running across recovery
-            now = time.perf_counter() - t0
-            remaining = None
-            if time_budget_s is not None:
-                if time_budget_s - now <= 0:
-                    stopped = "sweep"
-                    break
-                remaining = time_budget_s - now
-            if deadline_i is not None:
-                # deadlines charge the CUMULATIVE sweep wall (restored
-                # from the sidecar), not this attempt's
-                dl_left = deadline_i - sweep_wall()
-                if dl_left <= 0:
-                    stopped = "deadline"
-                    break
-                remaining = dl_left if remaining is None else min(
-                    remaining, dl_left
-                )
-            try:
-                res = sample_until_converged(
-                    spec.model,
-                    data_p,
-                    backend=backend,
-                    chains=chains,
-                    block_size=block_size,
-                    max_blocks=max_blocks,
-                    min_blocks=min_blocks,
-                    rhat_target=rhat_target,
-                    ess_target=ess_i,
-                    seed=seed_i + r * _LANE_SEED_STRIDE,
-                    checkpoint_path=ckpt_p,
-                    resume_from=resume_p,
-                    metrics_path=_problem_path(metrics_path, pid, b),
-                    draw_store_path=store_p,
-                    health_check=health_check,
-                    reseed=reseed,
-                    time_budget_s=remaining,
-                    stream_diag=stream_diag,
-                    diag_lags=diag_lags,
-                    diag_components=diag_components,
-                    adaptive_blocks=False,
-                    trace=trace,
-                    **cfg_kwargs,
-                )
-                lane_restarts = r
+                # never attempted: back on the queue so the tail below
+                # reports it budget_exhausted with the rest
+                work.insert(0, (i, pid, data_p, p_budget))
+                budget_hit = True
                 break
-            except ChainHealthError as e:
-                if b == 1:
-                    # the supervisor owns the single-problem fault story
-                    raise
-                # per-problem fault domain on the sequential path too:
-                # quarantine the poisoned attempt's artifacts (the reason
-                # rides the forensic copy) and retry under a seed shifted
-                # far outside every neighbor's lattice
-                faults_seen = r + 1
-                fault_reason = str(e)
-                log.warning(
-                    "sequential fleet problem %s poisoned "
-                    "(restart %d/%d): %s", pid, r + 1, mr_i, e,
-                )
-                for path in (ckpt_p, store_p):
-                    if path and os.path.exists(path):
-                        quarantine_path(
-                            path,
-                            reason=f"{pid}: {_FAULT_POISONED}: {e}",
+            ckpt_p = _problem_path(checkpoint_path, pid, multi)
+            resume_p = _problem_path(resume_from, pid, multi)
+            store_p = _problem_path(draw_store_path, pid, multi)
+            if multi > 1:
+                if not (resume_p and os.path.exists(resume_p)):
+                    resume_p = None
+                if resume_p is None and ckpt_p and os.path.exists(ckpt_p):
+                    healthy, _reason = checkpoint_health(ckpt_p)
+                    if healthy:
+                        resume_p = ckpt_p
+                    else:
+                        quarantine_path(ckpt_p, reason=_reason)
+                if (
+                    resume_p is None
+                    and store_p
+                    and os.path.exists(store_p)
+                ):
+                    # cold start: a discarded attempt's draws must not mix
+                    # into this run's store (supervisor discipline, applied
+                    # per problem)
+                    quarantine_path(store_p)
+            seed_i = seed + i
+            if reseed is not None and multi > 1:
+                # reseeded restart: the single runner folds `reseed` only
+                # into RESUMED keys, so a cold-started problem would replay
+                # a neighbor's attempt-0 stream (seed+attempt+i aliases
+                # seed+(i+attempt) — the same lattice collision `_cold_key`
+                # fixes on the vmapped path); spreading the problems keeps
+                # every attempt bump inside a problem's private seed range
+                seed_i = seed + i * _RESEED_STRIDE
+            res = None
+            fault_reason = None
+            faults_seen = 0
+            lane_restarts = 0
+            stopped = None  # "sweep" | "deadline" budget stop mid-retries
+            for r in range(mr_i + 1):
+                # the budget clamp is re-derived per ATTEMPT, retries
+                # included: a ChainHealthError retry must never re-grant a
+                # tenant its original deadline window (or outrun the sweep
+                # budget) — the clocks keep running across recovery
+                now = time.perf_counter() - t0
+                remaining = None
+                if time_budget_s is not None:
+                    if time_budget_s - now <= 0:
+                        stopped = "sweep"
+                        break
+                    remaining = time_budget_s - now
+                if deadline_i is not None:
+                    # deadlines charge the CUMULATIVE sweep wall (restored
+                    # from the sidecar), not this attempt's
+                    dl_left = deadline_i - sweep_wall()
+                    if dl_left <= 0:
+                        stopped = "deadline"
+                        break
+                    remaining = dl_left if remaining is None else min(
+                        remaining, dl_left
+                    )
+                try:
+                    res = sample_until_converged(
+                        spec.model,
+                        data_p,
+                        backend=backend,
+                        chains=chains,
+                        block_size=block_size,
+                        max_blocks=max_blocks,
+                        min_blocks=min_blocks,
+                        rhat_target=rhat_target,
+                        ess_target=ess_i,
+                        seed=seed_i + r * _LANE_SEED_STRIDE,
+                        checkpoint_path=ckpt_p,
+                        resume_from=resume_p,
+                        metrics_path=_problem_path(metrics_path, pid, multi),
+                        draw_store_path=store_p,
+                        health_check=health_check,
+                        reseed=reseed,
+                        time_budget_s=remaining,
+                        stream_diag=stream_diag,
+                        diag_lags=diag_lags,
+                        diag_components=diag_components,
+                        adaptive_blocks=False,
+                        trace=trace,
+                        **cfg_kwargs,
+                    )
+                    lane_restarts = r
+                    break
+                except ChainHealthError as e:
+                    if multi == 1:
+                        # the supervisor owns the single-problem fault story
+                        raise
+                    # per-problem fault domain on the sequential path too:
+                    # quarantine the poisoned attempt's artifacts (the reason
+                    # rides the forensic copy) and retry under a seed shifted
+                    # far outside every neighbor's lattice
+                    faults_seen = r + 1
+                    fault_reason = str(e)
+                    log.warning(
+                        "sequential fleet problem %s poisoned "
+                        "(restart %d/%d): %s", pid, r + 1, mr_i, e,
+                    )
+                    for path in (ckpt_p, store_p):
+                        if path and os.path.exists(path):
+                            quarantine_path(
+                                path,
+                                reason=f"{pid}: {_FAULT_POISONED}: {e}",
+                            )
+                    resume_p = None
+                    # same observable as the vmapped path's lane reseed:
+                    # the collector's fleet_lane_reseeds_total / /status
+                    # last_reseeded must move on the hatch too
+                    if faults_seen <= mr_i and trace.enabled:
+                        trace.emit(
+                            "problem_reseeded",
+                            problem_id=pid,
+                            fault=_FAULT_POISONED,
+                            reason=fault_reason,
+                            lane_restarts=faults_seen,
+                            max_restarts=mr_i,
                         )
-                resume_p = None
-                # same observable as the vmapped path's lane reseed:
-                # the collector's fleet_lane_reseeds_total / /status
-                # last_reseeded must move on the hatch too
-                if faults_seen <= mr_i and trace.enabled:
-                    trace.emit(
-                        "problem_reseeded",
+            if res is None:
+                if stopped == "deadline":
+                    # the tenant's own clock ran out (possibly mid-retries):
+                    # a budget outcome, NOT a quarantine — faults_seen keeps
+                    # the honest count of restarts actually consumed.  Same
+                    # forensic parity as the vmapped path: a blown per-
+                    # tenant deadline dumps a postmortem bundle
+                    results.append(empty_result(
+                        pid, budget_exhausted=True,
+                        lane_restarts=faults_seen,
+                    ))
+                    recorder.record_anomaly(
+                        f"deadline:{pid}",
+                        trace,
+                        "problem_converged",
                         problem_id=pid,
-                        fault=_FAULT_POISONED,
-                        reason=fault_reason,
+                        status="budget_exhausted",
+                        deadline_s=deadline_i,
+                        deadline_headroom_s=round(
+                            deadline_i - sweep_wall(), 4
+                        ),
                         lane_restarts=faults_seen,
                         max_restarts=mr_i,
                     )
-        if res is None:
-            if stopped == "deadline":
-                # the tenant's own clock ran out (possibly mid-retries):
-                # a budget outcome, NOT a quarantine — faults_seen keeps
-                # the honest count of restarts actually consumed.  Same
-                # forensic parity as the vmapped path: a blown per-
-                # tenant deadline dumps a postmortem bundle
+                    continue
+                if stopped == "sweep":
+                    # the FLEET budget cut this problem off before its retry
+                    # budget was spent: the tail marks it (and every problem
+                    # after it) budget_exhausted — never failed
+                    work.insert(0, (i, pid, data_p, p_budget))
+                    budget_hit = True
+                    break
+                # retries exhausted on faults: terminal quarantine, with the
+                # true fault count (every attempt faulted: mr_i + 1)
                 results.append(empty_result(
-                    pid, budget_exhausted=True,
-                    lane_restarts=faults_seen,
+                    pid, failed=_FAULT_POISONED,
+                    failed_reason=fault_reason, lane_restarts=faults_seen,
                 ))
                 recorder.record_anomaly(
-                    f"deadline:{pid}",
+                    f"quarantine:{pid}",
                     trace,
-                    "problem_converged",
+                    "problem_quarantined",
                     problem_id=pid,
-                    status="budget_exhausted",
-                    deadline_s=deadline_i,
-                    deadline_headroom_s=round(
-                        deadline_i - sweep_wall(), 4
-                    ),
+                    status=f"failed:{_FAULT_POISONED}",
+                    fault=_FAULT_POISONED,
+                    reason=fault_reason,
                     lane_restarts=faults_seen,
                     max_restarts=mr_i,
                 )
                 continue
-            if stopped == "sweep":
-                # the FLEET budget cut this problem off before its retry
-                # budget was spent: the tail marks it (and every problem
-                # after it) budget_exhausted — never failed
-                budget_hit = True
-                break
-            # retries exhausted on faults: terminal quarantine, with the
-            # true fault count (every attempt faulted: mr_i + 1)
-            results.append(empty_result(
-                pid, failed=_FAULT_POISONED,
-                failed_reason=fault_reason, lane_restarts=faults_seen,
+            grad_evals = int(sum(
+                r.get("block_grad_evals", 0)
+                for r in res.history
+                if r.get("event") == "block"
             ))
-            recorder.record_anomaly(
-                f"quarantine:{pid}",
-                trace,
-                "problem_quarantined",
-                problem_id=pid,
-                status=f"failed:{_FAULT_POISONED}",
-                fault=_FAULT_POISONED,
-                reason=fault_reason,
-                lane_restarts=faults_seen,
-                max_restarts=mr_i,
+            total_grads += grad_evals
+            last = res.history[-1] if res.history else {}
+            n_blocks = len(
+                [r for r in res.history if r.get("event") == "block"]
             )
-            continue
-        grad_evals = int(sum(
-            r.get("block_grad_evals", 0)
-            for r in res.history
-            if r.get("event") == "block"
-        ))
-        total_grads += grad_evals
-        last = res.history[-1] if res.history else {}
-        n_blocks = len(
-            [r for r in res.history if r.get("event") == "block"]
-        )
-        results.append(
-            FleetProblemResult(
-                pid,
-                res.draws_flat,
-                res.flat_model,
-                converged=res.converged,
-                # max_blocks exhaustion IS a budget outcome (the vmapped
-                # path's taxonomy) — the single runner only flags TIME
-                # budget trips itself
-                budget_exhausted=res.budget_exhausted or (
-                    not res.converged and n_blocks >= max_blocks
-                ),
-                blocks=n_blocks,
-                grad_evals=grad_evals,
-                num_divergent=int(np.sum(
-                    res.sample_stats.get("num_divergent", 0)
-                )),
-                min_ess=last.get("full_min_ess", last.get("min_ess")),
-                max_rhat=last.get("full_max_rhat", last.get("max_rhat")),
-                history=res.history,
-                _constrain_cache=constrain_cache,
-                lane_restarts=lane_restarts,
+            results.append(
+                FleetProblemResult(
+                    pid,
+                    res.draws_flat,
+                    res.flat_model,
+                    converged=res.converged,
+                    # max_blocks exhaustion IS a budget outcome (the vmapped
+                    # path's taxonomy) — the single runner only flags TIME
+                    # budget trips itself
+                    budget_exhausted=res.budget_exhausted or (
+                        not res.converged and n_blocks >= max_blocks
+                    ),
+                    blocks=n_blocks,
+                    grad_evals=grad_evals,
+                    num_divergent=int(np.sum(
+                        res.sample_stats.get("num_divergent", 0)
+                    )),
+                    min_ess=last.get("full_min_ess", last.get("min_ess")),
+                    max_rhat=last.get("full_max_rhat", last.get("max_rhat")),
+                    history=res.history,
+                    _constrain_cache=constrain_cache,
+                    lane_restarts=lane_restarts,
+                )
             )
-        )
+    except BaseException:
+        # hatch twin of the vmapped requeue-on-crash: EVERY drained feed
+        # submission (completed, in flight, or queued) goes back to the
+        # feed in arrival order, so the supervised retry re-drains them
+        # with the SAME global indices (same seed + i streams — no
+        # cross-problem collision) and re-reports completed ones off
+        # their per-problem checkpoints; spec problems need no requeue
+        # (the spec is re-supplied on every attempt)
+        if feed is not None and drained_feed:
+            log.warning(
+                "requeueing %d feed submission(s) after abnormal "
+                "sequential-fleet exit", len(drained_feed),
+            )
+            feed.requeue(drained_feed)
+        raise
     # the sweep RETURNED (converged, exhausted, or budget-stopped — all
     # terminal): the clock has served its purpose, and leaving it would
     # pre-charge the next logical sweep in this workdir
@@ -2297,13 +3256,13 @@ def _sample_fleet_sequential(
             os.unlink(sweep_sidecar)
         except OSError:
             pass
-    if len(results) < b:
-        # budget stop mid-sweep: problems never attempted still appear in
-        # the result (empty draws, budget_exhausted) — the fleet path
-        # reports every problem, and converged_fraction must count the
-        # unserved ones, not silently shrink its denominator
-        for pid in spec.problem_ids[len(results):]:
-            results.append(empty_result(pid, budget_exhausted=True))
+    # budget stop mid-sweep: problems never attempted (spec tail and any
+    # already-drained submissions) still appear in the result (empty
+    # draws, budget_exhausted) — the fleet path reports every problem,
+    # and converged_fraction must count the unserved ones, not silently
+    # shrink its denominator
+    for _i, pid, _d, _bud in work:
+        results.append(empty_result(pid, budget_exhausted=True))
     return FleetResult(
         results,
         wall_s=time.perf_counter() - t0,

@@ -405,6 +405,16 @@ class TraceCollector:
             f"{p}_fleet_compactions_total",
             "fleet batch compaction/refill events",
         )
+        self.fleet_admissions = r.counter(
+            f"{p}_fleet_admissions_total",
+            "queued problems admitted into the fleet batch in place "
+            "(slot-scheduler swaps and legacy top-ups)",
+        )
+        self.fleet_slot_recycles = r.counter(
+            f"{p}_fleet_slot_recycles_total",
+            "terminal lanes handed to queued problems without reshaping "
+            "the compiled batch",
+        )
         self.fleet_lane_reseeds = r.counter(
             f"{p}_fleet_lane_reseeds_total",
             "fleet lanes cold-restarted in place after a per-lane fault "
@@ -488,6 +498,11 @@ class TraceCollector:
         self.g_fleet_occupancy = r.gauge(
             f"{p}_fleet_occupancy",
             "active fraction of the fleet batch (compaction trigger)",
+        )
+        self.g_fleet_queue_depth = r.gauge(
+            f"{p}_fleet_queue_depth",
+            "problems waiting in the fleet admission queue (spec overflow "
+            "+ streamed FleetFeed submissions)",
         )
         self.g_fleet_converged = r.gauge(
             f"{p}_fleet_problems_converged",
@@ -710,15 +725,41 @@ class TraceCollector:
         ):
             if rec.get(field) is not None:
                 g.set(float(rec[field]))
+        if rec.get("queue_depth") is not None:
+            self.g_fleet_queue_depth.set(float(rec["queue_depth"]))
         fleet = {
             k: rec[k]
-            for k in ("block", "batch", "active", "occupancy")
+            for k in ("block", "batch", "active", "occupancy",
+                      "queue_depth")
             if rec.get(k) is not None
         }
         with self._lock:
             self._status["fleet"].update(fleet)
         self._set_status(phase="sample", block=rec.get("block"))
         self._sample_device_memory()
+
+    def _on_problem_admitted(self, rec: Dict[str, Any]) -> None:
+        """A queued problem entered the batch IN PLACE (slot scheduler /
+        legacy top-up): count the admission, track the queue it drained,
+        and surface the latest tenant admitted on /status."""
+        self.fleet_admissions.inc()
+        if rec.get("queue_depth") is not None:
+            self.g_fleet_queue_depth.set(float(rec["queue_depth"]))
+        admitted = {
+            k: rec[k]
+            for k in ("problem_id", "slot", "block", "queue_depth",
+                      "warmstart", "warmup_draws_saved", "source")
+            if rec.get(k) is not None
+        }
+        with self._lock:
+            fl = self._status["fleet"]
+            fl["last_admitted"] = admitted
+            fl["admissions"] = int(self.fleet_admissions.value())
+            if rec.get("queue_depth") is not None:
+                fl["queue_depth"] = rec["queue_depth"]
+
+    def _on_slot_recycled(self, rec: Dict[str, Any]) -> None:
+        self.fleet_slot_recycles.inc()
 
     def _set_slo_gauges(self, rec: Dict[str, Any]) -> None:
         """Per-tenant SLO rollups from a fleet ``problem_*`` event:
@@ -829,8 +870,12 @@ class TraceCollector:
 
     def _on_fleet_compact(self, rec: Dict[str, Any]) -> None:
         self.fleet_compactions.inc()
+        if rec.get("pending") is not None:
+            self.g_fleet_queue_depth.set(float(rec["pending"]))
         with self._lock:
             self._status["fleet"]["pending"] = rec.get("pending")
+            if rec.get("pending") is not None:
+                self._status["fleet"]["queue_depth"] = rec["pending"]
 
     def _on_checkpoint(self, rec: Dict[str, Any]) -> None:
         self.checkpoints.inc()

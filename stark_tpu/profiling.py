@@ -57,6 +57,7 @@ __all__ = [
     "SPAN_KINDS",
     "SpanRecorder",
     "deregister_probe",
+    "get_probe",
     "maybe_record_spans",
     "probe_counts",
     "record_spans",
@@ -584,6 +585,15 @@ def register_probe(probe: DispatchProbe,
 def deregister_probe(name: str) -> None:
     with _PROBES_LOCK:
         _PROBES.pop(name, None)
+
+
+def get_probe(name: str) -> Optional[DispatchProbe]:
+    """The live probe registered under ``name`` (None when absent) — how
+    an instrumentable entry point (the fleet's batched block scan wraps
+    its dispatch when ``"fleet_block_scan"`` is registered) discovers a
+    harness's probe without plumbing the object through every layer."""
+    with _PROBES_LOCK:
+        return _PROBES.get(name)
 
 
 def probe_counts(drain: bool = True) -> Dict[str, int]:

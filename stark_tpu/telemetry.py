@@ -109,10 +109,15 @@ AUX_EVENT_TYPES = frozenset({"progress", "adapt", "budget", "collect",
 #: ``problem_quarantined`` — a problem exhausted its per-problem restart
 #: budget (or its persisted draws were corrupt on resume) and was masked
 #: out terminally, its artifacts quarantined with the reason — the fleet
-#: completes DEGRADED around it
+#: completes DEGRADED around it; ``slot_recycled`` — a terminal problem's
+#: batch lane was handed to a queued problem IN PLACE (the slot-scheduler
+#: or legacy top-up admission path — the compiled batch shape never
+#: changes); ``problem_admitted`` — a queued problem entered the batch
+#: through an in-place admission (slot/queue-depth/warm-start accounting)
 FLEET_EVENT_TYPES = frozenset({"fleet_block", "problem_converged",
                                "fleet_compact", "problem_reseeded",
-                               "problem_quarantined"})
+                               "problem_quarantined", "slot_recycled",
+                               "problem_admitted"})
 
 #: profiling event types (stark_tpu.profiling): ``span`` — one
 #: attributed slice of the run timeline (``kind`` in
@@ -962,9 +967,14 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                    "problems_budget_exhausted", "problems_quarantined",
                    "lane_reseeds", "degraded",
                    "lost_problems",
-                   "compactions"} | {},          # fleet-sampling events
+                   "compactions",
+                   "admissions", "slot_recycles", "queue_depth_last",
+                   "warmstarted",
+                   "warmup_draws_saved"} | {},   # fleet-sampling events
                                                  # (stark_tpu.fleet), when
-                                                 # the run emitted them
+                                                 # the run emitted them —
+                                                 # the admission keys only
+                                                 # on streaming/slot runs
          "nutssched": {"ragged", "occupancy_last", "occupancy_min",
                        "occupancy_mean", "blocks",
                        "sched_iters_total"} | {},  # ragged-NUTS lane
@@ -1053,6 +1063,8 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                 fleet["grad_evals"] = (
                     fleet.get("grad_evals", 0) + int(e["block_grad_evals"])
                 )
+            if e.get("queue_depth") is not None:
+                fleet["queue_depth_last"] = int(e["queue_depth"])
         elif ev == "problem_converged":
             key = (
                 "problems_converged"
@@ -1071,12 +1083,31 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
             )
         elif ev == "fleet_compact":
             fleet["compactions"] = fleet.get("compactions", 0) + 1
+            if e.get("pending") is not None:
+                fleet["queue_depth_last"] = int(e["pending"])
+        elif ev == "slot_recycled":
+            fleet["slot_recycles"] = fleet.get("slot_recycles", 0) + 1
+        elif ev == "problem_admitted":
+            fleet["admissions"] = fleet.get("admissions", 0) + 1
+            if e.get("queue_depth") is not None:
+                fleet["queue_depth_last"] = int(e["queue_depth"])
+            if e.get("warmstart"):
+                fleet["warmstarted"] = fleet.get("warmstarted", 0) + 1
+            if e.get("warmup_draws_saved"):
+                fleet["warmup_draws_saved"] = (
+                    fleet.get("warmup_draws_saved", 0)
+                    + int(e["warmup_draws_saved"])
+                )
         elif ev == "run_start" and e.get("problems") is not None:
             fleet["problems"] = e["problems"]
         elif ev == "run_end" and e.get("degraded") is not None and (
             fleet or e.get("problems") is not None
         ):
             fleet["degraded"] = bool(e["degraded"])
+            if e.get("problems") is not None:
+                # the FINAL problem count: a streamed (FleetFeed) run
+                # ends with more problems than run_start announced
+                fleet["problems"] = e["problems"]
         if ev == "sample_block":
             for k in ("t_host_hidden_s", "device_idle_s", "t_wait_s"):
                 if e.get(k) is not None:

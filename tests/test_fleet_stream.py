@@ -1,0 +1,570 @@
+"""Zero-recompile streaming fleet (PR 13): fixed-capacity lane slots,
+in-place admission, and warm-start adaptation transfer.
+
+The contracts under test:
+
+* **Knob-off bit-identity** — with ``STARK_FLEET_SLOTS`` unset the
+  compaction path is untouched: per-problem draws, statuses, and
+  compaction counts match the pre-slot behavior, and checkpoints carry
+  none of the streaming keys.
+* **Zero-recompile gate** (the tier-1 twin of the ``fleet:stream:*``
+  bench leg) — a churn-heavy slotted fleet (B=8 through a 3-wide batch:
+  >=3 recycle waves) records EXACTLY ONE batched-scan compile
+  (`profiling.DispatchProbe` counts every executed dispatch, the
+  ``fleet_block_scan`` compile spans count the specializations) while
+  the legacy compaction path records >=2.
+* **Slot/admission-order independence** — a slotted problem's draws are
+  bit-identical to the legacy path's and to its unbatched run,
+  whichever slot it lands in.
+* **Streaming admission end-to-end** — problems submitted through a
+  `FleetFeed` WHILE the fleet runs (from another thread) complete with
+  per-problem budget semantics intact; the checkpointed queue survives
+  crash-resume; the sequential ``STARK_FLEET=0`` hatch honors the same
+  API and seed discipline.
+* **Legacy top-up bugfix** — a batch riding at/above
+  ``refill_occupancy`` with masked slots free no longer strands its
+  queue (documented behavior change): queued problems are admitted in
+  place, draws still bit-identical to their unbatched runs.
+* **Warm-start** (``STARK_FLEET_WARMSTART``) — admitted problems seed
+  from the donor pool and shorten warmup, every convergence still
+  passes the full validation gate, and the knob is inert without
+  ``STARK_FLEET_SLOTS``.
+* **Observability** — ``problem_admitted`` / ``slot_recycled`` events
+  are schema-registered, roll up in ``summarize_trace``, feed the
+  queue-depth/admissions metrics + ``/status`` ``last_admitted``, and
+  ``tools/trace_report.py`` renders the admission timeline (n/a-safe
+  on traces that predate it).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from stark_tpu import profiling, telemetry
+from stark_tpu.checkpoint import load_checkpoint
+from stark_tpu.fleet import (
+    FleetFeed,
+    FleetSpec,
+    ProblemBudget,
+    sample_fleet,
+)
+from stark_tpu.models.eight_schools import SIGMA, Y, EightSchools
+from stark_tpu.telemetry import ALL_EVENT_TYPES, RunTrace, read_trace, \
+    summarize_trace
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: one model instance for the module: the fleet's compiled-parts cache is
+#: keyed on the model object, so tests sharing batch widths reuse the
+#: jitted warmup/block parts (the model is stateless — sharing is safe)
+_MODEL = EightSchools()
+
+
+def _ds(seed):
+    r = np.random.default_rng(seed)
+    y, sig = np.asarray(Y), np.asarray(SIGMA)
+    return {"y": (y + r.normal(0, 2.0, y.shape)).astype(np.float32),
+            "sigma": sig}
+
+
+def _spec(n=8, budgets=None):
+    return FleetSpec.from_problems(
+        _MODEL, [_ds(i) for i in range(n)], budgets=budgets,
+    )
+
+
+# staggered gates: the easy problems converge early and churn the batch
+_KW = dict(
+    chains=2, block_size=20, max_blocks=14, min_blocks=2, num_warmup=100,
+    ess_target=40.0, rhat_target=1.3, seed=0, kernel="hmc",
+    num_leapfrog=12,
+)
+
+
+@pytest.fixture(scope="module")
+def churn_runs(tmp_path_factory):
+    """One churn-heavy run per scheduler over the SAME 8 problems
+    through a 3-wide batch, plus the slotted run's trace — shared by
+    the identity, compile-count, and observability tests."""
+    td = tmp_path_factory.mktemp("stream")
+    spec = _spec(8)
+    legacy = sample_fleet(spec, max_batch=3, refill_occupancy=1.0, **_KW)
+    trace_path = str(td / "slots_trace.jsonl")
+    probe = profiling.register_probe(
+        profiling.DispatchProbe(label="fleet_block_scan")
+    )
+    try:
+        slots = sample_fleet(
+            spec, max_batch=3, slots=True, trace=RunTrace(trace_path),
+            checkpoint_path=str(td / "slots.ckpt.npz"), **_KW,
+        )
+        dispatches = probe.snapshot()
+    finally:
+        profiling.deregister_probe("fleet_block_scan")
+    return spec, legacy, slots, trace_path, dispatches, td
+
+
+def test_zero_recompile_gate(churn_runs):
+    """THE acceptance gate: >=3 recycle waves of churn, and the slotted
+    fleet's batched scan specialized exactly once while the legacy
+    compaction path re-specialized — evidenced three ways (result
+    counter, DispatchProbe executed-dispatch count vs compile count,
+    and the fleet_block_scan compile spans in the trace)."""
+    _spec_, legacy, slots, trace_path, dispatches, _td = churn_runs
+    assert slots.slot_recycles >= 3, "not churn-heavy enough to gate on"
+    assert slots.block_scan_compiles == 1
+    assert slots.compactions == 0
+    assert legacy.block_scan_compiles >= 2
+    assert legacy.compactions >= 1
+    # the probe counted every EXECUTED dispatch: far more dispatches
+    # than specializations is exactly the zero-recompile shape
+    assert dispatches == slots.blocks_dispatched
+    assert dispatches > slots.block_scan_compiles
+    spans = [
+        e for e in read_trace(trace_path)
+        if e["event"] == "compile" and e.get("stage") == "fleet_block_scan"
+    ]
+    assert len(spans) == 1
+    assert spans[0]["batch"] == 3
+
+
+def test_slots_draws_bit_identical(churn_runs):
+    """Slot assignment and admission order change NOTHING about a
+    problem's draws: slotted == legacy == unbatched, status for
+    status."""
+    spec, legacy, slots, _tp, _d, _td = churn_runs
+    for a, b in zip(legacy.problems, slots.problems):
+        assert a.status == b.status
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+    # a recycled-slot problem against its own unbatched run
+    admitted = [p for p in slots.problems if p.problem_id == "p0005"]
+    single = sample_fleet(
+        FleetSpec.from_problems(_MODEL, [_ds(5)]),
+        **{**_KW, "seed": _KW["seed"] + 5},
+    )
+    np.testing.assert_array_equal(
+        admitted[0].draws_flat, single.problems[0].draws_flat
+    )
+
+
+def test_slots_checkpoint_keeps_legacy_schema_knob_off(churn_runs, tmp_path):
+    """Knob-off checkpoints carry NONE of the streaming keys (byte-level
+    schema compatibility); the slotted run's checkpoint marks itself."""
+    _spec_, _legacy, _slots, _tp, _d, td = churn_runs
+    _arrays, meta = load_checkpoint(str(td / "slots.ckpt.npz"))
+    assert meta.get("slots") is True
+    spec = _spec(3)
+    off_path = str(tmp_path / "off.ckpt.npz")
+    sample_fleet(spec, checkpoint_path=off_path, **_KW)
+    _arrays, meta_off = load_checkpoint(off_path)
+    for key in ("slots", "submitted", "donor_pool"):
+        assert key not in meta_off
+    for p in meta_off["problems"].values():
+        assert "warmstarted" not in p and "submitted" not in p
+
+
+def test_admission_events_schema_and_summary(churn_runs):
+    """problem_admitted / slot_recycled are registered writer events,
+    and summarize_trace rolls the admission story into the fleet
+    section."""
+    _spec_, _legacy, slots, trace_path, _d, _td = churn_runs
+    events = read_trace(trace_path)
+    names = {e["event"] for e in events}
+    assert {"problem_admitted", "slot_recycled"} <= names
+    assert names <= ALL_EVENT_TYPES | {"progress"}
+    admitted = [e for e in events if e["event"] == "problem_admitted"]
+    assert len(admitted) == slots.admissions
+    for e in admitted:
+        assert e["slot"] in (0, 1, 2)
+        assert e["source"] == "spec"
+        assert e["warmstart"] is False
+    s = summarize_trace(events)
+    assert s["fleet"]["admissions"] == slots.admissions
+    assert s["fleet"]["slot_recycles"] == slots.slot_recycles
+    assert s["fleet"]["queue_depth_last"] == 0
+    # fleet_block events carry the queue depth on slotted runs only
+    fb = [e for e in events if e["event"] == "fleet_block"]
+    assert all("queue_depth" in e for e in fb)
+
+
+def test_trace_report_renders_admission_timeline(churn_runs):
+    """tools/trace_report.py renders the admission timeline on a slotted
+    trace and stays n/a-safe (no admission table, no crash) on a
+    pre-PR-13 trace shape."""
+    _spec_, _legacy, _slots, trace_path, _d, td = churn_runs
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+         trace_path],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "admissions" in out.stdout
+    assert "warm-start" in out.stdout
+    # old-shape trace: fleet events without any admission fields
+    old = str(td / "old_trace.jsonl")
+    base = {"schema": 1, "ts": 0.0, "wall_s": 0.0, "run": 0}
+    with open(old, "w") as f:
+        for rec in (
+            {**base, "event": "run_start", "entry": "sample_fleet",
+             "problems": 2, "chains": 2},
+            {**base, "event": "fleet_block", "block": 1, "batch": 2,
+             "active": 2, "occupancy": 1.0, "dur_s": 0.1},
+            {**base, "event": "run_end", "dur_s": 0.2, "converged": True},
+        ):
+            f.write(json.dumps(rec) + "\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+         old],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "admitted" not in out.stdout
+
+
+def test_metrics_collector_admission_events():
+    """The /metrics + /status collector consumes the new events: the
+    admissions counter and queue-depth gauge move, /status gains
+    queue_depth + last_admitted."""
+    from stark_tpu.metrics import TraceCollector
+
+    c = TraceCollector()
+    base = {"schema": 1, "ts": 0.0, "wall_s": 0.0, "run": 1}
+    c.on_event({**base, "event": "run_start", "entry": "sample_fleet",
+                "problems": 4, "chains": 2})
+    c.on_event({**base, "event": "fleet_block", "block": 1, "batch": 2,
+                "active": 2, "occupancy": 1.0, "queue_depth": 2,
+                "block_len": 20, "chains": 2, "dur_s": 0.1})
+    c.on_event({**base, "event": "slot_recycled", "slot": 1,
+                "from_problem": "p0", "from_status": "converged",
+                "to_problem": "p2"})
+    c.on_event({**base, "event": "problem_admitted", "problem_id": "p2",
+                "slot": 1, "block": 1, "queue_depth": 1,
+                "warmstart": True, "warmup_draws_saved": 50,
+                "source": "feed"})
+    assert c.fleet_admissions.value() == 1.0
+    assert c.fleet_slot_recycles.value() == 1.0
+    assert c.g_fleet_queue_depth.value() == 1.0
+    st = c.status()
+    assert st["fleet"]["queue_depth"] == 1
+    assert st["fleet"]["last_admitted"]["problem_id"] == "p2"
+    assert st["fleet"]["last_admitted"]["warmstart"] is True
+    rendered = c.registry.render()
+    assert "stark_fleet_admissions_total" in rendered
+    assert "stark_fleet_queue_depth" in rendered
+    assert "stark_fleet_slot_recycles_total" in rendered
+
+
+def test_streaming_submission_mid_run():
+    """The headline streaming contract: a problem submitted from another
+    thread WHILE the fleet runs is admitted, honors its own budget, and
+    reaches draws bit-identical to its unbatched run (seed + arrival
+    index).  The feed keeps the loop alive until closed."""
+    spec = _spec(2)
+    feed = FleetFeed()
+    late = _ds(2)
+
+    def submitter():
+        feed.submit(late, budget=ProblemBudget(ess_target=40.0))
+        feed.close()
+
+    t = threading.Timer(0.5, submitter)
+    t.start()
+    try:
+        res = sample_fleet(spec, max_batch=2, slots=True, feed=feed, **_KW)
+    finally:
+        t.join()
+    assert [p.problem_id for p in res.problems] == ["p0000", "p0001",
+                                                    "s0000"]
+    sub = res["s0000"]
+    assert sub.status in ("converged", "budget_exhausted")
+    single = sample_fleet(
+        FleetSpec.from_problems(_MODEL, [late]),
+        **{**_KW, "seed": _KW["seed"] + 2},
+    )
+    np.testing.assert_array_equal(
+        sub.draws_flat, single.problems[0].draws_flat
+    )
+
+
+def test_feed_on_sequential_hatch(monkeypatch):
+    """STARK_FLEET=0 honors the same streaming API: submissions run
+    through the single-problem runner with the same seed discipline, so
+    the hatch's draws match the vmapped path's."""
+    monkeypatch.setenv("STARK_FLEET", "0")
+    feed = FleetFeed()
+    feed.submit(_ds(2))
+    feed.close()
+    seq = sample_fleet(_spec(2), feed=feed, **_KW)
+    assert [p.problem_id for p in seq.problems] == ["p0000", "p0001",
+                                                    "s0000"]
+    monkeypatch.delenv("STARK_FLEET")
+    feed2 = FleetFeed()
+    feed2.submit(_ds(2))
+    feed2.close()
+    vm = sample_fleet(_spec(2), slots=True, feed=feed2, **_KW)
+    for a, b in zip(seq.problems, vm.problems):
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+
+
+def test_feed_rejects_bad_submissions():
+    """A malformed submission (wrong shapes / duplicate id) is rejected
+    with the serving loop intact — the good work still completes."""
+    feed = FleetFeed()
+    feed.submit({"y": np.zeros(3, np.float32)}, problem_id="bad_shape")
+    feed.submit(_ds(2), problem_id="p0000")  # duplicate id
+    hostile = _ds(2)
+    hostile["y"] = (hostile["y"] * np.float32("nan")).astype(np.float32)
+    feed.submit(hostile, problem_id="nonfinite")  # would poison its lane
+    feed.submit(_ds(2), problem_id="ok")
+    feed.close()
+    res = sample_fleet(_spec(2), slots=True, feed=feed, **_KW)
+    assert [p.problem_id for p in res.problems] == ["p0000", "p0001", "ok"]
+    with pytest.raises(RuntimeError, match="closed"):
+        feed.submit(_ds(3))
+
+
+def test_checkpointed_queue_resume(tmp_path):
+    """Submissions consumed before a crash are rebuilt from the fleet
+    checkpoint on resume — same admission order, same draws — without
+    the caller re-submitting (the durable-queue contract; the chaos
+    twin drills the supervised path)."""
+    spec = _spec(2)
+
+    def make_feed():
+        f = FleetFeed()
+        f.submit(_ds(2))
+        f.submit(_ds(3), budget=ProblemBudget(ess_target=40.0))
+        f.close()
+        return f
+
+    kw = dict(_KW, max_batch=2, slots=True)
+    ref = sample_fleet(spec, feed=make_feed(), **kw)
+    ckpt = str(tmp_path / "fleet.ckpt.npz")
+    # one-block run: the checkpoint persists with both submissions queued
+    sample_fleet(spec, feed=make_feed(), checkpoint_path=ckpt,
+                 **{**kw, "max_blocks": 1})
+    _arrays, meta = load_checkpoint(ckpt)
+    assert [s["pid"] for s in meta["submitted"]] == ["s0000", "s0001"]
+    assert meta["submitted"][1]["budget"]["ess_target"] == 40.0
+    # resume with NO feed: the queue comes back from the checkpoint
+    closed = FleetFeed()
+    closed.close()
+    res = sample_fleet(spec, resume_from=ckpt, feed=closed, **kw)
+    assert [p.problem_id for p in res.problems] == [
+        p.problem_id for p in ref.problems
+    ]
+    for p in res.problems:
+        assert p.draws_flat.size > 0 or p.status != "incomplete"
+
+
+def test_legacy_topup_drains_queue(tmp_path):
+    """The PR 13 bugfix, regression-pinned: occupancy at/above
+    refill_occupancy with pending work and a masked slot free now tops
+    the batch up in place (previously the queue starved until the whole
+    batch finished).  Draws stay bit-identical to unbatched runs."""
+    spec = FleetSpec.from_problems(
+        _MODEL, [_ds(0), _ds(1), _ds(2)],
+        budgets=[ProblemBudget(ess_target=5.0),
+                 ProblemBudget(ess_target=200.0), None],
+    )
+    metrics = str(tmp_path / "m.jsonl")
+    res = sample_fleet(spec, max_batch=2, refill_occupancy=0.4,
+                       metrics_path=metrics, **_KW)
+    assert res.admissions >= 1, "top-up never fired"
+    with open(metrics) as f:
+        lines = [json.loads(l) for l in f if l.strip()]
+    admitted = [r for r in lines if r.get("event") == "problem_admitted"]
+    recycled = [r for r in lines if r.get("event") == "slot_recycled"]
+    assert admitted and recycled
+    assert admitted[0]["problem_id"] == "p0002"
+    single = sample_fleet(
+        FleetSpec.from_problems(_MODEL, [_ds(2)]),
+        **{**_KW, "seed": _KW["seed"] + 2},
+    )
+    np.testing.assert_array_equal(
+        res.problems[2].draws_flat, single.problems[0].draws_flat
+    )
+
+
+def test_warmstart_transfers_and_still_validates(monkeypatch, tmp_path):
+    """STARK_FLEET_WARMSTART=1: admitted problems seed from the donor
+    pool (warmup shortened, warmup_draws_saved recorded) and every
+    warm-started convergence still carries the full-validation
+    diagnostics; without STARK_FLEET_SLOTS the knob is inert."""
+    spec = _spec(6, budgets=[
+        ProblemBudget(ess_target=5.0), ProblemBudget(ess_target=5.0),
+        None, None, None, None,
+    ])
+    monkeypatch.setenv("STARK_FLEET_SLOTS", "1")
+    monkeypatch.setenv("STARK_FLEET_WARMSTART", "1")
+    metrics = str(tmp_path / "m.jsonl")
+    res = sample_fleet(spec, max_batch=2, metrics_path=metrics, **_KW)
+    warm = [p for p in res.problems if p.warmstarted]
+    assert warm, "no admission was warm-started"
+    assert res.warmup_draws_saved == sum(
+        p.warmup_draws_saved for p in warm
+    )
+    for p in warm:
+        assert p.warmup_draws_saved == _KW["num_warmup"] - 50
+        assert np.isfinite(p.draws_flat).all()
+        if p.converged:
+            # converged THROUGH the full split-R-hat/ESS pass: the
+            # validated diagnostics are recorded on the result
+            assert p.max_rhat is not None and p.max_rhat < 1.3
+            assert p.min_ess is not None and p.min_ess > 40.0
+    with open(metrics) as f:
+        lines = [json.loads(l) for l in f if l.strip()]
+    done = [r for r in lines if r.get("event") == "problem_done"
+            and r.get("warmstart")]
+    assert done and all(r["warmup_draws_saved"] > 0 for r in done)
+    # warm-start without slots: inert (legacy path untouched)
+    monkeypatch.delenv("STARK_FLEET_SLOTS")
+    ref = sample_fleet(_spec(3), **_KW)
+    monkeypatch.delenv("STARK_FLEET_WARMSTART")
+    off = sample_fleet(_spec(3), **_KW)
+    for a, b in zip(ref.problems, off.problems):
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+        assert not a.warmstarted and a.warmup_draws_saved == 0
+
+
+def test_warmstart_pool_rejects_nonfinite():
+    """DonorPool unit contract: non-finite donations are rejected at
+    add AND read time — poisoned adaptation state cannot seed a lane
+    (the chaos fleet_warmstart_poison twin drills it end-to-end)."""
+    from stark_tpu.fleet import DonorPool
+
+    pool = DonorPool()
+    assert pool.summary("m") is None
+    assert not pool.add("m", np.array([np.nan, 0.1]), np.ones((2, 3)))
+    assert pool.summary("m") is None
+    assert pool.add("m", np.array([0.1, 0.2]), np.ones((2, 3)))
+    step, im, n = pool.summary("m")
+    assert n == 1 and np.isfinite(step) and np.all(np.isfinite(im))
+    # round-trips through the checkpoint representation
+    pool2 = DonorPool()
+    pool2.load_state(pool.state_dict())
+    step2, im2, n2 = pool2.summary("m")
+    assert (step2, n2) == (step, n)
+    np.testing.assert_allclose(im2, im)
+
+
+def test_hatch_crash_retry_replays_submissions(tmp_path, monkeypatch):
+    """Sequential-hatch crash containment for the feed: an abnormal
+    exit requeues EVERY drained submission in arrival order, so the
+    supervised retry reassigns the SAME global indices (no seed
+    collision between submissions) and reports every accepted
+    submission — streams verified prefix-identical to an uninjected
+    sweep (completed problems may legally gain a post-resume block;
+    that is the hatch's historical resume behavior, spec problems
+    included)."""
+    from stark_tpu import faults
+    from stark_tpu.fleet import supervised_sample_fleet
+
+    monkeypatch.setenv("STARK_FLEET", "0")
+    spec = _spec(1)
+
+    def make_feed():
+        f = FleetFeed()
+        f.submit(_ds(1), problem_id="sA")
+        f.submit(_ds(2), problem_id="sB")
+        f.close()
+        return f
+
+    ref = sample_fleet(spec, feed=make_feed(), **_KW)
+    faults.configure("runner.block.post=crash*1@6")
+    try:
+        res = supervised_sample_fleet(
+            spec, workdir=str(tmp_path), max_restarts=3,
+            reseed_on_restart=False, feed=make_feed(), **_KW,
+        )
+    finally:
+        faults.reset()
+    assert [p.problem_id for p in res.problems] == [
+        p.problem_id for p in ref.problems
+    ]
+    for a, b in zip(ref.problems, res.problems):
+        n = min(a.draws_flat.shape[1], b.draws_flat.shape[1])
+        np.testing.assert_array_equal(
+            a.draws_flat[:, :n], b.draws_flat[:, :n]
+        )
+
+
+def test_unckeckpointed_submission_requeued_on_crash():
+    """The drain->checkpoint window cannot LOSE a submission: with no
+    durable checkpoint covering it, an abnormal exit puts the consumed
+    submission back on the feed for the retry to re-drain."""
+    from stark_tpu import faults
+
+    spec = _spec(2)
+    feed = FleetFeed()
+    feed.submit(_ds(2), problem_id="inflight")
+    feed.close()
+    faults.configure("fleet.block.post=crash*1")
+    try:
+        with pytest.raises(Exception, match="fleet.block.post"):
+            sample_fleet(spec, max_batch=2, slots=True, feed=feed, **_KW)
+    finally:
+        faults.reset()
+    assert [p for p, _d, _b in feed.drain()] == ["inflight"]
+
+
+def test_slots_grow_to_capacity(tmp_path):
+    """A slotted fleet whose spec is SMALLER than max_batch grows toward
+    the configured capacity when streamed work queues (one
+    specialization per growth wave, pinned again at capacity) instead
+    of serving below capacity forever; terminal submissions' data drops
+    out of later checkpoints (O(live problems), not O(submissions))."""
+    spec = _spec(2)
+    feed = FleetFeed()
+    for i in range(2, 6):
+        feed.submit(_ds(i))
+    feed.close()
+    ckpt = str(tmp_path / "grow.ckpt.npz")
+    res = sample_fleet(spec, max_batch=4, slots=True, feed=feed,
+                       checkpoint_path=ckpt, **_KW)
+    assert len(res.problems) == 6
+    for p in res.problems:
+        assert p.status in ("converged", "budget_exhausted")
+    # grew 2 -> 4: exactly one growth specialization on top of the first
+    assert res.block_scan_compiles == 2
+    arrays, meta = load_checkpoint(ckpt)
+    # every submission is terminal at the final checkpoint: meta keeps
+    # the admission order, the data leaves are gone
+    assert [s["pid"] for s in meta["submitted"]] == [
+        "s0000", "s0001", "s0002", "s0003"
+    ]
+    assert all(s["data"] is False for s in meta["submitted"])
+    assert not any(k.startswith("feed_") for k in arrays)
+
+
+def test_serving_loop_waits_for_feed():
+    """An open feed keeps sample_fleet alive after every problem
+    finishes (the long-lived serving loop): a submission arriving in
+    that idle window is still served."""
+    spec = _spec(1)
+    feed = FleetFeed()
+    done = threading.Event()
+
+    def late_submit():
+        feed.submit(_ds(1))
+        feed.close()
+        done.set()
+
+    # B=1 + feed routes through the vmapped path; the spec problem
+    # finishes long before the submission arrives
+    t = threading.Timer(1.0, late_submit)
+    t.start()
+    try:
+        res = sample_fleet(spec, slots=True, feed=feed, **_KW)
+    finally:
+        t.join()
+    assert done.is_set()
+    assert [p.problem_id for p in res.problems] == ["p0000", "s0000"]
+    assert res.problems[1].blocks > 0

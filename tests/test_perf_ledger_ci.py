@@ -96,6 +96,45 @@ def test_nutssched_rows_committed():
     )
 
 
+def test_fleet_stream_rows_committed():
+    """The churn-heavy streaming-fleet series (PR 13) is part of the
+    gated ledger: slotted, legacy-compaction, and warm-started rows all
+    committed at equal problem sets; the newest slotted row holds the
+    zero-recompile evidence (exactly ONE batched-scan compile, zero
+    compactions, steady-state occupancy >= 0.9 with a live queue) at an
+    aggregate min-ESS/s at or above the legacy-compaction baseline; the
+    legacy row records the >= 2 specializations the slot scheduler
+    exists to avoid; and the warm-start row records its warmup savings
+    with an honest-null speedup where transfer doesn't pay."""
+    rows = [json.loads(l) for l in open(_LEDGER) if l.strip()]
+    stream = [r for r in rows
+              if r["config"].startswith("fleet:stream:eight_schools:")]
+    assert stream, "committed ledger must carry fleet:stream:* rows"
+
+    def newest(sched):
+        series = [r for r in stream if f":sched={sched}:" in r["config"]]
+        assert series, f"missing fleet:stream sched={sched} series"
+        return series[-1]
+
+    slots = newest("slots")
+    compact = newest("compact")
+    ws = newest("slots_warmstart")
+    assert slots["converged"] is True
+    assert slots["block_scan_compiles"] == 1
+    assert slots["compactions"] == 0
+    assert slots["occupancy_streaming"] >= 0.9
+    assert compact["block_scan_compiles"] >= 2
+    assert slots["ess_per_sec"] >= compact["ess_per_sec"]
+    assert ws["warmup_draws_saved"] is not None
+    if ws["warmstart_speedup"] is not None:
+        # when the row claims a payoff it must be a real one
+        assert ws["warmstart_speedup"] > 1.0
+    elif ws["converged"] is not True:
+        # honest-null discipline: a warm-start leg that loses its gate
+        # records missing data, never a measured zero
+        assert ws["ess_per_sec"] is None
+
+
 def test_quantized_fusedvg_rows_committed():
     """The quantized data-plane's ledger evidence: committed
     ``fusedvg:*:x=int8`` and ``:x=fp8e4m3`` rows exist for the

@@ -12,9 +12,10 @@ silently lose its fallback path.  This lint closes both loops
 statically:
 
 1. AST-collect every covered knob string literal (``STARK_FUSED_<NAME>``,
-   ``STARK_RAGGED_NUTS``, or ``STARK_QUANT_<NAME>``) passed to an
-   env-read call (``os.environ.get`` / ``os.getenv`` / ``environ.pop`` /
-   ``precision.fused_knob``) under ``stark_tpu/``.
+   ``STARK_RAGGED_NUTS``, ``STARK_QUANT_<NAME>``, or the fleet
+   slot-scheduler pair ``STARK_FLEET_SLOTS`` / ``STARK_FLEET_WARMSTART``)
+   passed to an env-read call (``os.environ.get`` / ``os.getenv`` /
+   ``environ.pop`` / ``precision.fused_knob``) under ``stark_tpu/``.
 2. Fail if a collected knob is missing from the README (the
    operator-facing contract — the zoo-coverage table for fused knobs,
    the "Ragged NUTS scheduling" section for the scheduler knob), or
@@ -37,11 +38,15 @@ from typing import Dict, List, Set, Tuple
 #: call names whose string-literal argument is an env-knob read
 _READ_FUNCS = frozenset({"get", "getenv", "pop", "fused_knob"})
 
-#: covered knobs: the fused-op family, the kernel-scheduler knob, and
-#: the quant-calibration family — extend the alternation when a new
-#: execution-path knob family lands
+#: covered knobs: the fused-op family, the kernel-scheduler knob, the
+#: quant-calibration family, and the fleet slot-scheduler pair
+#: (STARK_FLEET_SLOTS pins the compiled batch shape, STARK_FLEET_WARMSTART
+#: turns on donor-seeded admission warmup — each changes which executable
+#: / how much warmup every admitted problem runs) — extend the
+#: alternation when a new execution-path knob family lands
 _KNOB_RE = re.compile(
-    r"^STARK_(?:FUSED_[A-Z0-9_]+|RAGGED_NUTS|QUANT_[A-Z0-9_]+)$"
+    r"^STARK_(?:FUSED_[A-Z0-9_]+|RAGGED_NUTS|QUANT_[A-Z0-9_]+"
+    r"|FLEET_SLOTS|FLEET_WARMSTART)$"
 )
 
 

@@ -185,6 +185,13 @@ def render_run(events, run) -> str:
              if fl.get("lost_problems") else None),
             ("fleet blocks", fl.get("blocks")),
             ("compactions", fl.get("compactions")),
+            # in-place admission accounting (slot scheduler / streaming
+            # feed, PR 13) — n/a on traces that predate it
+            ("admissions", fl.get("admissions")),
+            ("slot recycles", fl.get("slot_recycles")),
+            ("queue depth (last)", fl.get("queue_depth_last")),
+            ("warm-started admissions", fl.get("warmstarted")),
+            ("warmup draws saved", fl.get("warmup_draws_saved")),
             ("last occupancy", fl.get("occupancy_last")),
             ("last active/batch",
              f"{fl['active_last']}/{fl['batch_last']}"
@@ -196,6 +203,33 @@ def render_run(events, run) -> str:
             [r for r in rows if r[1] is not None], ("fleet", "value")
         ))
         out.append("")
+        # admission timeline (slot scheduler / streaming feed): which
+        # problem entered which slot at which block, what the queue
+        # looked like, and whether warm-start transfer seeded it —
+        # absent (not an error) on traces that predate the events
+        admitted = [
+            e for e in events
+            if e.get("run") == s["run"] and e["event"] == "problem_admitted"
+        ]
+        if admitted:
+            rows = [
+                (
+                    e.get("block"),
+                    e.get("problem_id"),
+                    e.get("slot"),
+                    e.get("source"),
+                    e.get("queue_depth"),
+                    e.get("warmstart"),
+                    e.get("warmup_draws_saved"),
+                )
+                for e in admitted
+            ]
+            out.append(_table(
+                rows,
+                ("block", "admitted", "slot", "source", "queue",
+                 "warm-start", "warmup saved"),
+            ))
+            out.append("")
         done = [
             e for e in events
             if e.get("run") == s["run"]
