@@ -859,6 +859,16 @@ def main():
             # vs legacy compaction at equal problem sets, own
             # fleet:stream:* ledger series per scheduler variant
             ("fleet_stream", bmarks.bench_fleet_stream, 420.0),
+            # device-parallel fleet (STARK_FLEET_MESH): problems sharded
+            # over a "problems" mesh vs the single-device fleet at equal
+            # B, own fleet:mesh:* series — needs >=2 local devices, so
+            # on a single-device fallback host the committed rows come
+            # from `bench.py fleetmesh` under a forced CPU mesh instead
+            *(
+                [("fleet_mesh",
+                  bmarks.bench_fleet_mesh_eight_schools, 300.0)]
+                if len(jax.devices()) >= 2 else []
+            ),
             # ragged-vs-legacy NUTS scheduling leg (STARK_RAGGED_NUTS):
             # lane occupancy + occupancy-adjusted throughput on the
             # mixed-depth synthetic, own nutssched:* ledger series
@@ -934,7 +944,7 @@ def main():
                 if (
                     leg_name.startswith("fused_vg_")
                     or leg_name in ("nutssched", "fleet_eight_schools",
-                                    "fleet_stream")
+                                    "fleet_stream", "fleet_mesh")
                 ) and not row["converged"]:
                     # a fused leg that fails its gate (broken kernel,
                     # lost speedup) must record null ess/s, NEVER 0.0 —
@@ -952,6 +962,12 @@ def main():
                     append_fleet_ledger_row(row)
                 elif leg_name == "fleet_stream":
                     append_fleet_stream_ledger_rows(row, platform)
+                elif leg_name == "fleet_mesh":
+                    append_ledger(
+                        fleet_mesh_config_key(row, platform), row,
+                        extra_keys=_FLEET_MESH_EXTRA_KEYS,
+                        label="fleet-mesh",
+                    )
                 elif leg_name.startswith("fused_vg_"):
                     append_fusedvg_ledger_row(row)
                 elif leg_name == "nutssched":
@@ -1141,6 +1157,73 @@ def fleet_config_key(row, platform):
     if row.get("sched") == "ragged":
         key += f":sched=ragged:depth={row.get('max_tree_depth')}"
     return key
+
+
+#: mesh-fleet evidence keys (the device-parallel problems-axis leg):
+#: bit-identity + both rates survive an honest-null value column, so a
+#: CPU row that loses the >=2x gate still documents the measurement
+_FLEET_MESH_EXTRA_KEYS = (
+    "converged_fraction", "bit_identical", "shards",
+    "mesh_ess_per_sec", "single_device_ess_per_sec",
+    "speedup_vs_single_device", "dispatch_occupancy_mean",
+    "degraded", "lost_problems", "sched", "max_tree_depth",
+)
+
+
+def fleet_mesh_config_key(row, platform):
+    """Ledger series key for the device-parallel (problems-mesh) fleet
+    leg — its own series: a D-device dispatch is a different workload
+    from the single-device fleet and must not share a trailing median."""
+    return (
+        f"fleet:mesh:eight_schools:B={row.get('problems')}"
+        f":shards={row.get('shards')}"
+        f":chains={row.get('chains')}"
+        f":platform={platform}"
+    )
+
+
+def run_fleet_mesh_bench():
+    """`python bench.py fleetmesh` — run the device-parallel fleet leg
+    standalone and append its ``fleet:mesh:*`` ledger row.  Meant to run
+    on a forced multi-device CPU mesh (the MULTICHIP dry-run
+    environment):
+
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+            JAX_PLATFORMS=cpu python bench.py fleetmesh
+
+    The committed rows gate in tests/test_perf_ledger_ci.py: bit
+    identity must hold; the >=2x rate gate records an honest null on
+    hosts where D virtual devices share one core."""
+    import jax
+
+    from stark_tpu import benchmarks as bmarks
+
+    if len(jax.devices()) < 2:
+        print(
+            "[bench] fleetmesh needs >=2 devices; force a CPU mesh via "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8",
+            file=sys.stderr,
+        )
+        return 2
+    platform = jax.devices()[0].platform
+    try:
+        r = bmarks.bench_fleet_mesh_eight_schools()
+    except Exception as e:  # noqa: BLE001 — report, exit nonzero
+        print(f"[bench] fleetmesh failed: {e!r}", file=sys.stderr)
+        return 1
+    row = res_row(r)
+    if not row["converged"]:
+        # the null-not-0.0 rule: a gate-losing mesh row records missing
+        # data in the value column; the measured rates stay readable in
+        # mesh_ess_per_sec / single_device_ess_per_sec
+        row["value"] = None
+    print(json.dumps(row), flush=True)
+    append_ledger(
+        fleet_mesh_config_key(row, platform), row,
+        extra_keys=_FLEET_MESH_EXTRA_KEYS, label="fleet-mesh",
+        source="bench.py fleetmesh",
+    )
+    return 0
 
 
 #: streaming-fleet evidence keys (the churn-heavy slotted-vs-compaction
@@ -1394,5 +1477,7 @@ if __name__ == "__main__":
     elif "microbench" in sys.argv:
         fam_args = [a for a in sys.argv[1:] if a != "microbench"]
         sys.exit(run_fused_microbench(fam_args))
+    elif "fleetmesh" in sys.argv:
+        sys.exit(run_fleet_mesh_bench())
     else:
         main()

@@ -17,9 +17,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from ..compat import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..guard import annotate_dispatch, resolve_dispatch
 from ..model import Model, flatten_model, prepare_model_data
@@ -29,6 +27,7 @@ from ..parallel.mesh import (
     row_partition_specs,
     shard_data,
 )
+from ..parallel.primitives import broadcast, map_shards, shard_put
 from ..sampler import (
     Posterior,
     SamplerConfig,
@@ -68,23 +67,20 @@ class ShardedBackend:
             runner = make_chain_runner(fm, cfg)
             vrunner = jax.vmap(runner, in_axes=(0, 0, None))
             if data is None:
-                fn = shard_map(
+                self._cache[key] = map_shards(
                     lambda keys, z0s: vrunner(keys, z0s, None),
                     mesh=self.mesh,
                     in_specs=(P("chains"), P("chains")),
                     out_specs=P("chains"),
-                    check_vma=False,
                 )
             else:
                 data_specs = row_partition_specs(data, "data", row_axes)
-                fn = shard_map(
+                self._cache[key] = map_shards(
                     vrunner,
                     mesh=self.mesh,
                     in_specs=(P("chains"), P("chains"), data_specs),
                     out_specs=P("chains"),
-                    check_vma=False,
                 )
-            self._cache[key] = jax.jit(fn)
         return self._cache[key]
 
     def run(
@@ -143,7 +139,7 @@ class ShardedBackend:
             z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
         chain_keys = jax.random.split(key_run, chains)
 
-        put_chains = self._chain_placer(multiproc)
+        put_chains = self._chain_placer()
         z0 = put_chains(z0)
         chain_keys = put_chains(chain_keys)
 
@@ -203,44 +199,31 @@ class ShardedBackend:
         """Platform of the mesh's devices (what the programs run on)."""
         return next(iter(self.mesh.devices.flat)).platform
 
-    def _chain_placer(self, multiproc: bool):
-        """Place a host-computed (chains, ...) array over the "chains" axis.
-
-        Multiproc: every process computed the full (identical, same-seed)
-        array; each contributes just its addressable shards.
-        """
-        chain_sharding = NamedSharding(self.mesh, P("chains"))
-        if not multiproc:
-            return lambda x: jax.device_put(x, chain_sharding)
-
-        def to_global(x):
-            x = np.asarray(x)
-            return jax.make_array_from_callback(
-                x.shape, chain_sharding, lambda idx: x[idx]
-            )
-
-        return to_global
+    def _chain_placer(self):
+        """Place a host-computed (chains, ...) array over the "chains"
+        axis via `primitives.shard_put(from_host_replica=True)` — on a
+        multi-process mesh every process computed the full (identical,
+        same-seed) array and contributes just its addressable shards;
+        single-process is a plain device_put (the primitive branches)."""
+        return lambda x: shard_put(
+            x, self.mesh, P("chains"), from_host_replica=True
+        )
 
     def _smap(self, fn, in_specs, out_specs, data, data_specs, donate=()):
-        """shard_map + jit over the backend mesh; a ``None`` dataset is
-        bound here so every compiled segment shares the (*args, *extra)
-        calling convention with the single-device backend.  ``donate``
-        forwards to the outer jit's ``donate_argnums`` (buffer donation of
-        carried state, e.g. the streaming-diagnostics accumulators)."""
+        """`primitives.map_shards` over the backend mesh; a ``None``
+        dataset is bound here so every compiled segment shares the
+        (*args, *extra) calling convention with the single-device
+        backend.  ``donate`` forwards to the outer jit's
+        ``donate_argnums`` (buffer donation of carried state, e.g. the
+        streaming-diagnostics accumulators)."""
         if data is None:
-            return jax.jit(
-                shard_map(
-                    lambda *a: fn(*a, None), mesh=self.mesh, in_specs=in_specs,
-                    out_specs=out_specs, check_vma=False,
-                ),
-                donate_argnums=donate,
+            return map_shards(
+                lambda *a: fn(*a, None), mesh=self.mesh, in_specs=in_specs,
+                out_specs=out_specs, donate=donate,
             )
-        return jax.jit(
-            shard_map(
-                fn, mesh=self.mesh, in_specs=in_specs + (data_specs,),
-                out_specs=out_specs, check_vma=False,
-            ),
-            donate_argnums=donate,
+        return map_shards(
+            fn, mesh=self.mesh, in_specs=in_specs + (data_specs,),
+            out_specs=out_specs, donate=donate,
         )
 
     def _data_specs(self, data, row_axes):
@@ -421,21 +404,17 @@ class ShardedBackend:
                 )
             else:
                 data = shard_data(data, self.mesh, "data", row_axes=row_axes)
-        rep = NamedSharding(self.mesh, P())
-
         def put_rep(x):
-            if not multiproc:
-                return jax.device_put(x, rep)
-            x = np.asarray(x)
             # replicated placement across processes: every process holds
             # the identical host value and contributes its local replicas
-            return jax.make_array_from_callback(x.shape, rep, lambda idx: x[idx])
+            # (`primitives.broadcast`)
+            return broadcast(x, self.mesh)
 
         bundle = AdaptiveParts(
             fm=fm,
             data=data,
             extra=() if data is None else (data,),
-            put_chains=self._chain_placer(multiproc),
+            put_chains=self._chain_placer(),
             put_rep=put_rep,
             collect=gather_draws,
         )
@@ -487,6 +466,6 @@ class ShardedBackend:
             warm_j=warm_j,
             samp_j=samp_j,
             extra=() if data is None else (data,),
-            put_z0=self._chain_placer(multiproc),
+            put_z0=self._chain_placer(),
             collect=gather_draws,
         )

@@ -273,6 +273,118 @@ def bench_fleet_eight_schools(
     )
 
 
+def bench_fleet_mesh_eight_schools(
+    *, problems=32, shards=None, chains=4, num_warmup=200, block_size=50,
+    max_blocks=24, ess_target=100.0, rhat_target=1.01, max_tree_depth=None,
+    seed=0,
+):
+    """Device-parallel fleet leg (PR 14): eight-schools x ``problems``
+    with the problem axis sharded over a ``shards``-wide "problems" mesh
+    (`parallel.primitives.map_shards` under ``sample_fleet(mesh=...)``)
+    vs the SINGLE-DEVICE fleet at equal B — the ROADMAP item 2 "no
+    problem axis on meshes yet" gap, measured.
+
+    Both variants run the same spec through `_timed` (compile pass
+    untimed; the parts cache is keyed per (model, cfg, mesh) so each
+    variant warms its own executable), and every problem's draws are
+    compared BIT-EXACTLY across the two layouts — the mesh split must be
+    free, not approximately free.
+
+    Gate: >=95% converged, draws bit-identical, and the mesh fleet at
+    >=2x the single-device aggregate min-ESS/s.  The 2x leg is the
+    accelerator's number: D virtual CPU devices on a 1-core container
+    share the same core, so the CPU row records an honest null for the
+    gate (never a fabricated speedup) while the bit-identity and
+    convergence evidence still ride the row.
+
+    ``shards`` defaults to every local device — the committed ledger
+    rows run under ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+    (the MULTICHIP dry-run environment).
+    """
+    from .fleet import sample_fleet
+    from .kernels.nuts_ragged import ragged_nuts_enabled
+    from .parallel.mesh import make_mesh
+
+    ragged = ragged_nuts_enabled()
+    if max_tree_depth is None:
+        max_tree_depth = 10 if ragged else 5
+    if shards is None:
+        shards = len(jax.devices())
+    if shards < 2:
+        raise RuntimeError(
+            f"bench_fleet_mesh needs >=2 devices to shard over (have "
+            f"{shards}); force a CPU mesh via "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    spec = fleet_eight_schools_spec(problems, seed=seed)
+    gate_kw = dict(
+        chains=chains, num_warmup=num_warmup, block_size=block_size,
+        max_blocks=max_blocks, min_blocks=2, ess_target=ess_target,
+        rhat_target=rhat_target, kernel="nuts",
+        max_tree_depth=max_tree_depth, seed=seed,
+    )
+
+    def rollup(res, wall):
+        per = [p.min_ess for p in res.problems if p.min_ess is not None]
+        agg = float(np.sum(per)) if per else float("nan")
+        return agg, (agg / wall if wall else 0.0)
+
+    single, s_wall = _timed(lambda: sample_fleet(spec, **gate_kw))
+    _s_agg, s_rate = rollup(single, s_wall)
+    mesh = make_mesh({"problems": shards}, devices=jax.devices()[:shards])
+    res, wall = _timed(lambda: sample_fleet(spec, mesh=mesh, **gate_kw))
+    agg, rate = rollup(res, wall)
+
+    bit_identical = True
+    for a, b in zip(single.problems, res.problems):
+        da, db = np.asarray(a.draws_flat), np.asarray(b.draws_flat)
+        if da.shape != db.shape or not np.array_equal(da, db):
+            bit_identical = False
+            break
+    conv_frac = res.converged_fraction
+    max_rhat = float(np.max([
+        p.max_rhat for p in res.problems if p.max_rhat is not None
+    ] or [float("nan")]))
+    speedup = rate / s_rate if s_rate else None
+    # per-shard occupancy rollup: mean over blocks of the mean shard
+    # occupancy — how evenly the problem axis kept the mesh busy
+    occ = [o for o, _q in res.dispatch_occupancy_trail]
+    return BenchResult(
+        name=f"fleet_mesh_eight_schools_x{problems}_s{shards}",
+        wall_s=wall,
+        min_ess=agg,
+        ess_per_sec=rate,
+        max_rhat=max_rhat,
+        metric_name="aggregate min-ESS/s (mesh)",
+        converged=(
+            conv_frac >= 0.95 and bit_identical
+            and speedup is not None and speedup >= 2.0
+        ),
+        gate=">=95% converged, draws bit-identical, >=2x single-device",
+        extra={
+            "problems": problems,
+            "shards": shards,
+            "chains": chains,
+            "sched": "ragged" if ragged else "legacy",
+            "max_tree_depth": max_tree_depth,
+            "converged_fraction": round(conv_frac, 4),
+            "bit_identical": bit_identical,
+            # the measured rates survive an honest-null value column
+            "mesh_ess_per_sec": round(rate, 3),
+            "single_device_ess_per_sec": round(s_rate, 3),
+            "speedup_vs_single_device": (
+                round(speedup, 2) if speedup is not None else None
+            ),
+            "degraded": res.degraded,
+            "lost_problems": len(res.lost_problems),
+            "blocks_dispatched": res.blocks_dispatched,
+            "dispatch_occupancy_mean": (
+                round(float(np.mean(occ)), 4) if occ else None
+            ),
+        },
+    )
+
+
 def bench_fleet_stream(
     *, problems=16, chains=2, num_warmup=300, block_size=25, max_blocks=40,
     ess_target=60.0, rhat_target=1.1, max_batch=4, seed=0, warmstart=True,

@@ -81,6 +81,18 @@ Fleet fault-domain scenarios (per-PROBLEM containment — stark_tpu.fleet):
                          clean donors still seed admissions, and every
                          admitted problem stays finite — poisoned
                          adaptation state never propagates
+  fleet_mesh_quarantine  the lane-quarantine drill on a DEVICE-PARALLEL
+                         fleet (STARK_FLEET_MESH tentpole, problems
+                         sharded over a "problems" mesh axis): a
+                         quarantine on shard k leaves the other shards'
+                         problems bit-identical to an uninjected
+                         single-device fleet
+  fleet_mesh_admit_crash the admission-crash drill under
+                         STARK_FLEET_MESH=1: the supervised resume on
+                         the mesh replays the checkpointed admission
+                         order into the owning shards' slots, draws
+                         bit-identical to the single-device streaming
+                         fleet
 
 The drill models are tiny on purpose: the contracts under test are
 supervision mechanics, not posterior quality — every scenario finishes in
@@ -725,6 +737,119 @@ def fleet_admit_crash(workdir: str) -> Dict[str, Any]:
     assert ref_adm, "drill never exercised the admission path"
     return {"restarts": 1, "admissions_replayed": len(got_adm),
             "bit_identical": True}
+
+
+@_scenario("fleet_mesh_quarantine")
+def fleet_mesh_quarantine(workdir: str) -> Dict[str, Any]:
+    """The PR 9 lane-quarantine drill on a DEVICE-PARALLEL fleet
+    (STARK_FLEET_MESH tentpole): problems shard over a "problems" mesh
+    axis, one shard's lane is poisoned every block and quarantined past
+    its budget — and the OTHER shards' problems finish with draws
+    BIT-IDENTICAL to an uninjected single-device fleet, pinning both
+    fault containment across the mesh and the mesh-off/mesh-on draw
+    identity at once."""
+    import jax
+
+    from .fleet import sample_fleet
+    from .parallel.mesh import make_mesh
+
+    spec = _fleet_spec(4)
+    kw = dict(_FLEET_KW, seed=0, health_check=True, problem_max_restarts=1)
+    # single-device reference, no injection: the strongest possible pin
+    # (mesh sharding AND the poison must both leave survivors untouched)
+    ref = sample_fleet(spec, **kw)
+    faults.reset()
+    n_dev = min(4, len(jax.devices()))
+    mesh = make_mesh({"problems": n_dev}, devices=jax.devices()[:n_dev])
+    faults.configure("fleet.lane_nan=nan(1)@1")
+    store = os.path.join(workdir, "draws")
+    res = sample_fleet(
+        spec, mesh=mesh, draw_store_path=store,
+        metrics_path=os.path.join(workdir, "fleet_metrics.jsonl"), **kw,
+    )
+    assert res.shards == n_dev, res.shards
+    assert res.degraded is True and res.lost_problems == ["p0001"]
+    assert res.problems[1].status == "failed:poisoned_state"
+    for a, b in zip(ref.problems, res.problems):
+        if a.problem_id != "p0001":
+            assert b.converged, b.status
+            np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+    # the quarantine left its forensic trail exactly like the
+    # single-device drill: reason sidecar + per-shard occupancy records
+    bad = glob.glob(os.path.join(store, "p_p0001.stkr.bad*"))
+    assert any(p.endswith(".reason.json") for p in bad), bad
+    blocks = [r for r in _fleet_metrics(workdir)
+              if r.get("event") == "fleet_block"]
+    assert blocks and all(
+        r.get("shards") == n_dev and len(r.get("shard_occupancy", [])) == n_dev
+        for r in blocks
+    ), "fleet_block records lost their per-shard fields"
+    return {"shards": n_dev, "lost": res.lost_problems,
+            "survivors_bit_identical": True}
+
+
+@_scenario("fleet_mesh_admit_crash")
+def fleet_mesh_admit_crash(workdir: str) -> Dict[str, Any]:
+    """The PR 13 admission-crash drill under ``STARK_FLEET_MESH=1``
+    (every local device on the "problems" axis, slot widths padded):
+    crash with streamed submissions in the persisted queue, then a
+    supervised resume on the SAME mesh — the admission order replays
+    bit-identically into the owning shards' slots, and every problem's
+    draws match the uninjected single-device streaming fleet."""
+    from .fleet import FleetFeed, FleetSpec, sample_fleet, \
+        supervised_sample_fleet
+
+    big = _fleet_spec(5)
+    spec = FleetSpec.from_problems(big.model, big.datasets[:2])
+
+    def make_feed():
+        f = FleetFeed()
+        for d in big.datasets[2:]:
+            f.submit(d)
+        f.close()
+        return f
+
+    kw = dict(_FLEET_KW, seed=0, slots=True, max_batch=2)
+    # single-device, uninjected reference: the mesh run must reproduce
+    # its draws AND its admission order exactly
+    ref = sample_fleet(
+        spec, feed=make_feed(),
+        metrics_path=os.path.join(workdir, "ref_metrics.jsonl"), **kw,
+    )
+    faults.reset()
+    faults.configure("fleet.admit_pending=crash*1")
+    prev = os.environ.get("STARK_FLEET_MESH")
+    os.environ["STARK_FLEET_MESH"] = "1"
+    try:
+        res = supervised_sample_fleet(
+            spec, workdir=workdir, max_restarts=2, reseed_on_restart=False,
+            feed=make_feed(), **kw,
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("STARK_FLEET_MESH", None)
+        else:
+            os.environ["STARK_FLEET_MESH"] = prev
+    rs = _restarts(_metrics(workdir))
+    assert len(rs) == 1 and rs[0]["fault"] == "transient", rs
+    assert res.shards is not None and res.shards >= 1
+    for a, b in zip(ref.problems, res.problems):
+        assert a.status == b.status, (a.problem_id, a.status, b.status)
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+
+    def admissions(lines):
+        return [
+            (r["problem_id"], r["slot"])
+            for r in lines if r.get("event") == "problem_admitted"
+        ]
+
+    with open(os.path.join(workdir, "ref_metrics.jsonl")) as f:
+        ref_adm = admissions([json.loads(l) for l in f if l.strip()])
+    got_adm = admissions(_metrics(workdir))
+    assert got_adm == ref_adm, (got_adm, ref_adm)
+    assert ref_adm, "drill never exercised the admission path"
+    return {"shards": res.shards, "restarts": 1,
+            "admissions_replayed": len(got_adm), "bit_identical": True}
 
 
 @_scenario("fleet_warmstart_poison")

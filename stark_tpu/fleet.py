@@ -78,6 +78,26 @@ as ``sample_until_converged(seed=seed+index, adaptive_blocks=False)``
     a short adapt-confirm warmup; the full split-R-hat/ESS validation
     still gates every stop.
 
+**Device-parallel fleet (PR 14).**  ``STARK_FLEET_MESH=1`` (or
+``sample_fleet(mesh=...)`` with a Mesh carrying a "problems" axis, env
+default off and knob-off bit-identical) shards the PROBLEM axis over the
+mesh via `parallel.primitives.map_shards`: every batched dispatch (warmup
+init, warmup segments, the block scan) runs the same vmapped program on
+each device's contiguous slice of the batch, so B problems span D devices
+instead of one.  Problems are independent — the mapped program contains
+no collective — and per-lane draws are bit-identical to the single-device
+fleet (batch-composition independence is the drilled contract that makes
+the device split free).  All host-side bookkeeping (per-lane finite scan,
+quarantine, budgets, slot admission, checkpoints) runs on the
+`gather_tree`'d global view, so PR 9 fault domains and PR 13 slots work
+unchanged per shard: an admission scatters into the owning shard's slot
+(slot j belongs to shard ``j // (width / D)`` for the life of the batch),
+so steady-state churn still costs zero re-specializations.  Batch widths
+that do not divide D are padded with discarded replicas of lane 0; the
+compile accounting (`FleetResult.block_scan_compiles`) tracks padded
+widths — the shapes XLA actually specializes on.  ``fleet_block`` events
+gain ``shards`` + per-shard occupancy on mesh runs only.
+
 Escape hatches: ``STARK_FLEET=0`` (or ``fleet=False``) runs the problems
 SEQUENTIALLY through the unmodified single-problem runner (honoring the
 same `FleetFeed` API) — and a one-problem feed-less fleet always takes
@@ -112,6 +132,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as _PSPEC
 
 from . import diagnostics, faults, telemetry
 from .adaptation import DualAveragingState, build_warmup_schedule
@@ -593,7 +614,7 @@ class FleetResult:
                  blocks_dispatched, compactions, occupancy_trail,
                  total_grad_evals, budget_exhausted=False,
                  block_scan_compiles=0, admissions=0, slot_recycles=0,
-                 dispatch_occupancy_trail=None):
+                 dispatch_occupancy_trail=None, shards=None):
         self.problems = problems
         self.wall_s = wall_s
         self.blocks_dispatched = blocks_dispatched
@@ -616,6 +637,10 @@ class FleetResult:
         # boundary's admissions, unlike occupancy_trail's post-block
         # pre-admission reading
         self.dispatch_occupancy_trail = dispatch_occupancy_trail or []
+        # mesh-parallel fleet (STARK_FLEET_MESH): the "problems" mesh
+        # axis size the batched dispatches sharded over; None on
+        # single-device (and sequential-hatch) runs
+        self.shards = shards
         self._by_id = {p.problem_id: p for p in problems}
 
     def __getitem__(self, problem_id: str) -> FleetProblemResult:
@@ -735,29 +760,117 @@ class FleetDrawStore:
 
 
 class _FleetParts:
-    """Compiled fleet callables, cached per (fm, cfg) instance: the
+    """Compiled fleet callables, cached per (fm, cfg, mesh) instance: the
     single-problem warmup parts and block runner with one extra leading
     problem axis from an outer ``jax.vmap`` (data mapped over problems,
     broadcast over chains — exactly the JaxBackend layout plus one axis).
     XLA re-specializes per batch size; compaction sizes are bounded by
-    the refill threshold (at most O(log B) distinct sizes per run)."""
+    the refill threshold (at most O(log B) distinct sizes per run).
 
-    def __init__(self, fm, cfg: SamplerConfig):
+    With a ``mesh`` (STARK_FLEET_MESH / ``sample_fleet(mesh=...)``) every
+    callable is additionally shard_mapped over the mesh "problems" axis
+    via `parallel.primitives.map_shards`: each device runs the SAME
+    vmapped program on its contiguous slice of the problem axis, so B
+    problems span D devices instead of one.  Problems are independent —
+    there is no collective inside the mapped program at all — and the
+    repo's drilled batch-composition-independence contract is exactly
+    what makes the sharded dispatch bit-identical per lane to the
+    single-device one.  Batch widths that do not divide the shard count
+    are padded with replicas of lane 0 (finite, discarded — the same
+    dummy-lane trick as `_warm_slots_padded`) and outputs sliced back,
+    so ALL host-side bookkeeping sees exactly the unpadded batch."""
+
+    def __init__(self, fm, cfg: SamplerConfig, mesh=None):
+        from .parallel.primitives import axis_size
+
         self.fm = fm
         self.cfg = cfg
+        self.mesh = mesh
+        self.shards = axis_size(mesh, "problems") if mesh is not None else 1
         init_carry, segment, _finalize = make_warmup_parts(fm, cfg)
         self.finalize = _finalize
-        self.v_init = jax.jit(
+        PP, R = _PSPEC("problems"), _PSPEC()
+        self.v_init = self._compile(
             jax.vmap(jax.vmap(init_carry, in_axes=(0, 0, None)),
-                     in_axes=(0, 0, 0))
+                     in_axes=(0, 0, 0)),
+            in_specs=(PP, PP, PP),
         )
-        self.v_seg = jax.jit(
+        self.v_seg = self._compile(
             jax.vmap(
                 jax.vmap(segment, in_axes=(1, None, None, 0, 0, 0, 0, None)),
                 in_axes=(0, None, None, 0, 0, 0, 0, 0),
-            )
+            ),
+            in_specs=(PP, R, R, PP, PP, PP, PP, PP),
         )
         self._blocks: Dict[Tuple[Any, ...], Any] = {}
+
+    def padded_width(self, width: int) -> int:
+        """The problem-axis width a dispatch of ``width`` lanes actually
+        runs at: the next multiple of the shard count (identity with no
+        mesh) — what the compiled program specializes on."""
+        d = self.shards
+        return -(-width // d) * d
+
+    def _compile(self, fn, in_specs):
+        """`map_shards` + the pad/slice wrapper.  No mesh: exactly
+        ``jax.jit(fn)`` (the primitive's identity fast path) — the
+        historical single-device fleet, bit- and trace-identical."""
+        from .parallel.primitives import map_shards
+
+        if self.mesh is None:
+            return map_shards(fn)
+        rep = _PSPEC()
+        jitted = map_shards(
+            fn, mesh=self.mesh, in_specs=in_specs,
+            out_specs=_PSPEC("problems"),
+        )
+        mapped = [i for i, s in enumerate(in_specs) if s != rep]
+
+        def call(*args):
+            width = jax.tree.leaves(args[mapped[0]])[0].shape[0]
+            padded = self.padded_width(width)
+            # pad per-TREE (each arg from its own leading dim): the
+            # stacked dataset arrives pre-padded + pre-sharded from
+            # `place_batch` at batch-rebuild time and passes through
+            # untouched, while host-rebuilt carries/keys pad here
+            args = tuple(
+                self.place_batch(a, padded) if i in mapped else a
+                for i, a in enumerate(args)
+            )
+            out = jitted(*args)
+            if padded != width:
+                out = jax.tree.map(lambda a: a[:width], out)
+            return out
+
+        return call
+
+    def place_batch(self, tree, padded: Optional[int] = None):
+        """Pad a problem-leading pytree up to ``padded`` lanes (default:
+        its own padded width) with discarded replicas of lane 0, and
+        commit it to the "problems" sharding.  Identity off-mesh.
+        Idempotent — an already padded-and-placed tree costs only the
+        sharding equality check, which is what lets `_sample_fleet`
+        place the stacked dataset ONCE per batch rebuild instead of
+        paying an O(dataset-bytes) reshard per block dispatch."""
+        if self.mesh is None:
+            return tree
+        from jax.sharding import NamedSharding
+
+        width = jax.tree.leaves(tree)[0].shape[0]
+        if padded is None:
+            padded = self.padded_width(width)
+        if width < padded:
+            tree = jax.tree.map(
+                lambda a: jnp.concatenate(
+                    [a, jnp.broadcast_to(
+                        a[:1], (padded - width,) + a.shape[1:]
+                    )], axis=0,
+                ),
+                tree,
+            )
+        return jax.device_put(
+            tree, NamedSharding(self.mesh, _PSPEC("problems"))
+        )
 
     def get_block(self, length: int, diag_lags: Optional[int] = None,
                   ragged: bool = False):
@@ -775,7 +888,7 @@ class _FleetParts:
             # slip independently (the fleet is where max-tree lane sync
             # is worst), and the runners return one extra trailing
             # (problems, chains) lane-iteration output
-            fn = self._blocks[key] = jax.jit(
+            fn = self._blocks[key] = self._compile(
                 jax.vmap(
                     jax.vmap(
                         make_block_runner(self.fm, self.cfg, length,
@@ -784,24 +897,27 @@ class _FleetParts:
                         in_axes=inner_axes,
                     ),
                     in_axes=outer_axes,
-                )
+                ),
+                in_specs=tuple(
+                    _PSPEC("problems") for _ in range(len(inner_axes))
+                ),
             )
         return fn
 
 
-#: compiled fleet parts per (model, cfg) — keyed on the model OBJECT
-#: (kept alive by the key, like JaxBackend's runner cache), so repeated
-#: fleet calls over the same model reuse every jitted warmup segment and
-#: block variant instead of re-tracing per call
+#: compiled fleet parts per (model, cfg, mesh) — keyed on the model
+#: OBJECT (kept alive by the key, like JaxBackend's runner cache), so
+#: repeated fleet calls over the same model reuse every jitted warmup
+#: segment and block variant instead of re-tracing per call
 _PARTS_CACHE: Dict[Tuple[Any, ...], Tuple[Any, _FleetParts]] = {}
 
 
-def _fleet_parts_for(model: Model, cfg: SamplerConfig):
-    key = (model, cfg)
+def _fleet_parts_for(model: Model, cfg: SamplerConfig, mesh=None):
+    key = (model, cfg, mesh)
     hit = _PARTS_CACHE.get(key)
     if hit is None:
         fm = flatten_model(model)
-        hit = _PARTS_CACHE[key] = (fm, _FleetParts(fm, cfg))
+        hit = _PARTS_CACHE[key] = (fm, _FleetParts(fm, cfg, mesh))
     return hit
 
 
@@ -886,6 +1002,49 @@ def _resolve_warmstart_flag(warmstart: Optional[bool]) -> bool:
     if warmstart is not None:
         return bool(warmstart)
     return os.environ.get("STARK_FLEET_WARMSTART", "0") == "1"
+
+
+def _resolve_fleet_mesh(mesh):
+    """None (single-device fleet) or a Mesh with a "problems" axis.
+
+    An explicit ``mesh`` argument wins (it must carry a "problems" axis
+    — the fleet shards problems, nothing else).  Otherwise the
+    STARK_FLEET_MESH env knob decides: "0"/unset — off, bit-identical to
+    the historical single-device fleet; "1" — every local device on one
+    "problems" axis; an integer N>1 — the first N devices.  Multi-process
+    is rejected at the `sample_fleet` boundary already (problems shard
+    over local devices; cross-host problem placement is the item-1
+    control plane's job).  The literal knob name keeps it collectable
+    by tools/lint_fused_knobs.py."""
+    if mesh is not None:
+        if "problems" not in mesh.axis_names:
+            raise ValueError(
+                f'fleet mesh must have a "problems" axis; got axes '
+                f"{mesh.axis_names}"
+            )
+        extra = [
+            (ax, sz) for ax, sz in mesh.shape.items()
+            if ax != "problems" and sz > 1
+        ]
+        if extra:
+            raise ValueError(
+                "the fleet shards only the problem axis; mesh axes "
+                f"{extra} would duplicate work — use a mesh with all "
+                'non-"problems" axes of size 1'
+            )
+        return mesh
+    val = os.environ.get("STARK_FLEET_MESH", "0")
+    if val in ("", "0"):
+        return None
+    devices = jax.devices()
+    n = len(devices) if val == "1" else int(val)
+    if n < 1 or n > len(devices):
+        raise ValueError(
+            f"STARK_FLEET_MESH={val!r}: need 1..{len(devices)} devices"
+        )
+    from .parallel.mesh import make_mesh
+
+    return make_mesh({"problems": n}, devices=devices[:n])
 
 
 def _fleet_workdir(*paths: Optional[str]) -> Optional[str]:
@@ -1071,6 +1230,7 @@ def _sample_fleet(
     slots: Optional[bool] = None,
     warmstart: Optional[bool] = None,
     warmstart_warmup: Optional[int] = None,
+    mesh: Optional[Any] = None,
     trace: Optional[Any] = None,
     **cfg_kwargs,
 ) -> FleetResult:
@@ -1153,6 +1313,18 @@ def _sample_fleet(
     (budgets, quarantine, deadlines) apply to admitted problems
     unchanged.
 
+    **Device-parallel fleet** (``mesh=`` / ``STARK_FLEET_MESH``, default
+    OFF — off is bit-identical to the single-device fleet).  The problem
+    axis shards over the mesh "problems" axis inside `_FleetParts`
+    (`parallel.primitives.map_shards`); draws are bit-identical per
+    problem to the unsharded run, the host loop is unchanged (it reads
+    the gathered global view), and every fault-domain/slot/streaming
+    feature composes per shard.  Widths pad up to the shard count with
+    discarded lane-0 replicas; per-shard occupancy rides ``fleet_block``
+    events and the ``stark_fleet_shard_occupancy`` gauge.  The
+    sequential hatch has no problem axis and ignores a requested mesh
+    (with a warning).
+
     **Warm-start adaptation transfer** (``warmstart=True`` /
     ``STARK_FLEET_WARMSTART=1``, default OFF; slot-scheduler path only).
     An admitted problem seeds its step size and mass-matrix diagonal
@@ -1193,6 +1365,14 @@ def _sample_fleet(
         spec.num_problems > 1 or feed is not None
     )
     if not use_fleet:
+        if mesh is not None:
+            # the escape hatch ALWAYS wins: a sequential sweep has no
+            # problem axis to shard, so a requested mesh is dropped
+            # loudly, never silently half-honored
+            log.warning(
+                "sequential fleet hatch (STARK_FLEET=0 / B=1): the "
+                "requested problems mesh is ignored"
+            )
         return _sample_fleet_sequential(
             spec, chains=chains, block_size=block_size,
             max_blocks=max_blocks, min_blocks=min_blocks,
@@ -1207,11 +1387,19 @@ def _sample_fleet(
         )
     slots_on = _resolve_slots_flag(slots)
     warmstart_on = slots_on and _resolve_warmstart_flag(warmstart)
+    # device-parallel fleet (STARK_FLEET_MESH / mesh=): the problem axis
+    # shards over the mesh "problems" axis inside _FleetParts — every
+    # host-side decision below runs on the gather_tree'd global view
+    # (np.asarray on sharded outputs), so fault domains, budgets, slot
+    # admission, and checkpoints are untouched by the device layout
+    fleet_mesh = _resolve_fleet_mesh(mesh)
+    n_shards = 1
 
     trace = telemetry.resolve_trace(trace)
     t_start = time.perf_counter()
     model = spec.model
-    fm, _parts_cached = _fleet_parts_for(model, cfg)
+    fm, _parts_cached = _fleet_parts_for(model, cfg, fleet_mesh)
+    n_shards = _parts_cached.shards
     B = spec.num_problems
     # postmortem flight recorder: per-problem quarantines and deadline
     # blows dump a forensic bundle next to the fleet's own artifacts
@@ -1236,6 +1424,9 @@ def _sample_fleet(
             rhat_target=rhat_target,
             ess_target=ess_target,
             resuming=bool(resume_from),
+            # mesh-parallel fleet accounting rides ONLY mesh runs, so
+            # knob-off trace files stay byte-identical to PR 13
+            **({"fleet_shards": n_shards} if fleet_mesh is not None else {}),
             **telemetry.device_info(),
             **telemetry.provenance(),
         )
@@ -1365,7 +1556,11 @@ def _sample_fleet(
 
     def batch_data(indices: List[int]):
         ix = jnp.asarray(indices)
-        return jax.tree.map(lambda a: a[ix], fdata_all)
+        picked = jax.tree.map(lambda a: a[ix], fdata_all)
+        # mesh runs: pad + commit the slab to the "problems" sharding
+        # HERE, once per batch rebuild — the dispatch wrapper's per-call
+        # placement then no-ops on it (identity off-mesh)
+        return parts.place_batch(picked)
 
     def warm_cohort(indices: List[int]):
         """Warm up a cohort of problems in one vmapped dispatch; returns
@@ -2248,6 +2443,8 @@ def _sample_fleet(
                 dur_s=round(time.perf_counter() - t_ckpt, 4),
             )
 
+    from .parallel.primitives import gather_tree
+
     # key advancement is batched: vmap maps the same deterministic
     # threefry split over the stacked keys, so each lane's stream stays
     # bit-identical to per-problem `jax.random.split` while the host
@@ -2344,7 +2541,10 @@ def _sample_fleet(
             )
             t_enq = time.perf_counter()
             lane_iters = None
-            width = len(order)
+            # the compiled program specializes on the PADDED width (the
+            # next multiple of the shard count; identity off-mesh), so
+            # the zero-recompile accounting tracks that, not len(order)
+            width = parts.padded_width(len(order))
             new_width = width not in seen_widths
             if new_width:
                 seen_widths.add(width)
@@ -2394,10 +2594,14 @@ def _sample_fleet(
             # into a per-tenant outcome instead of a fleet-wide fate
             faults.fail_point("fleet.lane_stall")
             t_blk = time.perf_counter()
-            zs = np.asarray(zs)
-            divergent_h = np.asarray(divergent)
-            ngrad_h = np.asarray(ngrad)
-            diag_h = jax.tree.map(np.asarray, diag) if stream_diag else None
+            # the GLOBAL host view (parallel.primitives.gather_tree):
+            # everything below — gates, fault domains, budgets, slots,
+            # checkpoints — reads this, so the mesh layout is invisible
+            # to the whole host loop
+            zs = gather_tree(zs)
+            divergent_h = gather_tree(divergent)
+            ngrad_h = gather_tree(ngrad)
+            diag_h = gather_tree(diag) if stream_diag else None
             t_wait = time.perf_counter() - t_blk
             # per-LANE finite scan: a poisoned lane is a PROBLEM fault,
             # contained below (reseed-or-quarantine) — never a fleet
@@ -2567,6 +2771,24 @@ def _sample_fleet(
             # identical to pre-PR traces)
             if slots_on or feed is not None:
                 sched_fields = dict(sched_fields, queue_depth=len(pending))
+            # mesh-parallel fleet: per-shard occupancy — shard k runs the
+            # k-th contiguous slice of the PADDED batch (shard_map's
+            # leading-axis layout); pad lanes count as idle.  Fields ride
+            # ONLY mesh runs (knob-off events stay byte-identical).
+            if fleet_mesh is not None:
+                lanes_per = width // n_shards
+                shard_occ = []
+                for k in range(n_shards):
+                    lo = k * lanes_per
+                    hi = min(lo + lanes_per, len(order))
+                    act = sum(
+                        1 for j in range(lo, max(hi, lo))
+                        if probs[order[j]].active
+                    )
+                    shard_occ.append(round(act / max(lanes_per, 1), 4))
+                sched_fields = dict(
+                    sched_fields, shards=n_shards, shard_occupancy=shard_occ,
+                )
             if trace.enabled:
                 trace.emit(
                     "fleet_block",
@@ -2779,6 +3001,8 @@ def _sample_fleet(
                  block_scan_compiles=block_scan_compiles)
             if (slots_on or feed is not None or n_admissions) else {}
         )
+        if fleet_mesh is not None:
+            stream_end = dict(stream_end, fleet_shards=n_shards)
         trace.emit(
             "run_end",
             dur_s=round(wall, 4),
@@ -2805,6 +3029,7 @@ def _sample_fleet(
         admissions=n_admissions,
         slot_recycles=n_slot_recycles,
         dispatch_occupancy_trail=dispatch_occupancy_trail,
+        shards=n_shards if fleet_mesh is not None else None,
     )
 
 

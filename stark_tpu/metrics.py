@@ -514,6 +514,16 @@ class TraceCollector:
             "(degraded completion; per-problem loss, NOT process "
             "unhealth — /healthz stays 200)",
         )
+        self.g_fleet_shards = r.gauge(
+            f"{p}_fleet_shards",
+            'mesh "problems"-axis size the fleet batch shards over '
+            "(STARK_FLEET_MESH; absent on single-device fleets)",
+        )
+        self.g_fleet_shard_occupancy = r.gauge(
+            f"{p}_fleet_shard_occupancy",
+            "active fraction of each mesh shard's slice of the fleet "
+            "batch, labeled by shard ordinal (pad lanes count as idle)",
+        )
         self.g_lane_occupancy = r.gauge(
             f"{p}_nuts_lane_occupancy",
             "ragged-NUTS useful-gradient fraction of the last block "
@@ -629,6 +639,11 @@ class TraceCollector:
             self.g_problem_ess_rate.clear()
             self.g_problem_headroom.clear()
             self.g_problem_restart_burn.clear()
+            # the mesh layout is per-run state: run B single-device (or
+            # on a narrower mesh) must not keep serving run A's shard
+            # count or shard labels
+            self.g_fleet_shards.clear()
+            self.g_fleet_shard_occupancy.clear()
             self._set_status(
                 phase="starting", run=rec.get("run", 0), meta=meta,
                 block=None, draws_per_chain=None, ess_forecast=None,
@@ -727,10 +742,20 @@ class TraceCollector:
                 g.set(float(rec[field]))
         if rec.get("queue_depth") is not None:
             self.g_fleet_queue_depth.set(float(rec["queue_depth"]))
+        # mesh-parallel fleet (STARK_FLEET_MESH): shard count + per-shard
+        # occupancy, labeled by shard ordinal — which device slice is
+        # riding hot/idle.  The fields only exist on mesh runs.
+        if rec.get("shards") is not None:
+            self.g_fleet_shards.set(float(rec["shards"]))
+        if rec.get("shard_occupancy"):
+            for k, occ in enumerate(rec["shard_occupancy"]):
+                self.g_fleet_shard_occupancy.set(
+                    float(occ), shard=str(k)
+                )
         fleet = {
             k: rec[k]
             for k in ("block", "batch", "active", "occupancy",
-                      "queue_depth")
+                      "queue_depth", "shards")
             if rec.get(k) is not None
         }
         with self._lock:

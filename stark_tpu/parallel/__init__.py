@@ -1,11 +1,23 @@
 from .consensus import consensus_sample
 from .mesh import make_mesh, shard_data
+from .primitives import (
+    broadcast,
+    gather_tree,
+    map_shards,
+    reduce_tree,
+    shard_put,
+)
 from .tempering import geometric_ladder, tempered_sample
 
 __all__ = [
+    "broadcast",
     "consensus_sample",
+    "gather_tree",
     "geometric_ladder",
     "make_mesh",
+    "map_shards",
+    "reduce_tree",
     "shard_data",
+    "shard_put",
     "tempered_sample",
 ]

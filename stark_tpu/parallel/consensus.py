@@ -25,11 +25,11 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import faults, telemetry
-from ..compat import shard_map
 from ..model import Model, flatten_model, prepare_model_data
+from .primitives import map_shards, shard_put
 from ..sampler import Posterior, SamplerConfig, _constrain_draws, make_chain_runner
 
 log = logging.getLogger("stark_tpu.consensus")
@@ -125,29 +125,25 @@ def _run_chees_shards(
     )
     v_samp = jax.vmap(parts.sample_segment, in_axes=(0, 0, None, 0))
 
-    if mesh is None:
-        init_j = jax.jit(v_init)
-        warm_j = jax.jit(v_warm)
-        samp_j = jax.jit(v_samp)
-    else:
-        D = P("data")  # prefix spec: every leaf carries the shard axis
-        R = P()
-        init_j = jax.jit(
-            shard_map(v_init, mesh=mesh, in_specs=(D, D, D),
-                      out_specs=D, check_vma=False)
-        )
-        warm_j = jax.jit(
-            shard_map(v_warm, mesh=mesh, in_specs=(D, D, R, R, R, R, D),
-                      out_specs=(D, D), check_vma=False)
-        )
-        samp_j = jax.jit(
-            shard_map(v_samp, mesh=mesh, in_specs=(D, D, R, D),
-                      out_specs=(D, D), check_vma=False)
-        )
-        put = lambda x: jax.device_put(x, NamedSharding(mesh, P("data")))
-        z0, wkeys, rkeys = put(z0), put(wkeys), put(rkeys)
-        sharded = jax.tree.map(put, sharded)
-        ikeys = put(ikeys)
+    # one primitive call per segment kind: mesh=None is the jit identity
+    # fast path, a mesh shard_maps the vmapped segments over "data"
+    # (shards resident per device; the only collective is the final
+    # gather) — parallel/primitives.py owns the shard_map idiom
+    D = P("data")  # prefix spec: every leaf carries the shard axis
+    R = P()
+    init_j = map_shards(v_init, mesh=mesh, in_specs=(D, D, D), out_specs=D)
+    warm_j = map_shards(
+        v_warm, mesh=mesh, in_specs=(D, D, R, R, R, R, D), out_specs=(D, D)
+    )
+    samp_j = map_shards(
+        v_samp, mesh=mesh, in_specs=(D, D, R, D), out_specs=(D, D)
+    )
+    if mesh is not None:
+        z0 = shard_put(z0, mesh, D)
+        wkeys = shard_put(wkeys, mesh, D)
+        rkeys = shard_put(rkeys, mesh, D)
+        sharded = shard_put(sharded, mesh, D)
+        ikeys = shard_put(ikeys, mesh, D)
 
     segments = lambda n: chees_segments(dispatch_steps, n)
 
@@ -411,27 +407,19 @@ def consensus_sample(
         blk = trace.tagged(shards=shards_here).phase(
             "sample_block", includes_warmup=True, includes_compile=True
         )
-        if mesh is None:
-            run = jax.jit(vshards)
-            with blk:
-                res = jax.block_until_ready(run(keys, z0, sharded))
-        else:
-            specs = jax.tree.map(lambda _: P("data"), sharded)
-            fn = shard_map(
-                vshards,
-                mesh=mesh,
-                in_specs=(P("data"), P("data"), specs),
-                out_specs=P("data"),
-                check_vma=False,
-            )
-            keys = jax.device_put(keys, NamedSharding(mesh, P("data")))
-            z0 = jax.device_put(z0, NamedSharding(mesh, P("data")))
-            sharded = jax.tree.map(
-                lambda x: jax.device_put(x, NamedSharding(mesh, P("data"))),
-                sharded,
-            )
-            with blk:
-                res = jax.block_until_ready(jax.jit(fn)(keys, z0, sharded))
+        specs = jax.tree.map(lambda _: P("data"), sharded)
+        run = map_shards(
+            vshards,
+            mesh=mesh,
+            in_specs=(P("data"), P("data"), specs),
+            out_specs=P("data"),
+        )
+        if mesh is not None:
+            keys = shard_put(keys, mesh, P("data"))
+            z0 = shard_put(z0, mesh, P("data"))
+            sharded = shard_put(sharded, mesh, P("data"))
+        with blk:
+            res = jax.block_until_ready(run(keys, z0, sharded))
         draws_sub = res.draws  # (S, C, T, d)
         stats_extra = {
             "accept_prob": np.asarray(res.accept_prob).reshape(

@@ -19,7 +19,7 @@ from typing import Dict, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 def make_mesh(
@@ -77,23 +77,23 @@ def shard_data(data, mesh: Mesh, axis: str = "data", row_axes=None):
     accordingly; use ``truncate_to_multiple`` first otherwise).
     row_axes: see ``row_partition_specs``.
     """
+    from .primitives import shard_put
+
     size = mesh.shape[axis]
     if row_axes is None:
         row_axes = jax.tree.map(lambda _: 0, data)
     specs = row_partition_specs(data, axis, row_axes)
 
-    def put(x, ax, spec):
+    def check(x, ax):
         x = jnp.asarray(x)
-        if ax < 0:  # row-less sentinel leaf: replicate as-is
-            return jax.device_put(x, NamedSharding(mesh, spec))
-        if x.shape[ax] % size:
+        if ax >= 0 and x.shape[ax] % size:  # row-less sentinels replicate
             raise ValueError(
                 f"rows {x.shape[ax]} not divisible by mesh axis {axis}={size}; "
                 "use truncate_to_multiple or pad the dataset"
             )
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return x
 
-    return jax.tree.map(put, data, row_axes, specs)
+    return shard_put(jax.tree.map(check, data, row_axes), mesh, specs)
 
 
 def truncate_to_multiple(data, k: int):
@@ -111,22 +111,13 @@ def run_over_chains(mesh: Mesh, vrun, *args):
 
     Every arg must have chains as its leading axis; outputs likewise (the
     P("chains") out_spec is applied as a pytree prefix).  Shared dispatch
-    for the samplers that parallelize only over chains (SG-HMC, tempering).
+    for the samplers that parallelize only over chains (SG-HMC, tempering)
+    — re-exported from `primitives`, where it is a `map_shards` +
+    `shard_put` composition.
     """
-    from ..compat import shard_map
+    from .primitives import run_over_chains as _run
 
-    if "chains" not in mesh.axis_names:
-        raise ValueError("mesh must have a 'chains' axis")
-    fn = shard_map(
-        vrun,
-        mesh=mesh,
-        in_specs=tuple(P("chains") for _ in args),
-        out_specs=P("chains"),
-        check_vma=False,
-    )
-    sharding = NamedSharding(mesh, P("chains"))
-    args = tuple(jax.device_put(a, sharding) for a in args)
-    return jax.block_until_ready(jax.jit(fn)(*args))
+    return _run(mesh, vrun, *args)
 
 
 def process_local_shard(data, mesh: Mesh, axis: str = "data", row_axes=None):
@@ -137,11 +128,7 @@ def process_local_shard(data, mesh: Mesh, axis: str = "data", row_axes=None):
     row_axes: see ``row_partition_specs`` — transformed layouts (e.g. a
     transposed ``xT``) shard their row axis, wherever it lives.
     """
+    from .primitives import shard_put
+
     specs = row_partition_specs(data, axis, row_axes)
-    return jax.tree.map(
-        lambda x, spec: jax.make_array_from_process_local_data(
-            NamedSharding(mesh, spec), np.asarray(x)
-        ),
-        data,
-        specs,
-    )
+    return shard_put(data, mesh, specs, process_local=True)

@@ -1,0 +1,231 @@
+"""DrJAX-style MapReduce primitives — ONE multi-host-aware collective
+layer under every parallel composition (ROADMAP item 4).
+
+Before this module, `parallel/consensus.py`, `parallel/tempering.py`,
+`parallel/mesh.py`, and `backends/sharded.py` each re-imported
+`compat.shard_map` and hand-rolled their own spec/placement boilerplate —
+four bespoke collective call sites whose compositions only worked by
+bespoke test matrix.  Following DrJAX ("Scalable and Differentiable
+MapReduce Primitives in JAX", PAPERS.md), everything they (and the fleet's
+problem-axis sharding) need reduces to a small primitive set with one
+implementation:
+
+  * `map_shards`   — map a function over shards of its inputs along a
+    named mesh axis: ``jit(shard_map(fn))`` on a mesh, a plain
+    ``jit(fn)`` identity fast path with no mesh (the vmapped lanes ARE
+    the shards on one device).  The only place in the repo that touches
+    `compat.shard_map`.
+  * `reduce_tree`  — cross-shard reduction inside a mapped function
+    (``lax.psum``/``pmax``/``pmin`` over the axis; identity with no
+    axis), the MapReduce "reduce".
+  * `broadcast`    — replicate a host value to every device of a mesh
+    (multi-host: every process contributes its addressable replicas).
+  * `shard_put`    — place a pytree along per-leaf PartitionSpecs
+    (multi-host: per-process rows glued into one global array).
+  * `gather_tree`  — materialize the global host view of a (possibly
+    sharded) pytree; multi-process runs allgather so every host sees the
+    same full value.
+
+Single-device, single-host behavior is bit-identical to the hand-rolled
+code it replaced: `map_shards(fn, mesh=None)` is literally ``jax.jit(fn)``
+and the placement helpers degrade to ``device_put``/``np.asarray``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..compat import shard_map
+
+PyTree = Any
+
+#: reduction ops `reduce_tree` accepts -> the lax collective that runs
+#: when a mesh axis is in scope
+_REDUCE_OPS = ("sum", "max", "min")
+
+
+def axis_size(mesh: Optional[Mesh], axis: str) -> int:
+    """Shard count along ``axis`` — 1 with no mesh (the identity path)."""
+    if mesh is None:
+        return 1
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh {mesh.axis_names} has no {axis!r} axis")
+    return int(mesh.shape[axis])
+
+
+def map_shards(
+    fn,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: Optional[str] = None,
+    in_specs: Optional[Tuple] = None,
+    out_specs: Any = None,
+    check_vma: bool = False,
+    donate: Sequence[int] = (),
+):
+    """The map primitive: ``fn`` runs once per shard of its inputs along
+    the mesh ``axis``, compiled as one program.
+
+    * ``mesh is None`` — identity fast path: returns ``jax.jit(fn,
+      donate_argnums=donate)`` exactly (no wrapper, no spec handling), so
+      single-device callers are bit- and trace-identical to plain jit.
+    * on a mesh — ``jit(shard_map(fn, mesh, in_specs, out_specs))``.
+      ``in_specs``/``out_specs`` default to a ``P(axis)`` pytree-prefix
+      on every argument/output (the common "everything carries the
+      mapped axis leading" layout); pass explicit specs (tuples of specs
+      or per-leaf spec pytrees) for mixed replicated/sharded signatures.
+
+    ``donate`` forwards to the outer jit's ``donate_argnums`` (buffer
+    donation of carried state) on both paths.
+    """
+    if mesh is None:
+        return jax.jit(fn, donate_argnums=tuple(donate))
+    if in_specs is None or out_specs is None:
+        if axis is None:
+            raise ValueError(
+                "map_shards on a mesh needs either explicit in_specs/"
+                "out_specs or a default `axis`"
+            )
+        spec = P(axis)
+        if in_specs is None:
+            import inspect
+
+            try:
+                params = list(inspect.signature(fn).parameters.values())
+            except (TypeError, ValueError):
+                params = None
+            # only plain positional parameters WITHOUT defaults count —
+            # *args/**kwargs make the arity unknowable and a defaulted
+            # or keyword-only parameter makes it ambiguous (the caller
+            # may or may not pass it); explicit in_specs resolves both
+            if params is None or any(
+                p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD,
+                           p.KEYWORD_ONLY)
+                or p.default is not p.empty
+                for p in params
+            ):
+                raise ValueError(
+                    "map_shards could not infer the arity of fn "
+                    "(*args/**kwargs, defaulted, or keyword-only "
+                    "parameters); pass in_specs explicitly"
+                )
+            in_specs = tuple(spec for _ in range(len(params)))
+        if out_specs is None:
+            out_specs = spec
+    return jax.jit(
+        shard_map(
+            fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs,
+            check_vma=check_vma,
+        ),
+        donate_argnums=tuple(donate),
+    )
+
+
+def reduce_tree(tree: PyTree, axis: Optional[str] = None, op: str = "sum"):
+    """The reduce primitive, for use INSIDE a mapped function: combine
+    every shard's value over the named mesh axis (``psum``/``pmax``/
+    ``pmin``).  ``axis=None`` is the single-shard identity, so shared
+    likelihood/statistics code runs unchanged under both layouts."""
+    if op not in _REDUCE_OPS:
+        raise ValueError(f"unknown reduce op {op!r}; one of {_REDUCE_OPS}")
+    if axis is None:
+        return tree
+    from jax import lax
+
+    fn = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin}[op]
+    return jax.tree.map(lambda x: fn(x, axis), tree)
+
+
+def broadcast(tree: PyTree, mesh: Optional[Mesh] = None) -> PyTree:
+    """Replicate a host value to every device of ``mesh`` (no mesh: the
+    identity).  Multi-host aware: each process holds the identical host
+    value and contributes its addressable replicas (the
+    ``make_array_from_callback`` placement `backends/sharded.py` used to
+    hand-roll)."""
+    return shard_put(tree, mesh, P(), from_host_replica=True)
+
+
+def shard_put(
+    tree: PyTree,
+    mesh: Optional[Mesh],
+    specs: Any,
+    *,
+    process_local: bool = False,
+    from_host_replica: bool = False,
+) -> PyTree:
+    """Place a pytree along per-leaf PartitionSpecs (``specs`` may be a
+    single spec applied to every leaf, or a spec pytree).  No mesh: the
+    identity.  Two multi-host flavors:
+
+    * ``process_local=True`` — each process passes only ITS rows and jax
+      glues one global array (``make_array_from_process_local_data``);
+    * ``from_host_replica=True`` — every process holds the identical
+      full host value (same-seed host computation) and contributes just
+      its addressable shards (``make_array_from_callback``).
+    """
+    if mesh is None:
+        return tree
+    if isinstance(specs, P):
+        specs = jax.tree.map(lambda _: specs, tree)
+    if process_local:
+        return jax.tree.map(
+            lambda x, spec: jax.make_array_from_process_local_data(
+                NamedSharding(mesh, spec), np.asarray(x)
+            ),
+            tree,
+            specs,
+        )
+    if from_host_replica and jax.process_count() > 1:
+
+        def place(x, spec):
+            x = np.asarray(x)
+            return jax.make_array_from_callback(
+                x.shape, NamedSharding(mesh, spec), lambda idx: x[idx]
+            )
+
+        return jax.tree.map(place, tree, specs)
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        tree,
+        specs,
+    )
+
+
+def gather_tree(tree: PyTree) -> PyTree:
+    """Materialize the GLOBAL host view of a (possibly device-sharded)
+    pytree as numpy arrays — the view all host-side bookkeeping (gates,
+    checkpoints, fault domains) runs on.  Single-process: ``np.asarray``
+    already assembles every addressable shard.  Multi-process: each
+    leaf is allgathered so every host returns the same full value (the
+    `distributed.gather_draws` contract, generalized)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return jax.tree.map(
+            lambda x: np.asarray(
+                multihost_utils.process_allgather(x, tiled=True)
+            ),
+            tree,
+        )
+    return jax.tree.map(np.asarray, tree)
+
+
+def run_over_chains(mesh: Mesh, vrun, *args):
+    """shard_map a vmapped chain runner over the mesh "chains" axis and
+    run it: every arg (and output) carries chains as its leading axis.
+    Shared dispatch for the samplers that parallelize only over chains
+    (SG-HMC, tempering) — a `map_shards` + `shard_put` composition."""
+    if "chains" not in mesh.axis_names:
+        raise ValueError("mesh must have a 'chains' axis")
+    fn = map_shards(
+        vrun,
+        mesh=mesh,
+        in_specs=tuple(P("chains") for _ in args),
+        out_specs=P("chains"),
+    )
+    args = tuple(shard_put(a, mesh, P("chains")) for a in args)
+    return jax.block_until_ready(fn(*args))

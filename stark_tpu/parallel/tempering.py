@@ -34,6 +34,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .. import telemetry
+from .primitives import map_shards, run_over_chains
 from ..adaptation import da_init, da_update
 from ..kernels.base import HMCState
 from ..kernels.hmc import hmc_step
@@ -334,10 +335,10 @@ def tempered_sample(
         transitions=num_warmup + num_samples, replicas=chains * num_temps,
     ):
         if mesh is None:
-            out = jax.block_until_ready(jax.jit(vrun)(chain_keys, z0))
+            out = jax.block_until_ready(
+                map_shards(vrun)(chain_keys, z0)
+            )
         else:
-            from .mesh import run_over_chains
-
             out = run_over_chains(mesh, vrun, chain_keys, z0)
 
     zs, n_div, swap_rate, rate_per_pair, betas_final, step_sizes = out

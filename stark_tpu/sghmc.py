@@ -216,7 +216,7 @@ def sghmc_sample(
     if mesh is None:
         zs, ke, n_div = jax.block_until_ready(jax.jit(vrun)(chain_keys, z0))
     else:
-        from .parallel.mesh import run_over_chains
+        from .parallel.primitives import run_over_chains
 
         zs, ke, n_div = run_over_chains(mesh, vrun, chain_keys, z0)
 

@@ -970,7 +970,9 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                    "compactions",
                    "admissions", "slot_recycles", "queue_depth_last",
                    "warmstarted",
-                   "warmup_draws_saved"} | {},   # fleet-sampling events
+                   "warmup_draws_saved",
+                   "shards",
+                   "shard_occupancy_last"} | {}, # fleet-sampling events
                                                  # (stark_tpu.fleet), when
                                                  # the run emitted them —
                                                  # the admission keys only
@@ -1055,6 +1057,13 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
             fleet["blocks"] = fleet.get("blocks", 0) + 1
             if e.get("occupancy") is not None:
                 fleet["occupancy_last"] = e["occupancy"]
+            # mesh-parallel fleet (STARK_FLEET_MESH): shard count and the
+            # latest per-shard occupancy — absent (not 0) off-mesh and on
+            # pre-PR-14 traces
+            if e.get("shards") is not None:
+                fleet["shards"] = int(e["shards"])
+            if e.get("shard_occupancy") is not None:
+                fleet["shard_occupancy_last"] = e["shard_occupancy"]
             if e.get("active") is not None:
                 fleet["active_last"] = e["active"]
             if e.get("batch") is not None:

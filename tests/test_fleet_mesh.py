@@ -1,0 +1,286 @@
+"""Device-parallel fleet (PR 14): the problem axis sharded over a mesh
+"problems" axis on the `parallel.primitives.map_shards` layer.
+
+The contracts under test:
+
+* **Knob-off bit-identity** — with ``STARK_FLEET_MESH`` unset (and no
+  ``mesh=``) nothing changes: `_FleetParts` compiles through the
+  identity fast path (literally ``jax.jit``), results carry
+  ``shards=None``, and fleet traces hold none of the per-shard fields.
+* **Mesh bit-identity** — per-problem draws on a D-shard mesh are
+  bit-identical to the single-device fleet (and therefore to the
+  unbatched runs the single-device fleet is pinned against), including
+  when the batch width does NOT divide the shard count (the pad-lane
+  path) and when problems are admitted into slots mid-run.
+* **Composition** — PR 13 slots + streaming admission run unchanged per
+  shard (zero batched-scan re-specializations at a pinned width); the
+  PR 9 quarantine/admission-crash drills ride the chaos matrix
+  (``fleet_mesh_quarantine`` / ``fleet_mesh_admit_crash``).
+* **Observability** — mesh runs' ``fleet_block`` events carry
+  ``shards`` + ``shard_occupancy``, `summarize_trace` rolls them up,
+  `tools/trace_report.py` renders them — and stays n/a-safe on the
+  committed PRE-PR-14 trace fixture (tests/fixtures/), the regression
+  pin for old traces.
+* **Guards** — a mesh without a "problems" axis (or with extra >1 axes)
+  is rejected; a bad ``STARK_FLEET_MESH`` value is rejected; the
+  sequential ``STARK_FLEET=0`` hatch ignores a requested mesh loudly.
+"""
+
+import importlib.util
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from stark_tpu.fleet import FleetFeed, FleetSpec, sample_fleet
+from stark_tpu.models.eight_schools import SIGMA, Y, EightSchools
+from stark_tpu.parallel.mesh import make_mesh
+from stark_tpu.telemetry import RunTrace, read_trace, summarize_trace
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: one model instance for the module: the fleet parts cache is keyed on
+#: (model, cfg, mesh), so tests sharing a mesh reuse compiled parts
+_MODEL = EightSchools()
+
+
+def _ds(seed):
+    r = np.random.default_rng(seed)
+    y, sig = np.asarray(Y), np.asarray(SIGMA)
+    return {"y": (y + r.normal(0, 2.0, y.shape)).astype(np.float32),
+            "sigma": sig}
+
+
+def _spec(n):
+    return FleetSpec.from_problems(_MODEL, [_ds(i) for i in range(n)])
+
+
+_KW = dict(
+    chains=2, block_size=20, max_blocks=10, min_blocks=2, num_warmup=100,
+    ess_target=40.0, rhat_target=1.3, seed=0, kernel="hmc",
+    num_leapfrog=12,
+)
+
+
+def _mesh(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices (conftest forces 8)")
+    return make_mesh({"problems": n}, devices=jax.devices()[:n])
+
+
+def _trace_report():
+    spec_ = importlib.util.spec_from_file_location(
+        "trace_report_mesh", os.path.join(_REPO, "tools", "trace_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec_)
+    spec_.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def mesh_runs(tmp_path_factory):
+    """The shared reference/mesh pair: B=4 single-device (with trace)
+    and the same spec over a 4-shard "problems" mesh (with trace)."""
+    td = tmp_path_factory.mktemp("fleet_mesh")
+    spec = _spec(4)
+    ref_trace = str(td / "ref.jsonl")
+    ref = sample_fleet(spec, trace=RunTrace(ref_trace), **_KW)
+    mesh = _mesh(4)
+    mesh_trace = str(td / "mesh.jsonl")
+    res = sample_fleet(
+        spec, mesh=mesh, trace=RunTrace(mesh_trace),
+        metrics_path=str(td / "mesh_metrics.jsonl"), **_KW,
+    )
+    return spec, ref, res, ref_trace, mesh_trace, td
+
+
+def test_mesh_bit_identity(mesh_runs):
+    """Per-problem draws on the 4-shard mesh are bit-identical to the
+    single-device fleet — the mesh split is free."""
+    _spec_, ref, res, *_ = mesh_runs
+    assert res.shards == 4
+    assert ref.shards is None
+    for a, b in zip(ref.problems, res.problems):
+        assert a.status == b.status
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+
+
+def test_mesh_padded_width_bit_identity():
+    """B=3 over 2 shards: the dispatch pads to 4 lanes (one discarded
+    lane-0 replica) and the three real problems' draws are untouched."""
+    spec = _spec(3)
+    ref = sample_fleet(spec, **_KW)
+    res = sample_fleet(spec, mesh=_mesh(2), **_KW)
+    assert res.shards == 2
+    for a, b in zip(ref.problems, res.problems):
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+
+
+def test_env_knob_resolves_and_matches(monkeypatch):
+    """STARK_FLEET_MESH=2 shards over the first two devices and keeps
+    draws bit-identical; the off value "0" stays single-device — the
+    knob-off escape hatch named by tools/lint_fused_knobs.py."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    spec = _spec(2)
+    monkeypatch.setenv("STARK_FLEET_MESH", "0")
+    ref = sample_fleet(spec, **_KW)
+    assert ref.shards is None
+    monkeypatch.setenv("STARK_FLEET_MESH", "2")
+    res = sample_fleet(spec, **_KW)
+    assert res.shards == 2
+    for a, b in zip(ref.problems, res.problems):
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+
+
+def test_env_knob_bad_value_raises(monkeypatch):
+    monkeypatch.setenv("STARK_FLEET_MESH", str(len(jax.devices()) + 1))
+    with pytest.raises(ValueError, match="STARK_FLEET_MESH"):
+        sample_fleet(_spec(2), **_KW)
+
+
+def test_mesh_axis_validation():
+    """A mesh without a "problems" axis — or with extra >1 axes that
+    would silently duplicate work — is rejected loudly."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    data_mesh = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="problems"):
+        sample_fleet(_spec(2), mesh=data_mesh, **_KW)
+    two_axis = make_mesh(
+        {"problems": 1, "chains": 2}, devices=jax.devices()[:2]
+    )
+    with pytest.raises(ValueError, match="duplicate work"):
+        sample_fleet(_spec(2), mesh=two_axis, **_KW)
+
+
+def test_sequential_hatch_ignores_mesh(monkeypatch, caplog):
+    """STARK_FLEET=0 always wins: the sweep has no problem axis, the
+    requested mesh is dropped with a warning, results carry shards=None."""
+    monkeypatch.setenv("STARK_FLEET", "0")
+    with caplog.at_level("WARNING", logger="stark_tpu.fleet"):
+        res = sample_fleet(_spec(2), mesh=_mesh(2), **_KW)
+    assert res.shards is None
+    assert any("mesh is ignored" in r.message for r in caplog.records)
+
+
+def test_slots_admission_on_mesh():
+    """PR 13 slots compose per shard: B=6 through a 4-wide pinned batch
+    over 2 shards — admissions scatter into the owning shard's slot,
+    the batched scan specializes ONCE, and every problem's draws match
+    the single-device slotted run."""
+    spec = _spec(6)
+    ref = sample_fleet(spec, slots=True, max_batch=4, **_KW)
+    res = sample_fleet(spec, slots=True, max_batch=4, mesh=_mesh(2), **_KW)
+    assert res.block_scan_compiles == 1
+    assert res.admissions >= 1
+    assert res.compactions == 0
+    for a, b in zip(ref.problems, res.problems):
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+
+
+def test_feed_submission_on_mesh():
+    """Streaming admission composes with the mesh: a problem submitted
+    through a FleetFeed lands in a shard's slot with draws bit-identical
+    to the single-device streaming run."""
+    spec = _spec(2)
+
+    def make_feed():
+        f = FleetFeed()
+        f.submit(_ds(100), problem_id="late")
+        f.close()
+        return f
+
+    kw = dict(_KW, slots=True, max_batch=2)
+    ref = sample_fleet(spec, feed=make_feed(), **kw)
+    res = sample_fleet(spec, feed=make_feed(), mesh=_mesh(2), **kw)
+    assert [p.problem_id for p in res.problems] == [
+        p.problem_id for p in ref.problems
+    ]
+    for a, b in zip(ref.problems, res.problems):
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+
+
+def test_mesh_trace_fields_and_knob_off_purity(mesh_runs):
+    """Mesh runs' fleet_block events carry shards + a per-shard
+    occupancy vector (one entry per shard, each in [0, 1]); knob-off
+    traces carry NONE of the per-shard fields — byte-purity with PR 13."""
+    _spec_, _ref, _res, ref_trace, mesh_trace, _td = mesh_runs
+    mesh_blocks = [
+        e for e in read_trace(mesh_trace) if e["event"] == "fleet_block"
+    ]
+    assert mesh_blocks
+    for e in mesh_blocks:
+        assert e["shards"] == 4
+        occ = e["shard_occupancy"]
+        assert len(occ) == 4
+        assert all(0.0 <= o <= 1.0 for o in occ)
+    starts = [
+        e for e in read_trace(mesh_trace) if e["event"] == "run_start"
+    ]
+    assert starts and starts[-1]["fleet_shards"] == 4
+    for e in read_trace(ref_trace):
+        assert "shards" not in e
+        assert "shard_occupancy" not in e
+        assert "fleet_shards" not in e
+
+
+def test_summarize_and_trace_report_render_shards(mesh_runs):
+    """summarize_trace rolls the per-shard fields into the fleet section
+    and trace_report renders them."""
+    _spec_, _ref, _res, _ref_trace, mesh_trace, _td = mesh_runs
+    events = read_trace(mesh_trace)
+    s = summarize_trace(events, run=events[-1].get("run", 1))
+    assert s["fleet"]["shards"] == 4
+    assert len(s["fleet"]["shard_occupancy_last"]) == 4
+    out = _trace_report().render_run(events, events[-1].get("run", 1))
+    assert "mesh shards" in out
+    assert "per-shard occupancy (last)" in out
+
+
+def test_trace_report_na_safe_on_pre_pr14_fixture():
+    """REGRESSION PIN: the committed pre-PR-14 fleet trace fixture (a
+    real PR 13-era `sample_fleet` run) renders without error and without
+    the per-shard rows — old traces are n/a-filtered, never crashed on."""
+    fixture = os.path.join(_REPO, "tests", "fixtures",
+                           "fleet_trace_pr13.jsonl")
+    events = read_trace(fixture)
+    assert events, "committed fixture trace is unreadable"
+    run = events[-1].get("run", 1)
+    s = summarize_trace(events, run=run)
+    assert "shards" not in s["fleet"]
+    assert "shard_occupancy_last" not in s["fleet"]
+    out = _trace_report().render_run(events, run)
+    # the fleet table renders (it IS a fleet trace) without shard rows
+    assert "fleet" in out
+    assert "mesh shards" not in out
+    assert "per-shard occupancy" not in out
+
+
+def test_metrics_collector_shard_gauges(mesh_runs):
+    """The collector turns fleet_block shard fields into the
+    stark_fleet_shards gauge and the shard-labeled occupancy gauge —
+    and a fresh run_start clears the per-shard labels."""
+    from stark_tpu import metrics as m
+
+    _spec_, _ref, _res, _ref_trace, mesh_trace, _td = mesh_runs
+    col = m.TraceCollector(registry=m.MetricsRegistry())
+    for e in read_trace(mesh_trace):
+        col.on_event(dict(e))
+    text = col.registry.render()
+    assert f"{m.METRIC_PREFIX}_fleet_shards 4" in text
+    assert f'{m.METRIC_PREFIX}_fleet_shard_occupancy{{shard="0"}}' in text
+    assert f'{m.METRIC_PREFIX}_fleet_shard_occupancy{{shard="3"}}' in text
+    # a fresh (non-restart) run_start clears run A's mesh layout: both
+    # the shard count and the per-shard labels vanish, so a following
+    # single-device run never scrapes a stale shards=4
+    col.on_event({"event": "run_start", "run": 99})
+    text2 = col.registry.render()
+    assert f"{m.METRIC_PREFIX}_fleet_shard_occupancy{{" not in text2
+    assert f"{m.METRIC_PREFIX}_fleet_shards 4" not in text2
+
+
+def test_fleet_result_shards_field(mesh_runs):
+    _spec_, ref, res, *_ = mesh_runs
+    assert ref.shards is None and res.shards == 4

@@ -39,14 +39,16 @@ from typing import Dict, List, Set, Tuple
 _READ_FUNCS = frozenset({"get", "getenv", "pop", "fused_knob"})
 
 #: covered knobs: the fused-op family, the kernel-scheduler knob, the
-#: quant-calibration family, and the fleet slot-scheduler pair
+#: quant-calibration family, the fleet slot-scheduler pair
 #: (STARK_FLEET_SLOTS pins the compiled batch shape, STARK_FLEET_WARMSTART
 #: turns on donor-seeded admission warmup — each changes which executable
-#: / how much warmup every admitted problem runs) — extend the
+#: / how much warmup every admitted problem runs), and the
+#: device-parallel fleet knob (STARK_FLEET_MESH shards the problem axis
+#: over a mesh — a different compiled dispatch per shard) — extend the
 #: alternation when a new execution-path knob family lands
 _KNOB_RE = re.compile(
     r"^STARK_(?:FUSED_[A-Z0-9_]+|RAGGED_NUTS|QUANT_[A-Z0-9_]+"
-    r"|FLEET_SLOTS|FLEET_WARMSTART)$"
+    r"|FLEET_SLOTS|FLEET_WARMSTART|FLEET_MESH)$"
 )
 
 

@@ -198,6 +198,13 @@ def render_run(events, run) -> str:
              if fl.get("active_last") is not None
              and fl.get("batch_last") is not None else None),
             ("active grad evals", fl.get("grad_evals")),
+            # mesh-parallel fleet (PR 14): shard count + per-shard
+            # occupancy — n/a-filtered on single-device and pre-PR-14
+            # traces like every other late-addition field
+            ("mesh shards", fl.get("shards")),
+            ("per-shard occupancy (last)",
+             ", ".join(f"{float(o):.2f}" for o in fl["shard_occupancy_last"])
+             if fl.get("shard_occupancy_last") else None),
         ]
         out.append(_table(
             [r for r in rows if r[1] is not None], ("fleet", "value")
