@@ -529,6 +529,7 @@ def main():
     chees_overlap = {}  # block-pipeline overlap from the supervised trace
     chees_diag = {}  # streaming-gate transfer + overshoot, same trace
     chees_profile = {}  # span-timeline attribution, same trace (PR 11)
+    chees_health = None  # statistical-health rollup, same trace (PR 15)
     # ChEES workload knobs, resolved ONCE: the sampling leg below and the
     # ledger config key both read these — two copies of the defaults
     # would let them drift, silently splitting the ledger's comparability
@@ -740,6 +741,19 @@ def main():
             else:
                 chees_overlap = trace_summary.get("overlap") or {}
                 chees_diag = trace_summary.get("diag") or {}
+                # advisory health column: only claim a clean trail when
+                # the observatory was actually on in THIS process — a
+                # warning-free trace under STARK_HEALTH=0 says nothing
+                try:
+                    from stark_tpu.health import health_enabled
+
+                    # an EMPTY health section (no chain_health events
+                    # survived — e.g. a warmup-only trace) stays None:
+                    # "observed clean" requires an observed trail
+                    if health_enabled() and trace_summary.get("health"):
+                        chees_health = trace_summary["health"]
+                except Exception:  # noqa: BLE001 — evidence, never a failure
+                    pass
             # span-timeline attribution (stark_tpu.profiling): compile
             # wall, retired device-dispatch count, and the attributed
             # fraction of the run wall — recorded evidence in the final
@@ -1057,6 +1071,22 @@ def main():
                 "span_coverage_frac": chees_profile.get(
                     "span_coverage_frac"
                 ),
+                # statistical-health observatory (stark_tpu.health):
+                # warnings the supervised leg's trace carries — ADVISORY
+                # only (never gated), and null when the trace predates
+                # the observatory / STARK_HEALTH=0 / no trace survived —
+                # never 0, so a silent trail can't read as "healthy"
+                "health_warnings": (
+                    chees_health.get("warnings", 0)
+                    if chees_health is not None else None
+                ),
+                **(
+                    {"health_warning_types": sorted(
+                        chees_health["warning_counts"]
+                    )}
+                    if chees_health and chees_health.get("warning_counts")
+                    else {}
+                ),
                 # quantized/bf16 X streaming (ops/quantize.py): the
                 # resolved stream dtype + design-slab bytes one fused
                 # value-and-grad evaluation reads — with dispatch_count
@@ -1085,6 +1115,10 @@ _PROFILING_EXTRA_KEYS = (
     # quantized X streaming evidence (absent from the artifact — and so
     # from the row — on plain f32 runs; append_ledger skips nulls)
     "x_dtype", "x_bytes_per_grad",
+    # statistical-health advisory column (stark_tpu.health): warning
+    # count from the supervised trace — null-not-0.0 when the trace
+    # can't say; recorded, never regression-gated
+    "health_warnings",
 )
 
 def _flagship_x_stream_fields(n, d):

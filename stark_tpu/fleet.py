@@ -134,7 +134,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as _PSPEC
 
-from . import diagnostics, faults, telemetry
+from . import diagnostics, faults, health as _health, telemetry
 from .adaptation import DualAveragingState, build_warmup_schedule
 from .kernels.base import STREAM_DIAG_LAGS, HMCState, StreamDiagState
 from .model import Model, flatten_model, prepare_model_data
@@ -557,7 +557,7 @@ class FleetProblemResult:
                  budget_exhausted, blocks, grad_evals, num_divergent,
                  min_ess, max_rhat, history, _constrain_cache,
                  failed=None, failed_reason=None, lane_restarts=0,
-                 warmstarted=False, warmup_draws_saved=0):
+                 warmstarted=False, warmup_draws_saved=0, health=None):
         self.problem_id = problem_id
         self.draws_flat = draws_flat  # (chains, n, d) unconstrained
         self.flat_model = fm
@@ -577,6 +577,11 @@ class FleetProblemResult:
         # draws per chain the shortened schedule skipped
         self.warmstarted = warmstarted
         self.warmup_draws_saved = warmup_draws_saved
+        # per-problem statistical-health verdict (stark_tpu.health):
+        # sorted warning names the observatory raised for this tenant
+        # ([] = clean trail); None when STARK_HEALTH=0 or the problem
+        # predates the observatory — null, never a claim of health
+        self.health = health
         self._cache = _constrain_cache
         self._draws = None
 
@@ -1410,6 +1415,37 @@ def _sample_fleet(
     recorder.set_workdir(
         _fleet_workdir(checkpoint_path, metrics_path, draw_store_path)
     )
+    # statistical-health observatory (stark_tpu.health): one host-side
+    # monitor per PROBLEM, fed from the gathered block readbacks below —
+    # warnings are per-tenant trace events (problem_id-tagged) and the
+    # terminal verdict rides the per-problem result.  Entirely outside
+    # the compiled dispatches: draws/metrics/checkpoints are
+    # bit-identical with it on, and STARK_HEALTH=0 removes the extra
+    # device->host energy/accept gathers too.
+    health_on = _health.health_enabled()
+    monitors: Dict[str, _health.HealthMonitor] = {}
+    health_verdicts: Dict[str, List[str]] = {}
+
+    def monitor_for(p):
+        m = monitors.get(p.pid)
+        if m is None:
+            m = monitors[p.pid] = _health.HealthMonitor(
+                kernel=cfg.kernel, max_depth=cfg.max_tree_depth,
+                trace=trace, problem_id=p.pid,
+            )
+        return m
+
+    def finalize_monitor(p):
+        """Terminal per-problem verdict: finalize the monitor (end-of-run
+        R-hat/ESS warnings) and bank the sorted warning names."""
+        m = monitors.pop(p.pid, None)
+        if m is not None:
+            health_verdicts[p.pid] = m.finalize(
+                converged=p.converged, max_rhat=p.max_rhat,
+                min_ess=p.min_ess,
+            )
+        else:
+            health_verdicts.setdefault(p.pid, [])
     if trace.enabled:
         trace.emit(
             "run_start",
@@ -1863,6 +1899,11 @@ def _sample_fleet(
         # never leak into aggregate-ESS numerators or bench gates
         p.min_ess = None
         p.max_rhat = None
+        if health_on:
+            # terminal verdict BEFORE the diagnostics are voided above
+            # took effect on the monitor (it holds the stuck_chain
+            # warning the containment path just raised)
+            finalize_monitor(p)
         if store is not None and quarantined_as is None:
             store.close_problem(p.pid)
             path = store.path(p.pid)
@@ -1922,6 +1963,10 @@ def _sample_fleet(
             store.close_problem(p.pid)
             store.truncate(p.pid, 0)
         p.reseed(_lane_key(p.idx, p.lane_restarts), chains, fm.ndim)
+        # the reseeded lane is a fresh chain: its health accumulators
+        # restart with it (the emitted stuck_chain warning and the
+        # lane_restarts count remain the durable evidence)
+        monitors.pop(p.pid, None)
         log.warning(
             "fleet problem %s lane reseeded (%s, restart %d/%d): %s",
             p.pid, fault, p.lane_restarts, p.max_restarts, reason,
@@ -1963,6 +2008,12 @@ def _sample_fleet(
         if store is not None:
             store.close_problem(p.pid)
         status = p.status
+        verdict = None
+        if health_on:
+            # end-of-problem health sweep (may emit high_rhat /
+            # low_ess_per_param) BEFORE the terminal announcement below
+            finalize_monitor(p)
+            verdict = health_verdicts.get(p.pid)
         # SLO rollup on the CUMULATIVE wall (the same clock deadlines
         # charge): what the tenant got, per second, and how much of its
         # deadline / restart budget the run consumed
@@ -1995,11 +2046,17 @@ def _sample_fleet(
             fields["warmup_draws_saved"] = p.warmup_draws_saved
         fields.update(extra)
         emit({"event": "problem_done", **fields})
+        # the health verdict rides ONLY the trace event (and only when
+        # non-empty): the metrics JSONL record above stays byte-identical
+        # to the pre-observatory fleet
+        trace_fields = (
+            dict(fields, health=verdict) if verdict else fields
+        )
         emitted = (
-            trace.emit("problem_converged", **fields)
+            trace.emit("problem_converged", **trace_fields)
             if trace.enabled else None
         )
-        return emitted or {"event": "problem_converged", **fields}
+        return emitted or {"event": "problem_converged", **trace_fields}
 
     def poison_lane_site(st):
         """``fleet.lane_nan`` (action ``nan``, arg = problem ordinal,
@@ -2251,11 +2308,12 @@ def _sample_fleet(
         raise
 
     def gate_and_record(p: _ProblemState, zs, divergent, blk_grads,
-                        diag_lane):
+                        diag_lane, accept=None, energy=None, ngrad=None):
         """One problem's share of a finished block: diagnostics, gate,
         metrics record — the per-problem twin of the single runner's
         `process_block` (same streaming gate, same full-pass validation,
-        same backoff)."""
+        same backoff).  ``accept``/``energy``/``ngrad`` are this lane's
+        health-observatory readbacks (None when STARK_HEALTH=0)."""
         p.blocks_done += 1
         p.hist.append(zs)
         if store is not None:
@@ -2338,6 +2396,22 @@ def _sample_fleet(
             p.budget_exhausted = True
         p.history.append(rec)
         emit(rec)
+        if health_on:
+            # per-tenant warning sweep AFTER the block record, so the
+            # metrics trail stays byte-identical to the pre-observatory
+            # fleet (warnings are trace events only)
+            monitor_for(p).observe_block(
+                block=p.blocks_done,
+                zs=zs,
+                accept=accept,
+                divergent=divergent,
+                energy=energy,
+                ngrad=ngrad if cfg.kernel == "nuts" else None,
+                max_rhat=p.max_rhat,
+                min_ess=p.min_ess,
+                n_stuck=n_stuck,
+                draws_per_chain=int(p.suff.count[0]),
+            )
         if not p.active:
             # this problem's final block was appended above; no masked
             # lane ever appends again, so its store file is final
@@ -2573,16 +2647,16 @@ def _sample_fleet(
                 out = v_dispatch(*args)
             if stream_diag:
                 if ragged:
-                    (state, diag, zs, accept, divergent, _energy, ngrad,
+                    (state, diag, zs, accept, divergent, energy, ngrad,
                      lane_iters) = out
                 else:
-                    state, diag, zs, accept, divergent, _energy, ngrad = out
+                    state, diag, zs, accept, divergent, energy, ngrad = out
             else:
                 if ragged:
-                    (state, zs, accept, divergent, _energy, ngrad,
+                    (state, zs, accept, divergent, energy, ngrad,
                      lane_iters) = out
                 else:
-                    state, zs, accept, divergent, _energy, ngrad = out
+                    state, zs, accept, divergent, energy, ngrad = out
             state = faults.poison("runner.carried_nan", state)
             state = poison_lane_site(state)
             blocks_dispatched += 1
@@ -2602,6 +2676,15 @@ def _sample_fleet(
             divergent_h = gather_tree(divergent)
             ngrad_h = gather_tree(ngrad)
             diag_h = gather_tree(diag) if stream_diag else None
+            # acceptance + per-block Hamiltonian series cross to host
+            # ONLY for the health observatory (STARK_HEALTH=0 restores
+            # the historical drop-on-device behavior)
+            accept_h = (
+                np.asarray(gather_tree(accept)) if health_on else None
+            )
+            energy_h = (
+                np.asarray(gather_tree(energy)) if health_on else None
+            )
             t_wait = time.perf_counter() - t_blk
             # per-LANE finite scan: a poisoned lane is a PROBLEM fault,
             # contained below (reseed-or-quarantine) — never a fleet
@@ -2646,8 +2729,12 @@ def _sample_fleet(
                     jax.tree.map(lambda a, j=j: a[j], diag_h)
                     if stream_diag else None
                 )
-                gate_and_record(p, zs[j], divergent_h[j], blk_grads,
-                                diag_lane)
+                gate_and_record(
+                    p, zs[j], divergent_h[j], blk_grads, diag_lane,
+                    accept=accept_h[j] if accept_h is not None else None,
+                    energy=energy_h[j] if energy_h is not None else None,
+                    ngrad=ngrad_h[j],
+                )
                 if donor_pool is not None and p.converged:
                     new_donors.append((j, p))
             if new_donors:
@@ -2673,6 +2760,14 @@ def _sample_fleet(
                 rewarm_js: List[int] = []
                 rewarm_idx: List[int] = []
                 for j, i, reason in poisoned:
+                    if health_on:
+                        # the statistical trail records the stuck lane
+                        # BEFORE the fault taxonomy acts on it (the
+                        # reseed/quarantine below) — the same
+                        # warning-first ordering as the single runner
+                        monitor_for(probs[i]).warn_nonfinite(
+                            reason, block=blocks_dispatched
+                        )
                     if reseed_problem(probs[i], _FAULT_POISONED, reason):
                         rewarm_js.append(j)
                         rewarm_idx.append(i)
@@ -2963,6 +3058,12 @@ def _sample_fleet(
             store.close()
 
     wall = time.perf_counter() - t_start
+    if health_on:
+        # problems still live at fleet exit (a fleet-level budget trip)
+        # get their terminal health sweep here
+        for p in probs:
+            if p.pid not in health_verdicts:
+                finalize_monitor(p)
     constrain_cache: Dict[Any, Any] = {}
     results = [
         FleetProblemResult(
@@ -2988,6 +3089,7 @@ def _sample_fleet(
             lane_restarts=p.lane_restarts,
             warmstarted=p.warmstarted,
             warmup_draws_saved=p.warmup_draws_saved,
+            health=health_verdicts.get(p.pid) if health_on else None,
         )
         for p in probs
     ]
@@ -3456,6 +3558,9 @@ def _sample_fleet_sequential(
                     history=res.history,
                     _constrain_cache=constrain_cache,
                     lane_restarts=lane_restarts,
+                    # the sequential hatch inherits the single runner's
+                    # health verdict (None when STARK_HEALTH=0)
+                    health=getattr(res, "health_warnings", None),
                 )
             )
     except BaseException:

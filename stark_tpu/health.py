@@ -1,0 +1,619 @@
+"""Streaming statistical-health observatory: Stan-style sampler warnings.
+
+The observability stack (telemetry/metrics/statusd/profiling) attributes
+every wall-second and captures every process fault, but until now the
+*statistical* health of the chains was nearly blind: the kernels compute
+acceptance, divergence flags, and per-draw energies on every transition,
+yet only coarse ``mean_accept``/``num_divergent`` counts survived into
+traces.  A run that is fast but silently biased is a worse failure than a
+crash — this module is the missing quality trail.
+
+`HealthMonitor` is a HOST-SIDE streaming accumulator fed from the block
+readbacks every sampling driver already materializes (draws, acceptance,
+divergence flags, energies, NUTS leaf counts).  Nothing here touches a
+compiled program or consumes a PRNG key, which is what makes the
+bit-identity contract structural: with health instrumentation on
+(the default), draws/metrics/checkpoints are bit-identical to the
+uninstrumented build, and ``STARK_HEALTH=0`` suppresses the trace events
+too (byte-identical trace files).
+
+Per block it accumulates, per chain:
+
+  * an **energy trail** for E-BFMI (Betancourt's energy Bayesian fraction
+    of missing information): sum of squared first differences of the
+    Hamiltonian over a Welford variance of the energy marginal — the
+    heavy-tail / funnel detector Stan prints as ``E-BFMI``;
+  * a **tree-depth histogram** (NUTS only), derived exactly from the leaf
+    count via `kernels.nuts.tree_depth_from_leaves` — no kernel output
+    was added for it;
+  * a bounded **divergence-snapshot ring**: the first
+    ``STARK_HEALTH_SNAPSHOTS`` divergent-transition positions per block
+    (unconstrained coordinates, truncated to
+    ``STARK_HEALTH_SNAPSHOT_DIM``), the divergence-LOCALIZATION evidence
+    (a centered funnel's snapshots concentrate at low tau);
+  * block-level acceptance / divergence-fraction / stuck-chain signals.
+
+The **warning engine** evaluates the Stan-style taxonomy (`WARNINGS`)
+from those stats plus the runner's streaming R-hat/ESS gate values, and
+emits each triggered warning as a schema'd ``health_warning`` trace
+event (registered in `telemetry.ALL_EVENT_TYPES`) with severity,
+affected chains, the measured value vs its ``STARK_HEALTH_*`` threshold
+knob, and a remediation hint.  Severity ``error`` warnings additionally
+dump a flight-recorder postmortem bundle (once per warning type per
+monitor) when a supervised/fleet run has the recorder armed — the
+warning engine only ever PEEKS at the recorder, it never creates one.
+
+Taxonomy (threshold knob in parentheses; all knobs documented in the
+README warning table and linted by ``tools/lint_health_thresholds.py``):
+
+  divergences               post-warmup divergent fraction above
+                            STARK_HEALTH_DIVERGENCE_FRAC (default 0 —
+                            any divergence warns, like Stan)
+  low_ebfmi                 any chain's E-BFMI below STARK_HEALTH_EBFMI
+                            once STARK_HEALTH_MIN_DRAWS draws accumulated
+  max_treedepth_saturation  fraction of NUTS transitions at max_depth
+                            above STARK_HEALTH_TREEDEPTH_FRAC
+  low_accept                block mean acceptance below
+                            STARK_HEALTH_LOW_ACCEPT
+  stuck_chain               a chain's block acceptance below
+                            STARK_HEALTH_STUCK_ACCEPT, a NaN streaming
+                            R-hat component, or a non-finite carried
+                            state (severity error — the pre-taxonomy
+                            twin of the supervisor's poisoned_state)
+  high_rhat                 final max split R-hat above
+                            STARK_HEALTH_RHAT (evaluated at run end —
+                            early-block R-hat is legitimately high)
+  low_ess_per_param         final worst-coordinate ESS below
+                            STARK_HEALTH_MIN_ESS (run end)
+
+Consumers: `metrics.TraceCollector` (``stark_health_*`` gauges + warning
+counters, ``/status.health.warnings``), `telemetry.summarize_trace`
+(``health.warnings``), ``tools/health_report.py`` (the renderer),
+`fleet` per-problem verdicts, and bench.py's advisory (non-gating,
+null-not-0.0) health column.
+
+ChEES note: the ensemble scan does not read back per-transition energies
+or tree depths (it has no trees), so the chees path gets the
+acceptance/divergence/R-hat warnings and E-BFMI stays n/a — extending
+its readback tuple would ripple through every backend for one statistic.
+SG-HMC has no accept statistic either; `sghmc_health_trail` wires its
+kinetic-energy/divergence arrays into the same trace bus.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import telemetry
+
+__all__ = [
+    "HealthMonitor",
+    "WARNINGS",
+    "health_enabled",
+    "sghmc_health_trail",
+    "thresholds",
+]
+
+#: master switch — the repo-wide ``=0 opts out`` env convention.  With it
+#: off, no monitor is built anywhere: no health_warning events, no
+#: flight-recorder dumps, trace files byte-identical to PR 14.
+HEALTH_ENV = "STARK_HEALTH"
+
+#: severity ladder (ordered); ``error`` triggers a flight-recorder dump
+SEVERITIES = ("info", "warn", "error")
+
+#: the warning taxonomy: name -> (default severity, threshold knob,
+#: remediation hint).  The knob column and hints are the operator
+#: contract the README table mirrors (lint_health_thresholds.py pins it).
+WARNINGS: Dict[str, Dict[str, str]] = {
+    "divergences": {
+        "severity": "warn",
+        "knob": "STARK_HEALTH_DIVERGENCE_FRAC",
+        "hint": ("increase target_accept, or reparameterize "
+                 "(non-centered) the hierarchy the snapshots localize"),
+    },
+    "low_ebfmi": {
+        "severity": "warn",
+        "knob": "STARK_HEALTH_EBFMI",
+        "hint": ("energy marginal poorly explored: reparameterize or "
+                 "run longer warmup (heavier-tailed momentum regime)"),
+    },
+    "max_treedepth_saturation": {
+        "severity": "warn",
+        "knob": "STARK_HEALTH_TREEDEPTH_FRAC",
+        "hint": ("trajectories truncated at max_tree_depth: raise "
+                 "max_tree_depth or improve the mass matrix / step size"),
+    },
+    "low_accept": {
+        "severity": "warn",
+        "knob": "STARK_HEALTH_LOW_ACCEPT",
+        "hint": ("acceptance far below target: step size too large for "
+                 "the geometry — retune warmup or raise target_accept"),
+    },
+    "stuck_chain": {
+        "severity": "error",
+        "knob": "STARK_HEALTH_STUCK_ACCEPT",
+        "hint": ("a chain stopped moving (frozen component, ~zero "
+                 "acceptance, or non-finite state): check the model's "
+                 "numerics; the supervisor will reseed on health_check"),
+    },
+    "high_rhat": {
+        "severity": "warn",
+        "knob": "STARK_HEALTH_RHAT",
+        "hint": ("chains disagree at the end of the run: draws are not "
+                 "trustworthy — run longer or reparameterize"),
+    },
+    "low_ess_per_param": {
+        "severity": "warn",
+        "knob": "STARK_HEALTH_MIN_ESS",
+        "hint": ("worst-coordinate ESS too small for stable estimates: "
+                 "run longer or thin less"),
+    },
+}
+
+
+def health_enabled() -> bool:
+    """STARK_HEALTH != 0 (default on).  The literal read keeps the
+    master switch visible to tools/lint_health_thresholds.py."""
+    return os.environ.get("STARK_HEALTH", "1") != "0"
+
+
+def _env_float(raw: Optional[str], default: float) -> float:
+    try:
+        return float(raw) if raw not in (None, "") else default
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_int(raw: Optional[str], default: int) -> int:
+    try:
+        return int(raw) if raw not in (None, "") else default
+    except (TypeError, ValueError):
+        return default
+
+
+def thresholds() -> Dict[str, float]:
+    """The resolved STARK_HEALTH_* threshold knobs (README table is the
+    operator contract; every read here must appear there AND in a named
+    test — tools/lint_health_thresholds.py enforces both)."""
+    return {
+        "divergence_frac": _env_float(
+            os.environ.get("STARK_HEALTH_DIVERGENCE_FRAC"), 0.0
+        ),
+        "ebfmi": _env_float(os.environ.get("STARK_HEALTH_EBFMI"), 0.3),
+        "treedepth_frac": _env_float(
+            os.environ.get("STARK_HEALTH_TREEDEPTH_FRAC"), 0.05
+        ),
+        "low_accept": _env_float(
+            os.environ.get("STARK_HEALTH_LOW_ACCEPT"), 0.6
+        ),
+        "stuck_accept": _env_float(
+            os.environ.get("STARK_HEALTH_STUCK_ACCEPT"), 0.05
+        ),
+        "rhat": _env_float(os.environ.get("STARK_HEALTH_RHAT"), 1.05),
+        "min_ess": _env_float(os.environ.get("STARK_HEALTH_MIN_ESS"), 100.0),
+        "min_draws": _env_int(
+            os.environ.get("STARK_HEALTH_MIN_DRAWS"), 100
+        ),
+        "snapshots": _env_int(os.environ.get("STARK_HEALTH_SNAPSHOTS"), 4),
+        "snapshot_dim": _env_int(
+            os.environ.get("STARK_HEALTH_SNAPSHOT_DIM"), 16
+        ),
+    }
+
+
+#: total snapshot-ring capacity per monitor (first-K-per-block entries,
+#: oldest evicted) — bounds memory on very long divergent runs
+_SNAPSHOT_RING = 64
+
+
+class HealthMonitor:
+    """Per-run (or per-fleet-problem) streaming health accumulator +
+    warning engine.  Purely host-side; every observe/emit is outside the
+    kernels' op/key sequence by construction.
+
+    ``kernel`` selects which statistics apply ("nuts" gets tree depth;
+    "nuts"/"hmc" get E-BFMI; "chees" neither).  ``problem_id`` tags
+    every emitted warning on fleet lanes.  ``trace`` defaults to the
+    ambient telemetry trace at emit time.
+    """
+
+    def __init__(self, *, kernel: str, max_depth: int = 10,
+                 trace: Any = None, problem_id: Optional[str] = None):
+        self.kernel = kernel
+        self.max_depth = int(max_depth)
+        self.problem_id = problem_id
+        self._trace = trace
+        self.thr = thresholds()
+        # energy trail (per chain): previous energy, sum of squared first
+        # differences + diff count, Welford moments of the energy marginal
+        self._e_prev: Optional[np.ndarray] = None
+        self._e_diff2: Optional[np.ndarray] = None
+        self._e_ndiff: Optional[np.ndarray] = None
+        self._e_n: Optional[np.ndarray] = None
+        self._e_mean: Optional[np.ndarray] = None
+        self._e_m2: Optional[np.ndarray] = None
+        # NUTS tree-depth histogram: (chains, max_depth + 1) counts
+        self._depth_hist: Optional[np.ndarray] = None
+        # divergence accounting + bounded snapshot ring
+        self._div_total = 0
+        self._trans_total = 0
+        self._sat_total = 0
+        self.snapshots: deque = deque(maxlen=_SNAPSHOT_RING)
+        # latest gate values (the runner's streaming R-hat/ESS trail)
+        self._last_rhat: Optional[float] = None
+        self._last_ess: Optional[float] = None
+        self._draws_per_chain = 0
+        # warning state: name -> last emitted event fields; error-severity
+        # names that already dumped a postmortem bundle
+        self.active: Dict[str, Dict[str, Any]] = {}
+        self._dumped: set = set()
+        self._finalized = False
+
+    # -- emission ----------------------------------------------------------
+
+    def _emit(self, name: str, *, severity: Optional[str] = None,
+              value: Optional[float] = None,
+              threshold: Optional[float] = None,
+              block: Optional[int] = None,
+              chains: Optional[List[int]] = None,
+              **fields) -> Dict[str, Any]:
+        """Emit one ``health_warning`` trace event, record it as active,
+        and dump a postmortem bundle on the first error-severity
+        occurrence (only when a supervised/fleet run armed the
+        recorder).  Never raises into the run."""
+        spec = WARNINGS[name]
+        sev = severity or spec["severity"]
+        rec = {
+            "warning": name,
+            "severity": sev,
+            "hint": spec["hint"],
+            "knob": spec["knob"],
+        }
+        if value is not None and np.isfinite(value):
+            rec["value"] = round(float(value), 6)
+        if threshold is not None:
+            rec["threshold"] = float(threshold)
+        if block is not None:
+            rec["block"] = int(block)
+        if chains:
+            # cap the affected-chain list so one 4096-lane fleet block
+            # cannot bloat a trace line
+            rec["chains"] = [int(c) for c in chains[:8]]
+            rec["num_chains_affected"] = len(chains)
+        if self.problem_id is not None:
+            rec["problem_id"] = self.problem_id
+        rec.update(fields)
+        trace = (
+            self._trace if self._trace is not None else telemetry.get_trace()
+        )
+        try:
+            emitted = trace.emit("health_warning", **rec)
+        except Exception:  # noqa: BLE001 — observability must not fault the run
+            emitted = None
+        self.active[name] = rec
+        if sev == "error" and name not in self._dumped:
+            self._dumped.add(name)
+            recorder = telemetry.peek_flight_recorder()
+            if recorder is not None:
+                try:
+                    recorder.note_anomaly(
+                        f"health:{name}", emitted or {
+                            "event": "health_warning", **rec
+                        }
+                    )
+                except Exception:  # noqa: BLE001 — forensics stay best-effort
+                    pass
+        return rec
+
+    # -- observations ------------------------------------------------------
+
+    def observe_block(self, *, block: int, zs=None, accept=None,
+                      divergent=None, energy=None, ngrad=None,
+                      max_rhat: Optional[float] = None,
+                      min_ess: Optional[float] = None,
+                      n_stuck: int = 0,
+                      draws_per_chain: Optional[int] = None) -> None:
+        """Fold one retired draw block into the accumulators and run the
+        per-block warning sweep.  Array layouts are the host readbacks:
+        ``zs`` (chains, block, d); ``accept``/``divergent``/``energy``/
+        ``ngrad`` (chains, block).  Any argument may be None (the path
+        that cannot supply it — e.g. chees energies) and its statistics
+        are simply skipped, never defaulted to zero."""
+        thr = self.thr
+        if max_rhat is not None and np.isfinite(max_rhat):
+            self._last_rhat = float(max_rhat)
+        if min_ess is not None and np.isfinite(min_ess):
+            self._last_ess = float(min_ess)
+        if draws_per_chain is not None:
+            self._draws_per_chain = int(draws_per_chain)
+
+        div = None
+        if divergent is not None:
+            div = np.asarray(divergent, bool)
+            self._div_total += int(div.sum())
+            self._trans_total += int(div.size)
+
+        acc = None
+        if accept is not None:
+            acc = np.asarray(accept, np.float64)
+
+        # -- energy trail / E-BFMI (per-chain, streaming, vectorized) --
+        if (
+            energy is not None
+            and self.kernel in ("nuts", "hmc")
+            and np.asarray(energy).size
+        ):
+            e = np.asarray(energy, np.float64)  # (chains, block)
+            c = e.shape[0]
+            if self._e_prev is None:
+                self._e_prev = np.full((c,), np.nan)
+                self._e_diff2 = np.zeros((c,))
+                self._e_ndiff = np.zeros((c,), np.int64)
+                self._e_n = np.zeros((c,), np.int64)
+                self._e_mean = np.zeros((c,))
+                self._e_m2 = np.zeros((c,))
+            # first differences, block-internal plus the block boundary
+            # (self._e_prev carries the previous block's final energy);
+            # non-finite energies are masked out, never zero-filled
+            seq = np.concatenate([self._e_prev[:, None], e], axis=1)
+            d = np.diff(seq, axis=1)
+            dok = np.isfinite(d)
+            self._e_diff2 += np.where(dok, d * d, 0.0).sum(axis=1)
+            self._e_ndiff += dok.sum(axis=1)
+            # parallel-Welford merge of the block's energy marginal into
+            # the running per-chain moments
+            ok = np.isfinite(e)
+            nb = ok.sum(axis=1)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                mb = np.where(
+                    nb > 0,
+                    np.where(ok, e, 0.0).sum(axis=1) / np.maximum(nb, 1),
+                    0.0,
+                )
+                m2b = np.where(
+                    ok, (e - mb[:, None]) ** 2, 0.0
+                ).sum(axis=1)
+                n_new = self._e_n + nb
+                delta = mb - self._e_mean
+                self._e_mean = self._e_mean + np.where(
+                    n_new > 0, delta * nb / np.maximum(n_new, 1), 0.0
+                )
+                self._e_m2 = self._e_m2 + m2b + np.where(
+                    n_new > 0,
+                    delta * delta * self._e_n * nb / np.maximum(n_new, 1),
+                    0.0,
+                )
+                self._e_n = n_new
+            last_ok = np.where(
+                ok.any(axis=1), e.shape[1] - 1 - np.argmax(ok[:, ::-1],
+                                                           axis=1), 0
+            )
+            last = e[np.arange(c), last_ok]
+            self._e_prev = np.where(ok.any(axis=1), last, self._e_prev)
+
+        # -- tree-depth histogram (NUTS; exact depth from leaf counts) --
+        sat_frac = None
+        if ngrad is not None and self.kernel == "nuts":
+            from .kernels.nuts import tree_depth_from_leaves
+
+            depth = tree_depth_from_leaves(np.asarray(ngrad, np.int64))
+            c = depth.shape[0]
+            if self._depth_hist is None:
+                self._depth_hist = np.zeros(
+                    (c, self.max_depth + 1), np.int64
+                )
+            capped = np.clip(depth, 0, self.max_depth)
+            for ch in range(c):
+                self._depth_hist[ch] += np.bincount(
+                    capped[ch], minlength=self.max_depth + 1
+                )
+            sat = depth >= self.max_depth
+            self._sat_total += int(sat.sum())
+            sat_frac = float(sat.mean()) if sat.size else None
+
+        # -- divergence snapshots (first K per block, bounded ring) --
+        snaps: List[Dict[str, Any]] = []
+        if div is not None and zs is not None and div.any():
+            z = np.asarray(zs)
+            k = max(int(thr["snapshots"]), 0)
+            dim = max(int(thr["snapshot_dim"]), 1)
+            # row-major over (chain, step): "first K per block" in
+            # transition order within each chain
+            where = np.argwhere(div)
+            for ch, t in where[:k]:
+                snaps.append({
+                    "chain": int(ch),
+                    "step": int(t),
+                    "z": [round(float(v), 6) for v in z[ch, t, :dim]],
+                })
+            for s in snaps:
+                self.snapshots.append({"block": int(block), **s})
+
+        # -- per-block warning sweep --
+        if div is not None and div.size:
+            frac = float(div.mean())
+            if frac > thr["divergence_frac"]:
+                self._emit(
+                    "divergences",
+                    value=frac,
+                    threshold=thr["divergence_frac"],
+                    block=block,
+                    chains=list(np.nonzero(div.any(axis=1))[0]),
+                    count=int(div.sum()),
+                    total=self._div_total,
+                    **({"snapshots": snaps} if snaps else {}),
+                )
+        if sat_frac is not None and sat_frac > thr["treedepth_frac"]:
+            self._emit(
+                "max_treedepth_saturation",
+                value=sat_frac,
+                threshold=thr["treedepth_frac"],
+                block=block,
+                max_tree_depth=self.max_depth,
+            )
+        if acc is not None and acc.size:
+            chain_acc = acc.mean(axis=1)
+            if float(acc.mean()) < thr["low_accept"]:
+                self._emit(
+                    "low_accept",
+                    value=float(acc.mean()),
+                    threshold=thr["low_accept"],
+                    block=block,
+                )
+            stuck = list(np.nonzero(chain_acc < thr["stuck_accept"])[0])
+            if stuck:
+                self._emit(
+                    "stuck_chain",
+                    severity="warn",
+                    value=float(chain_acc.min()),
+                    threshold=thr["stuck_accept"],
+                    block=block,
+                    chains=stuck,
+                    reason="acceptance collapsed",
+                )
+        if n_stuck:
+            self._emit(
+                "stuck_chain",
+                severity="warn",
+                block=block,
+                num_stuck_components=int(n_stuck),
+                reason="frozen components (NaN streaming R-hat)",
+            )
+        # E-BFMI judged only once enough draws accumulated — the
+        # estimator is meaninglessly noisy on a handful of transitions
+        if (
+            self._e_n is not None
+            and self._e_n.size
+            and int(self._e_n.min()) >= int(thr["min_draws"])
+        ):
+            eb = self.ebfmi()
+            if eb is not None and np.any(eb < thr["ebfmi"]):
+                bad = list(np.nonzero(eb < thr["ebfmi"])[0])
+                self._emit(
+                    "low_ebfmi",
+                    value=float(np.nanmin(eb)),
+                    threshold=thr["ebfmi"],
+                    block=block,
+                    chains=bad,
+                )
+
+    def observe_state(self, arrays: Dict[str, Any],
+                      block: Optional[int] = None) -> bool:
+        """Non-finite carried-state scan: the health-warning twin of
+        `supervise.check_finite_state`, run BEFORE it so the statistical
+        trail records the stuck chain before the fault taxonomy fires
+        (severity error -> postmortem bundle).  Returns True when a
+        warning was emitted."""
+        bad = [
+            k for k, v in arrays.items()
+            if not bool(np.all(np.isfinite(np.asarray(v))))
+        ]
+        if not bad:
+            return False
+        self._emit(
+            "stuck_chain",
+            severity="error",
+            block=block,
+            reason=f"non-finite carried state ({', '.join(sorted(bad))})",
+        )
+        return True
+
+    def warn_nonfinite(self, reason: str,
+                       block: Optional[int] = None) -> None:
+        """Explicit non-finite-lane warning (the fleet containment path
+        already holds the reason string from its per-lane scan)."""
+        self._emit(
+            "stuck_chain", severity="error", block=block, reason=reason
+        )
+
+    def finalize(self, *, converged: Optional[bool] = None,
+                 max_rhat: Optional[float] = None,
+                 min_ess: Optional[float] = None) -> List[str]:
+        """End-of-run sweep: the warnings that are only meaningful on the
+        finished history (early-block R-hat/ESS are legitimately poor).
+        Returns the terminal verdict (`verdict`).  Idempotent."""
+        if self._finalized:
+            return self.verdict()
+        self._finalized = True
+        thr = self.thr
+        rhat = max_rhat if max_rhat is not None else self._last_rhat
+        ess = min_ess if min_ess is not None else self._last_ess
+        if rhat is not None and np.isfinite(rhat) and rhat > thr["rhat"]:
+            self._emit("high_rhat", value=float(rhat),
+                       threshold=thr["rhat"], converged=converged)
+        if ess is not None and np.isfinite(ess) and ess < thr["min_ess"]:
+            self._emit("low_ess_per_param", value=float(ess),
+                       threshold=thr["min_ess"], converged=converged)
+        return self.verdict()
+
+    # -- summaries ---------------------------------------------------------
+
+    def ebfmi(self) -> Optional[np.ndarray]:
+        """Per-chain E-BFMI estimate (NaN where undefined), or None
+        before any energy was observed."""
+        if self._e_n is None:
+            return None
+        with np.errstate(invalid="ignore", divide="ignore"):
+            var = np.where(
+                self._e_n > 1, self._e_m2 / np.maximum(self._e_n - 1, 1),
+                np.nan,
+            )
+            num = np.where(
+                self._e_ndiff > 0,
+                self._e_diff2 / np.maximum(self._e_ndiff, 1),
+                np.nan,
+            )
+            return num / var
+
+    def tree_depth_histogram(self) -> Optional[np.ndarray]:
+        """(chains, max_depth + 1) NUTS depth counts, or None off-NUTS."""
+        return self._depth_hist
+
+    def verdict(self) -> List[str]:
+        """Sorted names of every warning this monitor raised — the
+        per-problem health verdict the fleet attaches to results."""
+        return sorted(self.active)
+
+
+def sghmc_health_trail(trace, *, kinetic_energy, num_divergent,
+                       transitions: int) -> None:
+    """Wire SG-HMC's already-computed per-draw kinetic energies and
+    divergence counts into the trace bus (satellite of the PR 15
+    observatory): one ``chain_health`` record with the kinetic-energy
+    marginal per chain, plus a ``divergences`` warning through the same
+    engine when any transition diverged.  SG-HMC has no accept statistic
+    and no Hamiltonian readback, so this is its whole health trail; a
+    NullTrace (or STARK_HEALTH=0 — callers gate) costs nothing."""
+    ke = np.asarray(kinetic_energy, np.float64)
+    ndiv = int(np.sum(np.asarray(num_divergent)))
+    if trace is not None and trace.enabled:
+        with np.errstate(invalid="ignore"):
+            ke_mean = float(np.nanmean(ke)) if ke.size else None
+            ke_std = float(np.nanstd(ke)) if ke.size else None
+        trace.emit(
+            "chain_health",
+            kernel="sghmc",
+            num_divergent=ndiv,
+            **(
+                {"kinetic_energy_mean": round(ke_mean, 6),
+                 "kinetic_energy_std": round(ke_std, 6)}
+                if ke_mean is not None and np.isfinite(ke_mean) else {}
+            ),
+        )
+    if transitions > 0 and ndiv > 0:
+        thr = thresholds()
+        frac = ndiv / float(transitions)
+        if frac > thr["divergence_frac"]:
+            mon = HealthMonitor(kernel="sghmc", trace=trace)
+            mon._emit(
+                "divergences",
+                value=frac,
+                threshold=thr["divergence_frac"],
+                count=ndiv,
+                total=ndiv,
+            )

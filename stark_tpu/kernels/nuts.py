@@ -317,6 +317,32 @@ def _merge_traj(traj: _Traj, sub: _Subtree, going_right, key_take,
     )
 
 
+def tree_depth_from_leaves(num_leaves):
+    """Exact trajectory depth from the per-transition leaf count — the
+    health observatory's tree-depth plumbing WITHOUT a new kernel output.
+
+    The doubling loop's invariant makes the depth recoverable: every
+    doubling round before the last generates its subtree's full
+    ``2**(round-1)`` leaves (a round that terminates early — U-turn or
+    divergence — ends the transition), so a trajectory of depth ``k``
+    has ``num_leaves`` in ``[2**(k-1), 2**k - 1]`` and
+
+        depth = floor(log2(num_leaves)) + 1        (num_leaves >= 1)
+
+    exactly.  ``num_grad_evals`` IS the leaf count for NUTS (one
+    gradient per leaf), so saturation detection
+    (``depth >= max_tree_depth``) needs no kernel change and cannot
+    perturb the compiled program.  Host-side numpy: int bit_length per
+    element via log2 on int64 (leaf counts are < 2**31).
+    """
+    import numpy as np
+
+    n = np.asarray(num_leaves, np.int64)
+    return np.where(n > 0, np.floor(np.log2(np.maximum(n, 1))), -1).astype(
+        np.int64
+    ) + 1
+
+
 def nuts_step(
     key: Array,
     state: HMCState,

@@ -529,6 +529,33 @@ class TraceCollector:
             "ragged-NUTS useful-gradient fraction of the last block "
             "(STARK_RAGGED_NUTS; 1.0 = no lane-sync waste)",
         )
+        # -- statistical-health observatory (stark_tpu.health): counters
+        # -- + gauges populated ONLY from health_warning events, so a
+        # -- clean run's exposition is byte-identical to pre-observatory
+        self.health_warnings = r.counter(
+            f"{p}_health_warnings_total",
+            "sampler statistical-health warnings emitted, by taxonomy "
+            "name and severity (stark_tpu.health)",
+        )
+        self.g_health_active = r.gauge(
+            f"{p}_health_warnings_active",
+            "distinct health-warning types raised so far in the current "
+            "run (reset on a fresh run_start)",
+        )
+        self.g_health_div_frac = r.gauge(
+            f"{p}_health_divergence_frac",
+            "divergent-transition fraction at the latest divergences "
+            "warning",
+        )
+        self.g_health_ebfmi = r.gauge(
+            f"{p}_health_ebfmi",
+            "worst-chain E-BFMI at the latest low_ebfmi warning",
+        )
+        self.g_health_treedepth = r.gauge(
+            f"{p}_health_treedepth_sat_frac",
+            "NUTS max-tree-depth saturation fraction at the latest "
+            "max_treedepth_saturation warning",
+        )
         # -- per-tenant SLO rollups (fleet problem_* events; labeled by
         # -- problem id, reset on a fresh run_start) --
         self.g_problem_ess_rate = r.gauge(
@@ -644,6 +671,12 @@ class TraceCollector:
             # count or shard labels
             self.g_fleet_shards.clear()
             self.g_fleet_shard_occupancy.clear()
+            # run B must not inherit run A's statistical-health verdict
+            # (counters stay monotone as always)
+            self.g_health_active.clear()
+            self.g_health_div_frac.clear()
+            self.g_health_ebfmi.clear()
+            self.g_health_treedepth.clear()
             self._set_status(
                 phase="starting", run=rec.get("run", 0), meta=meta,
                 block=None, draws_per_chain=None, ess_forecast=None,
@@ -977,6 +1010,37 @@ class TraceCollector:
     def _on_fault(self, rec: Dict[str, Any]) -> None:
         self.faults_injected.inc(site=str(rec.get("site", "unknown")))
 
+    def _on_health_warning(self, rec: Dict[str, Any]) -> None:
+        """Statistical-health warning (stark_tpu.health): count it by
+        taxonomy name + severity, surface the measured value on its
+        per-warning gauge, and keep the ``/status.health.warnings``
+        sub-object current (latest occurrence per warning type;
+        cleared on a fresh run_start with the rest of the health
+        snapshot)."""
+        name = str(rec.get("warning", "unknown"))
+        severity = str(rec.get("severity", "warn"))
+        self.health_warnings.inc(warning=name, severity=severity)
+        value = rec.get("value")
+        if isinstance(value, (int, float)):
+            gauge = {
+                "divergences": self.g_health_div_frac,
+                "low_ebfmi": self.g_health_ebfmi,
+                "max_treedepth_saturation": self.g_health_treedepth,
+            }.get(name)
+            if gauge is not None:
+                gauge.set(float(value))
+        seen = {
+            k: rec[k]
+            for k in ("severity", "value", "threshold", "block",
+                      "problem_id", "num_chains_affected", "hint")
+            if rec.get(k) is not None
+        }
+        with self._lock:
+            warns = self._status["health"].setdefault("warnings", {})
+            warns[name] = seen
+            active = len(warns)
+        self.g_health_active.set(float(active))
+
     # -- helpers -----------------------------------------------------------
 
     def _chains(self) -> int:
@@ -1008,6 +1072,15 @@ class TraceCollector:
         """The ``/status`` JSON snapshot."""
         healthy, detail = self.health.check()
         with self._lock:
+            # the health snapshot nests the mutable warnings dict (PR
+            # 15): copy one level deeper, or a health_warning arriving
+            # mid-serialization mutates the dict json.dumps is
+            # iterating in the HTTP thread (the per-warning values are
+            # replaced wholesale on update, never mutated, so one level
+            # suffices)
+            health_snap = dict(self._status["health"])
+            if "warnings" in health_snap:
+                health_snap["warnings"] = dict(health_snap["warnings"])
             snap = {
                 "phase": self._status["phase"],
                 "run": self._status["run"],
@@ -1015,7 +1088,7 @@ class TraceCollector:
                 "block": self._status["block"],
                 "draws_per_chain": self._status["draws_per_chain"],
                 "ess_forecast": self._status["ess_forecast"],
-                "health": dict(self._status["health"]),
+                "health": health_snap,
                 "restarts": dict(self._status["restarts"]),
                 "meta": dict(self._status["meta"]),
                 "fleet": dict(self._status["fleet"]),

@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import diagnostics, faults, telemetry
+from . import diagnostics, faults, health as _health, telemetry
 from .kernels.base import HMCState
 from .ops import quantize as _quantize
 from .model import Model
@@ -64,6 +64,9 @@ class AdaptiveResult(Posterior):
         # estimated draws beyond the ESS target at the measured ESS rate
         # (None when unconverged or no rate estimate) — see run_end trace
         self.overshoot_draws = None
+        # statistical-health verdict (stark_tpu.health): sorted warning
+        # names the observatory raised; None when STARK_HEALTH=0
+        self.health_warnings = None
 
 
 _ADAPT_KEYS = ("z", "log_eps", "log_T", "inv_mass")
@@ -345,6 +348,17 @@ def _sample_until_converged(
     # into run_start and every per-block grad-eval record below: a trace
     # or ledger row then says which path produced its numbers.
     fused_tag = model.fused_tag() if hasattr(model, "fused_tag") else None
+    # statistical-health observatory (stark_tpu.health): a host-side
+    # streaming monitor fed from the block readbacks below — entirely
+    # outside the kernels' op/key sequence, so draws/metrics/checkpoints
+    # are bit-identical with it on; STARK_HEALTH=0 removes the trace
+    # events too (byte-identical traces)
+    monitor = (
+        _health.HealthMonitor(
+            kernel=cfg.kernel, max_depth=cfg.max_tree_depth, trace=trace
+        )
+        if _health.health_enabled() else None
+    )
     t_run0 = time.perf_counter()  # run_end dur covers setup/compile too
     if trace.enabled:
         trace.emit(
@@ -1118,19 +1132,19 @@ def _sample_until_converged(
                     block_keys, state, diag, step_size, inv_mass, data
                 )
                 if ragged:
-                    (new_state, diag, zs, accept, divergent, _energy,
+                    (new_state, diag, zs, accept, divergent, energy,
                      ngrad, lane_iters) = out
                 else:
-                    new_state, diag, zs, accept, divergent, _energy, ngrad = out
+                    new_state, diag, zs, accept, divergent, energy, ngrad = out
             else:
                 out = get_v_block(length)(
                     block_keys, state, step_size, inv_mass, data
                 )
                 if ragged:
-                    (new_state, zs, accept, divergent, _energy, ngrad,
+                    (new_state, zs, accept, divergent, energy, ngrad,
                      lane_iters) = out
                 else:
-                    new_state, zs, accept, divergent, _energy, ngrad = out
+                    new_state, zs, accept, divergent, energy, ngrad = out
             # per-chain kernels CARRY the (possibly poisoned) state into
             # the next dispatch — same rebinding as the serial loop
             new_state = faults.poison("runner.carried_nan", new_state)
@@ -1144,6 +1158,10 @@ def _sample_until_converged(
                 "len": length,
                 "outs": {"zs": zs, "accept": accept,
                          "divergent": divergent, "ngrad": ngrad,
+                         # per-block Hamiltonian series: kernels always
+                         # computed it; the health observatory is its
+                         # first host-side consumer (E-BFMI)
+                         "energy": energy,
                          **({"lane_iters": lane_iters} if ragged else {})},
             }
 
@@ -1186,6 +1204,14 @@ def _sample_until_converged(
                 )
                 zs, zs_dm = np.asarray(zs), None
                 blk_grads = int(np.sum(np.asarray(ngrad)))
+            # the per-block energy series crosses to host ONLY for the
+            # health observatory (STARK_HEALTH=0 restores the historical
+            # drop-on-device behavior); chees blocks carry no energies
+            blk_energy = (
+                np.asarray(ap.collect(outs["energy"]))
+                if monitor is not None and "energy" in outs
+                else None
+            )
             # ragged-NUTS occupancy accounting: the batch executed
             # max(lane_iters) iterations x chains lane-gradients; the
             # useful fraction is what the step-synchronized scheduler
@@ -1207,15 +1233,19 @@ def _sample_until_converged(
                 # block k's checkpoint even with k+1 in flight.
                 from .supervise import check_finite_state
 
-                check_finite_state(
-                    ap.collect({
-                        "z": pend["state"].z,
-                        "pe": pend["state"].potential_energy,
-                        "grad": pend["state"].grad,
-                        "step_size": pend["step_size"],
-                        "inv_mass": pend["inv_mass"],
-                    })
-                )
+                carried = ap.collect({
+                    "z": pend["state"].z,
+                    "pe": pend["state"].potential_energy,
+                    "grad": pend["state"].grad,
+                    "step_size": pend["step_size"],
+                    "inv_mass": pend["inv_mass"],
+                })
+                if monitor is not None:
+                    # the statistical trail records the stuck chain
+                    # BEFORE the fault taxonomy fires (the finite check
+                    # below raises into the supervisor)
+                    monitor.observe_state(carried, block=blocks_done + 1)
+                check_finite_state(carried)
             blocks_done += 1
             draws_hist.append(zs)
             if draw_store is not None:
@@ -1360,6 +1390,30 @@ def _sample_until_converged(
                     next_full_check = blocks_done + max(1, blocks_done // 4)
             history.append(rec)
             emit(rec)
+            if monitor is not None:
+                # per-block warning sweep — host-side only, AFTER the
+                # block record so the metrics trail stays byte-identical
+                # to the pre-observatory runner.  The chees block is
+                # draw-major (block, chains): transpose to the monitor's
+                # (chains, block) layout (``zs`` is already transposed)
+                acc_cm = np.asarray(accept)
+                div_cm = np.asarray(divergent)
+                if is_chees:
+                    acc_cm, div_cm = acc_cm.T, div_cm.T
+                monitor.observe_block(
+                    block=blocks_done,
+                    zs=zs,
+                    accept=acc_cm,
+                    divergent=div_cm,
+                    energy=blk_energy,
+                    ngrad=(
+                        np.asarray(ngrad) if not is_chees else None
+                    ),
+                    max_rhat=max_rhat,
+                    min_ess=min_ess,
+                    n_stuck=n_stuck,
+                    draws_per_chain=draws_per_chain,
+                )
 
             t_ckpt_dur = 0.0
             if checkpoint_path:
@@ -1625,6 +1679,12 @@ def _sample_until_converged(
         wall_s=time.perf_counter() - t_start,
     )
     result.budget_exhausted = budget_exhausted
+    # statistical-health verdict: every warning the observatory raised
+    # (None when STARK_HEALTH=0 — null, never an empty claim of health)
+    result.health_warnings = (
+        monitor.finalize(converged=converged) if monitor is not None
+        else None
+    )
     # overshoot accounting: estimated draws spent beyond what the ESS
     # target needed (at the measured rate) — the number the adaptive
     # scheduler exists to drive toward ~one small block; surfaced in the

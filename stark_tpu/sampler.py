@@ -536,6 +536,18 @@ def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
     ng_blocks = [np.zeros((chains, 0), np.int32)]
     num_divergent = np.zeros((chains,), np.int64)
     trace = telemetry.get_trace()
+    # statistical-health observatory (stark_tpu.health): host-side only,
+    # fed from the readbacks this driver already materializes — the
+    # compiled programs and draws are untouched; STARK_HEALTH=0 removes
+    # the trace events too
+    from . import health as _health
+
+    monitor = (
+        _health.HealthMonitor(
+            kernel=cfg.kernel, max_depth=cfg.max_tree_depth, trace=trace
+        )
+        if _health.health_enabled() else None
+    )
     # multi-process meshes stay serial: their collect is an allgather —
     # a dispatched computation stream-ordered after the prefetched block,
     # so prefetching only delays this block's materialization (see the
@@ -602,6 +614,15 @@ def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
                 mean_accept=round(float(np.mean(accept)), 4),
                 num_divergent=int(num_divergent.sum()),
             )
+        if monitor is not None:
+            monitor.observe_block(
+                block=i + 1,
+                zs=np.asarray(zs),
+                accept=np.asarray(accept),
+                divergent=np.asarray(divergent),
+                energy=np.asarray(energy),
+                ngrad=np.asarray(ngrad),
+            )
         # global transition i is kept when (i+1) % thin == 0
         keep = np.arange(s, e)
         keep = (
@@ -615,6 +636,10 @@ def drive_segmented_sampling(fm: FlatModel, cfg: SamplerConfig, seg_warmup,
         en_blocks.append(energy[:, keep])
         ng_blocks.append(ngrad[:, keep])
 
+    if monitor is not None:
+        # no convergence gate on this driver: the end-of-run R-hat/ESS
+        # warnings stay silent (no values), the block-level trail stands
+        monitor.finalize()
     with trace.phase("collect"):
         zs = np.concatenate(zs_blocks, axis=1)  # (chains, num_samples, d)
         step_size, inv_mass = collect((step_size, inv_mass))

@@ -199,10 +199,14 @@ def sghmc_sample(
         # inside the jit so only kept draws cross device->host
         zs = jnp.take(zs, keep, axis=0)
         ke = jnp.take(ke, keep, axis=0)
-        n_div = jnp.sum(div.astype(jnp.int32)) + jnp.sum(
-            warm_div.astype(jnp.int32)
-        )
-        return zs, ke, n_div
+        # sampling-phase divergences separately from the combined total:
+        # the stats dict keeps the historical combined count, while the
+        # health trail (like NUTS/HMC's) judges POST-WARMUP transitions
+        # only — warmup divergences while the preconditioner tunes are
+        # expected, not a warning
+        n_div_sample = jnp.sum(div.astype(jnp.int32))
+        n_div = n_div_sample + jnp.sum(warm_div.astype(jnp.int32))
+        return zs, ke, n_div, n_div_sample
 
     key = jax.random.PRNGKey(seed)
     key_init, key_run = jax.random.split(key)
@@ -214,11 +218,15 @@ def sghmc_sample(
 
     vrun = jax.vmap(run_chain)
     if mesh is None:
-        zs, ke, n_div = jax.block_until_ready(jax.jit(vrun)(chain_keys, z0))
+        zs, ke, n_div, n_div_sample = jax.block_until_ready(
+            jax.jit(vrun)(chain_keys, z0)
+        )
     else:
         from .parallel.primitives import run_over_chains
 
-        zs, ke, n_div = run_over_chains(mesh, vrun, chain_keys, z0)
+        zs, ke, n_div, n_div_sample = run_over_chains(
+            mesh, vrun, chain_keys, z0
+        )
 
     zs = np.asarray(zs)
     ke = np.asarray(ke)
@@ -228,6 +236,21 @@ def sghmc_sample(
         "num_divergent": np.asarray(n_div),
         "step_size": np.full((chains,), step_size),
     }
+    # statistical-health trail (stark_tpu.health): the kernel always
+    # computed these arrays — wire them into the trace bus so the SG-HMC
+    # BNN leg carries the same chain-health evidence as NUTS/HMC.
+    # Gated on STARK_HEALTH so =0 keeps traces byte-identical.
+    from . import health as _health, telemetry
+
+    if _health.health_enabled():
+        # POST-WARMUP divergences only, like the NUTS/HMC trail (the
+        # stats dict above keeps the historical combined count)
+        _health.sghmc_health_trail(
+            telemetry.get_trace(),
+            kinetic_energy=ke,
+            num_divergent=n_div_sample,
+            transitions=chains * total_sample,
+        )
     if cycles > 0:
         # which warm-restart cycle each kept draw came from — the
         # per-cycle mode-coverage evidence for multimodal posteriors

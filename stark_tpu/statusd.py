@@ -33,7 +33,14 @@ Endpoints:
     ESS progress/forecast, attempt number, restart record, run metadata
     (model/kernel/chains + provenance), per-problem fleet state, and
     ``last_postmortem`` — the most recent flight-recorder bundle this
-    process dumped (``{path, trigger, ts}``; null when none).
+    process dumped (``{path, trigger, ts}``; null when none).  The
+    ``health`` sub-object carries the last-seen chain diagnostics plus —
+    since PR 15 — ``health.warnings``: the statistical-health
+    observatory's active warnings (``stark_tpu.health`` taxonomy; latest
+    occurrence per warning type, keyed by name, with severity /
+    measured value / threshold / remediation hint; absent until a
+    warning fires, cleared on a fresh ``run_start``).  Additive within
+    the existing ``health`` key, so the schema version is unchanged.
 
 Probe contract: ``python -m stark_tpu status --json`` prints ONE
 machine-parseable line ``{"endpoint", "code", "body"}`` for any of the

@@ -127,10 +127,23 @@ FLEET_EVENT_TYPES = frozenset({"fleet_block", "problem_converged",
 #: carry none and stay byte-identical)
 PROFILING_EVENT_TYPES = frozenset({"span"})
 
+#: statistical-health event types (stark_tpu.health): ``health_warning``
+#: — one Stan-style sampler-health warning (``warning`` in
+#: `health.WARNINGS`: divergences / low_ebfmi / max_treedepth_saturation
+#: / low_accept / stuck_chain / high_rhat / low_ess_per_param), with
+#: ``severity``, the measured ``value`` vs its ``threshold`` knob,
+#: affected ``chains`` (and ``problem_id`` on fleet lanes), a
+#: ``hint`` remediation string, and — on ``divergences`` — the bounded
+#: per-block ``snapshots`` ring of divergent-transition positions
+#: (divergence localization).  Emitted OUTSIDE the kernels' op/key
+#: sequence, from the host block loop; STARK_HEALTH=0 suppresses the
+#: family entirely (byte-identical traces).
+HEALTH_EVENT_TYPES = frozenset({"health_warning"})
+
 #: the complete WRITER registry: every emit()/phase() call in stark_tpu/
 #: must use one of these names (tools/lint_trace_schema.py enforces it)
 ALL_EVENT_TYPES = (EVENT_TYPES | AUX_EVENT_TYPES | FLEET_EVENT_TYPES
-                   | PROFILING_EVENT_TYPES)
+                   | PROFILING_EVENT_TYPES | HEALTH_EVENT_TYPES)
 
 #: envelope keys every event must carry (validate_event)
 ENVELOPE_KEYS = ("schema", "event", "ts", "wall_s", "run")
@@ -933,6 +946,14 @@ def last_postmortem() -> Optional[Dict[str, Any]]:
     return rec.last_postmortem() if rec is not None else None
 
 
+def peek_flight_recorder() -> Optional[FlightRecorder]:
+    """The process flight recorder IF one exists (None otherwise) — for
+    layers that should dump forensics when a supervised/fleet run armed
+    the recorder but must never create it from an unsupervised read
+    (the health warning engine's severity>=error dumps)."""
+    return _FLIGHT
+
+
 def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                     ) -> Dict[str, Any]:
     """Aggregate one run's events into the phase/health summary that
@@ -952,7 +973,12 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
          "wall_s": float | None,          # run_end dur, else event span
          "phases": {name: {"count": n, "total_s": s}},
          "health": {"mean_accept", "num_divergent", "max_rhat", "min_ess",
-                    "step_size", ...last-seen values...},
+                    "step_size", ...last-seen values...;
+                    "num_divergent" is cumulative-with-reset across the
+                    selected run's supervised restart chain (matching the
+                    metrics counters), and "warnings"/"warning_counts"
+                    aggregate health_warning events (stark_tpu.health) —
+                    absent on pre-PR-15 / STARK_HEALTH=0 traces},
          "overlap": {"t_host_hidden_s", "device_idle_s", "t_wait_s",
                      "device_idle_frac"} | {},   # block-pipeline totals,
                                                  # when the writer emitted
@@ -1021,6 +1047,50 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     while r in restarts_by_run:
         restarts_total += restarts_by_run[r]
         r -= 1
+    chain_runs = set(range(r + 1, run + 1))
+    # health.num_divergent: CUMULATIVE-WITH-RESET over the supervised
+    # restart chain, matching the monotone metrics counters.  Each
+    # attempt's per-block records carry a within-attempt cumulative
+    # count (the run's LAST qualifying value is its final count;
+    # run_end's num_divergent, when present, is authoritative — it also
+    # covers paths like consensus whose per-block events are per-SHARD
+    # partial counts, which are excluded below).  Attempt boundaries
+    # come from run_start's ``resuming`` flag: a checkpoint-RESUMED
+    # attempt restored its counter and continues the chain's number (no
+    # double count — its own final value already spans the whole run),
+    # while a cold retry restarts from zero, so the failed attempt's
+    # final count is banked first.  The old code took the LATEST
+    # event's value, silently dropping every cold attempt's
+    # divergences.  Warmup counts (chain_health status="warmup_done")
+    # and shard/replica-tagged partials stay out, as before.
+    per_run_last: Dict[int, Any] = {}
+    per_run_resuming: Dict[int, bool] = {}
+    for e in events:
+        e_run = e.get("run", 0)
+        if e_run not in chain_runs:
+            continue
+        ev_name = e.get("event")
+        if "shard" in e or "replica" in e:
+            continue  # per-shard/rung partial counts, not run totals
+        if ev_name == "run_start":
+            per_run_resuming[e_run] = bool(e.get("resuming"))
+        elif (
+            ev_name in ("sample_block", "run_end")
+            or (ev_name == "chain_health" and e.get("status") is None)
+        ):
+            v = e.get("num_divergent")
+            if v is not None:
+                per_run_last[e_run] = v
+    div_total = None
+    if per_run_last:
+        banked, last = 0, None
+        for rr in sorted(chain_runs):
+            if rr not in per_run_last:
+                continue
+            if last is not None and not per_run_resuming.get(rr, False):
+                banked += last  # cold retry: bank the failed attempt
+            last = per_run_last[rr]
+        div_total = banked + last
 
     meta: Dict[str, Any] = {}
     phases: Dict[str, Dict[str, float]] = {}
@@ -1032,8 +1102,8 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     occ_sum = 0.0
     saw_overlap = False
     wall = None
-    div_latest = None
     accepts: List[float] = []
+    warn_counts: Dict[str, int] = {}
     for e in evs:
         ev = e["event"]
         if (
@@ -1153,22 +1223,23 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                     health[k] = e[k]
             if e.get("mean_accept") is not None:
                 accepts.append(float(e["mean_accept"]))
-            if e.get("num_divergent") is not None:
-                div_latest = e["num_divergent"]
         # blocks may carry accept/divergence inline (monolithic runs)
         elif ev in ("sample_block", "warmup_block"):
             if e.get("mean_accept") is not None:
                 accepts.append(float(e["mean_accept"]))
-            if e.get("num_divergent") is not None:
-                div_latest = (
-                    e["num_divergent"]
-                    if ev == "sample_block"
-                    else div_latest
-                )
+        elif ev == "health_warning":
+            # statistical-health observatory (stark_tpu.health): count
+            # warning emissions by taxonomy name — absent (not 0) on
+            # pre-PR-15 / STARK_HEALTH=0 traces, the null-not-0.0 rule
+            name = str(e.get("warning", "unknown"))
+            warn_counts[name] = warn_counts.get(name, 0) + 1
     if accepts:
         health["mean_accept"] = sum(accepts) / len(accepts)
-    if div_latest is not None:
-        health["num_divergent"] = div_latest
+    if div_total is not None:
+        health["num_divergent"] = div_total
+    if warn_counts:
+        health["warnings"] = int(sum(warn_counts.values()))
+        health["warning_counts"] = dict(sorted(warn_counts.items()))
     if wall is None and evs:
         wall = evs[-1]["wall_s"] - evs[0]["wall_s"]
     if saw_overlap:

@@ -1,0 +1,559 @@
+"""Sampler statistical-health observatory (stark_tpu/health.py).
+
+Contracts under test:
+
+  * warning-engine unit behavior for every taxonomy entry, each against
+    its STARK_HEALTH_* threshold knob (the lint_health_thresholds
+    "named test" requirement is satisfied here by design);
+  * the FALSE-POSITIVE FLOOR: a clean non-centered eight-schools run
+    produces ZERO warnings at default thresholds;
+  * divergence LOCALIZATION: a centered (funnel) eight-schools run
+    yields a ``divergences`` warning whose snapshots concentrate at low
+    tau, verified end-to-end trace -> summarize -> /status -> /metrics
+    -> tools/health_report.py;
+  * bit-identity: draws are identical with the observatory on vs
+    STARK_HEALTH=0, and =0 traces carry no health events;
+  * fault-taxonomy ordering: the chaos injections (``runner.carried_nan``
+    — the nan_poison drill's failpoint — and ``fleet.lane_nan``) each
+    produce a ``stuck_chain`` warning BEFORE the fault machinery fires
+    (ChainHealthError / problem_reseeded), with a flight-recorder
+    bundle on the severity-error path;
+  * the SG-HMC trail satellite and per-problem fleet verdicts.
+"""
+
+import json
+import os
+import sys
+
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+import numpy as np
+import pytest
+
+from stark_tpu import faults, health, telemetry
+from stark_tpu.bijectors import Exp
+from stark_tpu.fleet import FleetSpec, sample_fleet
+from stark_tpu.kernels.nuts import tree_depth_from_leaves
+from stark_tpu.model import Model, ParamSpec
+from stark_tpu.models import EightSchools, eight_schools_data
+from stark_tpu.runner import sample_until_converged
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class _CaptureTrace:
+    """Minimal trace stub: records every emitted event in order."""
+
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **fields):
+        rec = {"event": event, **fields}
+        self.events.append(rec)
+        return rec
+
+    def warnings(self, name=None):
+        out = [e for e in self.events if e["event"] == "health_warning"]
+        if name is not None:
+            out = [e for e in out if e["warning"] == name]
+        return out
+
+
+class CenteredEightSchools(Model):
+    """Centered parameterization: theta ~ N(mu, tau) — the funnel that
+    makes NUTS diverge near tau -> 0 (the localization fixture)."""
+
+    def param_spec(self):
+        return {
+            "mu": ParamSpec(()),
+            "tau": ParamSpec((), Exp()),
+            "theta": ParamSpec((8,)),
+        }
+
+    def log_prior(self, p):
+        lp = jstats.norm.logpdf(p["mu"], 0.0, 5.0)
+        lp += jstats.cauchy.logpdf(p["tau"], 0.0, 5.0) + jnp.log(2.0)
+        lp += jnp.sum(jstats.norm.logpdf(p["theta"], p["mu"], p["tau"]))
+        return lp
+
+    def log_lik(self, p, data):
+        return jnp.sum(
+            jstats.norm.logpdf(data["y"], p["theta"], data["sigma"])
+        )
+
+
+# ---------------------------------------------------------------------------
+# tree-depth derivation (the no-new-kernel-output plumbing)
+# ---------------------------------------------------------------------------
+
+
+def test_tree_depth_from_leaves_exact():
+    """depth = floor(log2(leaves)) + 1 maps every leaf count in
+    [2**(k-1), 2**k - 1] to k — the doubling-loop invariant."""
+    for k in range(1, 11):
+        lo, hi = 2 ** (k - 1), 2 ** k - 1
+        got = tree_depth_from_leaves(np.array([lo, hi]))
+        assert got.tolist() == [k, k], (k, got)
+    assert tree_depth_from_leaves(np.array([0])).tolist() == [0]
+
+
+def test_tree_depth_saturation_threshold(monkeypatch):
+    """ngrad at 2**(max_depth-1) IS saturation; the
+    STARK_HEALTH_TREEDEPTH_FRAC knob gates the warning."""
+    tr = _CaptureTrace()
+    mon = health.HealthMonitor(kernel="nuts", max_depth=5, trace=tr)
+    ngrad = np.full((2, 10), 2 ** 4)  # every transition saturated
+    mon.observe_block(block=1, divergent=np.zeros((2, 10), bool),
+                      ngrad=ngrad)
+    assert len(tr.warnings("max_treedepth_saturation")) == 1
+    assert tr.warnings("max_treedepth_saturation")[0]["value"] == 1.0
+    hist = mon.tree_depth_histogram()
+    assert hist.shape == (2, 6) and hist[:, 5].sum() == 20
+
+    monkeypatch.setenv("STARK_HEALTH_TREEDEPTH_FRAC", "1.5")
+    tr2 = _CaptureTrace()
+    mon2 = health.HealthMonitor(kernel="nuts", max_depth=5, trace=tr2)
+    mon2.observe_block(block=1, divergent=np.zeros((2, 10), bool),
+                       ngrad=ngrad)
+    assert not tr2.warnings("max_treedepth_saturation")
+
+
+# ---------------------------------------------------------------------------
+# warning engine units (one named test per STARK_HEALTH_* threshold)
+# ---------------------------------------------------------------------------
+
+
+def test_divergence_warning_snapshots_and_threshold(monkeypatch):
+    tr = _CaptureTrace()
+    monkeypatch.setenv("STARK_HEALTH_SNAPSHOTS", "2")
+    monkeypatch.setenv("STARK_HEALTH_SNAPSHOT_DIM", "3")
+    mon = health.HealthMonitor(kernel="nuts", trace=tr)
+    div = np.zeros((2, 5), bool)
+    div[0, 1] = div[0, 3] = div[1, 0] = True
+    zs = np.arange(2 * 5 * 4, dtype=np.float64).reshape(2, 5, 4)
+    mon.observe_block(block=3, zs=zs, divergent=div)
+    (w,) = tr.warnings("divergences")
+    assert w["count"] == 3 and w["block"] == 3
+    # first K=2 snapshots in (chain, step) order, truncated to 3 dims
+    assert len(w["snapshots"]) == 2
+    assert w["snapshots"][0] == {
+        "chain": 0, "step": 1, "z": list(zs[0, 1, :3])
+    }
+    # raised STARK_HEALTH_DIVERGENCE_FRAC suppresses the warning
+    monkeypatch.setenv("STARK_HEALTH_DIVERGENCE_FRAC", "0.9")
+    tr2 = _CaptureTrace()
+    mon2 = health.HealthMonitor(kernel="nuts", trace=tr2)
+    mon2.observe_block(block=1, zs=zs, divergent=div)
+    assert not tr2.warnings("divergences")
+
+
+def test_low_accept_and_stuck_chain_thresholds(monkeypatch):
+    tr = _CaptureTrace()
+    mon = health.HealthMonitor(kernel="nuts", trace=tr)
+    accept = np.array([[0.9] * 10, [0.01] * 10])  # mean 0.455 < 0.6
+    mon.observe_block(block=1, accept=accept,
+                      divergent=np.zeros((2, 10), bool))
+    assert len(tr.warnings("low_accept")) == 1
+    (stuck,) = tr.warnings("stuck_chain")
+    assert stuck["chains"] == [1] and stuck["severity"] == "warn"
+    # knobs: STARK_HEALTH_LOW_ACCEPT / STARK_HEALTH_STUCK_ACCEPT lowered
+    # below the observed values suppress both
+    monkeypatch.setenv("STARK_HEALTH_LOW_ACCEPT", "0.1")
+    monkeypatch.setenv("STARK_HEALTH_STUCK_ACCEPT", "0.001")
+    tr2 = _CaptureTrace()
+    mon2 = health.HealthMonitor(kernel="nuts", trace=tr2)
+    mon2.observe_block(block=1, accept=accept,
+                       divergent=np.zeros((2, 10), bool))
+    assert not tr2.warnings()
+
+
+def test_ebfmi_streaming_matches_reference_and_threshold(monkeypatch):
+    """The streaming E-BFMI equals the direct two-pass estimate, iid
+    energies sit near the healthy value of 2 (no warning), and a random
+    walk trips STARK_HEALTH_EBFMI once STARK_HEALTH_MIN_DRAWS draws
+    accumulated."""
+    rng = np.random.default_rng(0)
+    monkeypatch.setenv("STARK_HEALTH_MIN_DRAWS", "60")
+    monkeypatch.setenv("STARK_HEALTH_EBFMI", "0.3")
+    # healthy: iid normal energies -> E-BFMI ~ 2
+    tr = _CaptureTrace()
+    mon = health.HealthMonitor(kernel="hmc", trace=tr)
+    e = rng.standard_normal((2, 150))
+    for s in range(0, 150, 50):  # streamed in 3 blocks
+        mon.observe_block(block=s // 50 + 1, energy=e[:, s:s + 50],
+                          divergent=np.zeros((2, 50), bool))
+    eb = mon.ebfmi()
+    ref = np.sum(np.diff(e, axis=1) ** 2, axis=1) / (
+        e.shape[1] - 1
+    ) / np.var(e, axis=1, ddof=1)
+    np.testing.assert_allclose(eb, ref, rtol=1e-10)
+    assert np.all(eb > 1.0) and not tr.warnings("low_ebfmi")
+    # pathological: slow random walk -> tiny diffs vs large variance
+    tr2 = _CaptureTrace()
+    mon2 = health.HealthMonitor(kernel="nuts", trace=tr2)
+    walk = np.cumsum(0.05 * rng.standard_normal((2, 150)), axis=1)
+    for s in range(0, 150, 50):
+        mon2.observe_block(block=s // 50 + 1, energy=walk[:, s:s + 50],
+                           divergent=np.zeros((2, 50), bool))
+    assert tr2.warnings("low_ebfmi")
+    assert tr2.warnings("low_ebfmi")[-1]["value"] < 0.3
+
+
+def test_finalize_rhat_ess_thresholds(monkeypatch):
+    monkeypatch.setenv("STARK_HEALTH_RHAT", "1.02")
+    monkeypatch.setenv("STARK_HEALTH_MIN_ESS", "200")
+    tr = _CaptureTrace()
+    mon = health.HealthMonitor(kernel="nuts", trace=tr)
+    verdict = mon.finalize(converged=False, max_rhat=1.2, min_ess=50.0)
+    assert verdict == ["high_rhat", "low_ess_per_param"]
+    assert tr.warnings("high_rhat")[0]["threshold"] == 1.02
+    # healthy end values stay silent
+    tr2 = _CaptureTrace()
+    mon2 = health.HealthMonitor(kernel="nuts", trace=tr2)
+    assert mon2.finalize(converged=True, max_rhat=1.005,
+                         min_ess=500.0) == []
+
+
+def test_observe_state_nonfinite_is_error_severity():
+    tr = _CaptureTrace()
+    mon = health.HealthMonitor(kernel="nuts", trace=tr)
+    assert not mon.observe_state({"z": np.ones(3)})
+    assert mon.observe_state({"z": np.array([1.0, np.nan])}, block=2)
+    (w,) = tr.warnings("stuck_chain")
+    assert w["severity"] == "error" and "z" in w["reason"]
+
+
+# ---------------------------------------------------------------------------
+# false-positive floor + funnel localization (end to end)
+# ---------------------------------------------------------------------------
+
+_RUN_KW = dict(chains=4, block_size=50, min_blocks=2, ess_target=100.0,
+               num_samples=1, seed=1)
+
+
+@pytest.fixture(scope="module")
+def clean_run(tmp_path_factory):
+    """Non-centered eight schools at target_accept=0.9: converges with
+    zero divergences (probed; seed-pinned)."""
+    path = str(tmp_path_factory.mktemp("clean") / "t.jsonl")
+    tr = telemetry.RunTrace(path)
+    with telemetry.use_trace(tr):
+        res = sample_until_converged(
+            EightSchools(), eight_schools_data(), num_warmup=300,
+            max_blocks=8, target_accept=0.9, **_RUN_KW,
+        )
+    tr.close()
+    return res, telemetry.read_trace(path)
+
+
+@pytest.fixture(scope="module")
+def funnel_run(tmp_path_factory):
+    """Centered eight schools: the funnel — divergences guaranteed."""
+    path = str(tmp_path_factory.mktemp("funnel") / "t.jsonl")
+    tr = telemetry.RunTrace(path)
+    with telemetry.use_trace(tr):
+        res = sample_until_converged(
+            CenteredEightSchools(), eight_schools_data(), num_warmup=200,
+            max_blocks=4, target_accept=0.8,
+            **dict(_RUN_KW, seed=0),
+        )
+    tr.close()
+    return res, telemetry.read_trace(path)
+
+
+def test_clean_run_zero_warnings(clean_run):
+    """The false-positive floor: a healthy run at default thresholds
+    emits NO health_warning events and an empty verdict."""
+    res, events = clean_run
+    assert res.converged
+    assert int(np.sum(res.sample_stats["num_divergent"])) == 0
+    assert res.health_warnings == []
+    assert [e for e in events if e["event"] == "health_warning"] == []
+
+
+def test_funnel_divergences_warning_and_verdict(funnel_run):
+    res, events = funnel_run
+    assert int(np.sum(res.sample_stats["num_divergent"])) > 0
+    assert "divergences" in res.health_warnings
+    warns = [e for e in events if e["event"] == "health_warning"]
+    div = [e for e in warns if e["warning"] == "divergences"]
+    assert div, "no divergences warning on a funnel run"
+    w = div[0]
+    assert w["severity"] == "warn" and w["hint"]
+    assert w["knob"] == "STARK_HEALTH_DIVERGENCE_FRAC"
+    assert w["value"] > w["threshold"] == 0.0
+
+
+def test_funnel_snapshots_localize_low_tau(funnel_run):
+    """Divergence localization: snapshot positions concentrate at low
+    tau (flat coordinate 1 = log tau) relative to the posterior bulk."""
+    res, events = funnel_run
+    snaps = [
+        s
+        for e in events
+        if e["event"] == "health_warning" and e["warning"] == "divergences"
+        for s in e.get("snapshots", [])
+    ]
+    assert snaps, "divergences warnings carried no snapshots"
+    log_tau_div = np.array([s["z"][1] for s in snaps])
+    log_tau_post = np.log(res.draws["tau"]).mean()
+    assert log_tau_div.mean() < log_tau_post - 0.5, (
+        log_tau_div.mean(), log_tau_post
+    )
+
+
+def test_funnel_end_to_end_status_metrics_report(funnel_run, tmp_path):
+    """trace -> summarize -> /status + /metrics (TraceCollector) ->
+    tools/health_report.py, all off the same event stream."""
+    res, events = funnel_run
+    s = telemetry.summarize_trace(events)
+    assert s["health"]["warnings"] >= 1
+    assert s["health"]["warning_counts"]["divergences"] >= 1
+
+    from stark_tpu.metrics import TraceCollector
+
+    col = TraceCollector()
+    for e in events:
+        col.on_event(e)
+    snap = col.status()
+    warns = snap["health"]["warnings"]
+    assert "divergences" in warns
+    assert warns["divergences"]["severity"] == "warn"
+    assert warns["divergences"]["hint"]
+    exposition = col.registry.render()
+    assert 'stark_health_warnings_total{severity="warn",' in exposition
+    assert "stark_health_divergence_frac" in exposition
+    assert "stark_health_warnings_active" in exposition
+
+    import health_report
+
+    summary = health_report.health_summary(events, s["run"])
+    names = [w["warning"] for w in summary["warnings"]]
+    assert "divergences" in names and summary["snapshots"]
+    text = health_report.render_run(events, s["run"])
+    assert "divergences" in text and "divergence localization" in text
+
+
+def test_health_report_na_safe_on_pre_observatory_trace(tmp_path):
+    """A trace with no health events renders the n/a line, never an
+    error (pre-PR-15 and STARK_HEALTH=0 files)."""
+    import health_report
+
+    path = tmp_path / "old.jsonl"
+    with telemetry.RunTrace(str(path)) as tr:
+        tr.emit("run_start", model="M", kernel="nuts", chains=2)
+        tr.emit("chain_health", mean_accept=0.9, num_divergent=0)
+        tr.emit("run_end", dur_s=0.1)
+    events = telemetry.read_trace(str(path))
+    text = health_report.render_run(events, 1)
+    assert "no health events" in text
+    assert health_report.health_summary(events, 1)["warnings"] == []
+
+
+# ---------------------------------------------------------------------------
+# bit-identity + STARK_HEALTH=0 opt-out
+# ---------------------------------------------------------------------------
+
+
+def test_health_off_bit_identical_draws_and_silent_trace(
+    monkeypatch, tmp_path
+):
+    """STARK_HEALTH=0: identical draws, no health events, no energy
+    readback path — the observatory is host-side by construction."""
+    kw = dict(chains=2, block_size=30, max_blocks=2, min_blocks=2,
+              rhat_target=0.0, ess_target=1e9, num_warmup=100,
+              num_samples=1, seed=0)
+
+    def run(tag):
+        path = str(tmp_path / f"{tag}.jsonl")
+        tr = telemetry.RunTrace(path)
+        with telemetry.use_trace(tr):
+            res = sample_until_converged(
+                EightSchools(), eight_schools_data(), **kw
+            )
+        tr.close()
+        return res, telemetry.read_trace(path)
+
+    monkeypatch.setenv("STARK_HEALTH", "1")
+    res_on, ev_on = run("on")
+    monkeypatch.setenv("STARK_HEALTH", "0")
+    res_off, ev_off = run("off")
+    assert np.array_equal(res_on.draws_flat, res_off.draws_flat)
+    assert res_on.health_warnings is not None
+    assert res_off.health_warnings is None
+    assert all(e["event"] != "health_warning" for e in ev_off)
+    # event streams identical once health events are dropped
+    names_on = [
+        e["event"] for e in ev_on if e["event"] != "health_warning"
+    ]
+    assert names_on == [e["event"] for e in ev_off]
+
+
+# ---------------------------------------------------------------------------
+# chaos-drill ordering: warning BEFORE the fault taxonomy
+# ---------------------------------------------------------------------------
+
+
+def test_nan_poison_warns_before_chain_health_error(tmp_path):
+    """The nan_poison drill's failpoint (runner.carried_nan): the
+    stuck_chain ERROR warning lands in the trace (and a health:*
+    postmortem bundle on disk) BEFORE check_finite_state raises the
+    ChainHealthError the fault taxonomy classifies."""
+    from stark_tpu.supervise import ChainHealthError
+
+    recorder = telemetry.flight_recorder(str(tmp_path))
+    recorder.install()
+    path = str(tmp_path / "t.jsonl")
+    faults.configure("runner.carried_nan=nan*1")
+    tr = telemetry.RunTrace(path)
+    try:
+        with telemetry.use_trace(tr):
+            with pytest.raises(ChainHealthError):
+                sample_until_converged(
+                    EightSchools(), eight_schools_data(), chains=2,
+                    block_size=20, max_blocks=4, min_blocks=2,
+                    rhat_target=0.0, ess_target=1e9, num_warmup=50,
+                    num_samples=1, seed=0, health_check=True,
+                )
+    finally:
+        tr.close()
+        recorder.uninstall()
+        recorder.set_workdir(None)
+    events = telemetry.read_trace(path)
+    stuck = [
+        e for e in events
+        if e["event"] == "health_warning" and e["warning"] == "stuck_chain"
+    ]
+    assert stuck and stuck[0]["severity"] == "error"
+    import glob
+
+    bundles = glob.glob(
+        os.path.join(str(tmp_path), "postmortem", "pm*health_stuck_chain")
+    )
+    assert bundles, "no health postmortem bundle for the error warning"
+    with open(os.path.join(bundles[0], "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["trigger"] == "health:stuck_chain"
+
+
+def test_fleet_lane_nan_warns_before_reseed(tmp_path):
+    """fleet.lane_nan: the per-tenant stuck_chain warning precedes the
+    problem_reseeded fault event in the trace, the reseeded lane still
+    converges, and per-problem verdicts ride the results."""
+    spec = FleetSpec.from_problems(
+        EightSchools(), [eight_schools_data()] * 3
+    )
+    faults.configure("fleet.lane_nan=nan(1)*1")
+    path = str(tmp_path / "fleet.jsonl")
+    tr = telemetry.RunTrace(path)
+    try:
+        with telemetry.use_trace(tr):
+            res = sample_fleet(
+                spec, chains=2, block_size=30, max_blocks=6, min_blocks=2,
+                ess_target=40.0, num_warmup=100, num_samples=1, seed=0,
+                health_check=True, problem_max_restarts=2,
+            )
+    finally:
+        tr.close()
+    assert all(p.converged for p in res.problems)
+    assert [p.health for p in res.problems] is not None
+    assert all(p.health is not None for p in res.problems)
+    events = telemetry.read_trace(path)
+    warn_idx = [
+        i for i, e in enumerate(events)
+        if e["event"] == "health_warning"
+        and e["warning"] == "stuck_chain"
+        and e.get("problem_id") == "p0001"
+    ]
+    reseed_idx = [
+        i for i, e in enumerate(events)
+        if e["event"] == "problem_reseeded"
+    ]
+    assert warn_idx and reseed_idx and warn_idx[0] < reseed_idx[0]
+
+
+# ---------------------------------------------------------------------------
+# SG-HMC trail satellite
+# ---------------------------------------------------------------------------
+
+
+def test_sghmc_health_trail(monkeypatch, tmp_path):
+    from stark_tpu.sghmc import sghmc_sample
+
+    class TinyNormal(Model):
+        def param_spec(self):
+            return {"x": ParamSpec((2,))}
+
+        def log_prior(self, p):
+            return -0.5 * jnp.sum(p["x"] ** 2)
+
+        def log_lik(self, p, data):
+            return jnp.sum(
+                jstats.norm.logpdf(data["y"], jnp.sum(p["x"]), 1.0)
+            )
+
+    data = {"y": np.zeros(16, np.float32)}
+    path = str(tmp_path / "sghmc.jsonl")
+    tr = telemetry.RunTrace(path)
+    with telemetry.use_trace(tr):
+        post = sghmc_sample(
+            TinyNormal(), data, batch_size=8, chains=2, num_warmup=20,
+            num_samples=30, step_size=1e-2, seed=0,
+        )
+    tr.close()
+    assert "kinetic_energy" in post.sample_stats
+    events = telemetry.read_trace(path)
+    ch = [
+        e for e in events
+        if e["event"] == "chain_health" and e.get("kernel") == "sghmc"
+    ]
+    assert ch and "num_divergent" in ch[0]
+    assert "kinetic_energy_mean" in ch[0]
+    # STARK_HEALTH=0 keeps the trace byte-free of the trail
+    monkeypatch.setenv("STARK_HEALTH", "0")
+    path2 = str(tmp_path / "sghmc_off.jsonl")
+    tr2 = telemetry.RunTrace(path2)
+    with telemetry.use_trace(tr2):
+        sghmc_sample(
+            TinyNormal(), data, batch_size=8, chains=2, num_warmup=20,
+            num_samples=30, step_size=1e-2, seed=0,
+        )
+    tr2.close()
+    assert not any(
+        e["event"] == "chain_health"
+        for e in telemetry.read_trace(path2)
+    )
+
+
+# ---------------------------------------------------------------------------
+# segmented (fixed-budget) sampler driver wiring
+# ---------------------------------------------------------------------------
+
+
+def test_segmented_sampler_emits_warnings(tmp_path):
+    """stark_tpu.sample(...) — the segmented driver — runs the funnel
+    and emits divergences warnings through the same engine."""
+    import stark_tpu
+    from stark_tpu.backends.jax_backend import JaxBackend
+
+    path = str(tmp_path / "seg.jsonl")
+    tr = telemetry.RunTrace(path)
+    with telemetry.use_trace(tr):
+        stark_tpu.sample(
+            CenteredEightSchools(), eight_schools_data(), chains=2,
+            num_warmup=150, num_samples=150, seed=0, target_accept=0.8,
+            backend=JaxBackend(dispatch_steps=50),
+        )
+    tr.close()
+    events = telemetry.read_trace(path)
+    assert any(e["event"] == "health_warning" for e in events)

@@ -1,0 +1,51 @@
+"""tools/lint_health_thresholds.py: every STARK_HEALTH* knob read under
+stark_tpu/ must be documented in the README warning-taxonomy table and
+named by at least one test (the threshold-coverage contract mirroring
+lint_fused_knobs.py).
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import lint_health_thresholds  # noqa: E402
+
+
+def test_repo_is_clean():
+    violations = lint_health_thresholds.lint_repo(REPO)
+    assert violations == [], "\n".join(violations)
+
+
+def test_collector_finds_master_switch_and_thresholds():
+    """A knob the collector can't see is a knob the lint can't protect:
+    the master switch plus every taxonomy threshold must be collected."""
+    knobs = lint_health_thresholds.collect_knobs(
+        os.path.join(REPO, "stark_tpu")
+    )
+    assert {
+        "STARK_HEALTH",
+        "STARK_HEALTH_DIVERGENCE_FRAC",
+        "STARK_HEALTH_EBFMI",
+        "STARK_HEALTH_TREEDEPTH_FRAC",
+        "STARK_HEALTH_LOW_ACCEPT",
+        "STARK_HEALTH_STUCK_ACCEPT",
+        "STARK_HEALTH_RHAT",
+        "STARK_HEALTH_MIN_ESS",
+        "STARK_HEALTH_MIN_DRAWS",
+        "STARK_HEALTH_SNAPSHOTS",
+        "STARK_HEALTH_SNAPSHOT_DIM",
+    } <= set(knobs)
+
+
+def test_word_boundary_matching(tmp_path):
+    """STARK_HEALTH appearing in a test must not satisfy
+    STARK_HEALTH_RHAT too — the grep is word-bounded."""
+    d = tmp_path / "tests"
+    d.mkdir()
+    (d / "test_x.py").write_text('os.environ["STARK_HEALTH"] = "0"\n')
+    found = lint_health_thresholds._grep_tree(
+        str(d), {"STARK_HEALTH", "STARK_HEALTH_RHAT"}
+    )
+    assert found == {"STARK_HEALTH"}

@@ -597,3 +597,101 @@ def test_trace_report_renders_na_for_missing_values():
     trace_report = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(trace_report)
     assert trace_report._fmt(None) == "n/a"
+
+
+# ---------------------------------------------------------------------------
+# PR 15 satellite bugfix: health.num_divergent is cumulative-with-reset
+# across supervised attempts, not the latest event's value
+# ---------------------------------------------------------------------------
+
+
+def _attempt_events(run, divs, restart_after=False, resumed=False):
+    """One supervised attempt's skeleton: run_start (stamped
+    ``resuming`` exactly as the runner does — bool(resume_from)), a
+    per-block chain_health divergence trail, optionally the failed
+    attempt's restart record (stamped with THIS run's ordinal, as
+    supervise does)."""
+    evs = [{"event": "run_start", "model": "M", "kernel": "nuts",
+            "resuming": bool(resumed)}]
+    if resumed:
+        # a checkpoint-resumed attempt re-emits warmup_done without a
+        # fresh warmup; its block counters CONTINUE the restored total
+        evs.append({"event": "chain_health", "status": "warmup_done",
+                    "num_divergent": 7})
+    for d in divs:
+        evs.append({"event": "chain_health", "mean_accept": 0.8,
+                    "num_divergent": d})
+    if restart_after:
+        evs.append({"event": "chain_health", "status": "restart",
+                    "fault": "transient", "attempt": run})
+    else:
+        evs.append({"event": "run_end", "dur_s": 1.0})
+    return [
+        {"schema": SCHEMA_VERSION, "ts": 0.0, "wall_s": 0.0, "run": run,
+         **e}
+        for e in evs
+    ]
+
+
+def test_summarize_num_divergent_accumulates_across_cold_restarts():
+    """A cold retry restarts its cumulative counter from zero: the
+    failed attempt's final count must be banked, not discarded (the
+    old latest-event semantics reported 2 here) — including when the
+    retry happens to reach a HIGHER count than the failed attempt (no
+    value decrease is ever observed; the run_start boundary is the
+    reset signal, not the values)."""
+    events = (
+        _attempt_events(1, [2, 3], restart_after=True)
+        + _attempt_events(2, [1, 2])
+    )
+    s = summarize_trace(events)
+    assert s["run"] == 2 and s["restarts"] == 1
+    assert s["health"]["num_divergent"] == 5  # 3 banked + 2 current
+    # monotone-looking cold retry: attempt 1 ends at 5, attempt 2
+    # reaches 7 with no observed decrease — still 5 + 7
+    events = (
+        _attempt_events(1, [5], restart_after=True)
+        + _attempt_events(2, [6, 7])
+    )
+    assert summarize_trace(events)["health"]["num_divergent"] == 12
+
+
+def test_summarize_num_divergent_resumed_attempt_not_double_counted():
+    """A checkpoint-resumed retry CONTINUES the restored counter (no
+    decrease) — cumulative-with-reset must not double count it, and the
+    warmup_done record's warmup divergences stay out of the number."""
+    events = (
+        _attempt_events(1, [2, 3], restart_after=True)
+        + _attempt_events(2, [3, 4], resumed=True)
+    )
+    s = summarize_trace(events)
+    assert s["restarts"] == 1
+    assert s["health"]["num_divergent"] == 4  # monotone across resume
+
+
+def test_summarize_num_divergent_shard_partials_excluded():
+    """Consensus-style per-shard chain_health records carry per-SHARD
+    partial counts: they must not be folded as if they were run totals
+    — run_end's total is the authoritative value."""
+    evs = [
+        {"event": "run_start", "model": "M", "kernel": "nuts"},
+        {"event": "chain_health", "shard": 0, "num_divergent": 5},
+        {"event": "chain_health", "shard": 1, "num_divergent": 2},
+        {"event": "chain_health", "shard": 2, "num_divergent": 7},
+        {"event": "chain_health", "shard": 3, "num_divergent": 1},
+        {"event": "run_end", "dur_s": 1.0, "num_divergent": 15},
+    ]
+    events = [
+        {"schema": SCHEMA_VERSION, "ts": 0.0, "wall_s": 0.0, "run": 1, **e}
+        for e in evs
+    ]
+    assert summarize_trace(events)["health"]["num_divergent"] == 15
+
+
+def test_summarize_num_divergent_ignores_unrelated_earlier_runs():
+    """Two independent runs appended to one file (bench legs): the
+    selected run's count never absorbs the other's."""
+    events = _attempt_events(1, [9]) + _attempt_events(2, [1])
+    s = summarize_trace(events)
+    assert s["health"]["num_divergent"] == 1
+    assert summarize_trace(events, run=1)["health"]["num_divergent"] == 9

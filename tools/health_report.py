@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Render the statistical-health trail of a telemetry trace.
+
+    python tools/health_report.py /tmp/t.jsonl           # last run in file
+    python tools/health_report.py /tmp/t.jsonl --run 1   # a specific run
+    python tools/health_report.py /tmp/t.jsonl --all     # every run
+    python tools/health_report.py /tmp/t.jsonl --json    # machine-readable
+
+The sampler statistical-health observatory (``stark_tpu/health.py``)
+emits schema'd ``health_warning`` events — the Stan-style taxonomy
+(divergences / low_ebfmi / max_treedepth_saturation / low_accept /
+stuck_chain / high_rhat / low_ess_per_param) with severity, measured
+value vs its ``STARK_HEALTH_*`` threshold knob, affected chains, a
+remediation hint, and (on ``divergences``) the bounded
+divergence-snapshot ring that LOCALIZES where in parameter space the
+sampler broke (a centered funnel's snapshots concentrate at low tau).
+This tool renders that trail per run: a warning summary table, the
+divergence-snapshot table, and the chain-health rollup
+`telemetry.summarize_trace` already computes.
+
+n/a-safe by contract: traces that predate PR 15 (or were written under
+``STARK_HEALTH=0``) carry no ``health_warning`` events and render a
+"no health events" line — never an error — so the tool is safe to point
+at any trace the repo ever wrote.  Stdlib + the telemetry reader only
+(no jax), so it runs anywhere the trace file lands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+# repo-root invocation without installation; tools/ for the shared
+# table/format helpers (one renderer idiom across the report tools)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from stark_tpu.telemetry import read_trace, summarize_trace  # noqa: E402
+from trace_report import _table  # noqa: E402
+
+#: severity sort rank (most severe first in the table)
+_SEV_RANK = {"error": 0, "warn": 1, "info": 2}
+
+
+def health_summary(events: List[Dict[str, Any]], run: int) -> Dict[str, Any]:
+    """Machine contract: one dict per run — the summarize_trace health
+    rollup plus per-warning aggregates and the flattened snapshot ring."""
+    s = summarize_trace(events, run=run)
+    warns = [
+        e for e in events
+        if e.get("run", 0) == run and e.get("event") == "health_warning"
+    ]
+    by_name: Dict[str, Dict[str, Any]] = {}
+    snapshots: List[Dict[str, Any]] = []
+    for e in warns:
+        name = str(e.get("warning", "unknown"))
+        agg = by_name.setdefault(name, {
+            "warning": name,
+            "severity": e.get("severity"),
+            "count": 0,
+            "knob": e.get("knob"),
+            "hint": e.get("hint"),
+        })
+        agg["count"] += 1
+        for k in ("severity", "value", "threshold", "block", "problem_id",
+                  "num_chains_affected"):
+            if e.get(k) is not None:
+                agg[k] = e[k]
+        for snap in e.get("snapshots") or []:
+            snapshots.append({
+                "block": e.get("block"),
+                **({"problem_id": e["problem_id"]}
+                   if e.get("problem_id") is not None else {}),
+                **snap,
+            })
+    return {
+        "run": run,
+        "health": s.get("health", {}),
+        "warnings": sorted(
+            by_name.values(),
+            key=lambda w: (_SEV_RANK.get(str(w.get("severity")), 9),
+                           w["warning"]),
+        ),
+        "snapshots": snapshots,
+    }
+
+
+def render_run(events: List[Dict[str, Any]], run: int) -> str:
+    s = health_summary(events, run)
+    out = [f"run {run}: statistical health"]
+    h = s["health"]
+    rollup = [
+        ("max R-hat", h.get("max_rhat")),
+        ("min ESS", h.get("min_ess")),
+        ("divergences (cumulative, restart-chain)", h.get("num_divergent")),
+        ("mean acceptance", h.get("mean_accept")),
+        ("stuck components", h.get("num_stuck_components")),
+        ("warnings emitted", h.get("warnings")),
+    ]
+    rows = [r for r in rollup if r[1] is not None]
+    if rows:
+        out.append("")
+        out.append(_table(rows, ("chain health", "value")))
+    if not s["warnings"]:
+        out.append("")
+        out.append(
+            "(no health events — clean run at default thresholds, a "
+            "pre-PR-15 trace, or STARK_HEALTH=0)"
+        )
+        return "\n".join(out)
+    out.append("")
+    out.append(_table(
+        [
+            (
+                w["warning"],
+                w.get("severity"),
+                w["count"],
+                w.get("value"),
+                w.get("threshold"),
+                w.get("knob"),
+                w.get("problem_id"),
+                w.get("hint"),
+            )
+            for w in s["warnings"]
+        ],
+        ("warning", "severity", "events", "last value", "threshold",
+         "knob", "problem", "remediation"),
+    ))
+    if s["snapshots"]:
+        out.append("")
+        out.append("divergence localization (unconstrained coordinates, "
+                   "first K per block):")
+        rows = [
+            (
+                snap.get("block"),
+                snap.get("problem_id"),
+                snap.get("chain"),
+                snap.get("step"),
+                ", ".join(f"{float(v):.3g}" for v in snap.get("z", [])[:8]),
+            )
+            for snap in s["snapshots"]
+        ]
+        out.append(_table(
+            rows, ("block", "problem", "chain", "step", "z[:8]")
+        ))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="JSONL trace file")
+    ap.add_argument("--run", type=int, default=None,
+                    help="run ordinal to report (default: last)")
+    ap.add_argument("--all", action="store_true", help="report every run")
+    ap.add_argument("--json", action="store_true",
+                    help="print the health summary dict(s) as JSON")
+    args = ap.parse_args(argv)
+
+    events = read_trace(args.trace, strict=False)
+    if not events:
+        print(f"{args.trace}: no parseable events", file=sys.stderr)
+        return 1
+    runs = sorted({e.get("run", 0) for e in events})
+    picked = (
+        runs if args.all
+        else [args.run if args.run is not None else runs[-1]]
+    )
+    if args.json:
+        out = [health_summary(events, r) for r in picked]
+        print(json.dumps(out[0] if len(out) == 1 else out, indent=1))
+        return 0
+    chunks = [render_run(events, r) for r in picked]
+    print(("\n\n" + "=" * 60 + "\n\n").join(chunks))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Repo lint: every STARK_HEALTH* knob must be documented + tested.
+
+The statistical-health observatory (``stark_tpu/health.py``) is driven by
+a family of threshold knobs — the master ``STARK_HEALTH`` switch plus one
+``STARK_HEALTH_<NAME>`` threshold per warning in the taxonomy.  Each knob
+changes which warnings a run emits (and so what operators alert on): an
+undocumented knob is invisible to the people tuning the warning floor,
+and an untested one can silently lose its default or its opt-out path.
+This lint closes both loops statically, mirroring
+``tools/lint_fused_knobs.py``:
+
+1. AST-collect every ``STARK_HEALTH*`` string literal passed to an
+   env-read call (``os.environ.get`` / ``os.getenv`` / ``environ.pop``)
+   under ``stark_tpu/``.
+2. Fail if a collected knob is missing from the README (the warning
+   taxonomy table in the Observability section is the operator
+   contract), or
+3. appears nowhere under ``tests/`` (every threshold needs a named test
+   exercising it).
+
+AST-based (strings in comments can't trip it); imports nothing from the
+package, so it runs anywhere.  Run directly or via
+``tests/test_lint_health_thresholds.py`` (tier-1).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+#: call names whose string-literal argument is an env-knob read
+_READ_FUNCS = frozenset({"get", "getenv", "pop"})
+
+#: the covered family: the master switch and every threshold knob
+_KNOB_RE = re.compile(r"^STARK_HEALTH(?:_[A-Z0-9_]+)?$")
+
+
+def _call_name(node: ast.Call) -> str:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def find_knob_reads(source: str, filename: str) -> List[Tuple[int, str]]:
+    """(lineno, knob) for every STARK_HEALTH* literal in an env-read."""
+    tree = ast.parse(source, filename=filename)
+    hits = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _call_name(node) in _READ_FUNCS):
+            continue
+        for arg in node.args:
+            if (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+                and _KNOB_RE.match(arg.value)
+            ):
+                hits.append((node.lineno, arg.value))
+    return hits
+
+
+def collect_knobs(pkg_dir: str) -> Dict[str, List[str]]:
+    """knob -> ["path:line", ...] across the package."""
+    knobs: Dict[str, List[str]] = {}
+    for root, _dirs, files in os.walk(pkg_dir):
+        if "__pycache__" in root:
+            continue
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            with open(path) as f:
+                source = f.read()
+            for lineno, knob in find_knob_reads(source, path):
+                knobs.setdefault(knob, []).append(f"{path}:{lineno}")
+    return knobs
+
+
+def _grep_tree(tree_dir: str, needles: Set[str]) -> Set[str]:
+    """Which needles appear in any .py file under tree_dir.
+
+    Matched on word boundaries so ``STARK_HEALTH`` in a test does not
+    silently satisfy every ``STARK_HEALTH_<NAME>`` threshold too."""
+    found: Set[str] = set()
+    pats = {n: re.compile(re.escape(n) + r"(?![A-Z0-9_])") for n in needles}
+    for root, _dirs, files in os.walk(tree_dir):
+        if "__pycache__" in root:
+            continue
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            with open(os.path.join(root, name)) as f:
+                text = f.read()
+            found.update(n for n, p in pats.items() if p.search(text))
+            if found == needles:
+                return found
+    return found
+
+
+def lint_repo(repo: str) -> List[str]:
+    """Violation strings for the whole repo; empty = clean."""
+    knobs = collect_knobs(os.path.join(repo, "stark_tpu"))
+    if not knobs:
+        return ["no STARK_HEALTH* env reads found under stark_tpu/ — the "
+                "collector itself is broken"]
+    violations = []
+    readme_path = os.path.join(repo, "README.md")
+    readme = open(readme_path).read() if os.path.exists(readme_path) else ""
+    tested = _grep_tree(os.path.join(repo, "tests"), set(knobs))
+    for knob in sorted(knobs):
+        where = knobs[knob][0]
+        # word-bounded like the tests grep: the bare STARK_HEALTH master
+        # switch must not be satisfied by STARK_HEALTH_<NAME> mentions
+        if not re.search(re.escape(knob) + r"(?![A-Z0-9_])", readme):
+            violations.append(
+                f"{where}: {knob} is read but missing from the README "
+                "warning-taxonomy table (Observability section) — "
+                "document the knob"
+            )
+        if knob not in tested:
+            violations.append(
+                f"{where}: {knob} is read but referenced by no test under "
+                "tests/ — add a named test exercising the threshold "
+                "(or the =0 opt-out for the master switch)"
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = lint_repo(repo)
+    for v in violations:
+        print(v, file=sys.stderr)
+    if violations:
+        print(
+            f"{len(violations)} STARK_HEALTH* knob violation(s) — see "
+            "tools/lint_health_thresholds.py docstring",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,13 @@ set plus the documented auxiliaries).  Non-literal first arguments (the
 `_Phase` re-emit helper's variable) are skipped — the names they forward
 were already collected at their literal call sites.
 
+PR 15 extended coverage to the flight-recorder emission idiom: the
+``record_anomaly(trigger, trace, "<event>", ...)`` sites (and their
+``event=`` keyword form) emit trace records through the recorder rather
+than a direct ``emit()`` call, so their event names — including the new
+``health_warning`` family — are collected and checked too; before this,
+a typo'd anomaly event name would have slipped past the lint.
+
 AST-based (strings/comments can't trip it); `stark_tpu.telemetry` imports
 no jax at module load, so the lint runs anywhere.  Run directly
 (``python tools/lint_trace_schema.py``) or via the test suite
@@ -37,20 +44,34 @@ _EMIT_METHODS = frozenset({"emit", "phase"})
 
 
 def find_event_names(source: str, filename: str) -> List[Tuple[int, str]]:
-    """(lineno, event_name) of every literal emit()/phase() call."""
+    """(lineno, event_name) of every literal emit()/phase() call, plus
+    the event argument of ``record_anomaly(trigger, trace, "<event>")``
+    flight-recorder sites (3rd positional or ``event=`` keyword)."""
     tree = ast.parse(source, filename=filename)
     hits = []
     for node in ast.walk(tree):
         if not (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
-            and node.func.attr in _EMIT_METHODS
-            and node.args
         ):
             continue
-        arg = node.args[0]
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            hits.append((node.lineno, arg.value))
+        if node.func.attr in _EMIT_METHODS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                hits.append((node.lineno, arg.value))
+        elif node.func.attr == "record_anomaly":
+            args = []
+            if len(node.args) >= 3:
+                args.append(node.args[2])
+            args.extend(
+                kw.value for kw in node.keywords if kw.arg == "event"
+            )
+            for arg in args:
+                if (
+                    isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                ):
+                    hits.append((node.lineno, arg.value))
     return hits
 
 

@@ -278,8 +278,20 @@ def render_run(events, run) -> str:
             ("num_stuck_components", "stuck components"),
             ("step_size", "step size"),
             ("draws_per_chain", "draws/chain"),
+            # statistical-health observatory (stark_tpu.health) rollup —
+            # n/a-filtered on pre-PR-15 / STARK_HEALTH=0 traces; the full
+            # warning + divergence-localization table is
+            # tools/health_report.py
+            ("warnings", "health warnings"),
         )
         rows = [(label, h[k]) for k, label in keys if k in h]
+        if h.get("warning_counts"):
+            rows.append((
+                "warning types",
+                ", ".join(
+                    f"{k}x{v}" for k, v in h["warning_counts"].items()
+                ),
+            ))
         out.append(_table(rows, ("chain health", "value")))
     else:
         out.append("(no chain_health events)")
