@@ -38,6 +38,13 @@ Scenario matrix (`SCENARIOS`):
                          bit-identical, traces identical in every
                          non-timing field, no postmortem bundle — the
                          recorder only reads
+  comm_clean_identity    comms observatory on vs off
+                         (STARK_COMM_TELEMETRY): mesh-fleet draws
+                         bit-identical, the off trace carries zero comm
+                         events, and stripping the on trace's comm
+                         events leaves the two streams identical in
+                         every non-timing field — the accounting only
+                         observes
 
 The postmortem flight recorder (telemetry.FlightRecorder) is drilled by
 the anomaly scenarios themselves: nan_poison (supervised restart),
@@ -907,6 +914,9 @@ def fleet_warmstart_poison(workdir: str) -> Dict[str, Any]:
 _TIMING_KEYS = frozenset({
     "ts", "wall_s", "dur_s", "device_idle_s", "backoff_s", "idle_s",
     "path", "elapsed_s", "ess_rate", "deadline_headroom_s",
+    # host-measured per-shard walls and the ratios derived from them
+    # (the PR 16 shard-imbalance trail) — timing by construction
+    "shard_walls", "straggler_shard", "straggler_ratio",
 })
 
 
@@ -962,6 +972,76 @@ def recorder_clean_identity(workdir: str) -> Dict[str, Any]:
         "span events leaked into a default (STARK_PROFILE_SPANS unset) trace"
     )
     return {"events": len(ev_on), "trace_identical": True}
+
+
+@_scenario("comm_clean_identity")
+def comm_clean_identity(workdir: str) -> Dict[str, Any]:
+    """Comms observatory on vs off (STARK_COMM_TELEMETRY): the
+    accounting is host-side and outside the compiled program's op/key
+    sequence, so two mesh-fleet runs must produce bit-identical draws;
+    the off trace must carry zero ``comm`` events; and the on trace,
+    with its ``comm`` events stripped, must match the off trace in
+    every non-timing field (the shard-wall trail is timing)."""
+    import jax
+
+    from .fleet import sample_fleet
+    from .parallel.primitives import COMM_TELEMETRY_ENV
+    from .telemetry import RunTrace, read_trace, use_trace
+
+    devices = jax.devices()
+    mesh = None
+    if len(devices) >= 2:
+        from .parallel.mesh import make_mesh
+
+        mesh = make_mesh({"problems": 2}, devices=devices[:2])
+    spec = _fleet_spec(2)
+
+    def run(tag: str, comm_off: bool):
+        trace_path = os.path.join(workdir, f"{tag}.jsonl")
+        prev = os.environ.get(COMM_TELEMETRY_ENV)
+        if comm_off:
+            os.environ[COMM_TELEMETRY_ENV] = "0"
+        try:
+            with RunTrace(trace_path) as tr, use_trace(tr):
+                res = sample_fleet(spec, seed=0, mesh=mesh, **_FLEET_KW)
+        finally:
+            if comm_off:
+                if prev is None:
+                    os.environ.pop(COMM_TELEMETRY_ENV, None)
+                else:
+                    os.environ[COMM_TELEMETRY_ENV] = prev
+        return res, read_trace(trace_path)
+
+    res_off, ev_off = run("comm_off", comm_off=True)
+    res_on, ev_on = run("comm_on", comm_off=False)
+    for a_p, b_p in zip(res_off.problems, res_on.problems):
+        np.testing.assert_array_equal(
+            np.asarray(a_p.draws_flat), np.asarray(b_p.draws_flat)
+        )
+
+    comm_on = [e for e in ev_on if e["event"] == "comm"]
+    assert not [e for e in ev_off if e["event"] == "comm"], (
+        "STARK_COMM_TELEMETRY=0 leaked comm events"
+    )
+    if mesh is not None:
+        assert comm_on, (
+            "a mesh fleet run with the comms observatory on emitted no "
+            "comm events"
+        )
+
+    def shape(events):
+        return [
+            {k: v for k, v in e.items() if not _is_timing_key(k)}
+            for e in events
+        ]
+
+    a = shape(ev_off)
+    b = shape([e for e in ev_on if e["event"] != "comm"])
+    assert a == b, (
+        "comm telemetry on/off changed the non-comm trace event stream"
+    )
+    return {"comm_events": len(comm_on), "mesh": mesh is not None,
+            "trace_identical": True}
 
 
 @_scenario("clean_identity")

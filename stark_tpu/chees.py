@@ -90,11 +90,15 @@ def _welford_batch(w: WelfordState, xs: jax.Array, chains_axis=None) -> WelfordS
     bc = xs.shape[0]
     bmean = jnp.mean(xs, axis=0)
     if chains_axis is not None:
-        bc = bc * jax.lax.psum(1, chains_axis)
+        from .parallel.primitives import mapped_axis_size
+
+        bc = bc * mapped_axis_size(chains_axis)
         bmean = jax.lax.pmean(bmean, chains_axis)
     bm2 = jnp.sum((xs - bmean[None, :]) ** 2, axis=0)
     if chains_axis is not None:
-        bm2 = jax.lax.psum(bm2, chains_axis)
+        from .parallel.primitives import reduce_tree
+
+        bm2 = reduce_tree(bm2, chains_axis)
     na = w.count.astype(xs.dtype)
     nb = jnp.asarray(bc, xs.dtype)
     delta = bmean - w.mean
@@ -281,8 +285,10 @@ def make_chees_parts(
         )
         n_div = jnp.sum(div.astype(jnp.int32))
         if chains_axis is not None:
+            from .parallel.primitives import reduce_tree
+
             # global count: the host reads one replicated scalar
-            n_div = jax.lax.psum(n_div, chains_axis)
+            n_div = reduce_tree(n_div, chains_axis)
         # nleap is the SHARED per-transition length (replicated across the
         # chains axis) — summed so the host can see where the warmup
         # gradient budget goes (the flagship wall is warmup-dominated)

@@ -92,11 +92,6 @@ def gather_draws(tree):
     driver-side collect, without funnelling through one node.
     ``ShardedBackend.run`` routes its results through here.
     """
-    if process_count() == 1:
-        return jax.tree.map(np.asarray, tree)
-    from jax.experimental import multihost_utils
+    from .parallel.primitives import gather_tree
 
-    return jax.tree.map(
-        lambda x: np.asarray(multihost_utils.process_allgather(x, tiled=True)),
-        tree,
-    )
+    return gather_tree(tree)

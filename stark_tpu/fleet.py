@@ -1052,6 +1052,49 @@ def _resolve_fleet_mesh(mesh):
     return make_mesh({"problems": n}, devices=devices[:n])
 
 
+def _shard_ready_walls(tree, t0: float) -> Optional[List[float]]:
+    """Host wall (since ``t0``, the dispatch enqueue) at which each mesh
+    shard's output buffer became ready, ordered by shard ordinal along
+    the leading (problems) axis — the per-shard timing trail behind
+    shard-imbalance attribution.
+
+    Polls ``is_ready`` across all shards when the runtime exposes it
+    (true per-shard completion order); otherwise falls back to
+    sequential ``block_until_ready`` in ordinal order, where each wall
+    is the time the shard was OBSERVED ready by — an upper bound that
+    keeps the slowest shard exact.  None when the output carries no
+    addressable shards (off-mesh paths)."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return None
+    shards = getattr(leaves[0], "addressable_shards", None)
+    if not shards or len(shards) < 2:
+        return None
+
+    def ordinal(sh):
+        idx = getattr(sh, "index", None)
+        if idx and isinstance(idx[0], slice) and idx[0].start is not None:
+            return int(idx[0].start)
+        return 0
+
+    datas = [sh.data for sh in sorted(shards, key=ordinal)]
+    walls: List[Optional[float]] = [None] * len(datas)
+    if all(hasattr(d, "is_ready") for d in datas):
+        remaining = set(range(len(datas)))
+        while remaining:
+            for k in list(remaining):
+                if datas[k].is_ready():
+                    walls[k] = time.perf_counter() - t0
+                    remaining.discard(k)
+            if remaining:
+                time.sleep(0.0002)
+    else:
+        for k, d in enumerate(datas):
+            jax.block_until_ready(d)
+            walls[k] = time.perf_counter() - t0
+    return [round(float(w), 6) for w in walls]
+
+
 def _fleet_workdir(*paths: Optional[str]) -> Optional[str]:
     """Directory the flight recorder drops postmortem bundles into: the
     parent of the first persisted fleet artifact (None for a fully
@@ -1425,6 +1468,19 @@ def _sample_fleet(
     health_on = _health.health_enabled()
     monitors: Dict[str, _health.HealthMonitor] = {}
     health_verdicts: Dict[str, List[str]] = {}
+    # shard-imbalance straggler trail (PR 16): on mesh runs the host
+    # times each shard's output readiness after dispatch (the per-shard
+    # comm trail that feeds fleet_block shard_walls fields and the
+    # windowed ``mesh_imbalance`` health warning).  Rides ONLY mesh +
+    # STARK_COMM_TELEMETRY runs — knob-off traces stay byte-identical.
+    from .parallel.primitives import comm_telemetry_enabled
+
+    comm_on = comm_telemetry_enabled()
+    shard_trail = (
+        _health.ShardBalanceTrail(trace=trace)
+        if fleet_mesh is not None and comm_on and health_on
+        else None
+    )
 
     def monitor_for(p):
         m = monitors.get(p.pid)
@@ -2667,6 +2723,13 @@ def _sample_fleet(
             # per-problem ``deadline_s`` budget is what turns the delay
             # into a per-tenant outcome instead of a fleet-wide fate
             faults.fail_point("fleet.lane_stall")
+            # per-shard timing trail (PR 16): observe each shard's output
+            # readiness since enqueue BEFORE the global gather collapses
+            # the layout — host-side observation only, the draws are
+            # untouched.  Rides mesh + STARK_COMM_TELEMETRY runs only.
+            shard_walls = None
+            if fleet_mesh is not None and comm_on:
+                shard_walls = _shard_ready_walls(zs, t_enq)
             t_blk = time.perf_counter()
             # the GLOBAL host view (parallel.primitives.gather_tree):
             # everything below — gates, fault domains, budgets, slots,
@@ -2884,6 +2947,27 @@ def _sample_fleet(
                 sched_fields = dict(
                     sched_fields, shards=n_shards, shard_occupancy=shard_occ,
                 )
+                # shard-imbalance attribution (PR 16): per-shard ready
+                # walls + slowest/median straggler ratio ride ONLY
+                # mesh + comm-telemetry runs (knob-off events stay
+                # byte-identical); the windowed health warning fires
+                # through the ShardBalanceTrail
+                if shard_walls is not None:
+                    med = float(np.median(shard_walls))
+                    worst = int(np.argmax(shard_walls))
+                    sched_fields = dict(
+                        sched_fields,
+                        shard_walls=shard_walls,
+                        straggler_shard=worst,
+                        straggler_ratio=(
+                            round(float(shard_walls[worst]) / med, 4)
+                            if med > 0 else None
+                        ),
+                    )
+                    if shard_trail is not None:
+                        shard_trail.observe(
+                            shard_walls, block=blocks_dispatched
+                        )
             if trace.enabled:
                 trace.emit(
                     "fleet_block",

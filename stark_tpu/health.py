@@ -92,6 +92,7 @@ from . import telemetry
 
 __all__ = [
     "HealthMonitor",
+    "ShardBalanceTrail",
     "WARNINGS",
     "health_enabled",
     "sghmc_health_trail",
@@ -153,6 +154,13 @@ WARNINGS: Dict[str, Dict[str, str]] = {
         "hint": ("worst-coordinate ESS too small for stable estimates: "
                  "run longer or thin less"),
     },
+    "mesh_imbalance": {
+        "severity": "warn",
+        "knob": "STARK_HEALTH_IMBALANCE",
+        "hint": ("one mesh shard consistently lags the median (straggler): "
+                 "rebalance problems across shards or check the slow "
+                 "device; the fleet_block shard_walls trail localizes it"),
+    },
 }
 
 
@@ -198,6 +206,9 @@ def thresholds() -> Dict[str, float]:
         "min_ess": _env_float(os.environ.get("STARK_HEALTH_MIN_ESS"), 100.0),
         "min_draws": _env_int(
             os.environ.get("STARK_HEALTH_MIN_DRAWS"), 100
+        ),
+        "imbalance": _env_float(
+            os.environ.get("STARK_HEALTH_IMBALANCE"), 2.0
         ),
         "snapshots": _env_int(os.environ.get("STARK_HEALTH_SNAPSHOTS"), 4),
         "snapshot_dim": _env_int(
@@ -617,3 +628,84 @@ def sghmc_health_trail(trace, *, kinetic_energy, num_divergent,
                 count=ndiv,
                 total=ndiv,
             )
+
+
+class ShardBalanceTrail:
+    """Shard-imbalance straggler attribution over a mesh fleet's per-block
+    shard walls (the PR 16 comms observatory's health leg).
+
+    The fleet hands every mesh block's host-measured per-shard completion
+    walls to ``observe``.  The trail windows them (``window`` blocks per
+    verdict so a single slow gather cannot page an operator), computes
+    per-shard mean wall over the window, and when the worst shard exceeds
+    ``STARK_HEALTH_IMBALANCE`` × the median it emits one ``mesh_imbalance``
+    health warning naming the straggler shard.  Purely host-side — shares
+    the warning taxonomy/emit shape with :class:`HealthMonitor` and, like
+    it, never raises into the run.
+    """
+
+    def __init__(self, *, trace: Any = None, window: int = 8,
+                 threshold: Optional[float] = None,
+                 problem_id: Optional[str] = None):
+        self._trace = trace
+        self.window = max(int(window), 1)
+        self.threshold = (
+            float(threshold) if threshold is not None
+            else thresholds()["imbalance"]
+        )
+        self.problem_id = problem_id
+        self._walls: List[List[float]] = []
+        #: warning state, mirroring HealthMonitor.active
+        self.active: Dict[str, Dict[str, Any]] = {}
+
+    def observe(self, walls, *, block: Optional[int] = None) -> None:
+        """Buffer one block's per-shard walls; every ``window`` blocks,
+        judge the window and clear the buffer."""
+        if walls is None:
+            return
+        w = [float(x) for x in walls]
+        if len(w) < 2 or not all(np.isfinite(w)):
+            return
+        if self._walls and len(self._walls[0]) != len(w):
+            self._walls = []  # shard count changed (mesh rebuilt): restart
+        self._walls.append(w)
+        if len(self._walls) < self.window:
+            return
+        self._judge(block=block)
+        self._walls = []
+
+    def _judge(self, *, block: Optional[int] = None) -> None:
+        means = np.mean(np.asarray(self._walls, np.float64), axis=0)
+        med = float(np.median(means))
+        if not (np.isfinite(med) and med > 0.0):
+            return
+        worst = int(np.argmax(means))
+        ratio = float(means[worst]) / med
+        if ratio <= self.threshold:
+            return
+        spec = WARNINGS["mesh_imbalance"]
+        rec = {
+            "warning": "mesh_imbalance",
+            "severity": spec["severity"],
+            "hint": spec["hint"],
+            "knob": spec["knob"],
+            "value": round(ratio, 4),
+            "threshold": float(self.threshold),
+            "shard": worst,
+            "window": len(self._walls),
+            "shard_wall_mean_s": round(float(means[worst]), 6),
+            "median_wall_mean_s": round(med, 6),
+        }
+        if block is not None:
+            rec["block"] = int(block)
+        if self.problem_id is not None:
+            rec["problem_id"] = self.problem_id
+        trace = (
+            self._trace if self._trace is not None else telemetry.get_trace()
+        )
+        try:
+            if trace is not None and trace.enabled:
+                trace.emit("health_warning", **rec)
+        except Exception:  # noqa: BLE001 — observability must not fault the run
+            pass
+        self.active["mesh_imbalance"] = rec

@@ -91,8 +91,10 @@ def _cmean(x: Array, chains_axis):
 
 
 def _csum(x, chains_axis):
+    from ..parallel.primitives import reduce_tree
+
     s = jnp.sum(x)
-    return jax.lax.psum(s, chains_axis) if chains_axis else s
+    return reduce_tree(s, chains_axis) if chains_axis else s
 
 
 def _cmax(x, chains_axis):

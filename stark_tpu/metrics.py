@@ -353,6 +353,7 @@ class TraceCollector:
             "meta": {},
             "restarts": {},
             "fleet": {},
+            "comms": {},
         }
 
         # -- counters (monotone across attempts by construction) --
@@ -556,6 +557,31 @@ class TraceCollector:
             "NUTS max-tree-depth saturation fraction at the latest "
             "max_treedepth_saturation warning",
         )
+        # -- mesh communication observatory (parallel.primitives comm
+        # -- events): counters fed ONLY from comm events, so a run with
+        # -- STARK_COMM_TELEMETRY=0 exposes nothing new
+        self.comm_calls = r.counter(
+            f"{p}_comm_calls_total",
+            "collective dispatches accounted by the primitives layer, "
+            "by primitive label (reduce_tree/gather_axis/broadcast/"
+            "shard_put/gather_tree/map_shards)",
+        )
+        self.comm_bytes = r.counter(
+            f"{p}_comm_bytes_total",
+            "predicted total wire bytes moved by accounted collectives, "
+            "by primitive label (payload x collective fan)",
+        )
+        self.comm_host_blocked_s = r.counter(
+            f"{p}_comm_host_blocked_s",
+            "host wall spent blocked inside accounted host-side "
+            "collectives (gathers, placements, dispatch enqueues)",
+        )
+        self.g_comm_straggler = r.gauge(
+            f"{p}_comm_straggler_ratio",
+            "per-shard block wall over the median shard wall at the "
+            "latest mesh fleet block, labeled by shard ordinal "
+            "(1.0 = balanced; the max label is the straggler)",
+        )
         # -- per-tenant SLO rollups (fleet problem_* events; labeled by
         # -- problem id, reset on a fresh run_start) --
         self.g_problem_ess_rate = r.gauge(
@@ -677,10 +703,13 @@ class TraceCollector:
             self.g_health_div_frac.clear()
             self.g_health_ebfmi.clear()
             self.g_health_treedepth.clear()
+            # run B's shard-balance picture must not inherit run A's
+            # straggler labels (comm counters stay monotone as always)
+            self.g_comm_straggler.clear()
             self._set_status(
                 phase="starting", run=rec.get("run", 0), meta=meta,
                 block=None, draws_per_chain=None, ess_forecast=None,
-                health={}, restarts={}, fleet={},
+                health={}, restarts={}, fleet={}, comms={},
             )
         # a new attempt is underway: a prior stall/restart is recovered
         # (budget exhaustion stays sticky inside RunHealth)
@@ -785,6 +814,33 @@ class TraceCollector:
                 self.g_fleet_shard_occupancy.set(
                     float(occ), shard=str(k)
                 )
+        # comms observatory: per-shard wall / median-wall ratio from the
+        # host-side shard timing trail (STARK_COMM_TELEMETRY mesh runs
+        # only) — the straggler shard is the max-valued label
+        walls = rec.get("shard_walls")
+        if walls:
+            try:
+                ws = sorted(float(w) for w in walls)
+                n = len(ws)
+                med = (
+                    ws[n // 2] if n % 2
+                    else 0.5 * (ws[n // 2 - 1] + ws[n // 2])
+                )
+                if med > 0.0:
+                    for k, w in enumerate(walls):
+                        self.g_comm_straggler.set(
+                            round(float(w) / med, 4), shard=str(k)
+                        )
+            except (TypeError, ValueError):
+                pass
+            comms = {
+                k: rec[k]
+                for k in ("straggler_shard", "straggler_ratio")
+                if rec.get(k) is not None
+            }
+            comms["shards_timed"] = len(walls)
+            with self._lock:
+                self._status["comms"].update(comms)
         fleet = {
             k: rec[k]
             for k in ("block", "batch", "active", "occupancy",
@@ -1041,6 +1097,33 @@ class TraceCollector:
             active = len(warns)
         self.g_health_active.set(float(active))
 
+    def _on_comm(self, rec: Dict[str, Any]) -> None:
+        """Collective accounting event (parallel.primitives, PR 16):
+        count calls and predicted wire bytes by primitive, accumulate
+        host-blocked wall, and keep the ``/status.comms`` rollup
+        current.  Absent entirely under STARK_COMM_TELEMETRY=0."""
+        prim = str(rec.get("primitive", "unknown"))
+        self.comm_calls.inc(primitive=prim)
+        wire = rec.get("wire_bytes")
+        if isinstance(wire, (int, float)):
+            self.comm_bytes.inc(float(wire), primitive=prim)
+        blocked = rec.get("host_blocked_s")
+        if isinstance(blocked, (int, float)):
+            self.comm_host_blocked_s.inc(max(float(blocked), 0.0))
+        with self._lock:
+            comms = self._status["comms"]
+            comms["calls"] = int(comms.get("calls", 0)) + 1
+            if isinstance(wire, (int, float)):
+                comms["wire_bytes"] = (
+                    int(comms.get("wire_bytes", 0)) + int(wire)
+                )
+            if isinstance(blocked, (int, float)):
+                comms["host_blocked_s"] = round(
+                    float(comms.get("host_blocked_s", 0.0))
+                    + max(float(blocked), 0.0), 6
+                )
+            comms["last_primitive"] = prim
+
     # -- helpers -----------------------------------------------------------
 
     def _chains(self) -> int:
@@ -1092,6 +1175,7 @@ class TraceCollector:
                 "restarts": dict(self._status["restarts"]),
                 "meta": dict(self._status["meta"]),
                 "fleet": dict(self._status["fleet"]),
+                "comms": dict(self._status["comms"]),
             }
         attempt = self.g_attempt.value()
         if attempt is not None:

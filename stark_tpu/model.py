@@ -242,7 +242,9 @@ def flatten_model(
         if data is not None:
             ll = _local_ll(params, data)
             if axis_name is not None:
-                ll = jax.lax.psum(ll, axis_name)
+                from .parallel.primitives import reduce_tree
+
+                ll = reduce_tree(ll, axis_name)
             lp = lp + lik_scale * ll
         return -lp
 
@@ -255,8 +257,10 @@ def flatten_model(
             params, _ = constrain_with_fldj(z)
             return _local_ll(params, data)
 
+        from .parallel.primitives import reduce_tree
+
         ll, ll_grad = jax.value_and_grad(local_ll)(flat)
-        packed = jax.lax.psum(jnp.concatenate([ll[None], ll_grad]), axis_name)
+        packed = reduce_tree(jnp.concatenate([ll[None], ll_grad]), axis_name)
         ll_tot, ll_grad_tot = packed[0], packed[1:]
 
         def prior_part(z):

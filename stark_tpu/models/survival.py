@@ -111,11 +111,12 @@ class CoxPH(Model):
         if jax.process_count() == 1:
             return
         import numpy as np
-        from jax.experimental import multihost_utils
+
+        from ..parallel.primitives import gather_tree
 
         t = np.asarray(data["t"], np.float64)
         ends = np.asarray(
-            multihost_utils.process_allgather(np.array([t[0], t[-1]]))
+            gather_tree(np.array([t[0], t[-1]]), tiled=False)
         ).reshape(-1, 2)  # (P, 2): per-process (first, last) time
         if np.any(ends[:-1, 1] < ends[1:, 0]):
             raise ValueError(
@@ -173,16 +174,18 @@ class CoxPH(Model):
         # unsharded log_lik compares native times, and under
         # jax_enable_x64 an f32 downcast (to pack the gather) would merge
         # near-tie blocks only on the sharded path (ADVICE r5)
+        from ..parallel.primitives import gather_axis, mapped_axis_size
+
         t = data["t"]
         s = jax.lax.axis_index(axis_name)
-        num_shards = jax.lax.psum(1, axis_name)  # static axis size
+        num_shards = mapped_axis_size(axis_name)  # static axis size
 
         # two tiny O(P) gathers: the prefix totals in eta's dtype and the
         # first local times in their own dtype (packing both into one
         # stack would force the time downcast the tie fix exists to avoid)
         prefix_l = _cumulative_logsumexp(eta)
-        totals = jax.lax.all_gather(prefix_l[-1], axis_name)  # (P,)
-        firsts = jax.lax.all_gather(t[0], axis_name)  # (P,) native dtype
+        totals = gather_axis(prefix_l[-1], axis_name)  # (P,)
+        firsts = gather_axis(t[0], axis_name)  # (P,) native dtype
 
         # exclusive cross-shard carry (log-space) onto the local prefix
         carry = jax.scipy.special.logsumexp(
@@ -201,7 +204,7 @@ class CoxPH(Model):
         # first-end fill (nearest shard > s with any end — the global
         # last row guarantees one exists).  One packed gather again.
         fill, has_end = _fill_from_right_valid(prefix_g, is_end)
-        g2 = jax.lax.all_gather(
+        g2 = gather_axis(
             jnp.stack([fill[0], has_end[0].astype(eta.dtype)]), axis_name
         )  # (P, 2)
         fs, hs = g2[:, 0], g2[:, 1] > 0.5

@@ -109,9 +109,11 @@ class StochasticVolatility(Model):
         must hold the contiguous time block ``local_row_range`` assigns
         it — there is no time index in ``data`` to validate against.
         """
+        from ..parallel.primitives import mapped_axis_size
+
         h = self.latent_h(p)
         m = data["y"].shape[0]  # this shard's (static) time-block length
-        num_shards = jax.lax.psum(1, axis_name)  # static axis size
+        num_shards = mapped_axis_size(axis_name)  # static axis size
         if m * num_shards != self.num_steps:
             # fail as loudly as the unsharded broadcast mismatch would:
             # dynamic_slice CLAMPS out-of-range starts, which would

@@ -29,10 +29,30 @@ implementation:
 Single-device, single-host behavior is bit-identical to the hand-rolled
 code it replaced: `map_shards(fn, mesh=None)` is literally ``jax.jit(fn)``
 and the placement helpers degrade to ``device_put``/``np.asarray``.
+
+**Communication observatory (PR 16).**  Because every collective in the
+repo routes through this one module (tools/lint_collectives.py enforces
+it), instrumenting HERE accounts for all of them with zero call-site
+changes: each primitive dispatch emits a ``comm`` trace event
+(`telemetry.COMM_EVENT_TYPES`) carrying the primitive kind, named axis,
+participant count, predicted payload/wire bytes (`predict_tree_bytes`,
+the `quantize.predict_x_bytes` idiom x collective fan), the host wall
+blocked inside the call, the caller site, and a monotone sequence number
+from `profiling.comm_probe` (so executed-vs-emitted counts are
+testable).  Host-side collectives (`gather_tree`/`shard_put`/
+`broadcast`/the `map_shards` on-mesh dispatch) account once per call;
+in-program collectives (`reduce_tree`/`gather_axis`) once per TRACE of
+the enclosing jit.  All of it is host-side bookkeeping outside the
+compiled program's op/key sequence — draws, metrics, and checkpoints are
+bit-identical with it on, and ``STARK_COMM_TELEMETRY=0`` removes every
+wrapper and restores byte-identical traces.
 """
 
 from __future__ import annotations
 
+import os
+import sys
+import time
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
@@ -46,6 +66,78 @@ PyTree = Any
 #: reduction ops `reduce_tree` accepts -> the lax collective that runs
 #: when a mesh axis is in scope
 _REDUCE_OPS = ("sum", "max", "min")
+
+#: opt-out knob for the communication observatory (default ON — the
+#: accounting is host-side metadata arithmetic; "0" removes every
+#: wrapper and restores byte-identical traces)
+COMM_TELEMETRY_ENV = "STARK_COMM_TELEMETRY"
+
+
+def comm_telemetry_enabled() -> bool:
+    """True unless ``STARK_COMM_TELEMETRY=0`` — checked per primitive
+    call (literal env read so the knob lint ties it to its README row)."""
+    return os.environ.get("STARK_COMM_TELEMETRY", "1") != "0"
+
+
+def predict_tree_bytes(tree: PyTree) -> int:
+    """Predicted payload bytes of ONE participant's copy of ``tree`` —
+    per-leaf ``prod(shape) * itemsize`` (the `quantize.predict_x_bytes`
+    idiom generalized to pytrees).  Pure metadata arithmetic: works on
+    tracers and on donated/deleted arrays, never touches buffer data."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            arr = np.asarray(leaf)
+            shape, dtype = arr.shape, arr.dtype
+        n = 1
+        for s in shape:
+            n *= int(s)
+        total += n * np.dtype(dtype).itemsize
+    return int(total)
+
+
+def _caller_site(depth: int = 2) -> str:
+    """``file.py:function`` of the primitive's caller — the zero-
+    call-site-changes attribution key for the bytes-by-site ranking."""
+    try:
+        f = sys._getframe(depth)
+        return f"{os.path.basename(f.f_code.co_filename)}:{f.f_code.co_name}"
+    except Exception:
+        return "unknown"
+
+
+def _record_comm(
+    primitive: str,
+    *,
+    site: str,
+    axis: Optional[str],
+    participants: int,
+    payload_bytes: int,
+    wire_bytes: int,
+    host_blocked_s: float,
+) -> None:
+    """Bump the process CommProbe and emit one ``comm`` event.  The probe
+    bump and the emission share this single path, so the acceptance
+    invariant (executed count == emitted count) holds by construction
+    whenever a trace is installed."""
+    from .. import profiling, telemetry
+
+    seq = profiling.comm_probe().bump(site, primitive, wire_bytes)
+    tr = telemetry.get_trace()
+    if tr is not None and tr.enabled:
+        tr.emit(
+            "comm",
+            primitive=primitive,
+            site=site,
+            axis=axis,
+            participants=int(participants),
+            payload_bytes=int(payload_bytes),
+            wire_bytes=int(wire_bytes),
+            host_blocked_s=round(float(host_blocked_s), 6),
+            seq=seq,
+        )
 
 
 def axis_size(mesh: Optional[Mesh], axis: str) -> int:
@@ -81,6 +173,14 @@ def map_shards(
 
     ``donate`` forwards to the outer jit's ``donate_argnums`` (buffer
     donation of carried state) on both paths.
+
+    On-mesh dispatches are comm-accounted: the returned callable wraps
+    the jit so each call emits one ``comm`` event (primitive
+    ``map_shards``, payload = the argument pytree's bytes, host-blocked
+    wall = the enqueue time — dispatch is async, so this is the host
+    cost, not device compute).  ``STARK_COMM_TELEMETRY=0`` returns the
+    bare jit; the ``mesh=None`` fast path is NEVER wrapped (its
+    bit/trace-identity contract is literal ``jax.jit``).
     """
     if mesh is None:
         return jax.jit(fn, donate_argnums=tuple(donate))
@@ -116,20 +216,61 @@ def map_shards(
             in_specs = tuple(spec for _ in range(len(params)))
         if out_specs is None:
             out_specs = spec
-    return jax.jit(
+    jitted = jax.jit(
         shard_map(
             fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs,
             check_vma=check_vma,
         ),
         donate_argnums=tuple(donate),
     )
+    if not comm_telemetry_enabled():
+        return jitted
+    site = _caller_site()
+    if axis is not None and axis in mesh.axis_names:
+        participants = int(mesh.shape[axis])
+    else:
+        participants = int(mesh.size)
+
+    def _dispatch(*args):
+        # payload BEFORE the call: donated argument buffers are deleted
+        # by the dispatch (metadata would survive, but don't rely on it)
+        payload = predict_tree_bytes(args)
+        t0 = time.perf_counter()
+        out = jitted(*args)
+        _record_comm(
+            "map_shards", site=site, axis=axis, participants=participants,
+            payload_bytes=payload // max(participants, 1),
+            wire_bytes=payload,
+            host_blocked_s=time.perf_counter() - t0,
+        )
+        return out
+
+    return _dispatch
+
+
+def mapped_axis_size(axis: Optional[str]):
+    """STATIC shard count of a named mesh axis, from INSIDE a mapped
+    function: ``lax.psum`` of a literal 1 constant-folds to the axis
+    size and moves nothing on the wire (the repo-wide "static axis
+    size" idiom, now with one implementation).  1 with no axis.  NOT
+    comm-accounted — there is no communication to account."""
+    if axis is None:
+        return 1
+    from jax import lax
+
+    return lax.psum(1, axis)
 
 
 def reduce_tree(tree: PyTree, axis: Optional[str] = None, op: str = "sum"):
     """The reduce primitive, for use INSIDE a mapped function: combine
     every shard's value over the named mesh axis (``psum``/``pmax``/
     ``pmin``).  ``axis=None`` is the single-shard identity, so shared
-    likelihood/statistics code runs unchanged under both layouts."""
+    likelihood/statistics code runs unchanged under both layouts.
+
+    Comm-accounted at TRACE time (the call runs while the enclosing jit
+    traces, once per compiled instantiation): wire bytes = leaf payload
+    x axis size, host-blocked wall = the tracing cost of the collective.
+    The identity path emits nothing — no axis, no communication."""
     if op not in _REDUCE_OPS:
         raise ValueError(f"unknown reduce op {op!r}; one of {_REDUCE_OPS}")
     if axis is None:
@@ -137,7 +278,56 @@ def reduce_tree(tree: PyTree, axis: Optional[str] = None, op: str = "sum"):
     from jax import lax
 
     fn = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin}[op]
-    return jax.tree.map(lambda x: fn(x, axis), tree)
+    if not comm_telemetry_enabled():
+        return jax.tree.map(lambda x: fn(x, axis), tree)
+    t0 = time.perf_counter()
+    out = jax.tree.map(lambda x: fn(x, axis), tree)
+    payload = predict_tree_bytes(tree)
+    _record_comm(
+        "reduce_tree", site=_caller_site(), axis=axis,
+        participants=_static_axis_count(axis),
+        payload_bytes=payload,
+        wire_bytes=payload * _static_axis_count(axis),
+        host_blocked_s=time.perf_counter() - t0,
+    )
+    return out
+
+
+def gather_axis(x: PyTree, axis: str, *, tiled: bool = False) -> PyTree:
+    """In-program allgather over a named mesh axis (``lax.all_gather``),
+    for use INSIDE a mapped function: every shard receives every shard's
+    value, stacked along a new leading axis (``tiled=True``
+    concatenates along the existing leading axis instead).  The only
+    sanctioned ``lax.all_gather`` in the repo (tools/lint_collectives).
+
+    Comm-accounted at trace time like `reduce_tree`; wire bytes = local
+    payload x axis size (every shard's contribution reaches every
+    shard)."""
+    from jax import lax
+
+    if not comm_telemetry_enabled():
+        return jax.tree.map(lambda v: lax.all_gather(v, axis, tiled=tiled), x)
+    t0 = time.perf_counter()
+    out = jax.tree.map(lambda v: lax.all_gather(v, axis, tiled=tiled), x)
+    payload = predict_tree_bytes(x)
+    _record_comm(
+        "gather_axis", site=_caller_site(), axis=axis,
+        participants=_static_axis_count(axis),
+        payload_bytes=payload,
+        wire_bytes=payload * _static_axis_count(axis),
+        host_blocked_s=time.perf_counter() - t0,
+    )
+    return out
+
+
+def _static_axis_count(axis: str) -> int:
+    """`mapped_axis_size` coerced to a plain int for event fields — 0
+    when the size is somehow not static (abstract axis), so the event
+    still emits instead of raising mid-trace."""
+    try:
+        return int(mapped_axis_size(axis))
+    except Exception:
+        return 0
 
 
 def broadcast(tree: PyTree, mesh: Optional[Mesh] = None) -> PyTree:
@@ -145,8 +335,26 @@ def broadcast(tree: PyTree, mesh: Optional[Mesh] = None) -> PyTree:
     identity).  Multi-host aware: each process holds the identical host
     value and contributes its addressable replicas (the
     ``make_array_from_callback`` placement `backends/sharded.py` used to
-    hand-roll)."""
-    return shard_put(tree, mesh, P(), from_host_replica=True)
+    hand-roll).
+
+    Comm-accounted as ONE ``broadcast`` event (wire bytes = payload x
+    device count — every device receives the full value); the internal
+    placement does not double-count as a ``shard_put``."""
+    if mesh is None:
+        return tree
+    specs = jax.tree.map(lambda _: P(), tree)
+    if not comm_telemetry_enabled():
+        return _shard_put_impl(tree, mesh, specs, from_host_replica=True)
+    payload = predict_tree_bytes(tree)
+    t0 = time.perf_counter()
+    out = _shard_put_impl(tree, mesh, specs, from_host_replica=True)
+    n = int(mesh.size)
+    _record_comm(
+        "broadcast", site=_caller_site(), axis=None, participants=n,
+        payload_bytes=payload, wire_bytes=payload * n,
+        host_blocked_s=time.perf_counter() - t0,
+    )
+    return out
 
 
 def shard_put(
@@ -166,11 +374,47 @@ def shard_put(
     * ``from_host_replica=True`` — every process holds the identical
       full host value (same-seed host computation) and contributes just
       its addressable shards (``make_array_from_callback``).
-    """
+
+    Comm-accounted per call on a mesh (wire bytes = the full payload —
+    each byte is placed once; per-participant payload = payload /
+    devices); the identity path emits nothing."""
     if mesh is None:
         return tree
     if isinstance(specs, P):
         specs = jax.tree.map(lambda _: specs, tree)
+    if not comm_telemetry_enabled():
+        return _shard_put_impl(
+            tree, mesh, specs,
+            process_local=process_local,
+            from_host_replica=from_host_replica,
+        )
+    payload = predict_tree_bytes(tree)
+    t0 = time.perf_counter()
+    out = _shard_put_impl(
+        tree, mesh, specs,
+        process_local=process_local,
+        from_host_replica=from_host_replica,
+    )
+    n = int(mesh.size)
+    _record_comm(
+        "shard_put", site=_caller_site(), axis=None, participants=n,
+        payload_bytes=payload // max(n, 1), wire_bytes=payload,
+        host_blocked_s=time.perf_counter() - t0,
+    )
+    return out
+
+
+def _shard_put_impl(
+    tree: PyTree,
+    mesh: Mesh,
+    specs: Any,
+    *,
+    process_local: bool = False,
+    from_host_replica: bool = False,
+) -> PyTree:
+    """The uninstrumented placement body `shard_put` and `broadcast`
+    share (so a broadcast never double-counts as a shard_put).
+    ``specs`` is already a per-leaf spec pytree here."""
     if process_local:
         return jax.tree.map(
             lambda x, spec: jax.make_array_from_process_local_data(
@@ -195,23 +439,51 @@ def shard_put(
     )
 
 
-def gather_tree(tree: PyTree) -> PyTree:
+def gather_tree(tree: PyTree, *, tiled: bool = True) -> PyTree:
     """Materialize the GLOBAL host view of a (possibly device-sharded)
     pytree as numpy arrays — the view all host-side bookkeeping (gates,
     checkpoints, fault domains) runs on.  Single-process: ``np.asarray``
     already assembles every addressable shard.  Multi-process: each
     leaf is allgathered so every host returns the same full value (the
-    `distributed.gather_draws` contract, generalized)."""
+    `distributed.gather_draws` contract, generalized).
+
+    ``tiled=False`` STACKS per-process values along a new leading axis
+    instead of gluing shards of one global array — the
+    ``process_allgather(tiled=False)`` per-rank-vote shape
+    (`supervise`'s resume agreement); single-process it returns
+    ``x[None]`` so rank-indexed consumers see the same (1, ...) layout.
+
+    Comm-accounted per call: payload = the tree's host-view bytes, wire
+    = payload x process count (every host receives the full value;
+    single-process this is the device->host readback, and the
+    host-blocked wall is the readback wall every block pays)."""
+    if not comm_telemetry_enabled():
+        return _gather_tree_impl(tree, tiled=tiled)
+    t0 = time.perf_counter()
+    out = _gather_tree_impl(tree, tiled=tiled)
+    payload = predict_tree_bytes(out)
+    n = int(jax.process_count())
+    _record_comm(
+        "gather_tree", site=_caller_site(), axis=None, participants=n,
+        payload_bytes=payload, wire_bytes=payload * n,
+        host_blocked_s=time.perf_counter() - t0,
+    )
+    return out
+
+
+def _gather_tree_impl(tree: PyTree, *, tiled: bool) -> PyTree:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
         return jax.tree.map(
             lambda x: np.asarray(
-                multihost_utils.process_allgather(x, tiled=True)
+                multihost_utils.process_allgather(x, tiled=tiled)
             ),
             tree,
         )
-    return jax.tree.map(np.asarray, tree)
+    if tiled:
+        return jax.tree.map(np.asarray, tree)
+    return jax.tree.map(lambda x: np.asarray(x)[None], tree)
 
 
 def run_over_chains(mesh: Mesh, vrun, *args):

@@ -76,6 +76,10 @@ PROFILE_SPANS_ENV = "STARK_PROFILE_SPANS"
 #: block (the PR 3 pipeline's win); ``device_idle`` is host work the
 #: device starved behind; ``host`` is un-overlapped host phases
 #: (the ``collect`` post-processing pass)
+#: ``comm`` is host wall blocked inside a parallel-primitives collective
+#: (PR 16's communication observatory) — carved OUT of the enclosing
+#: block span by the emission-order claiming below (comm events emit
+#: before their enclosing phase event closes)
 SPAN_KINDS = (
     "compile",
     "warmup",
@@ -83,6 +87,7 @@ SPAN_KINDS = (
     "host_hidden",
     "device_idle",
     "checkpoint",
+    "comm",
     "host",
 )
 
@@ -113,8 +118,24 @@ def _spans_from_phase_event(e: Dict[str, Any]) -> List[Dict[str, Any]]:
     ``dispatch`` span (coarser, never wrong-by-construction).
     """
     ev = e.get("event")
-    dur = e.get("dur_s")
     end = e.get("wall_s")
+    if ev == "comm":
+        # comm events carry host_blocked_s, NOT dur_s (they overlap the
+        # enclosing phase event and must not join the PHASE_EVENTS
+        # tiling); the span is the host wall blocked inside the call
+        hb = e.get("host_blocked_s")
+        if (
+            not isinstance(hb, (int, float))
+            or not isinstance(end, (int, float))
+            or float(hb) <= 0.0
+        ):
+            return []
+        base = {"src": "comm"}
+        if e.get("primitive") is not None:
+            base["stage"] = e["primitive"]
+        return [{"kind": "comm", "start": float(end) - float(hb),
+                 "end": float(end), **base}]
+    dur = e.get("dur_s")
     if not isinstance(dur, (int, float)) or not isinstance(end, (int, float)):
         return []
     dur = max(float(dur), 0.0)
@@ -606,3 +627,84 @@ def probe_counts(drain: bool = True) -> Dict[str, int]:
     for name, p in probes.items():
         out[name] = p.snapshot() if drain else p.calls
     return out
+
+
+# ---------------------------------------------------------------------------
+# collective-dispatch probe (the communication observatory, PR 16)
+# ---------------------------------------------------------------------------
+
+
+class CommProbe:
+    """Collective-dispatch counter for the parallel-primitives layer —
+    the `DispatchProbe` pattern WITHOUT the device callback: primitives
+    dispatch from host Python (or emit at jit-trace time), so a plain
+    locked counter is exact and `snapshot` needs no effects barrier (and
+    no jax import — the probe is readable from no-jax tooling).
+
+    ``bump(site, primitive, wire_bytes)`` returns the new monotone
+    per-(site, primitive) sequence number that rides each ``comm`` trace
+    event, so executed-vs-emitted collective counts are testable: both
+    sides of the acceptance check read the same counter."""
+
+    label = "comm"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[Tuple[str, str], int] = {}
+        self._bytes: Dict[Tuple[str, str], int] = {}
+
+    def bump(self, site: str, primitive: str, wire_bytes: int = 0) -> int:
+        """Count one executed collective; returns its per-(site,
+        primitive) sequence number (1-based, monotone)."""
+        key = (str(site), str(primitive))
+        with self._lock:
+            seq = self._counts.get(key, 0) + 1
+            self._counts[key] = seq
+            self._bytes[key] = self._bytes.get(key, 0) + int(wire_bytes)
+            return seq
+
+    def counts(self) -> Dict[Tuple[str, str], int]:
+        """(site, primitive) -> executed-dispatch count."""
+        with self._lock:
+            return dict(self._counts)
+
+    def bytes_by_site(self) -> Dict[Tuple[str, str], int]:
+        """(site, primitive) -> cumulative predicted wire bytes."""
+        with self._lock:
+            return dict(self._bytes)
+
+    def total_calls(self) -> int:
+        with self._lock:
+            return sum(self._counts.values())
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(self._bytes.values())
+
+    @property
+    def calls(self) -> int:
+        # DispatchProbe-registry protocol (probe_counts drain=False)
+        return self.total_calls()
+
+    def snapshot(self) -> int:
+        # registry protocol: host-side counter, no effects barrier needed
+        return self.total_calls()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._bytes.clear()
+
+
+_COMM_PROBE: Optional[CommProbe] = None
+
+
+def comm_probe() -> CommProbe:
+    """The process CommProbe singleton, registered under ``"comm"`` in
+    the probe registry on first use (readable via `probe_counts`)."""
+    global _COMM_PROBE
+    with _PROBES_LOCK:
+        if _COMM_PROBE is None:
+            _COMM_PROBE = CommProbe()
+            _PROBES["comm"] = _COMM_PROBE
+    return _COMM_PROBE

@@ -1572,12 +1572,12 @@ def _sample_until_converged(
                 and time.perf_counter() - t_start > time_budget_s
             )
             if time_budget_s is not None and jax.process_count() > 1:
-                from jax.experimental import multihost_utils
+                from .parallel.primitives import gather_tree
 
                 over_budget = bool(
                     np.any(
-                        multihost_utils.process_allgather(
-                            np.array([over_budget], np.bool_)
+                        gather_tree(
+                            np.array([over_budget], np.bool_), tiled=False
                         )
                     )
                 )

@@ -41,6 +41,15 @@ Endpoints:
     measured value / threshold / remediation hint; absent until a
     warning fires, cleared on a fresh ``run_start``).  Additive within
     the existing ``health`` key, so the schema version is unchanged.
+    Since PR 16 the snapshot also carries ``comms`` — the mesh
+    communication observatory's live rollup (``parallel.primitives``
+    ``comm`` events): cumulative accounted collective ``calls`` /
+    predicted ``wire_bytes`` / ``host_blocked_s``, the latest
+    primitive, and — on STARK_FLEET_MESH runs — the latest block's
+    straggler attribution (``straggler_shard``, ``straggler_ratio``,
+    ``shards_timed``).  Empty ``{}`` under STARK_COMM_TELEMETRY=0 or
+    on runs that never dispatch an accounted collective; additive, so
+    the schema version is again unchanged.
 
 Probe contract: ``python -m stark_tpu status --json`` prints ONE
 machine-parseable line ``{"endpoint", "code", "body"}`` for any of the

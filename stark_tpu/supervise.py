@@ -263,7 +263,6 @@ def agree_resume(
 
     if jax.process_count() == 1:
         return resume
-    from jax.experimental import multihost_utils
 
     trace = telemetry.resolve_trace(trace)
     # (phase, progress): warmup checkpoints count warm_done segments,
@@ -281,7 +280,9 @@ def agree_resume(
             )
         except Exception:  # noqa: BLE001 — unreadable: treat as cold
             done = (-1, -1)
-    all_done = multihost_utils.process_allgather(np.array(done))
+    from .parallel.primitives import gather_tree
+
+    all_done = gather_tree(np.array(done), tiled=False)
     if _ranks_agree(all_done):
         return resume
     if resume is not None:

@@ -140,10 +140,29 @@ PROFILING_EVENT_TYPES = frozenset({"span"})
 #: family entirely (byte-identical traces).
 HEALTH_EVENT_TYPES = frozenset({"health_warning"})
 
+#: communication-observatory event types (stark_tpu.parallel.primitives):
+#: ``comm`` — one collective dispatch through the MapReduce primitives
+#: layer, with ``primitive`` (map_shards / reduce_tree / gather_axis /
+#: broadcast / shard_put / gather_tree), the named mesh ``axis`` (when
+#: one is in scope), ``participants`` (collective fan-in/fan-out),
+#: ``payload_bytes`` (one participant's pytree-leaf bytes, the
+#: `quantize.predict_x_bytes` idiom), ``wire_bytes`` (payload x fan),
+#: ``host_blocked_s`` (host wall inside the call — NOT ``dur_s``: comm
+#: walls overlap the enclosing phase events, so they must not join the
+#: PHASE_EVENTS tiling), ``site`` (caller file:function) and ``seq``
+#: (monotone per-(site, primitive) count from `profiling.comm_probe`).
+#: Host-side collectives (gather_tree/shard_put/broadcast/map_shards
+#: dispatch) emit once per call; in-program collectives (reduce_tree /
+#: gather_axis) emit once per TRACE of the enclosing jit — both outside
+#: the compiled program's op/key sequence.  STARK_COMM_TELEMETRY=0
+#: suppresses the family entirely (byte-identical traces).
+COMM_EVENT_TYPES = frozenset({"comm"})
+
 #: the complete WRITER registry: every emit()/phase() call in stark_tpu/
 #: must use one of these names (tools/lint_trace_schema.py enforces it)
 ALL_EVENT_TYPES = (EVENT_TYPES | AUX_EVENT_TYPES | FLEET_EVENT_TYPES
-                   | PROFILING_EVENT_TYPES | HEALTH_EVENT_TYPES)
+                   | PROFILING_EVENT_TYPES | HEALTH_EVENT_TYPES
+                   | COMM_EVENT_TYPES)
 
 #: envelope keys every event must carry (validate_event)
 ENVELOPE_KEYS = ("schema", "event", "ts", "wall_s", "run")
@@ -1008,6 +1027,20 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                        "sched_iters_total"} | {},  # ragged-NUTS lane
                                                  # occupancy (STARK_RAGGED_
                                                  # NUTS), when emitted
+         "comms": {"calls", "payload_bytes", "wire_bytes",
+                   "host_blocked_s", "by_primitive",
+                   "straggler_ratio_last", "straggler_shard_last",
+                   "shards"} | {},               # communication
+                                                 # observatory (``comm``
+                                                 # events + fleet_block
+                                                 # shard walls) — absent
+                                                 # on pre-PR-16 /
+                                                 # STARK_COMM_TELEMETRY=0
+                                                 # traces
+         "other": {event: count},               # events outside
+                                                 # ALL_EVENT_TYPES —
+                                                 # future families degrade
+                                                 # visibly, never silently
          "restarts": int, "events": int}
 
     ``overlap`` aggregates the runner's pipelined ``sample_block``
@@ -1037,7 +1070,8 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     if not runs:
         return {"run": 0, "meta": {}, "wall_s": None, "phases": {},
                 "health": {}, "overlap": {}, "diag": {}, "fleet": {},
-                "nutssched": {}, "restarts": 0, "events": 0}
+                "nutssched": {}, "comms": {}, "other": {},
+                "restarts": 0, "events": 0}
     run = runs[-1] if run is None else run
     evs = [e for e in events if e.get("run", 0) == run]
     # restart chain: the selected run's own restarts (it may itself be a
@@ -1099,6 +1133,8 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
     diag: Dict[str, Any] = {}
     fleet: Dict[str, Any] = {}
     nutssched: Dict[str, Any] = {}
+    comms: Dict[str, Any] = {}
+    other: Dict[str, int] = {}
     occ_sum = 0.0
     saw_overlap = False
     wall = None
@@ -1144,6 +1180,15 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                 )
             if e.get("queue_depth") is not None:
                 fleet["queue_depth_last"] = int(e["queue_depth"])
+            # shard-imbalance trail (PR 16): per-shard host walls ride
+            # mesh + STARK_COMM_TELEMETRY runs only — absent (not 0) on
+            # everything else, the null-not-0.0 rule
+            if e.get("straggler_ratio") is not None:
+                comms["straggler_ratio_last"] = float(e["straggler_ratio"])
+            if e.get("straggler_shard") is not None:
+                comms["straggler_shard_last"] = int(e["straggler_shard"])
+            if e.get("shard_walls") is not None:
+                comms["shards"] = len(e["shard_walls"])
         elif ev == "problem_converged":
             key = (
                 "problems_converged"
@@ -1233,6 +1278,31 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
             # pre-PR-15 / STARK_HEALTH=0 traces, the null-not-0.0 rule
             name = str(e.get("warning", "unknown"))
             warn_counts[name] = warn_counts.get(name, 0) + 1
+        elif ev == "comm":
+            # communication observatory (parallel.primitives): roll the
+            # per-collective accounting up by primitive kind
+            comms["calls"] = comms.get("calls", 0) + 1
+            comms["payload_bytes"] = (
+                comms.get("payload_bytes", 0) + int(e.get("payload_bytes", 0))
+            )
+            comms["wire_bytes"] = (
+                comms.get("wire_bytes", 0) + int(e.get("wire_bytes", 0))
+            )
+            comms["host_blocked_s"] = round(
+                comms.get("host_blocked_s", 0.0)
+                + float(e.get("host_blocked_s", 0.0)),
+                6,
+            )
+            prim = str(e.get("primitive", "unknown"))
+            by = comms.setdefault("by_primitive", {}).setdefault(
+                prim, {"calls": 0, "wire_bytes": 0}
+            )
+            by["calls"] += 1
+            by["wire_bytes"] += int(e.get("wire_bytes", 0))
+        if ev not in ALL_EVENT_TYPES:
+            # forward-compat: an event family this build predates still
+            # shows up in the rollup instead of silently vanishing
+            other[ev] = other.get(ev, 0) + 1
     if accepts:
         health["mean_accept"] = sum(accepts) / len(accepts)
     if div_total is not None:
@@ -1277,6 +1347,8 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
         "diag": diag,
         "fleet": fleet,
         "nutssched": nutssched,
+        "comms": comms,
+        "other": other,
         "restarts": restarts_total,
         "events": len(evs),
     }

@@ -1,0 +1,491 @@
+"""PR 16 — the mesh communication observatory.
+
+The parallel primitives layer (`stark_tpu.parallel.primitives`) accounts
+every collective it dispatches: one ``comm`` trace event per host-side
+call (and per TRACE for in-program collectives), carrying predicted
+payload/wire bytes, participants, the caller site, and a monotone
+`profiling.comm_probe` sequence.  The contracts pinned here:
+
+* executed count == emitted count (probe and event share one path);
+* predicted bytes equal the leaf-size arithmetic exactly;
+* ``STARK_COMM_TELEMETRY=0`` removes the accounting — bit-identical
+  results, zero comm events;
+* mesh fleet blocks carry the host-measured per-shard walls and
+  straggler attribution, `health.ShardBalanceTrail` turns a persistent
+  imbalance into a ``mesh_imbalance`` warning
+  (``STARK_HEALTH_IMBALANCE``), and the metrics collector exposes the
+  ``stark_comm_*`` family;
+* the report tools render ``n/a`` — never an error — on pre-PR-16
+  traces (committed fixture), and `summarize_trace` counts unknown
+  event types under ``other`` instead of silently dropping them.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from stark_tpu import profiling, telemetry
+from stark_tpu.parallel.mesh import make_mesh
+from stark_tpu.parallel.primitives import (
+    COMM_TELEMETRY_ENV,
+    broadcast,
+    comm_telemetry_enabled,
+    gather_axis,
+    gather_tree,
+    map_shards,
+    mapped_axis_size,
+    predict_tree_bytes,
+    reduce_tree,
+    shard_put,
+)
+from stark_tpu.telemetry import RunTrace, read_trace, summarize_trace, use_trace
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+
+def _mesh(n, axis="problems"):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices (conftest forces 8)")
+    return make_mesh({axis: n}, devices=jax.devices()[:n])
+
+
+def _comm(events):
+    return [e for e in events if e.get("event") == "comm"]
+
+
+# -- the accounting itself ----------------------------------------------------
+
+
+def test_event_type_registered():
+    assert "comm" in telemetry.COMM_EVENT_TYPES
+    assert "comm" in telemetry.ALL_EVENT_TYPES
+
+
+def test_predict_tree_bytes_leaf_arithmetic():
+    tree = {
+        "a": jnp.zeros((3, 4), jnp.float32),   # 48
+        "b": np.zeros((5,), np.float64),        # 40
+        "c": 1.0,                               # python scalar -> f64: 8
+    }
+    assert predict_tree_bytes(tree) == 48 + 40 + 8
+
+
+def test_probe_matches_events_and_exact_bytes(tmp_path):
+    """The acceptance invariant: every accounted dispatch is matched by
+    exactly one comm event (probe executed count == emitted count), and
+    the predicted bytes ARE the leaf-size arithmetic."""
+    mesh = _mesh(2)
+    probe = profiling.comm_probe()
+    calls_before = probe.total_calls()
+    trace_path = str(tmp_path / "t.jsonl")
+    with RunTrace(trace_path) as tr, use_trace(tr):
+        x = jnp.arange(8, dtype=jnp.float32)            # 32 bytes
+        xs = shard_put(x, mesh, P("problems"))
+
+        def f(v):
+            s = reduce_tree(jnp.sum(v), "problems")     # scalar f32: 4
+            g = gather_axis(jnp.sum(v), "problems")     # scalar f32: 4
+            return v + s + jnp.sum(g)
+
+        fm = map_shards(f, mesh=mesh, axis="problems")
+        y = fm(xs)
+        host = gather_tree(y)                           # 32 bytes out
+        b = broadcast(np.float32(1.0), mesh)            # 4 bytes
+        jax.block_until_ready(b)
+    events = read_trace(trace_path)
+    comm = _comm(events)
+    assert probe.total_calls() - calls_before == len(comm), (
+        "executed collective count != emitted comm event count"
+    )
+    by = {}
+    for e in comm:
+        by.setdefault(e["primitive"], []).append(e)
+    # shard_put: wire = full payload (each byte placed once), payload =
+    # per-participant share over mesh.size devices
+    (sp,) = by["shard_put"]
+    assert sp["participants"] == 2
+    assert sp["payload_bytes"] == 16 and sp["wire_bytes"] == 32
+    # reduce_tree at trace time: scalar f32 x 2 shards on the wire
+    (rt,) = by["reduce_tree"]
+    assert rt["axis"] == "problems" and rt["participants"] == 2
+    assert rt["payload_bytes"] == 4 and rt["wire_bytes"] == 8
+    # gather_axis: same fan as reduce_tree
+    (ga,) = by["gather_axis"]
+    assert ga["payload_bytes"] == 4 and ga["wire_bytes"] == 8
+    # map_shards dispatch: payload = the argument pytree (32 bytes)
+    (ms,) = by["map_shards"]
+    assert ms["wire_bytes"] == 32 and ms["payload_bytes"] == 16
+    # gather_tree: single process -> participants 1, wire = payload
+    (gt,) = by["gather_tree"]
+    assert gt["participants"] == 1
+    assert gt["payload_bytes"] == 32 and gt["wire_bytes"] == 32
+    # broadcast: every device receives the full 4-byte value
+    (bc,) = by["broadcast"]
+    assert bc["participants"] == 2
+    assert bc["payload_bytes"] == 4 and bc["wire_bytes"] == 8
+    # every event names its caller site and is host-blocked-accounted
+    for e in comm:
+        assert e["site"].endswith((".py:" + e["site"].split(":")[-1]))
+        assert e["host_blocked_s"] >= 0.0
+        assert "dur_s" not in e, "comm events must not enter phase tiling"
+    np.testing.assert_array_equal(host, np.asarray(y))
+
+
+def test_seq_monotone_per_site_primitive(tmp_path):
+    """The CommProbe sequence is 1-based and strictly increasing per
+    (site, primitive) — repeated dispatches are distinguishable."""
+    mesh = _mesh(2)
+    trace_path = str(tmp_path / "t.jsonl")
+    with RunTrace(trace_path) as tr, use_trace(tr):
+        for _ in range(3):
+            jax.block_until_ready(
+                shard_put(jnp.arange(4.0), mesh, P("problems"))
+            )
+    comm = _comm(read_trace(trace_path))
+    assert len(comm) == 3
+    seqs = [e["seq"] for e in comm]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 3
+    assert all(s >= 1 for s in seqs)
+
+
+def test_comm_telemetry_off_bit_identity(tmp_path, monkeypatch):
+    """STARK_COMM_TELEMETRY=0: the same computation produces bit-identical
+    results and a trace with zero comm events — the accounting only
+    observes."""
+    mesh = _mesh(2)
+
+    def compute():
+        xs = shard_put(jnp.arange(8.0), mesh, P("problems"))
+        fm = map_shards(
+            lambda v: v + reduce_tree(jnp.sum(v), "problems"),
+            mesh=mesh, axis="problems",
+        )
+        return gather_tree(fm(xs))
+
+    trace_on = str(tmp_path / "on.jsonl")
+    with RunTrace(trace_on) as tr, use_trace(tr):
+        y_on = compute()
+    monkeypatch.setenv(COMM_TELEMETRY_ENV, "0")
+    assert not comm_telemetry_enabled()
+    trace_off = str(tmp_path / "off.jsonl")
+    with RunTrace(trace_off) as tr, use_trace(tr):
+        y_off = compute()
+    np.testing.assert_array_equal(y_on, y_off)
+    assert _comm(read_trace(trace_on))
+    assert not _comm(read_trace(trace_off)), (
+        "STARK_COMM_TELEMETRY=0 leaked comm events"
+    )
+
+
+def test_mapped_axis_size_not_accounted(tmp_path):
+    """`mapped_axis_size` is the static-size idiom, not a collective —
+    no comm event, no phantom wire bytes."""
+    mesh = _mesh(2)
+    trace_path = str(tmp_path / "t.jsonl")
+    with RunTrace(trace_path) as tr, use_trace(tr):
+        fm = map_shards(
+            lambda v: v * mapped_axis_size("problems"),
+            mesh=mesh, axis="problems",
+        )
+        if comm_telemetry_enabled():
+            # only the dispatch itself accounts; drop it from the check
+            out = fm(shard_put(jnp.arange(4.0), mesh, P("problems")))
+            jax.block_until_ready(out)
+    comm = _comm(read_trace(trace_path))
+    assert all(e["primitive"] != "mapped_axis_size" for e in comm)
+    assert not [e for e in comm if e["primitive"] == "reduce_tree"]
+
+
+# -- summarize_trace ----------------------------------------------------------
+
+
+def test_summarize_comms_rollup():
+    events = [
+        {"event": "run_start", "run": 1, "ts": 0.0, "wall_s": 0.0},
+        {"event": "comm", "run": 1, "primitive": "reduce_tree",
+         "payload_bytes": 4, "wire_bytes": 8, "host_blocked_s": 0.001},
+        {"event": "comm", "run": 1, "primitive": "gather_tree",
+         "payload_bytes": 32, "wire_bytes": 32, "host_blocked_s": 0.002},
+        {"event": "fleet_block", "run": 1, "block": 0,
+         "shard_walls": [0.1, 0.3], "straggler_shard": 1,
+         "straggler_ratio": 1.5},
+        {"event": "run_end", "run": 1, "ts": 1.0, "wall_s": 1.0},
+    ]
+    s = summarize_trace(events, run=1)
+    cm = s["comms"]
+    assert cm["calls"] == 2
+    assert cm["payload_bytes"] == 36 and cm["wire_bytes"] == 40
+    assert cm["by_primitive"]["reduce_tree"]["calls"] == 1
+    assert cm["by_primitive"]["gather_tree"]["wire_bytes"] == 32
+    assert cm["straggler_shard_last"] == 1
+    assert cm["straggler_ratio_last"] == 1.5
+    assert cm["shards"] == 2
+
+
+def test_summarize_unknown_event_counted_under_other():
+    """REGRESSION: an event type the summarizer does not know is counted
+    under ``other``, never silently dropped."""
+    events = [
+        {"event": "run_start", "run": 1, "ts": 0.0, "wall_s": 0.0},
+        {"event": "wombat_migration", "run": 1, "herd": 7},
+        {"event": "wombat_migration", "run": 1, "herd": 8},
+        {"event": "run_end", "run": 1, "ts": 1.0, "wall_s": 1.0},
+    ]
+    s = summarize_trace(events, run=1)
+    assert s["other"] == {"wombat_migration": 2}
+    # known event types never land in `other`
+    assert "run_start" not in s["other"]
+    # and an all-known trace reports an empty dict, not a missing key
+    s2 = summarize_trace(events[:1] + events[-1:], run=1)
+    assert s2["other"] == {}
+
+
+# -- the fleet's shard-imbalance trail ---------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh_fleet_trace(tmp_path_factory):
+    """One small traced mesh fleet run shared by the fleet-side tests."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices (conftest forces 8)")
+    from stark_tpu.fleet import FleetSpec, sample_fleet
+    from stark_tpu.models.eight_schools import SIGMA, Y, EightSchools
+
+    rng = np.random.default_rng(0)
+    y, sig = np.asarray(Y), np.asarray(SIGMA)
+    datasets = [
+        {"y": (y + rng.normal(0, 2.0, y.shape)).astype(np.float32),
+         "sigma": sig}
+        for _ in range(2)
+    ]
+    spec = FleetSpec.from_problems(EightSchools(), datasets)
+    mesh = make_mesh({"problems": 2}, devices=jax.devices()[:2])
+    trace_path = str(tmp_path_factory.mktemp("comms") / "fleet.jsonl")
+    calls_before = profiling.comm_probe().total_calls()
+    with RunTrace(trace_path) as tr, use_trace(tr):
+        res = sample_fleet(
+            spec, mesh=mesh, seed=0, chains=2, block_size=25,
+            max_blocks=6, min_blocks=2, num_warmup=100, ess_target=40.0,
+            rhat_target=1.3, kernel="hmc", num_leapfrog=12,
+        )
+    calls = profiling.comm_probe().total_calls() - calls_before
+    return res, read_trace(trace_path), calls
+
+
+def test_mesh_fleet_every_dispatch_accounted(mesh_fleet_trace):
+    """Acceptance: on a mesh fleet run, CommProbe executed count ==
+    emitted comm event count, and the summary's byte totals equal the
+    per-event sums exactly (well within the 2% criterion)."""
+    _res, events, executed = mesh_fleet_trace
+    comm = _comm(events)
+    assert comm, "mesh fleet run emitted no comm events"
+    assert executed == len(comm)
+    prims = {e["primitive"] for e in comm}
+    assert "map_shards" in prims and "gather_tree" in prims
+    s = summarize_trace(events, run=events[-1].get("run", 1))
+    assert s["comms"]["calls"] == len(comm)
+    assert s["comms"]["wire_bytes"] == sum(e["wire_bytes"] for e in comm)
+    assert s["comms"]["payload_bytes"] == sum(
+        e["payload_bytes"] for e in comm
+    )
+
+
+def test_mesh_fleet_block_shard_walls(mesh_fleet_trace):
+    """Mesh fleet blocks carry the host-measured per-shard walls and the
+    straggler attribution derived from them."""
+    _res, events, _calls = mesh_fleet_trace
+    blocks = [
+        e for e in events
+        if e.get("event") == "fleet_block" and e.get("shards") is not None
+    ]
+    assert blocks, "no mesh fleet_block events in the trace"
+    timed = [b for b in blocks if b.get("shard_walls")]
+    assert timed, "no fleet_block carries shard_walls"
+    for b in timed:
+        walls = b["shard_walls"]
+        assert len(walls) == 2
+        assert all(w >= 0.0 for w in walls)
+        assert b["straggler_shard"] == int(np.argmax(walls))
+        if b.get("straggler_ratio") is not None:
+            assert b["straggler_ratio"] >= 1.0
+    s = summarize_trace(events, run=events[-1].get("run", 1))
+    assert s["comms"]["shards"] == 2
+    assert s["comms"]["straggler_shard_last"] in (0, 1)
+
+
+def test_shard_balance_trail_warns(monkeypatch):
+    """A persistent straggler past STARK_HEALTH_IMBALANCE x median emits
+    one mesh_imbalance health warning naming the shard; a balanced mesh
+    emits nothing; the env knob moves the threshold."""
+    from stark_tpu import health
+
+    emitted = []
+
+    class _Tr:
+        enabled = True
+
+        def emit(self, event, **fields):
+            emitted.append({"event": event, **fields})
+            return {"event": event, **fields}
+
+    trail = health.ShardBalanceTrail(trace=_Tr(), window=3, threshold=2.0)
+    for b in range(3):
+        trail.observe([0.1, 0.1, 0.5, 0.1], block=b)
+    assert len(emitted) == 1
+    w = emitted[0]
+    assert w["event"] == "health_warning"
+    assert w["warning"] == "mesh_imbalance" and w["shard"] == 2
+    assert w["value"] == 5.0 and w["knob"] == "STARK_HEALTH_IMBALANCE"
+    assert "mesh_imbalance" in trail.active
+    # balanced walls: the next window stays silent
+    for b in range(3, 6):
+        trail.observe([0.1, 0.1, 0.1, 0.1], block=b)
+    assert len(emitted) == 1
+    # the knob moves the default threshold
+    monkeypatch.setenv("STARK_HEALTH_IMBALANCE", "10.0")
+    assert health.thresholds()["imbalance"] == 10.0
+    loose = health.ShardBalanceTrail(trace=_Tr(), window=2)
+    assert loose.threshold == 10.0
+    for b in range(2):
+        loose.observe([0.1, 0.5], block=b)
+    assert len(emitted) == 1, "ratio 5 must not trip a threshold of 10"
+    # mesh_imbalance is a registered taxonomy entry
+    assert health.WARNINGS["mesh_imbalance"]["knob"] == (
+        "STARK_HEALTH_IMBALANCE"
+    )
+
+
+# -- metrics + timeline surfaces ---------------------------------------------
+
+
+def test_metrics_comm_counters_and_straggler_gauge():
+    from stark_tpu import metrics as m
+
+    col = m.TraceCollector(registry=m.MetricsRegistry())
+    col.on_event({"event": "run_start", "run": 1})
+    col.on_event({"event": "comm", "primitive": "reduce_tree",
+                  "payload_bytes": 4, "wire_bytes": 8,
+                  "host_blocked_s": 0.001})
+    col.on_event({"event": "comm", "primitive": "gather_tree",
+                  "payload_bytes": 32, "wire_bytes": 32,
+                  "host_blocked_s": 0.002})
+    col.on_event({"event": "fleet_block", "block": 1,
+                  "shard_walls": [0.1, 0.3], "straggler_shard": 1,
+                  "straggler_ratio": 1.5})
+    text = col.registry.render()
+    p = m.METRIC_PREFIX
+    assert f'{p}_comm_calls_total{{primitive="reduce_tree"}} 1' in text
+    assert f'{p}_comm_bytes_total{{primitive="gather_tree"}} 32' in text
+    assert f"{p}_comm_host_blocked_s 0.003" in text
+    assert f'{p}_comm_straggler_ratio{{shard="1"}} 1.5' in text
+    snap = col.status()
+    assert snap["comms"]["calls"] == 2
+    assert snap["comms"]["wire_bytes"] == 40
+    assert snap["comms"]["straggler_shard"] == 1
+    # a fresh run clears the per-shard labels and the /status rollup
+    col.on_event({"event": "run_start", "run": 2})
+    text2 = col.registry.render()
+    assert f"{p}_comm_straggler_ratio{{" not in text2
+    assert col.status()["comms"] == {}
+    # counters stay monotone
+    assert f'{p}_comm_calls_total{{primitive="reduce_tree"}} 1' in text2
+
+
+def test_timeline_comm_span():
+    """comm events become comm spans [wall_s - host_blocked_s, wall_s]
+    in the PR 11 timeline, tagged with the primitive."""
+    from stark_tpu.profiling import SPAN_KINDS, spans_from_events
+
+    assert "comm" in SPAN_KINDS
+    events = [
+        {"event": "run_start", "run": 1, "ts": 0.0, "wall_s": 0.0},
+        {"event": "comm", "run": 1, "primitive": "gather_tree",
+         "wall_s": 1.0, "host_blocked_s": 0.25, "wire_bytes": 64},
+        {"event": "run_end", "run": 1, "ts": 2.0, "wall_s": 2.0},
+    ]
+    tl = spans_from_events(events, run=1)
+    comm = [sp for sp in tl["spans"] if sp["kind"] == "comm"]
+    assert len(comm) == 1
+    assert comm[0]["start"] == pytest.approx(0.75)
+    assert comm[0]["end"] == pytest.approx(1.0)
+    assert comm[0]["stage"] == "gather_tree"
+
+
+# -- report tools -------------------------------------------------------------
+
+
+def test_comms_report_renders(mesh_fleet_trace, tmp_path):
+    import comms_report
+
+    _res, events, _calls = mesh_fleet_trace
+    run = events[-1].get("run", 1)
+    out = comms_report.render_run(events, run)
+    assert "accounted calls" in out
+    assert "map_shards" in out and "gather_tree" in out
+    assert "call site" in out
+    # per-shard imbalance table from the fleet_block walls
+    assert "ratio to median" in out
+    r = comms_report.comms_rollup(events, run)
+    assert r["by_primitive"] and r["by_site"]
+    assert r["shards"] is not None
+    assert len(r["shards"]["mean_wall_s"]) == 2
+
+
+def test_trace_report_renders_comms_section(mesh_fleet_trace):
+    import trace_report
+
+    _res, events, _calls = mesh_fleet_trace
+    out = trace_report.render_run(events, events[-1].get("run", 1))
+    assert "accounted calls" in out
+    assert "by primitive" in out
+
+
+def test_reports_na_safe_on_pre_pr16_fixture():
+    """REGRESSION PIN: the committed pre-PR-16 mesh fleet trace (no comm
+    events, no shard_walls) renders through all three report tools
+    without error — old traces are n/a-filtered, never crashed on."""
+    import comms_report
+    import timeline_report
+    import trace_report
+
+    fixture = os.path.join(_REPO, "tests", "fixtures",
+                           "fleet_trace_pr15.jsonl")
+    events = read_trace(fixture)
+    assert events, "committed fixture trace is unreadable"
+    assert not _comm(events), "fixture must predate the comm events"
+    run = events[-1].get("run", 1)
+    s = summarize_trace(events, run=run)
+    assert s["comms"] == {} and s["other"] == {}
+    out = trace_report.render_run(events, run)
+    assert "accounted calls" not in out  # comms table n/a-filtered away
+    assert comms_report.main([fixture]) == 0
+    assert trace_report.main([fixture]) == 0
+    assert timeline_report.main([fixture]) == 0
+    r = comms_report.comms_rollup(events, run)
+    assert r["by_primitive"] == {} and r["shards"] is None
+    rendered = comms_report.render_run(events, run)
+    assert "no comm events" in rendered
+
+
+def test_comms_report_cli_json(mesh_fleet_trace, tmp_path):
+    _res, events, _calls = mesh_fleet_trace
+    trace_path = tmp_path / "t.jsonl"
+    with open(trace_path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "comms_report.py"),
+         str(trace_path), "--json"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    r = json.loads(out.stdout)
+    assert r["by_primitive"] and r["comms"]["calls"] > 0

@@ -305,18 +305,26 @@ from stark_tpu.backends.sharded import ShardedBackend
 from stark_tpu.models import Logistic, synth_logistic_data
 from stark_tpu.parallel.mesh import make_mesh
 
+from stark_tpu.telemetry import RunTrace, read_trace, use_trace
+
 data, _ = synth_logistic_data(jax.random.PRNGKey(0), 256, 2)
 lo, hi = dist.local_row_range(256)
 local = {k: np.asarray(v)[lo:hi] for k, v in data.items()}
-post = stark_tpu.sample(
-    Logistic(num_features=2), local,
-    backend=ShardedBackend(make_mesh({"data": 2, "chains": 1})),
-    chains=2, kernel="nuts", max_tree_depth=4, num_warmup=30,
-    num_samples=30, seed=0,
-)
+trace_path = sys.argv[3] + "/smoke_trace_%%d.jsonl" %% int(sys.argv[1])
+with RunTrace(trace_path) as tr, use_trace(tr):
+    post = stark_tpu.sample(
+        Logistic(num_features=2), local,
+        backend=ShardedBackend(make_mesh({"data": 2, "chains": 1})),
+        chains=2, kernel="nuts", max_tree_depth=4, num_warmup=30,
+        num_samples=30, seed=0,
+    )
+comm = [e for e in read_trace(trace_path) if e.get("event") == "comm"]
 print("RESULT " + json.dumps({
     "proc": dist.process_index(),
     "checksum": float(np.asarray(post.draws["beta"]).sum()),
+    "comm_events": len(comm),
+    "comm_participants": sorted({e.get("participants") for e in comm}),
+    "comm_primitives": sorted({e.get("primitive") for e in comm}),
 }), flush=True)
 """
 
@@ -325,8 +333,20 @@ print("RESULT " + json.dumps({
 def test_two_process_smoke(tmp_path):
     """DEFAULT-tier 2-process gloo smoke (VERDICT r4 weak #6): tiny
     shapes, one cross-process psum + draw allgather — keeps the
-    distributed path from regressing silently between slow-tier runs."""
+    distributed path from regressing silently between slow-tier runs.
+    Since PR 16 each worker also traces its run: the comms observatory
+    must account the cross-process draw gather with participants == 2
+    (the REAL process count, not the single-process fallback)."""
     script = tmp_path / "worker.py"
     script.write_text(_SMOKE_WORKER % {"port": _free_port()})
-    results = _run_workers(script, "smoke", dev_per_proc=1, timeout=120)
+    results = _run_workers(
+        script, "smoke", extra_args=(str(tmp_path),), dev_per_proc=1,
+        timeout=120,
+    )
     assert results[0]["checksum"] == pytest.approx(results[1]["checksum"])
+    for r in results:
+        assert r["comm_events"] > 0, r
+        assert "gather_tree" in r["comm_primitives"], r
+        assert 2 in r["comm_participants"], (
+            "cross-process gather_tree did not account 2 participants", r
+        )

@@ -394,7 +394,8 @@ class _FakeAllgather:
         self.peer = peer
         self.saw = None
 
-    def __call__(self, x):
+    def __call__(self, x, tiled=False):
+        assert not tiled, "agree_resume gathers rank-stacked reports"
         self.saw = tuple(int(v) for v in np.asarray(x))
         return np.stack([np.asarray(x), np.asarray(self.peer)])
 

@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Render the mesh communication report from a telemetry trace.
+
+    python tools/comms_report.py /tmp/t.jsonl            # last run in file
+    python tools/comms_report.py /tmp/t.jsonl --run 1    # a specific run
+    python tools/comms_report.py /tmp/t.jsonl --all      # every run
+    python tools/comms_report.py /tmp/t.jsonl --json     # machine-readable
+
+Where ``tools/trace_report.py`` answers "what happened" and
+``tools/timeline_report.py`` answers "where did the wall go", this
+answers "what moved over the wire": the ``comm`` events the parallel
+primitives layer (``stark_tpu.parallel.primitives``) emits for every
+accounted collective — per-primitive call/byte rollups, a wire-bytes
+ranking by call site (who is paying for the traffic), host-blocked wall,
+and the mesh fleet's shard-imbalance trail (per-shard block walls from
+``fleet_block`` events, straggler attribution, and any
+``mesh_imbalance`` health warnings the balance trail raised).
+
+Forward/backward compat: pre-PR-16 traces (and STARK_COMM_TELEMETRY=0
+runs) carry no ``comm`` events — the report says so and exits 0, never
+an error.  ``--json`` emits the raw rollup dict.  Stdlib-only read path
+apart from `stark_tpu.telemetry` (no jax import), so it runs anywhere
+the trace file lands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# repo-root invocation without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stark_tpu.telemetry import read_trace, summarize_trace  # noqa: E402
+
+
+def _fmt(v) -> str:
+    # "n/a", never a crash: fields a trace predates must still render
+    if v is None:
+        return "n/a"
+    if isinstance(v, bool):
+        return "yes" if v else "no"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _table(rows, header) -> str:
+    """Plain aligned text table (no deps)."""
+    cols = [header] + [[_fmt(c) for c in r] for r in rows]
+    widths = [max(len(r[i]) for r in cols) for i in range(len(header))]
+    lines = []
+    for j, r in enumerate(cols):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if j == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def _bytes(v):
+    if v is None:
+        return None
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024.0 or unit == "GiB":
+            return f"{v:.1f} {unit}" if unit != "B" else f"{int(v)} B"
+        v /= 1024.0
+
+
+def _median(xs):
+    ws = sorted(xs)
+    n = len(ws)
+    return ws[n // 2] if n % 2 else 0.5 * (ws[n // 2 - 1] + ws[n // 2])
+
+
+def comms_rollup(events, run):
+    """The machine-readable report dict for one run (the --json shape)."""
+    evs = [e for e in events if e.get("run", 0) == run]
+    comm = [e for e in evs if e.get("event") == "comm"]
+
+    by_prim = {}
+    by_site = {}
+    for e in comm:
+        prim = str(e.get("primitive", "unknown"))
+        p = by_prim.setdefault(prim, {
+            "calls": 0, "payload_bytes": 0, "wire_bytes": 0,
+            "host_blocked_s": 0.0, "participants_last": None,
+        })
+        p["calls"] += 1
+        p["payload_bytes"] += int(e.get("payload_bytes") or 0)
+        p["wire_bytes"] += int(e.get("wire_bytes") or 0)
+        p["host_blocked_s"] = round(
+            p["host_blocked_s"] + float(e.get("host_blocked_s") or 0.0), 6
+        )
+        if e.get("participants") is not None:
+            p["participants_last"] = e["participants"]
+        site = str(e.get("site", "unknown"))
+        s = by_site.setdefault(site, {"calls": 0, "wire_bytes": 0})
+        s["calls"] += 1
+        s["wire_bytes"] += int(e.get("wire_bytes") or 0)
+
+    # shard-imbalance trail: per-shard walls the mesh fleet stamped on
+    # its fleet_block events (absent off-mesh / pre-PR-16)
+    walls_rows = [
+        e["shard_walls"] for e in evs
+        if e.get("event") == "fleet_block" and e.get("shard_walls")
+    ]
+    shards = None
+    if walls_rows:
+        n = len(walls_rows[-1])
+        rows = [w for w in walls_rows if len(w) == n]
+        means = [
+            sum(float(w[k]) for w in rows) / len(rows) for k in range(n)
+        ]
+        maxes = [max(float(w[k]) for w in rows) for k in range(n)]
+        med = _median(means)
+        shards = {
+            "blocks_timed": len(rows),
+            "mean_wall_s": [round(m, 6) for m in means],
+            "max_wall_s": [round(m, 6) for m in maxes],
+            "ratio_to_median": [
+                round(m / med, 4) if med > 0 else None for m in means
+            ],
+        }
+    imbalance = [
+        e for e in evs
+        if e.get("event") == "health_warning"
+        and e.get("warning") == "mesh_imbalance"
+    ]
+
+    summary = summarize_trace(events, run=run)
+    return {
+        "run": run,
+        "comms": summary.get("comms") or {},
+        "by_primitive": by_prim,
+        "by_site": by_site,
+        "shards": shards,
+        "mesh_imbalance_warnings": [
+            {k: e.get(k) for k in ("block", "shard", "value", "threshold")}
+            for e in imbalance
+        ],
+    }
+
+
+def render_run(events, run) -> str:
+    r = comms_rollup(events, run)
+    out = [f"run {run}: communication report"]
+    if not r["by_primitive"]:
+        out.append(
+            "(no comm events — trace predates PR 16 or ran with "
+            "STARK_COMM_TELEMETRY=0; nothing to report)"
+        )
+        return "\n".join(out)
+
+    cm = r["comms"]
+    out.append(
+        f"{cm.get('calls', 0)} accounted calls, "
+        f"{_bytes(cm.get('wire_bytes')) or 'n/a'} predicted wire, "
+        f"{_fmt(cm.get('host_blocked_s'))}s host-blocked"
+    )
+    out.append("")
+
+    rows = [
+        (
+            prim,
+            p["calls"],
+            _bytes(p["payload_bytes"]),
+            _bytes(p["wire_bytes"]),
+            p["host_blocked_s"],
+            p["participants_last"],
+        )
+        for prim, p in sorted(
+            r["by_primitive"].items(),
+            key=lambda kv: -kv[1]["wire_bytes"],
+        )
+    ]
+    out.append(_table(
+        rows,
+        ("primitive", "calls", "payload", "wire", "host_blocked_s",
+         "participants"),
+    ))
+    out.append("")
+
+    rows = [
+        (site, s["calls"], _bytes(s["wire_bytes"]))
+        for site, s in sorted(
+            r["by_site"].items(), key=lambda kv: -kv[1]["wire_bytes"]
+        )
+    ]
+    out.append(_table(rows, ("call site", "calls", "wire")))
+    out.append("")
+
+    sh = r["shards"]
+    if sh:
+        rows = [
+            (
+                k,
+                sh["mean_wall_s"][k],
+                sh["max_wall_s"][k],
+                sh["ratio_to_median"][k],
+            )
+            for k in range(len(sh["mean_wall_s"]))
+        ]
+        out.append(_table(
+            rows,
+            ("shard", "mean wall_s", "max wall_s", "ratio to median"),
+        ))
+        out.append(f"({sh['blocks_timed']} mesh blocks timed)")
+        out.append("")
+    if r["mesh_imbalance_warnings"]:
+        rows = [
+            (w.get("block"), w.get("shard"), w.get("value"),
+             w.get("threshold"))
+            for w in r["mesh_imbalance_warnings"]
+        ]
+        out.append(_table(
+            rows, ("block", "straggler shard", "ratio", "threshold")
+        ))
+    return "\n".join(out).rstrip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="JSONL trace file")
+    ap.add_argument("--run", type=int, default=None,
+                    help="run ordinal to report (default: last)")
+    ap.add_argument("--all", action="store_true", help="report every run")
+    ap.add_argument("--json", action="store_true",
+                    help="print the rollup dict(s) as JSON instead")
+    args = ap.parse_args(argv)
+
+    # tolerate a torn final line: the trace may still be live
+    events = read_trace(args.trace, strict=False)
+    if not events:
+        print(f"{args.trace}: no parseable events", file=sys.stderr)
+        return 1
+    runs = sorted({e.get("run", 0) for e in events})
+    picked = runs if args.all else [
+        args.run if args.run is not None else runs[-1]
+    ]
+    if args.json:
+        out = [comms_rollup(events, r) for r in picked]
+        print(json.dumps(out[0] if len(out) == 1 else out, indent=1))
+        return 0
+    chunks = [render_run(events, r) for r in picked]
+    print(("\n\n" + "=" * 60 + "\n\n").join(chunks))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

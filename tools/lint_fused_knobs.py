@@ -44,11 +44,13 @@ _READ_FUNCS = frozenset({"get", "getenv", "pop", "fused_knob"})
 #: turns on donor-seeded admission warmup — each changes which executable
 #: / how much warmup every admitted problem runs), and the
 #: device-parallel fleet knob (STARK_FLEET_MESH shards the problem axis
-#: over a mesh — a different compiled dispatch per shard) — extend the
-#: alternation when a new execution-path knob family lands
+#: over a mesh — a different compiled dispatch per shard), and the
+#: comms-observatory switch (STARK_COMM_TELEMETRY=0 silences collective
+#: accounting for byte-identical traces) — extend the alternation when
+#: a new execution-path knob family lands
 _KNOB_RE = re.compile(
     r"^STARK_(?:FUSED_[A-Z0-9_]+|RAGGED_NUTS|QUANT_[A-Z0-9_]+"
-    r"|FLEET_SLOTS|FLEET_WARMSTART|FLEET_MESH)$"
+    r"|FLEET_SLOTS|FLEET_WARMSTART|FLEET_MESH|COMM_TELEMETRY)$"
 )
 
 

@@ -10,7 +10,7 @@
 Where ``tools/trace_report.py`` answers "what happened", this answers
 "where did every wall-second go": the run decomposes into non-
 overlapping, kind-tagged spans — compile / warmup / dispatch /
-host_hidden / device_idle / checkpoint / host — derived by
+host_hidden / device_idle / checkpoint / comm / host — derived by
 `stark_tpu.profiling` from the trace's phase events (or read directly
 from ``span`` events when the writer recorded them via
 STARK_PROFILE_SPANS).  The coverage line states how much of the run

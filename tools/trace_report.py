@@ -268,6 +268,43 @@ def render_run(events, run) -> str:
             ))
             out.append("")
 
+    # mesh communication observatory (parallel.primitives, PR 16):
+    # accounted collective calls / predicted wire bytes / host-blocked
+    # wall plus the latest straggler attribution — absent (not an
+    # error) on pre-PR-16 and STARK_COMM_TELEMETRY=0 traces
+    cm = s.get("comms") or {}
+    if cm:
+        def _bytes(v):
+            return None if v is None else f"{v / 1024.0:.1f} KiB"
+
+        rows = [
+            ("accounted calls", cm.get("calls")),
+            ("payload bytes", _bytes(cm.get("payload_bytes"))),
+            ("wire bytes", _bytes(cm.get("wire_bytes"))),
+            ("host blocked (s)", cm.get("host_blocked_s")),
+            ("by primitive",
+             ", ".join(
+                 f"{k}x{v['calls']}"
+                 for k, v in sorted(cm["by_primitive"].items())
+             ) if cm.get("by_primitive") else None),
+            ("shards timed", cm.get("shards")),
+            ("straggler shard (last)", cm.get("straggler_shard_last")),
+            ("straggler ratio (last)", cm.get("straggler_ratio_last")),
+        ]
+        out.append(_table(
+            [r for r in rows if r[1] is not None], ("comms", "value")
+        ))
+        out.append("")
+
+    # unknown event types the summarizer could not classify (newer
+    # writers): counted, never dropped
+    other = s.get("other") or {}
+    if other:
+        out.append(_table(
+            sorted(other.items()), ("unrecognized event", "count")
+        ))
+        out.append("")
+
     h = s["health"]
     if h:
         keys = (
