@@ -908,6 +908,94 @@ def fleet_warmstart_poison(workdir: str) -> Dict[str, Any]:
             "poisoned_donors_rejected": 1}
 
 
+@_scenario("fleet_shard_lost_degraded")
+def fleet_shard_lost_degraded(workdir: str) -> Dict[str, Any]:
+    """Elastic fault domains (PR 17) acceptance drill: ``fleet.shard_dead``
+    kills shard 1 of a 4-shard mesh fleet.  The STARK_SHARD_DEADLINE
+    deadman declares the shard lost, the fleet re-packs onto the 3
+    survivors (one accounted re-specialization) and completes DEGRADED:
+    the survivors' draws are BIT-IDENTICAL to an uninjected fleet (the
+    batch-composition-independence contract makes the shrunk-mesh
+    dispatch invisible), the victim either reconverges within its
+    EXISTING budget or quarantines ``failed:shard_lost``, and the loss
+    leaves a forensic bundle."""
+    import jax
+
+    from .fleet import sample_fleet
+    from .parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 4:
+        return {"skipped": "needs 4 devices"}
+    spec = _fleet_spec(4)
+    kw = dict(_FLEET_KW, seed=0, health_check=True, problem_max_restarts=1)
+    # uninjected reference (single-device — mesh-on/off draw identity is
+    # already pinned, so this also pins the post-loss shrunk mesh)
+    ref = sample_fleet(spec, **kw)
+    faults.reset()
+    mesh = make_mesh({"problems": 4}, devices=jax.devices()[:4])
+    faults.configure("fleet.shard_dead=kill(1)*1@1")
+    prev = os.environ.get("STARK_SHARD_DEADLINE")
+    os.environ["STARK_SHARD_DEADLINE"] = "4"
+    try:
+        res = sample_fleet(
+            spec, mesh=mesh,
+            metrics_path=os.path.join(workdir, "fleet_metrics.jsonl"),
+            **kw,
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("STARK_SHARD_DEADLINE", None)
+        else:
+            os.environ["STARK_SHARD_DEADLINE"] = prev
+    assert res.degraded is True, "shard loss must mark the run degraded"
+    assert res.lost_shards == [1], res.lost_shards
+    assert res.shards == 3, res.shards
+    victim = res.problems[1]
+    assert victim.converged or victim.status == "failed:shard_lost", (
+        victim.status
+    )
+    for a, b in zip(ref.problems, res.problems):
+        if a.problem_id == "p0001":
+            continue
+        assert a.status == b.status, (a.problem_id, a.status, b.status)
+        np.testing.assert_array_equal(a.draws_flat, b.draws_flat)
+    evs = [r for r in _fleet_metrics(workdir)
+           if r.get("event") == "shard_lost"]
+    assert len(evs) == 1 and evs[0]["shard"] == 1, evs
+    assert evs[0]["cause"] == "nonfinite", evs
+    assert evs[0]["shards_before"] == 4 and evs[0]["shards_after"] == 3
+    assert _postmortems(workdir, "shard_lost_1"), (
+        "no forensic bundle for the lost shard"
+    )
+    return {"lost_shards": res.lost_shards, "shards_final": res.shards,
+            "victim": victim.status, "survivors_bit_identical": True}
+
+
+@_scenario("fleet_region_lost_consensus")
+def fleet_region_lost_consensus(workdir: str) -> Dict[str, Any]:
+    """Hierarchical failure domains: consensus over a (region, device)
+    DomainTree loses shard 1 past its restart budget — region
+    containment condemns the WHOLE region 0 (shards 0-1), the combine
+    reweights over the surviving region, and the result names both the
+    lost shards and the lost region."""
+    from .parallel.consensus import consensus_sample
+    from .parallel.primitives import DomainTree
+
+    tree = DomainTree([("region", 2), ("device", 2)])
+    faults.configure("consensus.shard_death=kill(1)*9")
+    post = consensus_sample(
+        _GaussMean(), _consensus_data(), shard_restarts=1, domains=tree,
+        **_CONSENSUS_KW,
+    )
+    assert post.sample_stats["degraded"] is True
+    assert post.sample_stats["lost_shards"].tolist() == [0, 1]
+    assert post.sample_stats["lost_regions"].tolist() == [0]
+    assert np.isfinite(post.draws_flat).all(), (
+        "lost region leaked into combine"
+    )
+    return {"lost_regions": [0], "lost_shards": [0, 1]}
+
+
 #: envelope/timing keys that legitimately differ between two identical
 #: runs (clocks, measured walls, per-run artifact paths) — everything
 #: ELSE in a trace must be bit-equal for the recorder-off/on pair
@@ -1042,6 +1130,73 @@ def comm_clean_identity(workdir: str) -> Dict[str, Any]:
     )
     return {"comm_events": len(comm_on), "mesh": mesh is not None,
             "trace_identical": True}
+
+
+@_scenario("shard_loss_clean_identity")
+def shard_loss_clean_identity(workdir: str) -> Dict[str, Any]:
+    """STARK_SHARD_DEADLINE armed, no fault injected: the shard deadman
+    is pure host-side observation — a mesh fleet's draws are
+    bit-identical to the knob-off run, no ``shard_lost`` event fires,
+    and the two traces match in every non-timing field."""
+    import jax
+
+    from .fleet import SHARD_DEADLINE_ENV, sample_fleet
+    from .telemetry import RunTrace, read_trace, use_trace
+
+    devices = jax.devices()
+    mesh = None
+    if len(devices) >= 2:
+        from .parallel.mesh import make_mesh
+
+        mesh = make_mesh({"problems": 2}, devices=devices[:2])
+    spec = _fleet_spec(2)
+    assert not faults.active()
+
+    def run(tag: str, deadline: Optional[str]):
+        trace_path = os.path.join(workdir, f"{tag}.jsonl")
+        prev = os.environ.get(SHARD_DEADLINE_ENV)
+        if deadline is None:
+            os.environ.pop(SHARD_DEADLINE_ENV, None)
+        else:
+            os.environ[SHARD_DEADLINE_ENV] = deadline
+        try:
+            with RunTrace(trace_path) as tr, use_trace(tr):
+                res = sample_fleet(spec, seed=0, mesh=mesh,
+                                   health_check=True, **_FLEET_KW)
+        finally:
+            if prev is None:
+                os.environ.pop(SHARD_DEADLINE_ENV, None)
+            else:
+                os.environ[SHARD_DEADLINE_ENV] = prev
+        return res, read_trace(trace_path)
+
+    res_off, ev_off = run("deadline_off", None)
+    res_on, ev_on = run("deadline_on", "4")
+    for a_p, b_p in zip(res_off.problems, res_on.problems):
+        np.testing.assert_array_equal(
+            np.asarray(a_p.draws_flat), np.asarray(b_p.draws_flat)
+        )
+    assert res_on.lost_shards == [] and res_on.degraded is False
+    assert not [e for e in ev_on if e["event"] == "shard_lost"], (
+        "an unfired deadman emitted shard_lost"
+    )
+
+    def shape(events):
+        # comm events carry a process-global seq + measured host walls
+        # (never comparable across two runs); their on/off identity is
+        # comm_clean_identity's contract — here the COUNT must match
+        return [
+            {k: v for k, v in e.items() if not _is_timing_key(k)}
+            for e in events if e["event"] != "comm"
+        ]
+
+    assert shape(ev_off) == shape(ev_on), (
+        "an armed (unfired) shard deadman changed the trace event stream"
+    )
+    n_comm_off = len([e for e in ev_off if e["event"] == "comm"])
+    n_comm_on = len([e for e in ev_on if e["event"] == "comm"])
+    assert n_comm_off == n_comm_on, (n_comm_off, n_comm_on)
+    return {"mesh": mesh is not None, "trace_identical": True}
 
 
 @_scenario("clean_identity")

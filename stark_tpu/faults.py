@@ -39,7 +39,15 @@ Actions:
   ``corrupt``  data directive: `corrupt_file` overwrites bytes of the
                site's file (torn write / bitrot)
   ``kill``     data directive: `kill_shards` NaN-fills sub-posterior draws
-               of shard ``arg`` (shard death)
+               of shard ``arg`` (shard death).  The mesh fleet's
+               ``fleet.shard_dead`` site applies the same action to ONE
+               mesh shard's slice of the carried batch (arg = shard
+               ordinal) — the deterministic whole-shard death the
+               STARK_SHARD_DEADLINE deadman + degraded re-shard drill
+               against; ``primitives.collective_stall`` is its control-
+               flow twin at the collective dispatch boundary (arm it
+               with ``stall``/``sleep`` to wedge a collective under a
+               watchdog)
 
 Control-flow sites call `fail_point(site)`; data sites call the matching
 helper (`poison` / `corrupt_file` / `kill_shards`), which routes through

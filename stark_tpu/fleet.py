@@ -170,6 +170,98 @@ _LANE_SEED_STRIDE = (1 << 34) + 1
 _FAULT_POISONED = "poisoned_state"
 _FAULT_CORRUPT = "corrupt_checkpoint"
 
+#: fault class of a tenant whose MESH SHARD was declared lost (the shard
+#: deadman, STARK_SHARD_DEADLINE): the lane cold-restarts against its
+#: EXISTING budget on the shrunk mesh, then quarantines as
+#: ``failed:shard_lost``
+_FAULT_SHARD_LOST = "shard_lost"
+
+#: shard-deadman knob: a positive float ARMS shard-loss detection on
+#: mesh fleets — a shard whose active lanes all return non-finite, or
+#: whose block wall exceeds this multiple of the surviving-shard median
+#: wall, is declared lost and the fleet degrades onto a shrunk mesh.
+#: Unset / "" / "0" (the default) disables the subsystem entirely:
+#: traces stay byte-identical to a build without it.
+SHARD_DEADLINE_ENV = "STARK_SHARD_DEADLINE"
+
+#: wall-deadman absolute floor: the ratio test only applies once a
+#: shard's wall is past this, so sub-millisecond scheduler jitter on
+#: tiny blocks can never fake a dead shard (a real hung collective is
+#: seconds, not microseconds)
+_SHARD_WALL_FLOOR_S = 0.25
+
+#: `FleetFeed` backpressure knob: maximum queued (undrained) submissions
+#: before `submit` rejects with `FeedRejected`.  Unset / "" / "0" (the
+#: default) keeps the queue unbounded — the pre-PR-17 behavior.
+FEED_MAXDEPTH_ENV = "STARK_FEED_MAXDEPTH"
+
+
+class CapabilityError(NotImplementedError):
+    """A requested configuration is outside what this build supports,
+    with the KNOB that asked for it and the supported fallback named —
+    the structured twin of the sequential-hatch warning, so callers (and
+    the multi-process smoke test) can assert the capability boundary
+    instead of pattern-matching a bare exception."""
+
+    def __init__(self, message: str, *, knob: str, fallback: str):
+        super().__init__(f"{message} (knob: {knob}; supported fallback: "
+                         f"{fallback})")
+        self.knob = knob
+        self.fallback = fallback
+
+
+class FeedRejected(RuntimeError):
+    """`FleetFeed.submit` refused a submission: the queue is at its
+    bounded depth (``STARK_FEED_MAXDEPTH``).  Carries the observed
+    ``depth``, the ``maxdepth`` bound, and ``retry_after_s`` — the
+    producer's structured backoff hint (the feed's recent drain cadence,
+    1s when it has never drained)."""
+
+    def __init__(self, *, depth: int, maxdepth: int, retry_after_s: float):
+        super().__init__(
+            f"FleetFeed queue at depth {depth} >= maxdepth {maxdepth} "
+            f"({FEED_MAXDEPTH_ENV}); retry after ~{retry_after_s:.1f}s"
+        )
+        self.depth = int(depth)
+        self.maxdepth = int(maxdepth)
+        self.retry_after_s = float(retry_after_s)
+
+
+def _resolve_shard_deadline() -> Optional[float]:
+    """The armed shard-deadman ratio, or None (disabled — the default).
+    Literal env read so the knob lint ties it to its README row."""
+    raw = os.environ.get("STARK_SHARD_DEADLINE", "").strip()
+    if not raw or raw == "0":
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        log.warning("ignoring non-numeric %s=%r", SHARD_DEADLINE_ENV, raw)
+        return None
+    if v <= 0:
+        return None
+    if v < 1.0:
+        log.warning(
+            "%s=%g < 1 would declare the MEDIAN shard dead; clamping to 1",
+            SHARD_DEADLINE_ENV, v,
+        )
+        v = 1.0
+    return v
+
+
+def _resolve_feed_maxdepth() -> Optional[int]:
+    """The feed's bounded depth, or None (unbounded — the default).
+    Literal env read so the knob lint ties it to its README row."""
+    raw = os.environ.get("STARK_FEED_MAXDEPTH", "").strip()
+    if not raw or raw == "0":
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        log.warning("ignoring non-integer %s=%r", FEED_MAXDEPTH_ENV, raw)
+        return None
+    return v if v > 0 else None
+
 
 def _status_string(failed, converged, budget_exhausted, *,
                    default: str) -> str:
@@ -207,7 +299,10 @@ class ProblemBudget:
       never poisons neighbors), and on the sequential hatch the same
       clamp bounds every attempt including `ChainHealthError` retries.
     * ``max_restarts`` — in-place lane reseeds allowed before the
-      problem is QUARANTINED (terminal ``failed:poisoned_state``).
+      problem is QUARANTINED (terminal ``failed:<fault>`` —
+      ``poisoned_state``, or ``shard_lost`` when the lane's mesh shard
+      died; a shard-loss re-placement burns THIS budget, never a fresh
+      one).
     """
 
     ess_target: Optional[float] = None
@@ -393,19 +488,57 @@ class FleetFeed:
     caller re-submitting.  The sequential ``STARK_FLEET=0`` hatch honors
     the same API (submissions run through the single-problem runner after
     the spec sweep, same seed discipline).
+
+    Backpressure: ``maxdepth`` (default ``STARK_FEED_MAXDEPTH``, unset =
+    unbounded) bounds the UNDRAINED queue — an admission storm gets a
+    structured `FeedRejected` carrying ``retry_after_s`` (the feed's
+    recent drain cadence) instead of unbounded host-memory growth.  A
+    reject emits one ``feed_reject`` trace event (the
+    ``stark_fleet_feed_rejects_total`` counter) and consumes nothing:
+    the producer retries with the SAME problem_id or drops.  `requeue`
+    is exempt — crash-recovery reinsertion of already-admitted items
+    must never bounce.
     """
 
-    def __init__(self):
+    def __init__(self, maxdepth: Optional[int] = None):
         self._cond = threading.Condition()
         self._items: List[Tuple[Optional[str], PyTree,
                                 Optional[ProblemBudget]]] = []
         self._closed = False
         self._seq = 0
+        self.maxdepth = (
+            int(maxdepth) if maxdepth is not None
+            else _resolve_feed_maxdepth()
+        )
+        self._rejects = 0
+        # drain cadence for the retry-after hint: the consumer's block
+        # boundary sets the natural retry horizon
+        self._last_drain_t: Optional[float] = None
+        self._drain_gap_s: Optional[float] = None
+        # the fleet binds its trace here so producer-thread rejects emit
+        # on the run's bus (the ambient ContextVar does not cross threads)
+        self._trace = None
+
+    @property
+    def rejects(self) -> int:
+        """Submissions refused by the depth bound since construction."""
+        with self._cond:
+            return self._rejects
+
+    def _retry_after_s(self) -> float:
+        """Backoff hint: the feed's observed drain cadence (how often the
+        fleet's block boundary empties the queue), default 1s."""
+        gap = self._drain_gap_s
+        if gap is None and self._last_drain_t is not None:
+            gap = time.monotonic() - self._last_drain_t
+        return round(min(max(gap if gap is not None else 1.0, 0.1), 60.0), 3)
 
     def submit(self, data: PyTree, problem_id: Optional[str] = None,
                budget: Optional[ProblemBudget] = None) -> str:
         """Queue one problem; returns its problem_id (``s####`` when not
-        given).  Raises once the feed is closed."""
+        given).  Raises once the feed is closed, or `FeedRejected` when
+        the bounded queue is full (nothing is consumed — retry with the
+        same arguments after ``retry_after_s``)."""
         if budget is not None and not isinstance(budget, ProblemBudget):
             raise ValueError(
                 f"budget is {type(budget).__name__}, expected "
@@ -414,6 +547,23 @@ class FleetFeed:
         with self._cond:
             if self._closed:
                 raise RuntimeError("FleetFeed is closed")
+            if (self.maxdepth is not None
+                    and len(self._items) >= self.maxdepth):
+                self._rejects += 1
+                depth, retry = len(self._items), self._retry_after_s()
+                tr = self._trace
+                if tr is None:
+                    tr = telemetry.get_trace()
+                if tr is not None and tr.enabled:
+                    tr.emit(
+                        "feed_reject", depth=depth,
+                        maxdepth=self.maxdepth, retry_after_s=retry,
+                        rejects=self._rejects,
+                    )
+                raise FeedRejected(
+                    depth=depth, maxdepth=self.maxdepth,
+                    retry_after_s=retry,
+                )
             if problem_id is None:
                 problem_id = f"s{self._seq:04d}"
             self._seq += 1
@@ -437,6 +587,10 @@ class FleetFeed:
         """Pop every queued submission (the fleet's block-boundary
         consumption point)."""
         with self._cond:
+            now = time.monotonic()
+            if self._last_drain_t is not None:
+                self._drain_gap_s = now - self._last_drain_t
+            self._last_drain_t = now
             items, self._items = self._items, []
             return items
 
@@ -619,7 +773,8 @@ class FleetResult:
                  blocks_dispatched, compactions, occupancy_trail,
                  total_grad_evals, budget_exhausted=False,
                  block_scan_compiles=0, admissions=0, slot_recycles=0,
-                 dispatch_occupancy_trail=None, shards=None):
+                 dispatch_occupancy_trail=None, shards=None,
+                 lost_shards=None):
         self.problems = problems
         self.wall_s = wall_s
         self.blocks_dispatched = blocks_dispatched
@@ -644,8 +799,13 @@ class FleetResult:
         self.dispatch_occupancy_trail = dispatch_occupancy_trail or []
         # mesh-parallel fleet (STARK_FLEET_MESH): the "problems" mesh
         # axis size the batched dispatches sharded over; None on
-        # single-device (and sequential-hatch) runs
+        # single-device (and sequential-hatch) runs.  On a run that
+        # degraded onto a shrunk mesh this is the FINAL shard count.
         self.shards = shards
+        # shard ordinals the deadman (STARK_SHARD_DEADLINE) declared
+        # lost, in loss order — the fleet twin of degraded consensus's
+        # lost_shards (empty on healthy / off-mesh runs)
+        self.lost_shards: List[int] = list(lost_shards or [])
         self._by_id = {p.problem_id: p for p in problems}
 
     def __getitem__(self, problem_id: str) -> FleetProblemResult:
@@ -671,10 +831,13 @@ class FleetResult:
 
     @property
     def degraded(self) -> bool:
-        """True when the fleet completed AROUND lost problems (any lane
-        was quarantined).  Budget-exhausted problems are a policy
-        outcome, not a fault — they do not degrade the fleet."""
-        return bool(self.lost_problems)
+        """True when the fleet completed AROUND a loss: any quarantined
+        problem, or any mesh shard the deadman declared lost (even when
+        every displaced tenant reconverged within budget — the run did
+        not execute on the mesh it was asked for).  Budget-exhausted
+        problems are a policy outcome, not a fault — they do not degrade
+        the fleet."""
+        return bool(self.lost_problems) or bool(self.lost_shards)
 
     def aggregate_min_ess(self) -> float:
         """Sum of per-problem min-ESS — the fleet throughput numerator
@@ -1079,20 +1242,98 @@ def _shard_ready_walls(tree, t0: float) -> Optional[List[float]]:
 
     datas = [sh.data for sh in sorted(shards, key=ordinal)]
     walls: List[Optional[float]] = [None] * len(datas)
+    # per-shard watchdog beats: every shard that completes IS progress,
+    # so a single hung shard cannot silence the deadman — and the wait
+    # context names the shards still outstanding, so a stall fired here
+    # carries the culprit in the stall event and postmortem bundle
     if all(hasattr(d, "is_ready") for d in datas):
         remaining = set(range(len(datas)))
-        while remaining:
-            for k in list(remaining):
-                if datas[k].is_ready():
-                    walls[k] = time.perf_counter() - t0
-                    remaining.discard(k)
-            if remaining:
-                time.sleep(0.0002)
+        telemetry.set_progress_context(
+            waiting_on_shards=sorted(remaining))
+        try:
+            while remaining:
+                progressed = False
+                for k in list(remaining):
+                    if datas[k].is_ready():
+                        walls[k] = time.perf_counter() - t0
+                        remaining.discard(k)
+                        progressed = True
+                if progressed:
+                    telemetry.set_progress_context(
+                        waiting_on_shards=sorted(remaining))
+                    telemetry.notify_progress()
+                if remaining:
+                    time.sleep(0.0002)
+        finally:
+            telemetry.clear_progress_context("waiting_on_shards")
     else:
-        for k, d in enumerate(datas):
-            jax.block_until_ready(d)
-            walls[k] = time.perf_counter() - t0
+        try:
+            for k, d in enumerate(datas):
+                telemetry.set_progress_context(
+                    waiting_on_shards=list(range(k, len(datas))))
+                jax.block_until_ready(d)
+                walls[k] = time.perf_counter() - t0
+                telemetry.notify_progress()
+        finally:
+            telemetry.clear_progress_context("waiting_on_shards")
     return [round(float(w), 6) for w in walls]
+
+
+def _classify_lost_shards(
+    *,
+    n_shards: int,
+    lanes_per: int,
+    active_js: List[int],
+    poisoned_js: Any,
+    shard_walls: Optional[List[float]],
+    deadline_ratio: float,
+    wall_floor_s: float = _SHARD_WALL_FLOOR_S,
+) -> Dict[int, str]:
+    """The shard deadman's pure classifier: which mesh shards are LOST
+    this block, and why — ``{shard: "nonfinite" | "wall"}``.
+
+    Two independent signals (either alone declares the shard):
+
+    * ``nonfinite`` — every ACTIVE lane the shard carries failed the
+      per-lane finite scan (``poisoned_js``).  One poisoned lane is a
+      lane fault (PR 9 containment); ALL of a shard's lanes poisoned at
+      once is the shard-death signature — independent tenants do not
+      fail together by coincidence.
+    * ``wall`` — the shard's block wall (the PR 16 ``shard_walls``
+      trail) exceeds ``deadline_ratio`` x the median wall of the OTHER
+      live shards, AND the absolute floor ``wall_floor_s`` (so
+      microsecond scheduler jitter on tiny blocks can never fake a
+      death; a real hung collective is seconds).
+
+    A shard with no active lanes has no evidence and no victims: it is
+    never classified.  Callers must treat "every shard lost" as a BATCH
+    fault, not a shard fault (there is no surviving mesh to re-pack
+    onto) — this function just reports what it sees.
+    """
+    per_shard_active: Dict[int, List[int]] = {}
+    for j in active_js:
+        k = j // max(lanes_per, 1)
+        if 0 <= k < n_shards:
+            per_shard_active.setdefault(k, []).append(j)
+    lost: Dict[int, str] = {}
+    for k, js in per_shard_active.items():
+        if js and all(j in poisoned_js for j in js):
+            lost[k] = "nonfinite"
+    if shard_walls:
+        walls = [float(w) for w in shard_walls]
+        for k, w in enumerate(walls):
+            if k in lost or k not in per_shard_active:
+                continue
+            others = [
+                x for k2, x in enumerate(walls)
+                if k2 != k and k2 not in lost
+            ]
+            if not others:
+                continue
+            med = float(np.median(others))
+            if w > max(wall_floor_s, deadline_ratio * med):
+                lost[k] = "wall"
+    return lost
 
 
 def _fleet_workdir(*paths: Optional[str]) -> Optional[str]:
@@ -1390,9 +1631,17 @@ def _sample_fleet(
             "the chees ensemble warmup has its own host loop"
         )
     if jax.process_count() > 1:
-        raise NotImplementedError(
-            "fleet sampling is single-process for now (multi-process "
-            "meshes shard chains, not problems)"
+        # the structured twin of the sequential-hatch warning: name the
+        # capability boundary, the knob that crossed it, and the
+        # supported way down — so a control plane (and the two-process
+        # smoke) can branch on the message instead of a bare exception
+        raise CapabilityError(
+            f"fleet sampling is single-process for now (this run has "
+            f"{jax.process_count()} processes; multi-process meshes "
+            "shard chains, not problems)",
+            knob="mesh=/STARK_FLEET_MESH",
+            fallback="run one fleet per process, or STARK_FLEET=0 for "
+                     "the sequential per-problem sweep",
         )
     if stream_diag is None:
         stream_diag = os.environ.get("STARK_STREAM_DIAG", "1") != "0"
@@ -1481,6 +1730,17 @@ def _sample_fleet(
         if fleet_mesh is not None and comm_on and health_on
         else None
     )
+    # elastic fault domains (PR 17): STARK_SHARD_DEADLINE arms the
+    # per-shard deadman on mesh runs — None (the default) disables the
+    # whole subsystem and keeps traces byte-identical
+    shard_deadline = (
+        _resolve_shard_deadline() if fleet_mesh is not None else None
+    )
+    lost_shard_ids: List[int] = []
+    # producer-thread feed rejects must emit on THIS run's trace bus
+    # (the ambient ContextVar does not cross threads)
+    if feed is not None:
+        feed._trace = trace
 
     def monitor_for(p):
         m = monitors.get(p.pid)
@@ -2137,6 +2397,41 @@ def _sample_fleet(
                 return jax.tree.map(bad, st)
         return st
 
+    def kill_shard_site(st):
+        """``fleet.shard_dead`` (action ``kill``, arg = shard ordinal):
+        NaN-fill EVERY lane of one mesh shard of the carried state — the
+        deterministic whole-shard death the deadman + degraded re-shard
+        are drilled against (the mesh twin of ``fleet.lane_nan``; the
+        `faults.kill_shards` idiom applied to the fleet's problem axis).
+        Fizzles off-mesh or on a shard past the current width (the shot
+        is still consumed)."""
+        act = faults.fail_point("fleet.shard_dead")
+        if act is None or act.kind != "kill":
+            return st
+        if fleet_mesh is None or n_shards < 2:
+            log.warning(
+                "failpoint fleet.shard_dead fired off-mesh: fizzled"
+            )
+            return st
+        k = act.arg_int(0)
+        width = parts.padded_width(len(order))
+        lanes_per = width // n_shards
+        lo, hi = k * lanes_per, (k + 1) * lanes_per
+        if not 0 <= k < n_shards:
+            log.warning(
+                "failpoint fleet.shard_dead: shard %d outside mesh of "
+                "%d: fizzled", k, n_shards,
+            )
+            return st
+
+        def bad(x):
+            x = jnp.asarray(x)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return x.at[lo:hi].set(jnp.nan)
+            return x
+
+        return jax.tree.map(bad, st)
+
     def corrupt_one_store_site():
         """``fleet.ckpt_corrupt_one`` (action ``corrupt``): tear the
         header of the FIRST ACTIVE problem's draw store right after the
@@ -2715,6 +3010,7 @@ def _sample_fleet(
                     state, zs, accept, divergent, energy, ngrad = out
             state = faults.poison("runner.carried_nan", state)
             state = poison_lane_site(state)
+            state = kill_shard_site(state)
             blocks_dispatched += 1
 
             # --- host side ------------------------------------------------
@@ -2726,9 +3022,15 @@ def _sample_fleet(
             # per-shard timing trail (PR 16): observe each shard's output
             # readiness since enqueue BEFORE the global gather collapses
             # the layout — host-side observation only, the draws are
-            # untouched.  Rides mesh + STARK_COMM_TELEMETRY runs only.
+            # untouched.  Rides mesh + STARK_COMM_TELEMETRY runs — and
+            # mesh + STARK_SHARD_DEADLINE runs, where the walls feed the
+            # shard deadman's ``wall`` signal (comm-off deadman runs
+            # keep the walls OUT of the trace: timing-field emission
+            # stays the comm observatory's contract).
             shard_walls = None
-            if fleet_mesh is not None and comm_on:
+            if fleet_mesh is not None and (
+                comm_on or shard_deadline is not None
+            ):
                 shard_walls = _shard_ready_walls(zs, t_enq)
             t_blk = time.perf_counter()
             # the GLOBAL host view (parallel.primitives.gather_tree):
@@ -2777,6 +3079,153 @@ def _sample_fleet(
                         })
                     except ChainHealthError as e:
                         poisoned.append((j, i, str(e)))
+
+            # --- shard deadman + degraded re-shard (elastic mesh) ---------
+            # geometry AS DISPATCHED: the fleet_block accounting below
+            # must describe the mesh this block actually ran on, even
+            # when the deadman re-packs the fleet mid-cycle
+            mesh_ran, shards_ran, width_ran = fleet_mesh, n_shards, width
+            lane_fault: Dict[int, str] = {}
+            if (
+                shard_deadline is not None
+                and fleet_mesh is not None
+                and n_shards > 1
+            ):
+                # the SHARD as a unit of failure: all of a shard's active
+                # lanes non-finite (device loss surfaces as NaN'd
+                # transfers), or its ready wall blown past
+                # STARK_SHARD_DEADLINE x the surviving-shard median —
+                # either declares the shard LOST.  Victim lanes join the
+                # per-problem containment below under the shard_lost
+                # fault class (burn restarts, then quarantine
+                # failed:shard_lost); the survivors re-pack onto a
+                # shrunk mesh and the block loop carries on.
+                lost_now = _classify_lost_shards(
+                    n_shards=n_shards,
+                    lanes_per=width // n_shards,
+                    active_js=[
+                        j for j, i in enumerate(order) if probs[i].active
+                    ],
+                    poisoned_js={j for j, _i, _r in poisoned},
+                    shard_walls=shard_walls,
+                    deadline_ratio=shard_deadline,
+                )
+                if lost_now and len(lost_now) >= n_shards:
+                    # every shard "lost" is not shard loss — it is a
+                    # batch-wide fault (e.g. poisoned carried state
+                    # reaching every lane at once): there is no
+                    # surviving mesh to re-pack onto, so leave it to the
+                    # per-problem taxonomy instead of tearing the fleet
+                    # down to nothing
+                    log.error(
+                        "fleet shard deadman: all %d shards classified "
+                        "lost (%s) — treating as a batch fault, not "
+                        "shard loss", n_shards, lost_now,
+                    )
+                    lost_now = {}
+                if lost_now:
+                    lanes_per = width // n_shards
+                    already = {j for j, _i, _r in poisoned}
+                    shards_after = n_shards - len(lost_now)
+                    for k in sorted(lost_now):
+                        cause = lost_now[k]
+                        lo = k * lanes_per
+                        victims = [
+                            j
+                            for j in range(lo, min(lo + lanes_per,
+                                                   len(order)))
+                            if probs[order[j]].active
+                        ]
+                        for j in victims:
+                            lane_fault[j] = _FAULT_SHARD_LOST
+                            if j not in already:
+                                # a wall-lost shard's draws came back
+                                # finite but untrusted — discarded with
+                                # the shard, exactly like a poisoned
+                                # lane's block
+                                poisoned.append((
+                                    j, order[j],
+                                    f"shard {k} lost ({cause})",
+                                ))
+                        ev = dict(
+                            shard=k,
+                            cause=cause,
+                            lanes=len(victims),
+                            problem_ids=[
+                                probs[order[j]].pid for j in victims
+                            ],
+                            shards_before=n_shards,
+                            shards_after=shards_after,
+                            block=blocks_dispatched,
+                        )
+                        emit({"event": "shard_lost", **ev})
+                        # the loss IS the forensic moment: one idiom
+                        # emits the trace event AND dumps a postmortem
+                        # bundle per lost shard (trigger slug names the
+                        # shard)
+                        recorder.record_anomaly(
+                            f"shard_lost:{k}", trace, "shard_lost", **ev
+                        )
+                        lost_shard_ids.append(k)
+                        log.error(
+                            "fleet shard %d LOST (%s): %d lane(s) "
+                            "re-homed, mesh %d -> %d shard(s)",
+                            k, cause, len(victims), n_shards,
+                            shards_after,
+                        )
+                    # degraded re-shard: the survivors' carried state is
+                    # host-recoverable (the finite scan above already
+                    # read it back), so snapshot it and re-pack onto the
+                    # surviving devices.  ONE accounted
+                    # re-specialization: clearing seen_widths makes the
+                    # next dispatch take the existing new-width path
+                    # (compile phase + block_scan_compiles), and the
+                    # batch-composition-independence contract is what
+                    # makes the survivors' draws bit-identical to an
+                    # uninjected fleet on the shrunk mesh.
+                    old_devices = list(
+                        np.asarray(fleet_mesh.devices).reshape(-1)
+                    )
+                    survivors_d = [
+                        d for k2, d in enumerate(old_devices)
+                        if k2 not in lost_now
+                    ]
+                    if len(survivors_d) > 1:
+                        from .parallel.mesh import make_mesh
+
+                        fleet_mesh = make_mesh(
+                            {"problems": len(survivors_d)},
+                            devices=survivors_d,
+                        )
+                    else:
+                        # one survivor: the mesh degrades all the way to
+                        # the historical single-device fleet
+                        fleet_mesh = None
+                    fm, parts = _fleet_parts_for(model, cfg, fleet_mesh)
+                    n_shards = parts.shards
+                    # host round-trip the carried trees; the dispatch
+                    # wrapper re-pads + re-places them onto the new mesh
+                    state, step_size, inv_mass = (
+                        jax.tree.map(
+                            lambda a: jnp.asarray(np.asarray(a)), t
+                        )
+                        for t in (state, step_size, inv_mass)
+                    )
+                    if stream_diag:
+                        diag = jax.tree.map(
+                            lambda a: jnp.asarray(np.asarray(a)), diag
+                        )
+                    bdata = batch_data(order)
+                    v_block = parts.get_block(
+                        block_size,
+                        diag_lags=diag_lags if stream_diag else None,
+                        ragged=ragged,
+                    )
+                    v_dispatch = (
+                        _probe.wrap(v_block)
+                        if _probe is not None else v_block
+                    )
+                    seen_widths.clear()
             poisoned_idx = {i for _j, i, _r in poisoned}
             block_grads_active = 0
             new_donors: List[Tuple[int, _ProblemState]] = []
@@ -2822,6 +3271,7 @@ def _sample_fleet(
             if poisoned:
                 rewarm_js: List[int] = []
                 rewarm_idx: List[int] = []
+                rewarm_fault: List[str] = []
                 for j, i, reason in poisoned:
                     if health_on:
                         # the statistical trail records the stuck lane
@@ -2831,9 +3281,20 @@ def _sample_fleet(
                         monitor_for(probs[i]).warn_nonfinite(
                             reason, block=blocks_dispatched
                         )
-                    if reseed_problem(probs[i], _FAULT_POISONED, reason):
+                    # the fault CLASS travels with the lane: a shard-loss
+                    # victim burns the same per-problem RestartBudget as
+                    # a poisoned lane (no fresh budget on re-placement)
+                    # but its reseed/quarantine events — and a terminal
+                    # verdict — say shard_lost, not poisoned
+                    if reseed_problem(
+                        probs[i], lane_fault.get(j, _FAULT_POISONED),
+                        reason,
+                    ):
                         rewarm_js.append(j)
                         rewarm_idx.append(i)
+                        rewarm_fault.append(
+                            lane_fault.get(j, _FAULT_POISONED)
+                        )
                 # cold-restart the reseeded lanes IN PLACE: one vmapped
                 # warmup dispatch per round, scattered back into their
                 # batch slots — every other lane's arrays (and key
@@ -2879,16 +3340,23 @@ def _sample_fleet(
                             )
                     retry_js: List[int] = []
                     retry_idx: List[int] = []
+                    retry_fault: List[str] = []
                     for k in range(len(rewarm_idx)):
                         if k in ok:
                             continue
+                        # retries keep the lane's original fault class: a
+                        # shard-loss victim whose cold restart itself
+                        # comes back non-finite still quarantines as
+                        # failed:shard_lost
                         if reseed_problem(
-                            probs[rewarm_idx[k]], _FAULT_POISONED,
+                            probs[rewarm_idx[k]], rewarm_fault[k],
                             "non-finite warmup state after lane reseed",
                         ):
                             retry_js.append(rewarm_js[k])
                             retry_idx.append(rewarm_idx[k])
+                            retry_fault.append(rewarm_fault[k])
                     rewarm_js, rewarm_idx = retry_js, retry_idx
+                    rewarm_fault = retry_fault
 
             # --- per-problem deadlines ------------------------------------
             # charged against the CUMULATIVE wall (wall_offset restores
@@ -2933,10 +3401,10 @@ def _sample_fleet(
             # k-th contiguous slice of the PADDED batch (shard_map's
             # leading-axis layout); pad lanes count as idle.  Fields ride
             # ONLY mesh runs (knob-off events stay byte-identical).
-            if fleet_mesh is not None:
-                lanes_per = width // n_shards
+            if mesh_ran is not None:
+                lanes_per = width_ran // shards_ran
                 shard_occ = []
-                for k in range(n_shards):
+                for k in range(shards_ran):
                     lo = k * lanes_per
                     hi = min(lo + lanes_per, len(order))
                     act = sum(
@@ -2945,14 +3413,16 @@ def _sample_fleet(
                     )
                     shard_occ.append(round(act / max(lanes_per, 1), 4))
                 sched_fields = dict(
-                    sched_fields, shards=n_shards, shard_occupancy=shard_occ,
+                    sched_fields, shards=shards_ran,
+                    shard_occupancy=shard_occ,
                 )
                 # shard-imbalance attribution (PR 16): per-shard ready
                 # walls + slowest/median straggler ratio ride ONLY
                 # mesh + comm-telemetry runs (knob-off events stay
-                # byte-identical); the windowed health warning fires
-                # through the ShardBalanceTrail
-                if shard_walls is not None:
+                # byte-identical — a deadman-only run computes the walls
+                # but keeps them out of the trace); the windowed health
+                # warning fires through the ShardBalanceTrail
+                if shard_walls is not None and comm_on:
                     med = float(np.median(shard_walls))
                     worst = int(np.argmax(shard_walls))
                     sched_fields = dict(
@@ -3199,8 +3669,12 @@ def _sample_fleet(
             compactions=compactions,
             fleet_grad_evals=total_grads,
             budget_exhausted=fleet_budget_exhausted,
-            degraded=bool(lost),
+            degraded=bool(lost) or bool(lost_shard_ids),
             lost_problems=lost,
+            # shard-loss accounting rides run_end ONLY on runs that
+            # actually lost shards (knob-off — and knob-on-but-clean —
+            # trace files stay byte-identical)
+            **({"lost_shards": lost_shard_ids} if lost_shard_ids else {}),
             **stream_end,
         )
     return FleetResult(
@@ -3216,6 +3690,7 @@ def _sample_fleet(
         slot_recycles=n_slot_recycles,
         dispatch_occupancy_trail=dispatch_occupancy_trail,
         shards=n_shards if fleet_mesh is not None else None,
+        lost_shards=lost_shard_ids,
     )
 
 

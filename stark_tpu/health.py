@@ -159,7 +159,9 @@ WARNINGS: Dict[str, Dict[str, str]] = {
         "knob": "STARK_HEALTH_IMBALANCE",
         "hint": ("one mesh shard consistently lags the median (straggler): "
                  "rebalance problems across shards or check the slow "
-                 "device; the fleet_block shard_walls trail localizes it"),
+                 "device; the fleet_block shard_walls trail localizes it, "
+                 "and STARK_SHARD_DEADLINE arms the deadman that declares "
+                 "a blown-out shard lost and re-packs the fleet around it"),
     },
 }
 

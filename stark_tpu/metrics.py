@@ -426,6 +426,17 @@ class TraceCollector:
             "fleet problems terminally quarantined past their restart "
             "budget (the fleet completes degraded around them)",
         )
+        self.fleet_shards_lost = r.counter(
+            f"{p}_fleet_shards_lost_total",
+            "mesh shards the shard deadman (STARK_SHARD_DEADLINE) "
+            "declared lost; the fleet re-packed onto the survivors",
+        )
+        self.fleet_feed_rejects = r.counter(
+            f"{p}_fleet_feed_rejects_total",
+            "FleetFeed submissions rejected by backpressure "
+            "(STARK_FEED_MAXDEPTH; producers retry after the hinted "
+            "delay)",
+        )
         self.device_idle_s = r.counter(
             f"{p}_device_idle_seconds_total",
             "estimated device idle attributed to host work between blocks",
@@ -972,6 +983,37 @@ class TraceCollector:
                 lost.append(rec["problem_id"])
             fl["last_quarantined"] = lost_rec
             fl["problems_done"] = self._fleet_problems_done_total()
+
+    def _on_shard_lost(self, rec: Dict[str, Any]) -> None:
+        """The deadman declared a mesh shard lost: the fleet is DEGRADED
+        (it no longer runs on the mesh it was asked for) but the process
+        is healthy — same /healthz policy as a quarantined problem: 200,
+        with the loss carried on /status.fleet.lost_shards."""
+        self.fleet_shards_lost.inc()
+        self.g_fleet_degraded.set(1.0)
+        if rec.get("shards_after") is not None:
+            self.g_fleet_shards.set(float(rec["shards_after"]))
+        with self._lock:
+            fl = self._status["fleet"]
+            fl["degraded"] = True
+            if rec.get("shard") is not None:
+                fl.setdefault("lost_shards", []).append(rec["shard"])
+            fl["last_shard_lost"] = {
+                k: rec[k]
+                for k in ("shard", "cause", "lanes", "problem_ids",
+                          "shards_before", "shards_after", "block")
+                if rec.get(k) is not None
+            }
+
+    def _on_feed_reject(self, rec: Dict[str, Any]) -> None:
+        """Backpressure did its job: a submission bounced off the bounded
+        feed.  Load shedding, not unhealth — RunHealth never trips."""
+        self.fleet_feed_rejects.inc()
+        with self._lock:
+            fl = self._status["fleet"]
+            fl["feed_rejects"] = int(self.fleet_feed_rejects.value())
+            if rec.get("depth") is not None:
+                fl["feed_depth_at_reject"] = rec["depth"]
 
     def _fleet_problems_done_total(self) -> int:
         """Every terminal outcome a fleet problem can reach — the ONE

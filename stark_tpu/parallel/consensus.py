@@ -227,6 +227,7 @@ def consensus_sample(
     dispatch_steps: Optional[int] = None,
     shard_restarts: int = 1,
     on_shard_failure: str = "degrade",  # "degrade" | "raise"
+    domains: Optional[Any] = None,
     **cfg_kwargs,
 ) -> Posterior:
     """Run consensus MC and return the combined Posterior.
@@ -250,6 +251,19 @@ def consensus_sample(
     into an error instead; every shard dead always raises.  Per-shard
     ``sample_stats`` (step sizes etc.) describe the first attempt; the
     draws are the authoritative post-retry state.
+
+    HIERARCHICAL FAILURE DOMAINS: pass ``domains`` (a
+    `parallel.primitives.DomainTree` whose total size equals
+    ``num_shards``, outermost level = region) to contain shard death at
+    the REGION granularity: a shard that exhausts its restarts condemns
+    its whole outermost domain — a dead device rarely dies alone; its
+    host/region's survivors hold correlated risk (stale NICs, shared
+    power), so the combine reweights over the SURVIVING REGIONS only.
+    The result additionally carries ``sample_stats["lost_regions"]``
+    (outermost-level indices), mirrored as ``chain_health``
+    ``status="region_dropped"`` events and ``lost_regions`` on
+    ``run_end``.  Without ``domains`` the flat per-shard policy above is
+    unchanged.
 
     MULTI-PROCESS (r5): with ``jax.distributed`` initialized, each host
     passes only ITS contiguous row block (``distributed.local_row_range``
@@ -284,6 +298,11 @@ def consensus_sample(
     data = prepare_model_data(model, data)
     row_axes = model.data_row_axes(data)
 
+    if domains is not None and getattr(domains, "size", None) != num_shards:
+        raise ValueError(
+            f"domains tree of size {getattr(domains, 'size', None)} must "
+            f"match num_shards={num_shards} (one leaf domain per shard)"
+        )
     multiproc = jax.process_count() > 1
     if multiproc and mesh is not None:
         raise ValueError(
@@ -485,6 +504,27 @@ def consensus_sample(
             draws_sub = np.array(draws_sub)  # first mutation: host copy
         draws_sub[idx] = new
         dead = _dead_shard_mask(draws_sub)
+    # hierarchical containment: with a ``domains`` tree, a shard that
+    # exhausted its restarts condemns its whole OUTERMOST domain — the
+    # dead mask expands to every shard in the lost region(s) before the
+    # flat drop/degrade policy below runs, so the combine reweights over
+    # surviving REGIONS (never over a lost region's nominally-alive
+    # leftovers, whose risk is correlated with the dead shard)
+    lost_regions: list = []
+    if domains is not None and dead.any():
+        region_level = domains.axis_names[0]
+        for k in np.nonzero(dead)[0].tolist():
+            r = int(domains.domain_of(k))
+            if r not in lost_regions:
+                lost_regions.append(r)
+        dead = np.array(dead)
+        for r in lost_regions:
+            dead[np.asarray(domains.ordinals_of(region_level, r),
+                            np.int64)] = True
+        log.warning(
+            "consensus: region containment — %s %s condemned (shards %s)",
+            region_level, lost_regions, np.nonzero(dead)[0].tolist(),
+        )
     lost = np.nonzero(dead)[0]
     degraded = bool(lost.size)
     if degraded:
@@ -508,6 +548,13 @@ def consensus_sample(
                 trace.tagged(shard=int(k)).emit(
                     "chain_health", status="shard_dropped",
                     shard_restarts=shard_restarts,
+                )
+            for r in lost_regions:
+                trace.emit(
+                    "chain_health", status="region_dropped",
+                    region=int(r),
+                    shards=[int(o) for o in domains.ordinals_of(
+                        domains.axis_names[0], r)],
                 )
 
     if trace.enabled:
@@ -546,6 +593,10 @@ def consensus_sample(
         "sub_draws_flat": np.asarray(draws_sub),
         "degraded": degraded,
         "lost_shards": np.asarray(lost, np.int64),
+        # region-level containment accounting rides ONLY domain-tree
+        # runs (flat consensus stats/traces stay byte-identical)
+        **({"lost_regions": np.asarray(lost_regions, np.int64)}
+           if domains is not None else {}),
     }
     if trace.enabled:
         trace.emit(
@@ -554,5 +605,7 @@ def consensus_sample(
             num_divergent=int(np.sum(np.asarray(stats_extra["num_divergent"]))),
             degraded=degraded,
             lost_shards=lost.tolist(),
+            **({"lost_regions": [int(r) for r in lost_regions]}
+               if domains is not None else {}),
         )
     return Posterior(draws, stats, flat_model=fm, draws_flat=np.asarray(combined))

@@ -30,6 +30,24 @@ Single-device, single-host behavior is bit-identical to the hand-rolled
 code it replaced: `map_shards(fn, mesh=None)` is literally ``jax.jit(fn)``
 and the placement helpers degrade to ``device_put``/``np.asarray``.
 
+**Hierarchical failure domains (PR 17).**  `DomainTree` describes the
+physical placement hierarchy as an ordered axis tree — e.g. ``(region,
+host, device)`` — and builds the matching multi-axis mesh.  The
+primitives compose over it: `reduce_tree` accepts a SEQUENCE of axis
+names and reduces level by level (innermost first), emitting one
+comm event per level so wire bytes are accounted PER DOMAIN (the
+device-level reduce never leaves its region; only the region-level
+reduce crosses the expensive boundary), and `shard_put(..., home=)`
+pins process-local data to its home slice of one domain axis instead
+of striping it across the whole mesh.  A domain is thereby a unit of
+failure the layers above can reason about: consensus drops a whole
+region when any shard in it dies (`parallel/consensus.py`
+``domains=``), and the mesh fleet re-packs survivors onto a shrunk
+mesh (`stark_tpu/fleet.py`, ``STARK_SHARD_DEADLINE``).  The
+``primitives.collective_stall`` failpoint drills a hung collective
+deterministically at the two host-blocking dispatch sites
+(`gather_tree` and the on-mesh `map_shards` dispatch).
+
 **Communication observatory (PR 16).**  Because every collective in the
 repo routes through this one module (tools/lint_collectives.py enforces
 it), instrumenting HERE accounts for all of them with zero call-site
@@ -59,6 +77,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import faults
 from ..compat import shard_map
 
 PyTree = Any
@@ -149,6 +168,93 @@ def axis_size(mesh: Optional[Mesh], axis: str) -> int:
     return int(mesh.shape[axis])
 
 
+class DomainTree:
+    """Hierarchical failure-domain placement: an ordered axis tree.
+
+    ``levels`` is a sequence of ``(name, size)`` pairs, OUTERMOST first —
+    e.g. ``[("region", 2), ("device", 4)]`` describes 2 regions of 4
+    devices.  The tree is pure placement metadata: `mesh()` realizes it
+    as a multi-axis `jax.sharding.Mesh` (row-major over the levels, so a
+    flat device ordinal's outermost coordinate IS its region), and the
+    coordinate helpers answer "which domain does shard ``k`` live in" —
+    the question every containment policy above this layer asks
+    (consensus drops the whole region of a dead shard; the fleet's
+    degraded re-shard excludes a lost domain's devices).
+
+    Composition contract: ``reduce_tree(x, axis=tree.axis_names)``
+    reduces level by level, innermost first, so the per-level comm
+    events carry per-domain participant counts — wire bytes within a
+    region and across regions are accounted separately.
+    """
+
+    def __init__(self, levels: Sequence[Tuple[str, int]]):
+        levels = [(str(n), int(s)) for n, s in levels]
+        if not levels:
+            raise ValueError("DomainTree needs at least one level")
+        names = [n for n, _ in levels]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate level names in {names}")
+        for n, s in levels:
+            if s < 1:
+                raise ValueError(f"level {n!r} must have size >= 1, got {s}")
+        self.levels: Tuple[Tuple[str, int], ...] = tuple(levels)
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.levels)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(s for _, s in self.levels)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def coords_of(self, ordinal: int) -> Tuple[int, ...]:
+        """Per-level coordinates of a flat (row-major) device ordinal."""
+        if not 0 <= int(ordinal) < self.size:
+            raise ValueError(f"ordinal {ordinal} outside tree of {self.size}")
+        out, rem = [], int(ordinal)
+        for s in reversed(self.shape):
+            out.append(rem % s)
+            rem //= s
+        return tuple(reversed(out))
+
+    def domain_of(self, ordinal: int, level: Optional[str] = None) -> int:
+        """The coordinate of ``ordinal`` at ``level`` (default: the
+        OUTERMOST level — its region)."""
+        names = self.axis_names
+        k = names.index(str(level)) if level is not None else 0
+        return self.coords_of(ordinal)[k]
+
+    def ordinals_of(self, level: str, index: int) -> Tuple[int, ...]:
+        """Every flat device ordinal whose ``level`` coordinate is
+        ``index`` — the membership of one failure domain."""
+        k = self.axis_names.index(str(level))
+        return tuple(
+            o for o in range(self.size) if self.coords_of(o)[k] == int(index)
+        )
+
+    def mesh(self, devices: Optional[Sequence[Any]] = None) -> Mesh:
+        """Realize the tree as a multi-axis mesh over ``devices`` (default
+        ``jax.devices()``), row-major: consecutive ordinals share the
+        innermost domains first, so one region is a contiguous device
+        range — the contiguity the fleet's shard->device mapping and
+        `shard_put(home=)` pinning both rely on."""
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if len(devs) < self.size:
+            raise ValueError(
+                f"DomainTree of size {self.size} needs {self.size} devices, "
+                f"have {len(devs)}"
+            )
+        arr = np.asarray(devs[: self.size], dtype=object).reshape(self.shape)
+        return Mesh(arr, self.axis_names)
+
+
 def map_shards(
     fn,
     *,
@@ -232,6 +338,9 @@ def map_shards(
         participants = int(mesh.size)
 
     def _dispatch(*args):
+        # deterministic hung-collective drill (watchdog / shard-deadman
+        # chaos): a zero-cost no-op unless the site is armed
+        faults.fail_point("primitives.collective_stall")
         # payload BEFORE the call: donated argument buffers are deleted
         # by the dispatch (metadata would survive, but don't rely on it)
         payload = predict_tree_bytes(args)
@@ -261,11 +370,21 @@ def mapped_axis_size(axis: Optional[str]):
     return lax.psum(1, axis)
 
 
-def reduce_tree(tree: PyTree, axis: Optional[str] = None, op: str = "sum"):
+def reduce_tree(tree: PyTree, axis=None, op: str = "sum"):
     """The reduce primitive, for use INSIDE a mapped function: combine
     every shard's value over the named mesh axis (``psum``/``pmax``/
     ``pmin``).  ``axis=None`` is the single-shard identity, so shared
     likelihood/statistics code runs unchanged under both layouts.
+
+    ``axis`` may also be a SEQUENCE of axis names — a `DomainTree`
+    hierarchy — in which case the reduction composes level by level,
+    INNERMOST (last) first: a ``("region", "device")`` reduce runs the
+    device-level collective inside each region, then the region-level
+    collective across regions.  The result equals the flat reduce over
+    all named axes (the ops are associative and commutative), but each
+    level emits its OWN comm event with that level's participant count,
+    so wire bytes within a domain and across domains are accounted
+    separately.
 
     Comm-accounted at TRACE time (the call runs while the enclosing jit
     traces, once per compiled instantiation): wire bytes = leaf payload
@@ -278,19 +397,23 @@ def reduce_tree(tree: PyTree, axis: Optional[str] = None, op: str = "sum"):
     from jax import lax
 
     fn = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin}[op]
-    if not comm_telemetry_enabled():
-        return jax.tree.map(lambda x: fn(x, axis), tree)
-    t0 = time.perf_counter()
-    out = jax.tree.map(lambda x: fn(x, axis), tree)
-    payload = predict_tree_bytes(tree)
-    _record_comm(
-        "reduce_tree", site=_caller_site(), axis=axis,
-        participants=_static_axis_count(axis),
-        payload_bytes=payload,
-        wire_bytes=payload * _static_axis_count(axis),
-        host_blocked_s=time.perf_counter() - t0,
-    )
-    return out
+    levels = list(axis) if isinstance(axis, (tuple, list)) else [axis]
+    site = _caller_site()
+    for ax in reversed(levels):
+        if not comm_telemetry_enabled():
+            tree = jax.tree.map(lambda x, a=ax: fn(x, a), tree)
+            continue
+        payload = predict_tree_bytes(tree)
+        t0 = time.perf_counter()
+        tree = jax.tree.map(lambda x, a=ax: fn(x, a), tree)
+        _record_comm(
+            "reduce_tree", site=site, axis=ax,
+            participants=_static_axis_count(ax),
+            payload_bytes=payload,
+            wire_bytes=payload * _static_axis_count(ax),
+            host_blocked_s=time.perf_counter() - t0,
+        )
+    return tree
 
 
 def gather_axis(x: PyTree, axis: str, *, tiled: bool = False) -> PyTree:
@@ -364,6 +487,7 @@ def shard_put(
     *,
     process_local: bool = False,
     from_host_replica: bool = False,
+    home: Optional[Tuple[str, int]] = None,
 ) -> PyTree:
     """Place a pytree along per-leaf PartitionSpecs (``specs`` may be a
     single spec applied to every leaf, or a spec pytree).  No mesh: the
@@ -375,11 +499,22 @@ def shard_put(
       full host value (same-seed host computation) and contributes just
       its addressable shards (``make_array_from_callback``).
 
+    ``home=(axis_name, index)`` PINS the placement to one failure
+    domain: the value lands only on the sub-mesh slice at ``index``
+    along the named `DomainTree` axis (e.g. ``("region", 0)`` keeps a
+    region's process-local rows inside their home region instead of
+    striping them across the whole mesh — a region loss then costs only
+    that region's tenants).  ``specs`` must then partition over the
+    REMAINING axes only, and the comm event's participant count is the
+    sub-mesh's device count.
+
     Comm-accounted per call on a mesh (wire bytes = the full payload —
     each byte is placed once; per-participant payload = payload /
     devices); the identity path emits nothing."""
     if mesh is None:
         return tree
+    if home is not None:
+        mesh = _home_submesh(mesh, home)
     if isinstance(specs, P):
         specs = jax.tree.map(lambda _: specs, tree)
     if not comm_telemetry_enabled():
@@ -402,6 +537,29 @@ def shard_put(
         host_blocked_s=time.perf_counter() - t0,
     )
     return out
+
+
+def _home_submesh(mesh: Mesh, home: Tuple[str, int]) -> Mesh:
+    """The sub-mesh slice at ``home=(axis_name, index)`` — the home
+    failure domain of a `shard_put` pinning.  The home axis is consumed
+    (the slice is one coordinate thick), so the mesh must keep at least
+    one other axis to partition over."""
+    ax, idx = home
+    names = list(mesh.axis_names)
+    if ax not in names:
+        raise ValueError(f"mesh {tuple(names)} has no {ax!r} axis to pin to")
+    if len(names) < 2:
+        raise ValueError(
+            "home pinning needs at least one non-home mesh axis "
+            f"(mesh has only {tuple(names)})"
+        )
+    k = names.index(ax)
+    n = int(mesh.shape[ax])
+    idx = int(idx)
+    if not 0 <= idx < n:
+        raise ValueError(f"home index {idx} outside axis {ax!r} of size {n}")
+    sub = np.take(np.asarray(mesh.devices), idx, axis=k)
+    return Mesh(sub, tuple(nm for nm in names if nm != ax))
 
 
 def _shard_put_impl(
@@ -457,6 +615,9 @@ def gather_tree(tree: PyTree, *, tiled: bool = True) -> PyTree:
     = payload x process count (every host receives the full value;
     single-process this is the device->host readback, and the
     host-blocked wall is the readback wall every block pays)."""
+    # the other host-blocking collective dispatch the stall drill covers
+    # (armed via STARK_FAILPOINTS; independent of the telemetry knob)
+    faults.fail_point("primitives.collective_stall")
     if not comm_telemetry_enabled():
         return _gather_tree_impl(tree, tiled=tiled)
     t0 = time.perf_counter()

@@ -25,8 +25,13 @@ Endpoints:
     degradation is surfaced in ``/status``'s ``fleet`` sub-object
     (``degraded``, ``lost_problems``, ``last_quarantined``) and the
     ``*_fleet_degraded`` / ``*_fleet_problems_quarantined_total``
-    metrics; 503 stays reserved for process-level unhealth (stall,
-    restart in progress, restart budget exhausted).
+    metrics.  The same policy covers MESH loss: a fleet whose shard
+    deadman (``STARK_SHARD_DEADLINE``) declared shards lost re-packed
+    onto the survivors and kept serving — /healthz stays 200 and
+    ``/status``'s ``fleet`` carries ``lost_shards`` /
+    ``last_shard_lost`` (plus ``*_fleet_shards_lost_total``); 503 stays
+    reserved for process-level unhealth (stall, restart in progress,
+    restart budget exhausted).
   * ``GET /status``   — JSON snapshot: ``schema`` (contract version —
     `metrics.STATUS_SCHEMA`; consumers key on it before trusting the
     shape), ``uptime_s`` (exporter uptime), current phase, block index,

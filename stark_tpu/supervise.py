@@ -25,6 +25,11 @@ carries the class):
     supervisor restarts from the last checkpoint.
   * ``restart_budget_exhausted`` — the restart-rate window overflowed; the
     final fault is re-raised to the caller.
+  * ``shard_lost``          — fleet-only (stark_tpu.fleet): the mesh shard
+    a problem's lane lived on was declared dead by the shard deadman
+    (``STARK_SHARD_DEADLINE``); the victim cold-restarts against its
+    EXISTING per-problem budget on the shrunk mesh, and past the budget
+    quarantines terminally as ``failed:shard_lost``.
 
 Restart discipline: failures are recorded in a sliding `RestartBudget`
 (``max_restarts`` within ``restart_window_s``; an infinite window — the

@@ -113,11 +113,24 @@ AUX_EVENT_TYPES = frozenset({"progress", "adapt", "budget", "collect",
 #: batch lane was handed to a queued problem IN PLACE (the slot-scheduler
 #: or legacy top-up admission path — the compiled batch shape never
 #: changes); ``problem_admitted`` — a queued problem entered the batch
-#: through an in-place admission (slot/queue-depth/warm-start accounting)
+#: through an in-place admission (slot/queue-depth/warm-start accounting);
+#: ``shard_lost`` — the mesh fleet's shard deadman (STARK_SHARD_DEADLINE)
+#: declared one mesh shard a unit of failure: every active lane on it
+#: returned non-finite, or its block wall blew the deadline ratio over
+#: the surviving-shard median — with ``shard`` (the lost ordinal),
+#: ``cause`` ("nonfinite" or "wall"), ``lanes`` (the tenant lanes it
+#: carried), ``shards_before``/``shards_after`` (the degraded re-shard),
+#: and the affected ``problem_ids``; the survivors re-pack onto the
+#: shrunk mesh and the victims cold-restart against their existing
+#: budgets; ``feed_reject`` — a `FleetFeed.submit` was refused by the
+#: bounded-depth backpressure gate (STARK_FEED_MAXDEPTH), with ``depth``
+#: / ``maxdepth`` / ``retry_after_s`` (the structured reject the
+#: producer got)
 FLEET_EVENT_TYPES = frozenset({"fleet_block", "problem_converged",
                                "fleet_compact", "problem_reseeded",
                                "problem_quarantined", "slot_recycled",
-                               "problem_admitted"})
+                               "problem_admitted", "shard_lost",
+                               "feed_reject"})
 
 #: profiling event types (stark_tpu.profiling): ``span`` — one
 #: attributed slice of the run timeline (``kind`` in
@@ -488,6 +501,40 @@ def notify_progress() -> None:
             fn()
         except Exception:  # noqa: BLE001 — liveness must not fault the run
             pass
+
+
+#: WHAT the run is waiting on right now — context the watchdog stamps on
+#: its stall event (a stall that names the hung shard is actionable; one
+#: that doesn't is a shrug).  A plain dict swapped atomically: the host
+#: driver writes, the watchdog thread reads a snapshot.
+_PROGRESS_CONTEXT: Dict[str, Any] = {}
+
+
+def set_progress_context(**fields: Any) -> None:
+    """Annotate the current wait (e.g. ``waiting_on_shards=[2]``) so a
+    stall fired DURING it carries the culprit.  Overwrites per key; the
+    driver clears with `clear_progress_context` once the wait returns."""
+    global _PROGRESS_CONTEXT
+    ctx = dict(_PROGRESS_CONTEXT)
+    ctx.update(fields)
+    _PROGRESS_CONTEXT = ctx
+
+
+def clear_progress_context(*keys: str) -> None:
+    """Drop the named context keys (no args: drop everything)."""
+    global _PROGRESS_CONTEXT
+    if not keys:
+        _PROGRESS_CONTEXT = {}
+        return
+    _PROGRESS_CONTEXT = {
+        k: v for k, v in _PROGRESS_CONTEXT.items() if k not in keys
+    }
+
+
+def progress_context() -> Dict[str, Any]:
+    """Snapshot of the current wait annotations (watchdog-thread safe:
+    the dict is replaced, never mutated in place)."""
+    return dict(_PROGRESS_CONTEXT)
 
 
 def get_trace():
@@ -1012,6 +1059,7 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
                    "problems_budget_exhausted", "problems_quarantined",
                    "lane_reseeds", "degraded",
                    "lost_problems",
+                   "lost_shards", "feed_rejects",
                    "compactions",
                    "admissions", "slot_recycles", "queue_depth_last",
                    "warmstarted",
@@ -1205,6 +1253,12 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
             fleet.setdefault("lost_problems", []).append(
                 e.get("problem_id")
             )
+        elif ev == "shard_lost":
+            # the mesh fleet's shard deadman fired (PR 17): absent (not
+            # []) on traces that never lost a shard
+            fleet.setdefault("lost_shards", []).append(e.get("shard"))
+        elif ev == "feed_reject":
+            fleet["feed_rejects"] = fleet.get("feed_rejects", 0) + 1
         elif ev == "fleet_compact":
             fleet["compactions"] = fleet.get("compactions", 0) + 1
             if e.get("pending") is not None:
@@ -1228,6 +1282,8 @@ def summarize_trace(events: List[Dict[str, Any]], run: Optional[int] = None
             fleet or e.get("problems") is not None
         ):
             fleet["degraded"] = bool(e["degraded"])
+            if e.get("lost_shards"):
+                fleet["lost_shards"] = list(e["lost_shards"])
             if e.get("problems") is not None:
                 # the FINAL problem count: a streamed (FleetFeed) run
                 # ends with more problems than run_start announced

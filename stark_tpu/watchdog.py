@@ -196,7 +196,15 @@ class Watchdog:
             # the stall IS the forensic moment: emit the stall event and
             # dump the postmortem bundle before firing the abort (the
             # workdir was set by whoever supervises this run; no
-            # workdir → recorded only)
+            # workdir → recorded only).  The progress context names WHAT
+            # the run was waiting on (e.g. the mesh fleet's
+            # waiting_on_shards) so the stall and its postmortem carry
+            # the culprit, not just the silence.
+            ctx = {
+                k: v for k, v in telemetry.progress_context().items()
+                if k not in ("status", "label", "deadline_s", "idle_s",
+                             "stall_count")
+            }
             telemetry.flight_recorder().record_anomaly(
                 "stall",
                 self._trace,
@@ -206,6 +214,7 @@ class Watchdog:
                 deadline_s=self.deadline_s,
                 idle_s=round(idle, 3),
                 stall_count=self.stall_count,
+                **ctx,
             )
             try:
                 if self.on_stall is not None:

@@ -26,7 +26,11 @@ def _clean_faults():
 # fleet_stall_watchdog rides slow with its single-run twin (real stall +
 # watchdog deadline); the other fleet scenarios are sub-second once the
 # first has paid the shared fleet compile
-_SLOW = {"stall_watchdog", "shard_death_recovered", "fleet_stall_watchdog"}
+_SLOW = {"stall_watchdog", "shard_death_recovered", "fleet_stall_watchdog",
+         # the shard-death drill pays an uninjected reference fleet PLUS
+         # the 4-shard mesh + post-loss 3-shard re-specializations; the
+         # consensus region drill pays a full consensus run + retry
+         "fleet_shard_lost_degraded", "fleet_region_lost_consensus"}
 
 
 # every scenario is its own test so a matrix regression names the exact

@@ -1,0 +1,426 @@
+"""Elastic mesh fault domains (PR 17): shard-loss classification,
+degraded re-sharding budgets, hierarchical (region, host, device)
+placement, and the capability/backpressure boundaries around them.
+
+The contracts under test:
+
+* `_classify_lost_shards` — the shard deadman's PURE classifier: a
+  shard is lost when every ACTIVE lane it carries fails the finite scan
+  (``nonfinite``) or its block wall blows past
+  ``STARK_SHARD_DEADLINE`` x the surviving-shard median AND the
+  absolute floor (``wall``); a shard with no active lanes is never
+  classified.
+* **Knob resolution** — ``STARK_SHARD_DEADLINE`` and
+  ``STARK_FEED_MAXDEPTH`` follow the repo-wide env conventions
+  (unset/""/"0" = off, junk warns and disables, sub-1 deadline ratios
+  clamp to 1).
+* `DomainTree` — the axis-tree is row-major placement metadata:
+  coordinates, domain membership, mesh realization, and the
+  hierarchical `reduce_tree` / `shard_put(home=)` compositions on top.
+* **RestartBudget x shard loss** — a lost shard's victims burn the
+  EXISTING `ProblemBudget`, re-placement grants nothing fresh, and
+  per-problem deadlines stay enforced in the degraded fleet.
+* `CapabilityError` / `FeedRejected` — the structured boundary
+  exceptions carry the knob/fallback and depth/retry-after their
+  callers branch on.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from stark_tpu import faults, telemetry
+from stark_tpu.fleet import (
+    CapabilityError,
+    FeedRejected,
+    FleetFeed,
+    FleetSpec,
+    ProblemBudget,
+    _classify_lost_shards,
+    _resolve_feed_maxdepth,
+    _resolve_shard_deadline,
+    sample_fleet,
+)
+from stark_tpu.models.eight_schools import SIGMA, Y, EightSchools
+from stark_tpu.parallel.mesh import make_mesh
+from stark_tpu.parallel.primitives import (
+    DomainTree,
+    gather_tree,
+    map_shards,
+    reduce_tree,
+    shard_put,
+)
+from stark_tpu.telemetry import RunTrace, read_trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# _classify_lost_shards: the deadman's pure classifier
+
+
+def _classify(**kw):
+    base = dict(
+        n_shards=4, lanes_per=1, active_js=[0, 1, 2, 3],
+        poisoned_js=set(), shard_walls=None, deadline_ratio=4.0,
+    )
+    base.update(kw)
+    return _classify_lost_shards(**base)
+
+
+def test_classify_all_lanes_nonfinite_is_shard_death():
+    assert _classify(poisoned_js={1}) == {1: "nonfinite"}
+
+
+def test_classify_partial_poison_is_a_lane_fault_not_shard_death():
+    """One poisoned lane on a multi-lane shard is PR 9 containment —
+    the shard is only condemned when EVERY active lane fails."""
+    kw = dict(n_shards=4, lanes_per=2, active_js=list(range(8)))
+    assert _classify(poisoned_js={2}, **kw) == {}
+    assert _classify(poisoned_js={2, 3}, **kw) == {1: "nonfinite"}
+
+
+def test_classify_inactive_shard_never_classified():
+    """No active lanes = no evidence and no victims: even a blown wall
+    cannot condemn an empty shard."""
+    lost = _classify(
+        active_js=[0, 1, 2],
+        shard_walls=[0.3, 0.3, 0.3, 30.0],
+    )
+    assert lost == {}
+
+
+def test_classify_wall_blowout_over_median():
+    lost = _classify(shard_walls=[0.3, 0.31, 0.29, 2.0])
+    assert lost == {3: "wall"}
+
+
+def test_classify_wall_floor_suppresses_microsecond_jitter():
+    """Tiny blocks jitter by scheduler noise; the absolute floor keeps
+    a 5ms 'blowout' from faking a death."""
+    assert _classify(shard_walls=[1e-4, 1e-4, 1e-4, 5e-3]) == {}
+
+
+def test_classify_wall_median_excludes_already_lost_shards():
+    """A nonfinite-dead shard's wall is not part of the survivor median
+    the ratio is taken against."""
+    lost = _classify(poisoned_js={0}, shard_walls=[9.0, 0.3, 0.3, 2.0])
+    assert lost == {0: "nonfinite", 3: "wall"}
+
+
+def test_classify_nonfinite_wins_over_wall():
+    lost = _classify(poisoned_js={3}, shard_walls=[0.3, 0.3, 0.3, 2.0])
+    assert lost == {3: "nonfinite"}
+
+
+def test_classify_every_shard_lost_is_still_reported():
+    """The classifier just reports; treating all-lost as a BATCH fault
+    is the caller's job."""
+    lost = _classify(poisoned_js={0, 1, 2, 3})
+    assert lost == {k: "nonfinite" for k in range(4)}
+
+
+# ---------------------------------------------------------------------------
+# knob resolution: STARK_SHARD_DEADLINE / STARK_FEED_MAXDEPTH
+
+
+@pytest.mark.parametrize("raw, want", [
+    (None, None), ("", None), ("0", None), ("junk", None), ("-3", None),
+    ("0.5", 1.0),  # sub-1 would declare the MEDIAN dead: clamps to 1
+    ("4", 4.0),
+])
+def test_resolve_shard_deadline(monkeypatch, raw, want):
+    if raw is None:
+        monkeypatch.delenv("STARK_SHARD_DEADLINE", raising=False)
+    else:
+        monkeypatch.setenv("STARK_SHARD_DEADLINE", raw)
+    assert _resolve_shard_deadline() == want
+
+
+@pytest.mark.parametrize("raw, want", [
+    (None, None), ("", None), ("0", None), ("junk", None), ("-1", None),
+    ("8", 8),
+])
+def test_resolve_feed_maxdepth(monkeypatch, raw, want):
+    if raw is None:
+        monkeypatch.delenv("STARK_FEED_MAXDEPTH", raising=False)
+    else:
+        monkeypatch.setenv("STARK_FEED_MAXDEPTH", raw)
+    assert _resolve_feed_maxdepth() == want
+
+
+# ---------------------------------------------------------------------------
+# DomainTree: hierarchical placement metadata
+
+
+def test_domain_tree_coords_row_major():
+    tree = DomainTree([("region", 2), ("host", 2), ("device", 2)])
+    assert tree.axis_names == ("region", "host", "device")
+    assert tree.shape == (2, 2, 2)
+    assert tree.size == 8
+    assert tree.coords_of(0) == (0, 0, 0)
+    assert tree.coords_of(5) == (1, 0, 1)
+    assert tree.coords_of(7) == (1, 1, 1)
+
+
+def test_domain_tree_domain_of_defaults_to_outermost():
+    tree = DomainTree([("region", 2), ("device", 4)])
+    assert tree.domain_of(5) == 1
+    assert tree.domain_of(5, level="device") == 1
+    assert tree.domain_of(3, level="region") == 0
+
+
+def test_domain_tree_ordinals_of_is_contiguous_membership():
+    """Row-major means one region is a contiguous device range — the
+    contiguity the fleet's shard->device mapping relies on."""
+    tree = DomainTree([("region", 2), ("device", 4)])
+    assert tree.ordinals_of("region", 0) == (0, 1, 2, 3)
+    assert tree.ordinals_of("region", 1) == (4, 5, 6, 7)
+    assert tree.ordinals_of("device", 2) == (2, 6)
+
+
+def test_domain_tree_validation():
+    with pytest.raises(ValueError, match="at least one level"):
+        DomainTree([])
+    with pytest.raises(ValueError, match="duplicate"):
+        DomainTree([("region", 2), ("region", 2)])
+    with pytest.raises(ValueError, match="size >= 1"):
+        DomainTree([("region", 0)])
+    tree = DomainTree([("region", 2), ("device", 2)])
+    with pytest.raises(ValueError, match="outside tree"):
+        tree.coords_of(4)
+
+
+def _domain_mesh(tree):
+    if len(jax.devices()) < tree.size:
+        pytest.skip(f"needs {tree.size} devices (conftest forces 8)")
+    return tree.mesh(jax.devices()[: tree.size])
+
+
+def test_domain_tree_mesh_realization():
+    tree = DomainTree([("region", 2), ("device", 2)])
+    mesh = _domain_mesh(tree)
+    assert mesh.axis_names == ("region", "device")
+    assert dict(mesh.shape) == {"region": 2, "device": 2}
+    # row-major: region 1's mesh row IS ordinals_of("region", 1)
+    devs = np.asarray(mesh.devices)
+    assert [d.id for d in devs[1]] == [
+        jax.devices()[o].id for o in tree.ordinals_of("region", 1)
+    ]
+    with pytest.raises(ValueError, match="needs 4 devices"):
+        tree.mesh(jax.devices()[:2])
+
+
+def test_hierarchical_reduce_matches_flat_reduce():
+    """reduce_tree over the tree's axis names (innermost first) equals
+    the global sum — the per-level composition is algebraically free."""
+    tree = DomainTree([("region", 2), ("device", 2)])
+    mesh = _domain_mesh(tree)
+    x = jnp.arange(8.0)
+
+    def f(x):
+        return reduce_tree(jnp.sum(x), axis=tree.axis_names)
+
+    out = map_shards(
+        f, mesh=mesh, in_specs=(P(("region", "device")),), out_specs=P()
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), 28.0)
+
+
+def test_shard_put_home_pins_to_one_region():
+    tree = DomainTree([("region", 2), ("device", 2)])
+    mesh = _domain_mesh(tree)
+    x = np.arange(4.0, dtype=np.float32)
+    out = shard_put(x, mesh, P("device"), home=("region", 1))
+    np.testing.assert_array_equal(np.asarray(out), x)
+    home_devs = {jax.devices()[o].id for o in tree.ordinals_of("region", 1)}
+    assert {d.id for d in out.devices()} <= home_devs
+
+
+def test_shard_put_home_validation():
+    tree = DomainTree([("region", 2), ("device", 2)])
+    mesh = _domain_mesh(tree)
+    with pytest.raises(ValueError, match="no 'rack' axis"):
+        shard_put(np.ones(4), mesh, P("device"), home=("rack", 0))
+    with pytest.raises(ValueError, match="outside axis"):
+        shard_put(np.ones(4), mesh, P("device"), home=("region", 5))
+    flat = make_mesh({"problems": 2}, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="non-home mesh axis"):
+        shard_put(np.ones(4), flat, P(), home=("problems", 0))
+
+
+# ---------------------------------------------------------------------------
+# structured boundaries: CapabilityError / FeedRejected
+
+
+def test_multiprocess_fleet_raises_capability_error(monkeypatch):
+    """The multi-process boundary names the knob and the supported way
+    down instead of a bare exception."""
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    spec = FleetSpec.from_problems(
+        EightSchools(),
+        [{"y": np.asarray(Y), "sigma": np.asarray(SIGMA)}],
+    )
+    with pytest.raises(CapabilityError) as ei:
+        sample_fleet(spec, chains=2, num_warmup=10, block_size=10)
+    err = ei.value
+    assert err.knob == "mesh=/STARK_FLEET_MESH"
+    assert "STARK_FLEET=0" in err.fallback
+    assert "knob:" in str(err) and "supported fallback:" in str(err)
+    assert isinstance(err, NotImplementedError)
+
+
+def test_feed_backpressure_rejects_with_retry_hint(tmp_path):
+    feed = FleetFeed(maxdepth=2)
+    feed.submit({"x": 1.0})
+    feed.submit({"x": 2.0})
+    with pytest.raises(FeedRejected) as ei:
+        feed.submit({"x": 3.0})
+    err = ei.value
+    assert err.depth == 2 and err.maxdepth == 2
+    assert err.retry_after_s > 0
+    assert "STARK_FEED_MAXDEPTH" in str(err)
+    assert feed.rejects == 1
+    # a reject consumes nothing: drain frees the slot, retry succeeds
+    assert len(feed.drain()) == 2
+    feed.submit({"x": 3.0})
+    assert feed.rejects == 1
+
+
+def test_feed_reject_emits_trace_event(tmp_path):
+    path = str(tmp_path / "feed.jsonl")
+    feed = FleetFeed(maxdepth=1)
+    with RunTrace(path) as tr:
+        feed._trace = tr  # the fleet binds its trace the same way
+        feed.submit({"x": 1.0})
+        with pytest.raises(FeedRejected):
+            feed.submit({"x": 2.0})
+    evs = [e for e in read_trace(path) if e["event"] == "feed_reject"]
+    assert len(evs) == 1
+    assert evs[0]["depth"] == 1 and evs[0]["maxdepth"] == 1
+    assert evs[0]["rejects"] == 1 and evs[0]["retry_after_s"] > 0
+
+
+def test_feed_requeue_is_exempt_from_backpressure():
+    """Crash-recovery reinsertion of already-admitted items must never
+    bounce — only NEW submissions feel the depth bound."""
+    feed = FleetFeed(maxdepth=1)
+    pid = feed.submit({"x": 1.0})
+    items = feed.drain()
+    feed.requeue(items + [("extra", {"x": 2.0}, None)])
+    with pytest.raises(FeedRejected):
+        feed.submit({"x": 3.0})
+    drained = feed.drain()
+    assert [p for p, _, _ in drained] == [pid, "extra"]
+
+
+def test_feed_maxdepth_env_knob(monkeypatch):
+    monkeypatch.setenv("STARK_FEED_MAXDEPTH", "1")
+    assert FleetFeed().maxdepth == 1
+    # an explicit argument beats the environment
+    assert FleetFeed(maxdepth=3).maxdepth == 3
+    monkeypatch.setenv("STARK_FEED_MAXDEPTH", "0")
+    assert FleetFeed().maxdepth is None
+
+
+# ---------------------------------------------------------------------------
+# failpoint + watchdog plumbing
+
+
+def test_collective_stall_failpoint_fires_at_dispatch():
+    faults.configure("primitives.collective_stall=sleep(0.01)*1")
+    gather_tree({"x": np.ones(3, np.float32)})
+    rec = faults.fired()
+    assert [f["site"] for f in rec] == ["primitives.collective_stall"]
+
+
+def test_progress_context_round_trip():
+    telemetry.clear_progress_context()
+    try:
+        telemetry.set_progress_context(block=3, waiting_on="dispatch")
+        assert telemetry.progress_context() == {
+            "block": 3, "waiting_on": "dispatch",
+        }
+        telemetry.set_progress_context(block=4)
+        assert telemetry.progress_context()["block"] == 4
+        telemetry.clear_progress_context("waiting_on")
+        assert telemetry.progress_context() == {"block": 4}
+    finally:
+        telemetry.clear_progress_context()
+    assert telemetry.progress_context() == {}
+
+
+# ---------------------------------------------------------------------------
+# RestartBudget x shard loss (the degraded-fleet budget contract)
+
+
+def _fleet_spec(n, budgets=None):
+    rng = np.random.default_rng(0)
+    y, sig = np.asarray(Y), np.asarray(SIGMA)
+    datasets = [
+        {"y": (y + rng.normal(0, 2.0, y.shape)).astype(np.float32),
+         "sigma": sig}
+        for _ in range(n)
+    ]
+    return FleetSpec.from_problems(EightSchools(), datasets, budgets=budgets)
+
+
+_FLEET_KW = dict(
+    chains=2, block_size=25, max_blocks=8, min_blocks=2, num_warmup=100,
+    ess_target=40.0, rhat_target=1.3, seed=0, kernel="hmc",
+    num_leapfrog=12, health_check=True,
+)
+
+
+@pytest.mark.slow
+def test_shard_loss_burns_existing_budget_no_fresh_grant(tmp_path,
+                                                         monkeypatch):
+    """A lost shard's victim is re-placed against its EXISTING
+    `ProblemBudget`: max_restarts=0 means the loss quarantines it
+    immediately (``failed:shard_lost``, zero lane restarts) — degraded
+    re-sharding grants no fresh budget.  A per-problem deadline on a
+    neighbor stays enforced in the same degraded run (the cumulative
+    sampling wall carries — no new window)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices (conftest forces 8)")
+    budgets = [
+        None,
+        ProblemBudget(max_restarts=0),    # the victim: no reseeds left
+        None,
+        ProblemBudget(deadline_s=0.01),   # survivor with a blown deadline
+    ]
+    spec = _fleet_spec(4, budgets=budgets)
+    mesh = make_mesh({"problems": 4}, devices=jax.devices()[:4])
+    monkeypatch.setenv("STARK_SHARD_DEADLINE", "4")
+    faults.configure("fleet.shard_dead=kill(1)*1@1")
+    res = sample_fleet(
+        spec, mesh=mesh, problem_max_restarts=1,
+        trace=RunTrace(str(tmp_path / "t.jsonl")), **_FLEET_KW,
+    )
+    assert res.degraded is True
+    assert res.lost_shards == [1]
+    assert res.shards == 3
+    victim = res.problems[1]
+    assert victim.status == "failed:shard_lost"
+    # no fresh grant: the loss itself blew the zero budget — the trace
+    # shows a quarantine under fault=shard_lost and NO reseed ever ran
+    evs = read_trace(str(tmp_path / "t.jsonl"))
+    reseeds = [e for e in evs if e["event"] == "problem_reseeded"
+               and e["problem_id"] == victim.problem_id]
+    assert reseeds == [], "re-placement must not grant a fresh budget"
+    quar = [e for e in evs if e["event"] == "problem_quarantined"
+            and e["problem_id"] == victim.problem_id]
+    assert len(quar) == 1 and quar[0]["fault"] == "shard_lost"
+    assert quar[0]["max_restarts"] == 0
+    assert res.problems[3].status == "budget_exhausted"
+    for i in (0, 2):
+        assert res.problems[i].status == "converged", res.problems[i].status

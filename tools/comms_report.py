@@ -129,6 +129,14 @@ def comms_rollup(events, run):
         if e.get("event") == "health_warning"
         and e.get("warning") == "mesh_imbalance"
     ]
+    # elastic fault domains (PR 17): shards the deadman declared lost
+    # mid-run — the wall trail above covers the mesh AS DISPATCHED, so a
+    # loss event is the reader's cue that the shard axis shrank
+    lost = [
+        {k: e.get(k) for k in ("block", "shard", "cause",
+                               "shards_before", "shards_after")}
+        for e in evs if e.get("event") == "shard_lost"
+    ]
 
     summary = summarize_trace(events, run=run)
     return {
@@ -141,6 +149,7 @@ def comms_rollup(events, run):
             {k: e.get(k) for k in ("block", "shard", "value", "threshold")}
             for e in imbalance
         ],
+        "lost_shards": lost,
     }
 
 
@@ -217,6 +226,16 @@ def render_run(events, run) -> str:
         ]
         out.append(_table(
             rows, ("block", "straggler shard", "ratio", "threshold")
+        ))
+        out.append("")
+    if r.get("lost_shards"):
+        rows = [
+            (w.get("block"), w.get("shard"), w.get("cause"),
+             f"{w.get('shards_before')} -> {w.get('shards_after')}")
+            for w in r["lost_shards"]
+        ]
+        out.append(_table(
+            rows, ("block", "lost shard", "cause", "mesh"),
         ))
     return "\n".join(out).rstrip()
 

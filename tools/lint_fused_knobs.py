@@ -46,11 +46,16 @@ _READ_FUNCS = frozenset({"get", "getenv", "pop", "fused_knob"})
 #: device-parallel fleet knob (STARK_FLEET_MESH shards the problem axis
 #: over a mesh — a different compiled dispatch per shard), and the
 #: comms-observatory switch (STARK_COMM_TELEMETRY=0 silences collective
-#: accounting for byte-identical traces) — extend the alternation when
-#: a new execution-path knob family lands
+#: accounting for byte-identical traces), and the elastic-fault-domain
+#: pair (STARK_SHARD_DEADLINE arms the mesh fleet's shard deadman —
+#: detection + degraded re-shard change the dispatch path;
+#: STARK_FEED_MAXDEPTH bounds FleetFeed admission, changing what
+#: `submit` does under load) — extend the alternation when a new
+#: execution-path knob family lands
 _KNOB_RE = re.compile(
     r"^STARK_(?:FUSED_[A-Z0-9_]+|RAGGED_NUTS|QUANT_[A-Z0-9_]+"
-    r"|FLEET_SLOTS|FLEET_WARMSTART|FLEET_MESH|COMM_TELEMETRY)$"
+    r"|FLEET_SLOTS|FLEET_WARMSTART|FLEET_MESH|COMM_TELEMETRY"
+    r"|SHARD_DEADLINE|FEED_MAXDEPTH)$"
 )
 
 

@@ -205,6 +205,14 @@ def render_run(events, run) -> str:
             ("per-shard occupancy (last)",
              ", ".join(f"{float(o):.2f}" for o in fl["shard_occupancy_last"])
              if fl.get("shard_occupancy_last") else None),
+            # elastic fault domains (PR 17): shards the deadman declared
+            # lost (the fleet re-packed onto the survivors) and
+            # backpressure-bounced feed submissions — n/a-filtered on
+            # traces that predate them
+            ("lost shards",
+             ", ".join(str(k) for k in fl["lost_shards"])
+             if fl.get("lost_shards") else None),
+            ("feed rejects", fl.get("feed_rejects")),
         ]
         out.append(_table(
             [r for r in rows if r[1] is not None], ("fleet", "value")
