@@ -1166,6 +1166,23 @@ _NUTSSCHED_EXTRA_KEYS = (
     "useful_per_draw",
 )
 
+#: posterior-serving read-plane evidence (``bench.py microbench
+#: serving`` — stark_tpu.benchmarks.bench_serving): per-leg acceptance
+#: numbers ride the committed ``read:*`` rows under the same non-gated
+#: trend rule.  The headline ``value`` column is null whenever a leg
+#: loses its own gate (>=10x warm summary QPS / >=5x batched predict at
+#: parity / reconverge_draws_saved > 0) — honest-null, never 0.0.
+_SERVING_EXTRA_KEYS = (
+    "tenants", "summary_qps_warm", "summary_qps_cold",
+    "warm_cold_speedup", "cache_hit_ratio",
+    "batch", "draws_used", "design_rows", "batched_evals_per_sec",
+    "loop_evals_per_sec", "speedup_vs_loop", "predict_parity_abs_err",
+    "quantized_tenant", "predict_p50_ms", "predict_p99_ms",
+    "reconverge_draws_saved", "cold_total_draws_per_chain",
+    "warm_total_draws_per_chain", "warmup_draws_saved", "warmstarted",
+    "cold_sampling_draws", "warm_sampling_draws",
+)
+
 #: fleet evidence keys (shared by the in-bench leg and row committers);
 #: degraded + lost_problems make a lossy (quarantine-degraded) fleet
 #: visible in its ledger row — such rows also fail the converged-
@@ -1411,6 +1428,22 @@ def fusedvg_config_key(row, platform):
     return key
 
 
+def serving_config_key(row, platform):
+    """Ledger series keys for the posterior-serving read plane — one
+    ``read:<leg>`` series per bench_serving leg, scale-suffixed the same
+    way the fusedvg keys are so a re-scaled leg never shares a trailing
+    median with the committed baseline."""
+    name = row.get("benchmark", "")
+    if name == "serving_summary_qps":
+        return f"read:summary:T={row.get('tenants')}:platform={platform}"
+    if name == "serving_predict_batched":
+        return (
+            f"read:predict:B={row.get('batch')}:S={row.get('draws_used')}"
+            f":m={row.get('design_rows')}:platform={platform}"
+        )
+    return f"read:reconverge:eight_schools:platform={platform}"
+
+
 def run_fused_microbench(argv):
     """`python bench.py microbench [logistic lmm[:x_dtype] irt ordinal
     robust nutssched]` — run the per-op microbench legs standalone (no
@@ -1426,12 +1459,15 @@ def run_fused_microbench(argv):
     from stark_tpu import benchmarks as bmarks
     from stark_tpu.ops.precision import X_DTYPE_NAMES
 
-    known = ("logistic", "lmm", "irt", "ordinal", "robust", "nutssched")
+    known = (
+        "logistic", "lmm", "irt", "ordinal", "robust", "nutssched",
+        "serving",
+    )
     legs, unknown = [], []
     for a in argv:
         fam, _, xdt = a.partition(":")
         if fam not in known or (xdt and xdt not in X_DTYPE_NAMES) or (
-            xdt and fam == "nutssched"
+            xdt and fam in ("nutssched", "serving")
         ):
             unknown.append(a)
         else:
@@ -1453,36 +1489,43 @@ def run_fused_microbench(argv):
     failed = False
     for fam, xdt in legs:
         try:
-            r = (
-                bmarks.bench_nuts_sched()
-                if fam == "nutssched"
-                else bmarks.bench_fused_value_and_grad(fam, x_dtype=xdt)
-            )
+            if fam == "serving":
+                results = bmarks.bench_serving()  # 3 read-plane legs
+            elif fam == "nutssched":
+                results = [bmarks.bench_nuts_sched()]
+            else:
+                results = [
+                    bmarks.bench_fused_value_and_grad(fam, x_dtype=xdt)
+                ]
         except Exception as e:  # noqa: BLE001 — one broken family must
             # not hide the others' measurements
             print(f"[bench] microbench {fam} failed: {e!r}", file=sys.stderr)
             failed = True
             continue
-        row = res_row(r)
-        if not row["converged"]:
-            # null, never 0.0: a failed leg gates as missing data
-            # (ADVICE r5 / the PR 4 convention)
-            row["value"] = None
-            failed = True
-        print(json.dumps(row), flush=True)
-        if fam == "nutssched":
-            key = nutssched_config_key(row, platform)
-            extra, label = _NUTSSCHED_EXTRA_KEYS, "nutssched"
-        else:
-            key = fusedvg_config_key(row, platform)
-            extra, label = _FUSEDVG_EXTRA_KEYS, "fusedvg"
-        append_ledger(
-            key,
-            row,
-            extra_keys=extra,
-            label=label,
-            source="bench.py microbench",
-        )
+        for r in results:
+            row = res_row(r)
+            if not row["converged"]:
+                # null, never 0.0: a failed leg gates as missing data
+                # (ADVICE r5 / the PR 4 convention)
+                row["value"] = None
+                failed = True
+            print(json.dumps(row), flush=True)
+            if fam == "serving":
+                key = serving_config_key(row, platform)
+                extra, label = _SERVING_EXTRA_KEYS, "serving"
+            elif fam == "nutssched":
+                key = nutssched_config_key(row, platform)
+                extra, label = _NUTSSCHED_EXTRA_KEYS, "nutssched"
+            else:
+                key = fusedvg_config_key(row, platform)
+                extra, label = _FUSEDVG_EXTRA_KEYS, "fusedvg"
+            append_ledger(
+                key,
+                row,
+                extra_keys=extra,
+                label=label,
+                source="bench.py microbench",
+            )
     return 1 if failed else 0
 
 

@@ -17,8 +17,9 @@ the trace holds exactly one run per benchmark.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -1281,6 +1282,307 @@ def bench_nuts_sched(
             "sched_iters_max": int(lane_iters.max()),
         },
     )
+
+
+def _serving_summary_leg(tenants, chains, draws, dim, seed):
+    """``read:summary:*``: warm-LRU vs cold-mmap summary QPS over a
+    synthetic multi-tenant root.  Cold reads evict first (fresh mmap
+    open + sidecar parse per query); warm reads hit the LRU.  Gate:
+    warm >= 10x cold — the cache either pays for itself or the row says
+    it did not."""
+    import shutil
+    import tempfile
+
+    from . import serving
+    from .drawstore import DrawStore
+
+    t0 = time.perf_counter()
+    root = tempfile.mkdtemp(prefix="stark_bench_serve_")
+    try:
+        rng = np.random.default_rng(seed)
+        for t in range(tenants):
+            path = os.path.join(root, f"p_t{t:03d}.stkr")
+            with DrawStore(path, chains, dim) as ds:
+                ds.append(
+                    rng.standard_normal((chains, draws, dim)).astype(
+                        np.float32
+                    )
+                )
+                ds.flush()
+            serving.write_summary(
+                path, problem_id=f"t{t:03d}", model_tag="bench",
+                status="converged",
+            )
+        store = serving.PosteriorStore(root, capacity=tenants)
+        ids = store.ids()
+        queries = 400
+
+        def qps(cold: bool) -> float:
+            t = time.perf_counter()
+            for k in range(queries):
+                pid = ids[k % len(ids)]
+                if cold:
+                    store.evict(pid)
+                store.summary(pid)
+            return queries / (time.perf_counter() - t)
+
+        qps(cold=True)  # touch every sidecar once (page cache parity)
+        cold_qps = qps(cold=True)
+        warm_qps = qps(cold=False)
+        stats = store.cache_stats()
+        store.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    speedup = warm_qps / cold_qps if cold_qps > 0 else float("nan")
+    ok = bool(np.isfinite(speedup) and speedup >= 10.0)
+    hit_ratio = stats["hits"] / max(stats["requests"], 1)
+    return BenchResult(
+        name="serving_summary_qps",
+        wall_s=time.perf_counter() - t0,
+        min_ess=float("nan"),  # not a sampling leg: no ESS to report
+        ess_per_sec=warm_qps if ok else float("nan"),
+        max_rhat=float("nan"),
+        metric_name="summaries/s (warm)",
+        converged=ok,
+        gate=">=10x warm-LRU vs cold-mmap summary QPS",
+        extra={
+            "tenants": tenants,
+            "summary_qps_warm": round(warm_qps, 1),
+            "summary_qps_cold": round(cold_qps, 1),
+            "warm_cold_speedup": round(speedup, 2),
+            "cache_hit_ratio": round(hit_ratio, 4),
+        },
+    )
+
+
+def _serving_predict_leg(tenants, chains, draws, dim, m, seed):
+    """``read:predict:*``: ONE batched vmapped dispatch across tenants vs
+    the per-draw Python-loop reference, at parity.  One tenant serves a
+    packed int8 design (the `dequant_dot` scale-fold identity) — its
+    parity is checked against the DEQUANTIZED design, so the gate proves
+    the fold, not just the speed.  Gate: >=5x AND max |err| <= 1e-5."""
+    import shutil
+    import tempfile
+
+    from . import serving
+    from .drawstore import DrawStore
+
+    t0 = time.perf_counter()
+    root = tempfile.mkdtemp(prefix="stark_bench_predict_")
+    try:
+        rng = np.random.default_rng(seed + 1)
+        designs = {}
+        for t in range(tenants):
+            pid = f"t{t:03d}"
+            path = os.path.join(root, f"p_{pid}.stkr")
+            with DrawStore(path, chains, dim) as ds:
+                ds.append(
+                    (0.3 * rng.standard_normal((chains, draws, dim))).astype(
+                        np.float32
+                    )
+                )
+                ds.flush()
+            designs[pid] = rng.standard_normal((m, dim)).astype(np.float32)
+        store = serving.PosteriorStore(root, capacity=tenants)
+        quant_pid = "t000"  # one tenant serves off the packed int8 slab
+        for pid, x in designs.items():
+            store.register_design(
+                pid, x, dtype="int8" if pid == quant_pid else None
+            )
+        reqs = [
+            serving.PredictRequest(pid, link="identity")
+            for pid in sorted(designs)
+        ]
+        out = store.predict(reqs)  # compile pass + the parity artifact
+
+        # parity vs the per-draw loop on each tenant's EFFECTIVE design
+        # (xq * scale — for the quantized tenant that is the dequantized
+        # slab, so agreement proves the scale-fold identity end to end)
+        max_err, s_used = 0.0, 0
+        for req, row in zip(reqs, out):
+            beta, xq, scale, _cache = store._predict_operands(req)
+            s_used = beta.shape[0]
+            x_eff = np.asarray(xq, np.float32) * scale[None, :]
+            ref_mean, ref_q = serving.predict_reference(beta, x_eff)
+            max_err = max(
+                max_err,
+                float(np.max(np.abs(np.asarray(row["mean"]) - ref_mean))),
+                float(np.max(np.abs(np.asarray(row["quantiles"]) - ref_q))),
+            )
+
+        rounds, lat = 8, []
+        for _ in range(rounds):
+            t = time.perf_counter()
+            store.predict(reqs)
+            lat.append(time.perf_counter() - t)
+        evals = s_used * m * len(reqs)  # draw-row predictions per call
+        batched_eps = evals / min(lat)
+
+        t = time.perf_counter()
+        for req in reqs:
+            beta, xq, scale, _cache = store._predict_operands(req)
+            serving.predict_reference(
+                beta, np.asarray(xq, np.float32) * scale[None, :]
+            )
+        loop_eps = evals / (time.perf_counter() - t)
+        store.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    speedup = batched_eps / loop_eps if loop_eps > 0 else float("nan")
+    ok = bool(np.isfinite(speedup) and speedup >= 5.0 and max_err <= 1e-5)
+    lat_ms = sorted(1e3 * v for v in lat)
+    return BenchResult(
+        name="serving_predict_batched",
+        wall_s=time.perf_counter() - t0,
+        min_ess=float("nan"),
+        ess_per_sec=batched_eps if ok else float("nan"),
+        max_rhat=float("nan"),
+        metric_name="predictive evals/s",
+        converged=ok,
+        gate=">=5x vs per-draw loop at |err|<=1e-5 (incl. int8 tenant)",
+        extra={
+            "batch": len(reqs),
+            "draws_used": s_used,
+            "design_rows": m,
+            "batched_evals_per_sec": round(batched_eps, 1),
+            "loop_evals_per_sec": round(loop_eps, 1),
+            "speedup_vs_loop": round(speedup, 2),
+            "predict_parity_abs_err": float(max_err),
+            "quantized_tenant": quant_pid,
+            "predict_p50_ms": round(lat_ms[len(lat_ms) // 2], 3),
+            "predict_p99_ms": round(lat_ms[-1], 3),
+        },
+    )
+
+
+def _serving_reconverge_leg(chains, seed):
+    """``read:reconverge:*``: incremental posterior updating end to end.
+
+    Day 1: a fleet run persists one eight-schools tenant's store +
+    summary sidecar.  Day 2: the tenant's data grows (a fresh
+    re-observation) and it is RESUBMITTED through `fleet.FleetFeed` into
+    a live slotted fleet — once cold, once with
+    `serving.donor_pool_from_store` (yesterday's sidecar adaptation +
+    store-tail position ensemble) as the donor under
+    ``warmstart=True``.  The anchor problem that holds the slot open
+    carries ``deadline_s=0`` so it exits ``budget_exhausted`` after one
+    block WITHOUT donating (only converged problems donate), leaving the
+    pool exactly as the serving layer seeded it.  Gate: both resubmitted
+    runs converge AND the warm one needs strictly fewer total draws per
+    chain (warmup + sampling) — ``reconverge_draws_saved > 0``."""
+    import shutil
+    import tempfile
+
+    from . import serving
+    from .fleet import FleetFeed, FleetSpec, ProblemBudget, sample_fleet
+    from .models.eight_schools import SIGMA, Y
+
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(seed + 2)
+    y, sig = np.asarray(Y, np.float32), np.asarray(SIGMA, np.float32)
+
+    def reobs():
+        return {
+            "y": (y + rng.normal(0.0, 0.25 * sig, y.shape)).astype(
+                np.float32
+            ),
+            "sigma": sig,
+        }
+
+    kw = dict(
+        chains=chains, block_size=25, max_blocks=8, min_blocks=2,
+        num_warmup=100, ess_target=40.0, rhat_target=1.3, kernel="hmc",
+        num_leapfrog=12, slots=True,
+    )
+    day1_data, day2_data = reobs(), reobs()
+    root = tempfile.mkdtemp(prefix="stark_bench_reconv_")
+    try:
+        # --- day 1: cold run persists the tenant's store + sidecar ----
+        spec1 = FleetSpec.from_problems(
+            EightSchools(), [day1_data], problem_ids=["tenant"]
+        )
+        # an (empty, closed) feed pins the vmapped fleet path at B=1 —
+        # the sequential hatch writes no summary sidecar, and the
+        # sidecar's adaptation state is half the donor
+        feed1 = FleetFeed()
+        feed1.close()
+        res1 = sample_fleet(spec1, draw_store_path=root, feed=feed1, **kw)
+        if not res1["tenant"].converged:
+            raise RuntimeError("day-1 tenant did not converge")
+        store_path = serving.PosteriorStore(root).path("tenant")
+
+        def day2(donor_pool):
+            spec = FleetSpec.from_problems(
+                EightSchools(), [reobs()], problem_ids=["anchor"],
+                budgets=[ProblemBudget(deadline_s=0.0)],
+            )
+            feed = FleetFeed()
+            feed.submit(day2_data, problem_id="tenant_day2")
+            feed.close()
+            res = sample_fleet(
+                spec, feed=feed, max_batch=1, warmstart=True,
+                donor_pool=donor_pool, **kw,
+            )
+            p = res["tenant_day2"]
+            total = (
+                kw["num_warmup"] - p.warmup_draws_saved + p.draws_per_chain
+            )
+            return p, total
+
+        p_cold, cold_total = day2(None)
+        pool = serving.donor_pool_from_store(store_path, "EightSchools")
+        p_warm, warm_total = day2(pool)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    saved = cold_total - warm_total
+    ok = bool(p_cold.converged and p_warm.converged and saved > 0)
+    return BenchResult(
+        name="serving_incremental_reconverge",
+        wall_s=time.perf_counter() - t0,
+        min_ess=float(p_warm.min_ess or float("nan")),
+        ess_per_sec=float(saved) if ok else float("nan"),
+        max_rhat=float(p_warm.max_rhat or float("nan")),
+        metric_name="draws saved/chain",
+        converged=ok,
+        gate="warm + cold resubmits converge AND reconverge_draws_saved>0",
+        extra={
+            "reconverge_draws_saved": int(saved),
+            "cold_total_draws_per_chain": int(cold_total),
+            "warm_total_draws_per_chain": int(warm_total),
+            "warmup_draws_saved": int(p_warm.warmup_draws_saved),
+            "warmstarted": bool(p_warm.warmstarted),
+            "cold_sampling_draws": int(p_cold.draws_per_chain),
+            "warm_sampling_draws": int(p_warm.draws_per_chain),
+        },
+    )
+
+
+def bench_serving(
+    *, tenants=16, chains=4, draws=512, dim=8, m=8, seed=0,
+) -> List[BenchResult]:
+    """``bench.py microbench serving``: the posterior-as-a-service read
+    plane's three ledgered legs — summary-cache QPS, batched predictive
+    throughput at parity, and the eight-schools incremental-reconvergence
+    drill.  Returns one `BenchResult` per leg (``read:summary`` /
+    ``read:predict`` / ``read:reconverge`` ledger series).  Timed reads
+    run with serve telemetry OFF so the measurement is the data plane,
+    not the event emission."""
+    from .serving import SERVE_TELEMETRY_ENV
+
+    prev = os.environ.get(SERVE_TELEMETRY_ENV)
+    os.environ[SERVE_TELEMETRY_ENV] = "0"
+    try:
+        return [
+            _serving_summary_leg(tenants, chains, draws, dim, seed),
+            _serving_predict_leg(min(tenants, 8), chains, draws, dim, m,
+                                 seed),
+            _serving_reconverge_leg(chains, seed),
+        ]
+    finally:
+        if prev is None:
+            os.environ.pop(SERVE_TELEMETRY_ENV, None)
+        else:
+            os.environ[SERVE_TELEMETRY_ENV] = prev
 
 
 ALL_BENCHMARKS = {

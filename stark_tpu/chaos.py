@@ -45,6 +45,13 @@ Scenario matrix (`SCENARIOS`):
                          events leaves the two streams identical in
                          every non-timing field — the accounting only
                          observes
+  serving_clean_identity posterior read plane actively querying a live
+                         fleet's stores vs no read plane
+                         (STARK_SERVE_TELEMETRY=0): draws bit-identical,
+                         both traces carry zero serve_request events and
+                         match in every non-timing field — serving is
+                         provably read-only; with the knob back on the
+                         same queries DO emit serve_request
 
 The postmortem flight recorder (telemetry.FlightRecorder) is drilled by
 the anomaly scenarios themselves: nan_poison (supervised restart),
@@ -1130,6 +1137,115 @@ def comm_clean_identity(workdir: str) -> Dict[str, Any]:
     )
     return {"comm_events": len(comm_on), "mesh": mesh is not None,
             "trace_identical": True}
+
+
+@_scenario("serving_clean_identity")
+def serving_clean_identity(workdir: str) -> Dict[str, Any]:
+    """Posterior read plane querying a LIVE fleet vs no read plane: the
+    plane is host-side and read-only (hardened torn-tail mmap reads can
+    race the async writer), so the two fleet runs must produce
+    bit-identical draws; with STARK_SERVE_TELEMETRY=0 neither trace
+    carries a ``serve_request`` event and they match in every non-timing
+    field.  A final telemetry-ON query against the finished stores must
+    emit ``serve_request`` — proving it was the knob, not a dead plane."""
+    import threading
+
+    from .fleet import sample_fleet
+    from .serving import SERVE_TELEMETRY_ENV, PosteriorStore
+    from .telemetry import RunTrace, read_trace, use_trace
+
+    spec = _fleet_spec(2)
+
+    def run(tag: str, serve: bool):
+        trace_path = os.path.join(workdir, f"{tag}.jsonl")
+        store_root = os.path.join(workdir, f"{tag}_stores")
+        prev = os.environ.get(SERVE_TELEMETRY_ENV)
+        os.environ[SERVE_TELEMETRY_ENV] = "0"
+        stop = threading.Event()
+        served = {"n": 0}
+        worker = None
+        if serve:
+            plane = PosteriorStore(store_root, capacity=8)
+
+            def hammer():
+                # live queries racing the fleet's async writers: ids()
+                # rescans the root, so tenants appear as their stores do
+                while not stop.is_set():
+                    for pid in plane.ids():
+                        try:
+                            plane.summary(pid)
+                            plane.draws(pid)
+                            served["n"] += 2
+                        except Exception:  # noqa: BLE001 — races are the point
+                            pass
+                        # cold-path coverage too, not just LRU hits
+                        plane.evict(pid)
+                    stop.wait(0.01)
+
+            worker = threading.Thread(target=hammer, daemon=True)
+            worker.start()
+        try:
+            with RunTrace(trace_path) as tr, use_trace(tr):
+                res = sample_fleet(
+                    spec, seed=0, draw_store_path=store_root, **_FLEET_KW
+                )
+        finally:
+            stop.set()
+            if worker is not None:
+                worker.join(timeout=10.0)
+            if prev is None:
+                os.environ.pop(SERVE_TELEMETRY_ENV, None)
+            else:
+                os.environ[SERVE_TELEMETRY_ENV] = prev
+        return res, read_trace(trace_path), store_root, served["n"]
+
+    res_plain, ev_plain, _root_p, _ = run("serve_off", serve=False)
+    res_served, ev_served, root_s, n_served = run("serve_on", serve=True)
+    for a_p, b_p in zip(res_plain.problems, res_served.problems):
+        np.testing.assert_array_equal(
+            np.asarray(a_p.draws_flat), np.asarray(b_p.draws_flat)
+        )
+    for ev, tag in ((ev_plain, "plain"), (ev_served, "served")):
+        assert not [e for e in ev if e["event"] == "serve_request"], (
+            f"STARK_SERVE_TELEMETRY=0 leaked serve_request events ({tag})"
+        )
+
+    def shape(events):
+        return [
+            {k: v for k, v in e.items() if not _is_timing_key(k)}
+            for e in events
+        ]
+
+    # comm events carry a process-global seq + measured host walls, so
+    # two same-process runs can never match on them field-for-field
+    # (comm_clean_identity's contract) — here the COUNT must match and
+    # everything else must be identical in every non-timing field
+    comm_plain = [e for e in ev_plain if e["event"] == "comm"]
+    comm_served = [e for e in ev_served if e["event"] == "comm"]
+    assert len(comm_plain) == len(comm_served), (
+        "an active read plane changed the fleet's collective accounting"
+    )
+    a = shape([e for e in ev_plain if e["event"] != "comm"])
+    b = shape([e for e in ev_served if e["event"] != "comm"])
+    assert a == b, (
+        "an active read plane changed the fleet's trace event stream"
+    )
+
+    # knob back on: the same queries must now emit serve_request
+    on_path = os.path.join(workdir, "serve_events.jsonl")
+    with RunTrace(on_path) as tr:
+        plane = PosteriorStore(root_s, capacity=8, trace=tr)
+        for pid in plane.ids():
+            plane.summary(pid)
+    ev_on = [
+        e for e in read_trace(on_path) if e["event"] == "serve_request"
+    ]
+    assert ev_on, "telemetry-on serving emitted no serve_request events"
+    return {
+        "queries_during_run": n_served,
+        "serve_events_after": len(ev_on),
+        "trace_identical": True,
+    }
 
 
 @_scenario("shard_loss_clean_identity")

@@ -126,17 +126,32 @@ def truncate_draws(path: str, n_draws: int) -> None:
 
 
 def read_draws(path: str, mmap: bool = True) -> Tuple[np.ndarray, int, int]:
-    """-> (draws (n, chains, dim), chains, dim); zero-copy memmap by default."""
+    """-> (draws (n, chains, dim), chains, dim); zero-copy memmap by default.
+
+    Read-path hardening (the serving contract): the store may be mid-write
+    or torn — a crash, a full disk, or a reader racing the async writer can
+    leave a partial final record.  ``n`` floors to the last COMPLETE row and
+    the tail fragment is ignored instead of raising, on both paths (the
+    non-mmap path reads exactly ``n*chains*dim`` floats rather than
+    ``fromfile().reshape()``-ing whatever is on disk).  Both paths open the
+    file read-only (mmap ``mode="r"``), so a serving process can never
+    corrupt a live store.
+    """
     chains, dim = _read_header(path)
     size = os.path.getsize(path) - _HEADER_BYTES
-    n = size // (4 * chains * dim)
+    n = max(size, 0) // (4 * chains * dim)
+    if n == 0:
+        # np.memmap cannot map an empty region; an empty store (or one
+        # torn inside its first row) reads as zero draws, not an error
+        return np.empty((0, chains, dim), np.float32), chains, dim
     if mmap:
         arr = np.memmap(
             path, np.float32, mode="r", offset=_HEADER_BYTES,
             shape=(n, chains, dim),
         )
     else:
-        arr = np.fromfile(path, np.float32, offset=_HEADER_BYTES).reshape(
-            n, chains, dim
-        )
+        with open(path, "rb") as f:
+            f.seek(_HEADER_BYTES)
+            arr = np.fromfile(f, np.float32, count=n * chains * dim)
+        arr = arr.reshape(n, chains, dim)
     return arr, chains, dim

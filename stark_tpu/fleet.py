@@ -633,12 +633,25 @@ class DonorPool:
     ``fleet.warmstart_poison`` drill) is rejected at the pool boundary
     and can never propagate into an admitted lane's warmup.  The pool
     state rides the fleet checkpoint so crash-resume replays warm-started
-    admissions deterministically."""
+    admissions deterministically.
+
+    Since the serving layer landed the pool also carries full POSITION
+    ENSEMBLES per tag (`add_ensemble` / `ensemble`): the latest finite
+    (chains, d) snapshot of a completed problem's final draws.  An
+    admitted problem whose tag has an ensemble starts its chains AT the
+    donor posterior instead of at ``init_flat`` — the substrate for
+    incremental posterior updating (resubmit a grown-data tenant with
+    yesterday's posterior as the donor; `serving.donor_pool_from_store`
+    builds such a pool from a served store + sidecar).  Ensembles obey
+    the same discipline as the moments: finite-validated on write AND
+    read, and they ride ``state_dict``/``load_state``."""
 
     def __init__(self):
         # tag -> {"count": int, "log_step_sum": float,
         #         "inv_mass_sum": np.ndarray (d,)}
         self._by_tag: Dict[str, Dict[str, Any]] = {}
+        # tag -> np.ndarray (chains, d): latest finite position ensemble
+        self._ens_by_tag: Dict[str, np.ndarray] = {}
 
     def add(self, tag: str, step_size: np.ndarray,
             inv_mass: np.ndarray) -> bool:
@@ -675,12 +688,37 @@ class DonorPool:
             return None
         return step, im, n
 
+    def add_ensemble(self, tag: str, positions: np.ndarray) -> bool:
+        """Bank one completed problem's (chains, d) final positions as the
+        tag's position donor (latest finite wins); False = rejected
+        (non-finite anywhere, or not a 2-D ensemble)."""
+        positions = np.asarray(positions, np.float32)
+        if positions.ndim != 2 or positions.size == 0 \
+                or not np.all(np.isfinite(positions)):
+            return False
+        self._ens_by_tag[tag] = np.array(positions, np.float32, copy=True)
+        return True
+
+    def ensemble(self, tag: str) -> Optional[np.ndarray]:
+        """The tag's (chains, d) position ensemble, or None — with the
+        same reader-side finite guard as `summary` (checkpoint state is
+        operator-editable JSON; trust nothing)."""
+        ens = self._ens_by_tag.get(tag)
+        if ens is None or ens.ndim != 2 or ens.size == 0 \
+                or not np.all(np.isfinite(ens)):
+            return None
+        return ens
+
     def state_dict(self) -> Dict[str, Any]:
-        return {
+        state = {
             tag: {"count": e["count"], "log_step_sum": e["log_step_sum"],
                   "inv_mass_sum": np.asarray(e["inv_mass_sum"]).tolist()}
             for tag, e in self._by_tag.items()
         }
+        for tag, ens in self._ens_by_tag.items():
+            state.setdefault(tag, {})["ensemble"] = \
+                np.asarray(ens, np.float32).tolist()
+        return state
 
     def load_state(self, state: Dict[str, Any]) -> None:
         self._by_tag = {
@@ -689,7 +727,16 @@ class DonorPool:
                   "inv_mass_sum": np.asarray(e["inv_mass_sum"],
                                              np.float64)}
             for tag, e in (state or {}).items()
+            if "count" in e  # ensemble-only entries carry no moments
         }
+        self._ens_by_tag = {}
+        for tag, e in (state or {}).items():
+            if "ensemble" in e:
+                # add-side validation re-runs on load: a hand-edited or
+                # torn checkpoint cannot smuggle NaNs past the boundary
+                self.add_ensemble(
+                    tag, np.asarray(e["ensemble"], np.float32)
+                )
 
 
 # --------------------------------------------------------------------------
@@ -1519,6 +1566,7 @@ def _sample_fleet(
     slots: Optional[bool] = None,
     warmstart: Optional[bool] = None,
     warmstart_warmup: Optional[int] = None,
+    donor_pool: Optional[DonorPool] = None,
     mesh: Optional[Any] = None,
     trace: Optional[Any] = None,
     **cfg_kwargs,
@@ -1868,8 +1916,14 @@ def _sample_fleet(
 
     # warm-start adaptation transfer: donor summaries of completed
     # problems, keyed by model tag; the adapt-confirm window replaces
-    # the full warmup schedule for donor-seeded admissions
-    donor_pool = DonorPool() if warmstart_on else None
+    # the full warmup schedule for donor-seeded admissions.  A caller-
+    # provided ``donor_pool`` (e.g. `serving.donor_pool_from_store` — an
+    # earlier run's posterior as the donor) seeds the pool for
+    # INCREMENTAL reconvergence; without warm-start it is ignored.
+    if warmstart_on:
+        donor_pool = donor_pool if donor_pool is not None else DonorPool()
+    else:
+        donor_pool = None
     donor_tag = getattr(model, "tag", type(model).__name__)
     # adapt-confirm window: long enough that the schedule's slow window
     # re-estimates the mass matrix from a usable sample count (a too-
@@ -2071,7 +2125,8 @@ def _sample_fleet(
             )
             diag = jax.tree.map(lambda a, b: a.at[ix].set(b), diag, dg)
 
-    def _warm_slots_padded(pairs: List[Tuple[int, int]], donor) -> None:
+    def _warm_slots_padded(pairs: List[Tuple[int, int]], donor,
+                           donor_ens=None) -> None:
         """Full-batch-width warmup for an admitted cohort (slot
         scheduler): admitted problems ride their TARGET slots, every
         other lane is a dummy (zero key, zero z0 — vmap lanes are
@@ -2079,13 +2134,25 @@ def _sample_fleet(
         cohort warmup exactly and the compiled warmup parts are reused
         with zero re-specialization.  ``donor`` (step, inv_mass_diag,
         count or None) seeds the dual-averaging state and mass diagonal
-        and shrinks the schedule to the adapt-confirm window."""
+        and shrinks the schedule to the adapt-confirm window.
+        ``donor_ens`` ((chains, d) or None — `DonorPool.ensemble`)
+        additionally starts the admitted chains AT the donor posterior's
+        final positions (incremental reconvergence): z0 is traced DATA,
+        so the override costs zero re-specialization, and the key-split
+        discipline below is unchanged (init keys are still split and
+        burned) so every neighbor's stream is untouched."""
         js = [j for j, _ in pairs]
         for j, i in pairs:
             p = probs[i]
             p.key, key_init, key_warm = jax.random.split(p.key, 3)
             # placed first so the fill lanes can zeros_like a real lane
             p_z0 = jax.vmap(fm.init_flat)(jax.random.split(key_init, chains))
+            if donor_ens is not None and donor_ens.shape[1] == p_z0.shape[1]:
+                # donor chains tile/truncate onto the lane's chain count
+                p_z0 = jnp.asarray(
+                    donor_ens[np.arange(chains) % donor_ens.shape[0]],
+                    p_z0.dtype,
+                )
             p_wk = jax.random.split(key_warm, chains)
             if j == js[0]:
                 z0_l = [jnp.zeros_like(p_z0)] * len(order)
@@ -2124,14 +2191,16 @@ def _sample_fleet(
         wdiv = np.asarray(wdiv)
         for j, i in pairs:
             p = probs[i]
-            if donor is not None:
+            if donor is not None or donor_ens is not None:
                 p.warmstarted = True
+            if donor is not None:
                 p.warmup_draws_saved = max(cfg.num_warmup - ws_window, 0)
             emit({
                 "event": "warmup_done",
                 "problem_id": p.pid,
                 "num_divergent": int(wdiv[j].sum()),
                 "warmstart": donor is not None,
+                "warmstart_positions": donor_ens is not None,
                 "wall_s": time.perf_counter() - t_start,
             })
         ix = jnp.asarray(js, dtype=jnp.int32)
@@ -2169,7 +2238,13 @@ def _sample_fleet(
                 donor_pool.summary(donor_tag)
                 if donor_pool is not None else None
             )
-            _warm_slots_padded(list(zip(slot_js, indices)), donor)
+            donor_ens = (
+                donor_pool.ensemble(donor_tag)
+                if donor_pool is not None else None
+            )
+            _warm_slots_padded(
+                list(zip(slot_js, indices)), donor, donor_ens
+            )
         else:
             st, ss, im = warm_cohort(indices)
             ix = jnp.asarray(slot_js, dtype=jnp.int32)
@@ -2360,6 +2435,47 @@ def _sample_fleet(
             # cold runs' terminal records stay byte-identical
             fields["warmstart"] = True
             fields["warmup_draws_saved"] = p.warmup_draws_saved
+        if store is not None:
+            # posterior-as-a-service summary sidecar
+            # (``<store>.summary.json``): moments + quantile sketch +
+            # the gate/health verdicts + adaptation state, written ONCE
+            # here so a serving summary read never touches draws (and
+            # `serving.donor_pool_from_store` can fully re-seed a donor).
+            # The fleet is the ONLY writer — the read plane never writes
+            # into the store root.  No new trace/metrics events, and a
+            # failed write degrades serving, never the run.
+            try:
+                from . import serving as _serving
+
+                adapt = None
+                if step_size is not None and p.idx in order:
+                    j_lane = order.index(p.idx)
+                    ss_j = np.asarray(step_size)[j_lane]
+                    im_j = np.asarray(inv_mass)[j_lane]
+                    adapt = {
+                        "step_size": float(np.exp(np.mean(np.log(ss_j)))),
+                        "inv_mass_diag": np.mean(
+                            im_j.reshape(-1, im_j.shape[-1]), axis=0
+                        ),
+                    }
+                    if not (np.isfinite(adapt["step_size"]) and
+                            np.all(np.isfinite(adapt["inv_mass_diag"]))):
+                        adapt = None
+                _serving.write_summary(
+                    store.path(p.pid),
+                    problem_id=p.pid,
+                    model_tag=donor_tag,
+                    status=status,
+                    min_ess=p.min_ess,
+                    max_rhat=p.max_rhat,
+                    health=verdict,
+                    adaptation=adapt,
+                )
+            except Exception as e:  # noqa: BLE001 — serving is best-effort
+                log.warning(
+                    "summary sidecar for %s failed (%s: %s)",
+                    p.pid, type(e).__name__, e,
+                )
         fields.update(extra)
         emit({"event": "problem_done", **fields})
         # the health verdict rides ONLY the trace event (and only when
@@ -3258,13 +3374,23 @@ def _sample_fleet(
                 im_h2 = np.asarray(inv_mass)
                 for j, p in new_donors:
                     d_ss, d_im = ss_h2[j], im_h2[j]
+                    d_ens = np.asarray(zs[j][:, -1, :], np.float32)
                     act = faults.fail_point("fleet.warmstart_poison")
                     if act is not None and act.kind == "nan":
                         d_ss = np.full_like(d_ss, np.nan)
+                        d_ens = np.full_like(d_ens, np.nan)
                     if not donor_pool.add(donor_tag, d_ss, d_im):
                         log.warning(
                             "fleet warm-start donor %s rejected "
                             "(non-finite adaptation summary)", p.pid,
+                        )
+                    # position donor: the lane's final draw across chains
+                    # — the latest finite ensemble wins; a poisoned one is
+                    # rejected at the same boundary as the moments
+                    if not donor_pool.add_ensemble(donor_tag, d_ens):
+                        log.warning(
+                            "fleet warm-start position ensemble from %s "
+                            "rejected (non-finite)", p.pid,
                         )
 
             # --- lane containment -----------------------------------------

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from . import telemetry
@@ -55,8 +56,11 @@ METRIC_PREFIX = "stark"
 
 #: version of the ``/status`` JSON contract (stamped as its ``schema``
 #: field): bump when a consumer-visible key changes shape.  2 = PR 11
-#: (schema/uptime_s/last_postmortem + per-problem SLO gauges).
-STATUS_SCHEMA = 2
+#: (schema/uptime_s/last_postmortem + per-problem SLO gauges); 3 = the
+#: posterior read plane (``serving`` sub-object: cumulative request /
+#: cache-hit-miss counts, per-endpoint totals, the latest endpoint, and
+#: the scrape-window QPS — ``{}`` until the first ``serve_request``).
+STATUS_SCHEMA = 3
 
 #: default histogram buckets (seconds) — block/checkpoint walls span
 #: ~10 ms (tiny CPU drills) to minutes (compile-inclusive first blocks)
@@ -354,7 +358,11 @@ class TraceCollector:
             "restarts": {},
             "fleet": {},
             "comms": {},
+            "serving": {},
         }
+        # sliding 60 s window of serve_request arrival times: the QPS
+        # gauge computes from it at scrape time
+        self._serve_times: deque = deque(maxlen=4096)
 
         # -- counters (monotone across attempts by construction) --
         self.events = r.counter(
@@ -592,6 +600,38 @@ class TraceCollector:
             "per-shard block wall over the median shard wall at the "
             "latest mesh fleet block, labeled by shard ordinal "
             "(1.0 = balanced; the max label is the straggler)",
+        )
+        # -- posterior read plane (stark_tpu.serving serve_request
+        # -- events): fed ONLY from that family, so a run with
+        # -- STARK_SERVE_TELEMETRY=0 (or no read plane) exposes nothing
+        self.serve_requests = r.counter(
+            f"{p}_serve_requests_total",
+            "posterior read-plane requests served, by endpoint label "
+            "(summary/predict/draws) and ok label",
+        )
+        self.serve_cache_hits = r.counter(
+            f"{p}_serve_cache_hits_total",
+            "read-plane requests answered from the hot-tenant LRU "
+            "(mmap + summary already resident)",
+        )
+        self.serve_cache_misses = r.counter(
+            f"{p}_serve_cache_misses_total",
+            "read-plane requests that opened a cold store (mmap + "
+            "sidecar read, LRU fill)",
+        )
+        self.g_serve_qps = r.gauge(
+            f"{p}_serve_qps",
+            "read-plane requests per second over the trailing 60 s "
+            "window (scrape-time)",
+        )
+        self.g_serve_qps.set_function(self._serve_qps)
+        self.h_serve_s = r.histogram(
+            f"{p}_serve_request_seconds",
+            "host wall of each read-plane request, by endpoint label "
+            "(sub-millisecond buckets: serving latencies, not block "
+            "walls)",
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                     0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0),
         )
         # -- per-tenant SLO rollups (fleet problem_* events; labeled by
         # -- problem id, reset on a fresh run_start) --
@@ -1166,6 +1206,39 @@ class TraceCollector:
                 )
             comms["last_primitive"] = prim
 
+    def _on_serve_request(self, rec: Dict[str, Any]) -> None:
+        """Posterior read-plane request (stark_tpu.serving): count by
+        endpoint + cache outcome, observe the latency histogram, feed
+        the QPS window, and keep the ``/status.serving`` rollup current.
+        Absent entirely under STARK_SERVE_TELEMETRY=0 or with no read
+        plane attached."""
+        endpoint = str(rec.get("endpoint", "unknown"))
+        ok = bool(rec.get("ok", True))
+        self.serve_requests.inc(endpoint=endpoint, ok=str(ok).lower())
+        cache = rec.get("cache")
+        if cache == "hit":
+            self.serve_cache_hits.inc()
+        elif cache == "miss":
+            self.serve_cache_misses.inc()
+        dur = rec.get("dur_s")
+        if isinstance(dur, (int, float)):
+            self.h_serve_s.observe(max(float(dur), 0.0), endpoint=endpoint)
+        now = time.monotonic()
+        self._serve_times.append(now)
+        with self._lock:
+            sv = self._status["serving"]
+            sv["requests"] = int(sv.get("requests", 0)) + 1
+            key = "hits" if cache == "hit" else "misses"
+            sv[key] = int(sv.get(key, 0)) + 1
+            by_ep = sv.setdefault("by_endpoint", {})
+            by_ep[endpoint] = int(by_ep.get(endpoint, 0)) + 1
+            sv["last_endpoint"] = endpoint
+
+    def _serve_qps(self) -> float:
+        """Trailing-60 s request rate (scrape-time gauge hook)."""
+        cutoff = time.monotonic() - 60.0
+        return sum(1 for t in self._serve_times if t >= cutoff) / 60.0
+
     # -- helpers -----------------------------------------------------------
 
     def _chains(self) -> int:
@@ -1206,6 +1279,13 @@ class TraceCollector:
             health_snap = dict(self._status["health"])
             if "warnings" in health_snap:
                 health_snap["warnings"] = dict(health_snap["warnings"])
+            serving_snap = dict(self._status["serving"])
+            if "by_endpoint" in serving_snap:
+                serving_snap["by_endpoint"] = dict(
+                    serving_snap["by_endpoint"]
+                )
+            if serving_snap:
+                serving_snap["qps"] = round(self._serve_qps(), 4)
             snap = {
                 "phase": self._status["phase"],
                 "run": self._status["run"],
@@ -1218,6 +1298,7 @@ class TraceCollector:
                 "meta": dict(self._status["meta"]),
                 "fleet": dict(self._status["fleet"]),
                 "comms": dict(self._status["comms"]),
+                "serving": serving_snap,
             }
         attempt = self.g_attempt.value()
         if attempt is not None:

@@ -1,0 +1,635 @@
+"""Posterior-as-a-service: the high-QPS READ plane over ``.stkr`` stores.
+
+The fleet (write side) produces one draw store per tenant problem
+(``p_<id>.stkr`` under a root directory — `fleet.FleetDrawStore`) plus,
+since this layer landed, a ``.summary.json`` sidecar written once at
+``problem_converged`` time.  This module is the read side:
+
+* **Zero-copy draw access** — `PosteriorStore` registers tenants by
+  scanning the root for ``p_*.stkr`` and hands out the stores' draws as
+  read-only memmaps (`drawstore.read_draws(mmap=True)`); no f32 copy of a
+  store is ever materialized by the registry, so a million-tenant root
+  costs open-fd + page-cache, not RAM.  The hardened read path tolerates
+  a torn tail, so reads can race the live async writer safely.
+* **Summary cache** — per-tenant posterior summaries (per-dimension
+  moments, a fixed-grid quantile sketch, the fleet's ESS/R-hat gate
+  verdict and `stark_tpu.health` warning verdict, and the adaptation
+  state needed to re-seed a donor) persisted as the sidecar so a summary
+  read never touches draws.  When a tenant has no sidecar (pre-serving
+  store), the summary is computed from the mmap on first read and cached
+  in memory — but NEVER written back: the read plane does not write into
+  the store directory.
+* **Batched predictive evaluator** — posterior-predictive means and
+  quantiles for many tenants in ONE compiled vmapped dispatch per shape
+  group (the PR 13 slot idiom applied to reads).  The predictive matvec
+  is the same scale-folded stream as a quantized gradient
+  (``(beta * scale) @ q`` — the `ops.quantize.dequant_dot` epilogue
+  identity), so quantized-X tenants serve predictions straight off the
+  packed slab without dequantizing it.
+* **LRU** — mmap handles + summaries for the ``STARK_SERVE_CACHE``
+  hottest tenants (default 64), with hit/miss counters surfaced through
+  `metrics.py` and the ``/posterior/*`` statusd endpoints.
+* **Incremental reconvergence** — `donor_pool_from_store` turns
+  yesterday's posterior (sidecar adaptation + store-tail position
+  ensemble) into a pre-seeded `fleet.DonorPool`, so resubmitting a
+  grown-data tenant through `FleetFeed` reconverges in fewer draws than
+  a cold start (measured by ``bench.py microbench serving``).
+
+Telemetry: every request emits one ``serve_request`` trace event
+(endpoint / problem_id / dur_s / cache hit-miss) on the trace given at
+construction, else the ambient trace, else a private in-memory bus that
+still reaches the metrics listeners.  ``STARK_SERVE_TELEMETRY=0``
+silences the family entirely — with it off, a fleet run queried by a
+live read plane produces byte-identical traces (and always bit-identical
+draws): the ``serving_clean_identity`` chaos drill pins this.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .drawstore import read_draws
+from . import telemetry
+
+__all__ = [
+    "SERVE_CACHE_ENV",
+    "SERVE_TELEMETRY_ENV",
+    "SERVE_SKETCH_ENV",
+    "SERVE_PREDICT_DRAWS_ENV",
+    "SUMMARY_SCHEMA",
+    "QUANTILE_PROBS",
+    "PosteriorStore",
+    "PredictRequest",
+    "compute_summary",
+    "donor_pool_from_store",
+    "read_summary",
+    "serve_telemetry_enabled",
+    "summary_path",
+    "write_summary",
+]
+
+#: LRU capacity: how many tenants' mmap handles + summaries stay hot
+#: (``STARK_SERVE_CACHE=0`` disables caching — every read is a cold miss)
+SERVE_CACHE_ENV = "STARK_SERVE_CACHE"
+_DEFAULT_CACHE = 64
+
+#: ``STARK_SERVE_TELEMETRY=0`` suppresses the ``serve_request`` event
+#: family entirely (the byte-identical-traces opt-out, same convention as
+#: STARK_COMM_TELEMETRY)
+SERVE_TELEMETRY_ENV = "STARK_SERVE_TELEMETRY"
+
+#: quantile-sketch row cap: summaries computed from draws subsample to at
+#: most this many rows (deterministic stride), keeping sidecar writes and
+#: cold-summary fallbacks O(cap) instead of O(store)
+SERVE_SKETCH_ENV = "STARK_SERVE_SKETCH"
+_DEFAULT_SKETCH = 4096
+
+#: predictive working set: each predict request evaluates over at most
+#: this many tail draws (the most-converged end of the store)
+SERVE_PREDICT_DRAWS_ENV = "STARK_SERVE_PREDICT_DRAWS"
+_DEFAULT_PREDICT_DRAWS = 512
+
+#: sidecar contract version (bump on shape changes; readers key on it)
+SUMMARY_SCHEMA = 1
+
+#: the fixed quantile grid every summary and predictive response carries
+QUANTILE_PROBS = (0.01, 0.05, 0.25, 0.5, 0.75, 0.95, 0.99)
+
+_STORE_PREFIX = "p_"
+_STORE_SUFFIX = ".stkr"
+
+
+def serve_telemetry_enabled() -> bool:
+    return os.environ.get("STARK_SERVE_TELEMETRY", "").strip() != "0"
+
+
+def _cache_capacity() -> int:
+    raw = os.environ.get("STARK_SERVE_CACHE", "").strip()
+    if not raw:
+        return _DEFAULT_CACHE
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return _DEFAULT_CACHE
+
+
+def _sketch_cap() -> int:
+    raw = os.environ.get("STARK_SERVE_SKETCH", "").strip()
+    try:
+        return max(64, int(raw)) if raw else _DEFAULT_SKETCH
+    except ValueError:
+        return _DEFAULT_SKETCH
+
+
+def _predict_draw_cap() -> int:
+    raw = os.environ.get("STARK_SERVE_PREDICT_DRAWS", "").strip()
+    try:
+        return max(1, int(raw)) if raw else _DEFAULT_PREDICT_DRAWS
+    except ValueError:
+        return _DEFAULT_PREDICT_DRAWS
+
+
+# --------------------------------------------------------------------------
+# summary sidecar
+# --------------------------------------------------------------------------
+
+
+def summary_path(store_path: str) -> str:
+    """The sidecar lives NEXT TO the store (``<store>.summary.json``), so
+    a summary read never opens — never mind scans — the draw file."""
+    return store_path + ".summary.json"
+
+
+def compute_summary(
+    draws: np.ndarray,
+    *,
+    problem_id: Optional[str] = None,
+    model_tag: Optional[str] = None,
+    status: Optional[str] = None,
+    min_ess: Optional[float] = None,
+    max_rhat: Optional[float] = None,
+    health: Optional[Sequence[str]] = None,
+    adaptation: Optional[Dict[str, Any]] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """One tenant's posterior summary from its (n, chains, dim) draws.
+
+    Pure + host-side: per-dimension mean/std over all draws, a
+    fixed-grid quantile sketch over a deterministic stride subsample
+    (``STARK_SERVE_SKETCH`` row cap), and whatever gate/health verdicts
+    the caller banked at convergence time.  Works directly on a
+    read-only memmap without materializing the store.
+    """
+    draws = np.asarray(draws) if draws.ndim == 3 else np.asarray(draws)
+    n, chains, dim = draws.shape
+    out: Dict[str, Any] = {
+        "schema": SUMMARY_SCHEMA,
+        "problem_id": problem_id,
+        "model_tag": model_tag,
+        "status": status,
+        "n_draws": int(n),
+        "chains": int(chains),
+        "dim": int(dim),
+        "min_ess": None if min_ess is None else float(min_ess),
+        "max_rhat": None if max_rhat is None else float(max_rhat),
+        "health": sorted(health) if health else [],
+        "adaptation": None,
+        "quantile_probs": list(QUANTILE_PROBS),
+    }
+    if adaptation is not None:
+        out["adaptation"] = {
+            "step_size": float(adaptation["step_size"]),
+            "inv_mass_diag": [
+                float(v) for v in np.asarray(adaptation["inv_mass_diag"]).ravel()
+            ],
+        }
+    if n == 0:
+        out["mean"] = []
+        out["std"] = []
+        out["quantiles"] = []
+    else:
+        flat = draws.reshape(n * chains, dim)
+        # float64 accumulation: a million-row f32 mean drifts
+        out["mean"] = [float(v) for v in flat.mean(axis=0, dtype=np.float64)]
+        out["std"] = [float(v) for v in flat.std(axis=0, dtype=np.float64)]
+        cap = _sketch_cap()
+        stride = max(1, flat.shape[0] // cap)
+        sketch = np.asarray(flat[::stride], np.float64)
+        q = np.quantile(sketch, QUANTILE_PROBS, axis=0)
+        out["quantiles"] = [[float(v) for v in row] for row in q]
+    if extra:
+        out.update(extra)
+    return out
+
+
+def write_summary(
+    store_path: str, *, draws: Optional[np.ndarray] = None, **meta
+) -> str:
+    """Compute + atomically persist one store's sidecar; -> sidecar path.
+
+    The WRITE side of the summary contract — called by the fleet at
+    ``problem_converged`` time (the only writer).  Atomic tmp+rename so a
+    concurrent reader never sees a torn sidecar.  ``draws=None`` reads
+    the store (mmap, zero-copy) for the moment/sketch pass.
+    """
+    if draws is None:
+        draws, _, _ = read_draws(store_path, mmap=True)
+    summary = compute_summary(draws, **meta)
+    dst = summary_path(store_path)
+    tmp = dst + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(summary, f)
+    os.replace(tmp, dst)
+    return dst
+
+
+def read_summary(store_path: str) -> Optional[Dict[str, Any]]:
+    """The persisted sidecar for one store, or None (absent / torn)."""
+    try:
+        with open(summary_path(store_path)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+# --------------------------------------------------------------------------
+# predictive evaluator
+# --------------------------------------------------------------------------
+
+
+class PredictRequest:
+    """One tenant's posterior-predictive query.
+
+    ``x`` — (m, k) f32 covariate rows, or None to evaluate against the
+    tenant's REGISTERED design (`PosteriorStore.register_design`), which
+    may be a packed int8/int4 slab served without dequantization.
+    ``link`` — "identity" (linear predictor) or "logistic" (sigmoid).
+    """
+
+    __slots__ = ("problem_id", "x", "link")
+
+    def __init__(
+        self,
+        problem_id: str,
+        x: Optional[np.ndarray] = None,
+        link: str = "identity",
+    ):
+        if link not in ("identity", "logistic"):
+            raise ValueError(f"unknown link {link!r}")
+        self.problem_id = problem_id
+        self.x = None if x is None else np.asarray(x, np.float32)
+        self.link = link
+
+
+def _predict_group_fn(link: str):
+    """The ONE compiled dispatch for a shape group: vmapped over tenants.
+
+    ``beta`` (B, S, k) posterior draws, ``xq`` (B, m, k) covariates at
+    ANY storage dtype (int8 packed slabs included), ``scale`` (B, k)
+    per-column dequant scales (ones for f32 tenants).  Scales fold into
+    beta — ``(s * q) @ beta == q @ (s * beta)`` — so the packed slab
+    streams at its storage width, the `dequant_dot` identity.
+
+    Returns ``(mean, mu)``: the contraction + link + mean (the FLOPs —
+    a matmul the accelerator is built for) run compiled; the fixed-grid
+    quantile epilogue deliberately does NOT — XLA lowers quantiles to a
+    full comparator sort, which on CPU is ~4x slower than numpy's O(n)
+    introselect over the same batched ``mu``, so the caller takes the
+    quantiles host-side in one vectorized `np.quantile` (also exactly
+    the reference algorithm, so parity is bit-for-bit in the epilogue).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def f(beta, xq, scale):
+        eta = jnp.einsum(
+            "bsk,bmk->bsm",
+            beta * scale[:, None, :],
+            xq.astype(jnp.float32),
+        )
+        mu = jax.nn.sigmoid(eta) if link == "logistic" else eta
+        return jnp.mean(mu, axis=1), mu
+
+    return jax.jit(f)
+
+
+_PREDICT_FNS: Dict[str, Any] = {}
+_PREDICT_LOCK = threading.Lock()
+
+
+def _predict_fn(link: str):
+    with _PREDICT_LOCK:
+        fn = _PREDICT_FNS.get(link)
+        if fn is None:
+            fn = _PREDICT_FNS[link] = _predict_group_fn(link)
+        return fn
+
+
+def predict_reference(beta: np.ndarray, x: np.ndarray, link: str = "identity"):
+    """The naive per-draw Python loop — the parity/benchmark baseline.
+
+    One matvec per posterior draw, accumulated host-side: exactly what a
+    non-batched service would do per request.
+    """
+    mus = []
+    for s in range(beta.shape[0]):
+        eta = x.astype(np.float32) @ beta[s]
+        mus.append(1.0 / (1.0 + np.exp(-eta)) if link == "logistic" else eta)
+    mu = np.stack(mus)
+    return mu.mean(axis=0), np.quantile(mu, QUANTILE_PROBS, axis=0)
+
+
+# --------------------------------------------------------------------------
+# the multi-tenant registry
+# --------------------------------------------------------------------------
+
+
+class _Tenant:
+    """One cached tenant: read-only mmap + summary + optional design."""
+
+    __slots__ = ("draws", "chains", "dim", "summary")
+
+    def __init__(self, draws, chains, dim, summary=None):
+        self.draws = draws
+        self.chains = chains
+        self.dim = dim
+        self.summary = summary
+
+
+class PosteriorStore:
+    """Multi-tenant read-only registry over one fleet draw-store root.
+
+    Thread-safe (statusd handler threads share one instance); every
+    public read emits a ``serve_request`` event unless
+    ``STARK_SERVE_TELEMETRY=0``.  Never writes under ``root``.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        capacity: Optional[int] = None,
+        trace: Optional[Any] = None,
+    ):
+        self.root = root
+        self.capacity = _cache_capacity() if capacity is None else max(0, int(capacity))
+        self._lru: "OrderedDict[str, _Tenant]" = OrderedDict()
+        self._designs: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self._requests = 0
+        # explicit trace wins; else the ambient trace at call time; else a
+        # private in-memory bus so metrics listeners still see requests
+        self._trace = trace
+        self._bus = None
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _emit(self, endpoint: str, problem_id: str, t0: float,
+              cache: str, ok: bool = True, **fields) -> None:
+        if not serve_telemetry_enabled():
+            return
+        tr = self._trace
+        if tr is None:
+            amb = telemetry.get_trace()
+            if getattr(amb, "enabled", False):
+                tr = amb
+            else:
+                if self._bus is None:
+                    self._bus = telemetry.RunTrace(None)
+                tr = self._bus
+        tr.emit(
+            "serve_request",
+            endpoint=endpoint,
+            problem_id=problem_id,
+            dur_s=round(time.perf_counter() - t0, 6),
+            cache=cache,
+            ok=ok,
+            **fields,
+        )
+
+    # -- registry ----------------------------------------------------------
+
+    def path(self, problem_id: str) -> str:
+        return os.path.join(
+            self.root, f"{_STORE_PREFIX}{problem_id}{_STORE_SUFFIX}"
+        )
+
+    def ids(self) -> List[str]:
+        """Tenant ids present under the root (sorted; a directory scan,
+        not a cache read — new stores appear without invalidation)."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if name.startswith(_STORE_PREFIX) and name.endswith(_STORE_SUFFIX):
+                out.append(name[len(_STORE_PREFIX):-len(_STORE_SUFFIX)])
+        return sorted(out)
+
+    def cache_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._lru),
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "requests": self._requests,
+            }
+
+    def _tenant(self, problem_id: str) -> Tuple[_Tenant, str]:
+        """The cached tenant (LRU hit) or a fresh mmap open (miss)."""
+        with self._lock:
+            self._requests += 1
+            t = self._lru.get(problem_id)
+            if t is not None:
+                self.hits += 1
+                self._lru.move_to_end(problem_id)
+                return t, "hit"
+            self.misses += 1
+        path = self.path(problem_id)
+        if not os.path.exists(path):
+            raise KeyError(f"no posterior store for {problem_id!r}")
+        draws, chains, dim = read_draws(path, mmap=True)
+        t = _Tenant(draws, chains, dim, summary=read_summary(path))
+        with self._lock:
+            if self.capacity > 0:
+                self._lru[problem_id] = t
+                self._lru.move_to_end(problem_id)
+                while len(self._lru) > self.capacity:
+                    self._lru.popitem(last=False)
+        return t, "miss"
+
+    def evict(self, problem_id: Optional[str] = None) -> None:
+        """Drop one tenant (or all) from the LRU — the bench's cold knob."""
+        with self._lock:
+            if problem_id is None:
+                self._lru.clear()
+            else:
+                self._lru.pop(problem_id, None)
+
+    # -- reads -------------------------------------------------------------
+
+    def draws(self, problem_id: str) -> np.ndarray:
+        """(n, chains, dim) read-only memmap of one tenant's store."""
+        t0 = time.perf_counter()
+        try:
+            t, cache = self._tenant(problem_id)
+        except Exception:
+            self._emit("draws", problem_id, t0, "miss", ok=False)
+            raise
+        self._emit("draws", problem_id, t0, cache, n=int(t.draws.shape[0]))
+        return t.draws
+
+    def summary(self, problem_id: str) -> Dict[str, Any]:
+        """One tenant's summary: sidecar if persisted, else computed from
+        the mmap on first read (cached in memory, never persisted)."""
+        t0 = time.perf_counter()
+        try:
+            t, cache = self._tenant(problem_id)
+            if t.summary is None:
+                t.summary = compute_summary(t.draws, problem_id=problem_id)
+        except Exception:
+            self._emit("summary", problem_id, t0, "miss", ok=False)
+            raise
+        self._emit("summary", problem_id, t0, cache)
+        return t.summary
+
+    # -- predictive --------------------------------------------------------
+
+    def register_design(
+        self,
+        problem_id: str,
+        x: np.ndarray,
+        *,
+        dtype: Optional[str] = None,
+        pct: Optional[float] = None,
+    ) -> None:
+        """Attach a tenant's (m, k) design for x-less predict requests.
+
+        ``dtype`` in `ops.quantize.PACKED_DTYPES` packs the slab
+        (per-column symmetric calibration) and the tenant serves off the
+        packed bytes; None keeps f32 (scale = ones).
+        """
+        x = np.asarray(x, np.float32)
+        if dtype is None:
+            xq = x
+            scale = np.ones(x.shape[1], np.float32)
+        else:
+            from .ops.quantize import PACKED_DTYPES, pack_slab
+
+            q, s = pack_slab(x.T, PACKED_DTYPES[dtype], pct=pct)
+            xq = np.asarray(q).T  # (m, k) at storage width, zero-copy view
+            scale = np.asarray(s, np.float32)
+        with self._lock:
+            self._designs[problem_id] = (xq, scale)
+
+    def _predict_operands(self, req: PredictRequest):
+        t, cache = self._tenant(req.problem_id)
+        if req.x is not None:
+            xq = req.x
+            scale = np.ones(req.x.shape[1], np.float32)
+        else:
+            with self._lock:
+                pair = self._designs.get(req.problem_id)
+            if pair is None:
+                raise KeyError(
+                    f"predict for {req.problem_id!r} gave no x and no "
+                    "design is registered"
+                )
+            xq, scale = pair
+        n, chains, dim = t.draws.shape
+        if n == 0:
+            raise ValueError(f"{req.problem_id!r} has no draws to serve")
+        if xq.shape[1] != dim:
+            raise ValueError(
+                f"x has k={xq.shape[1]} columns, posterior dim is {dim}"
+            )
+        cap = _predict_draw_cap()
+        rows = min(n, max(1, -(-cap // chains)))  # ceil(cap/chains) tail rows
+        beta = np.asarray(t.draws[n - rows:], np.float32).reshape(
+            rows * chains, dim
+        )
+        return beta, xq, scale, cache
+
+    def predict(self, requests: Sequence[PredictRequest]) -> List[Dict[str, Any]]:
+        """Batched posterior-predictive evaluation across tenants.
+
+        Requests sharing a shape signature (S draws, m rows, k dims,
+        x dtype, link) are stacked and served by ONE compiled vmapped
+        dispatch; mixed batches fall into one dispatch per group.
+        Returns one response dict per request, in request order.
+        """
+        t0 = time.perf_counter()
+        # resolve operands first (cache accounting + validation up front)
+        resolved = []
+        for req in requests:
+            resolved.append((req, *self._predict_operands(req)))
+        groups: Dict[Tuple, List[int]] = {}
+        for i, (req, beta, xq, scale, _cache) in enumerate(resolved):
+            key = (
+                beta.shape[0], xq.shape[0], xq.shape[1],
+                str(np.asarray(xq).dtype), req.link,
+            )
+            groups.setdefault(key, []).append(i)
+        out: List[Optional[Dict[str, Any]]] = [None] * len(resolved)
+        for key, idxs in groups.items():
+            _S, _m, _k, _dt, link = key
+            beta_b = np.stack([resolved[i][1] for i in idxs])
+            xq_b = np.stack([np.asarray(resolved[i][2]) for i in idxs])
+            scale_b = np.stack([resolved[i][3] for i in idxs])
+            fn = _predict_fn(link)
+            mean_b, mu_b = fn(beta_b, xq_b, scale_b)
+            mean_b = np.asarray(mean_b)
+            # host-side quantile epilogue over the whole group (one
+            # vectorized introselect — see `_predict_group_fn`)
+            q_b = np.quantile(
+                np.asarray(mu_b), QUANTILE_PROBS, axis=1
+            )
+            for j, i in enumerate(idxs):
+                req = resolved[i][0]
+                out[i] = {
+                    "problem_id": req.problem_id,
+                    "link": req.link,
+                    "draws_used": int(key[0]),
+                    "mean": mean_b[j].tolist(),
+                    "quantile_probs": list(QUANTILE_PROBS),
+                    "quantiles": q_b[:, j, :].tolist(),
+                    "cache": resolved[i][4],
+                }
+        hit_all = all(r[4] == "hit" for r in resolved) if resolved else False
+        self._emit(
+            "predict",
+            ",".join(r[0].problem_id for r in resolved[:8]),
+            t0,
+            "hit" if hit_all else "miss",
+            batch=len(resolved),
+            groups=len(groups),
+        )
+        return [r for r in out if r is not None]
+
+    def close(self) -> None:
+        with self._lock:
+            self._lru.clear()
+            self._designs.clear()
+
+
+# --------------------------------------------------------------------------
+# incremental reconvergence: yesterday's posterior as a donor
+# --------------------------------------------------------------------------
+
+
+def donor_pool_from_store(store_path: str, tag: str):
+    """A `fleet.DonorPool` pre-seeded from one served posterior.
+
+    Sidecar adaptation (step size + inverse-mass diagonal) seeds the
+    moment donor; the store's LAST draw row — one position per chain, the
+    most-converged ensemble on disk — seeds the position donor.  Both
+    validations (finite on write) run inside the pool.  Pass the result
+    to ``sample_fleet(donor_pool=...)`` with STARK_FLEET_WARMSTART=1 and
+    the resubmitted tenant reconverges warm instead of cold.
+    """
+    from .fleet import DonorPool
+
+    pool = DonorPool()
+    s = read_summary(store_path)
+    if s and s.get("adaptation"):
+        a = s["adaptation"]
+        pool.add(
+            tag,
+            float(a["step_size"]),
+            np.asarray(a["inv_mass_diag"], np.float64),
+        )
+    draws, _chains, _dim = read_draws(store_path, mmap=True)
+    if draws.shape[0]:
+        pool.add_ensemble(tag, np.asarray(draws[-1], np.float32))
+    return pool

@@ -56,6 +56,20 @@ Endpoints:
     on runs that never dispatch an accounted collective; additive, so
     the schema version is again unchanged.
 
+  * ``/posterior/<id>/summary``, ``/posterior/<id>/predict``,
+    ``/posterior/<id>/draws`` — the posterior READ plane
+    (`stark_tpu.serving`), live once a `serving.PosteriorStore` is
+    attached (``attach_serving`` or ``STARK_SERVE_ROOT``; 503 with a
+    JSON reason otherwise).  GET summary returns the tenant's
+    ``.summary.json`` sidecar (or an in-memory computed fallback); GET
+    draws returns the last ``?n=`` draws off the zero-copy mmap; POST
+    predict evaluates the batched posterior-predictive (body
+    ``{"x": [[...]], "link": ...}``, or no ``x`` to serve the
+    registered — possibly int8-packed — design).  Request accounting
+    (``serve_request`` events) feeds the ``stark_serve_*`` metrics and
+    ``/status``'s ``serving`` sub-object; see the README "Posterior
+    serving" section for the full JSON contracts.
+
 Probe contract: ``python -m stark_tpu status --json`` prints ONE
 machine-parseable line ``{"endpoint", "code", "body"}`` for any of the
 three endpoints (body parsed when the response was JSON).
@@ -88,6 +102,8 @@ from .metrics import MetricsRegistry, RunHealth, TraceCollector
 log = logging.getLogger("stark_tpu.statusd")
 
 __all__ = [
+    "ROUTES",
+    "SERVE_ROOT_ENV",
     "STATUS_PORT_ENV",
     "StatusServer",
     "get_server",
@@ -97,6 +113,24 @@ __all__ = [
 ]
 
 STATUS_PORT_ENV = "STARK_STATUS_PORT"
+
+#: posterior read plane: when set, `maybe_start_from_env` attaches a
+#: `serving.PosteriorStore` over this fleet draw-store root, enabling
+#: the ``/posterior/*`` endpoints on the same daemon
+SERVE_ROOT_ENV = "STARK_SERVE_ROOT"
+
+#: the DECLARED endpoint contract: every route this daemon serves, in
+#: the exact spelling the README endpoint table and the contract tests
+#: must carry (tools/lint_endpoints.py closes the loop statically).
+#: ``<id>`` segments are path parameters.
+ROUTES = (
+    "/metrics",
+    "/healthz",
+    "/status",
+    "/posterior/<id>/summary",
+    "/posterior/<id>/predict",
+    "/posterior/<id>/draws",
+)
 
 #: bind address: loopback by default — the endpoints expose run metadata
 #: (git SHA, toolchain versions, device inventory) with no auth, so
@@ -120,6 +154,23 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_json(self, code: int, obj: Any) -> None:
+        body = (json.dumps(obj, default=str) + "\n").encode()
+        self._send(code, body, "application/json")
+
+    def _posterior_route(self, path: str):
+        """``/posterior/<id>/<verb>`` -> (problem_id, verb) or None."""
+        parts = path.strip("/").split("/")
+        if len(parts) == 3 and parts[0] == "posterior" and parts[1]:
+            return parts[1], parts[2]
+        return None
+
+    def _query(self) -> Dict[str, str]:
+        from urllib.parse import parse_qsl
+
+        raw = self.path.split("?", 1)
+        return dict(parse_qsl(raw[1])) if len(raw) == 2 else {}
 
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
         sd: "StatusServer" = self.server.statusd  # type: ignore[attr-defined]
@@ -149,9 +200,101 @@ class _Handler(BaseHTTPRequestHandler):
                     + "\n"
                 ).encode()
                 self._send(200, body, "application/json")
+            elif self._posterior_route(path) is not None:
+                self._serve_posterior_get(sd, *self._posterior_route(path))
             else:
                 self._send(404, b"not found\n", "text/plain; charset=utf-8")
         except Exception as e:  # noqa: BLE001 — a scrape must never kill the daemon
+            try:
+                self._send(
+                    500,
+                    f"internal error: {type(e).__name__}\n".encode(),
+                    "text/plain; charset=utf-8",
+                )
+            except Exception:  # noqa: BLE001 — client already gone
+                pass
+
+    def _serve_posterior_get(
+        self, sd: "StatusServer", pid: str, verb: str
+    ) -> None:
+        """GET half of the read plane: ``/posterior/<id>/summary`` (the
+        sidecar or a computed fallback) and ``/posterior/<id>/draws``
+        (the LAST ``n`` draws — ``?n=``, default 100, JSON rows read
+        straight off the zero-copy mmap)."""
+        store = sd.serving
+        if store is None:
+            self._send_json(
+                503, {"error": "no posterior store attached "
+                      f"(set {SERVE_ROOT_ENV} or attach_serving)"}
+            )
+            return
+        try:
+            if verb == "summary":
+                self._send_json(200, store.summary(pid))
+            elif verb == "draws":
+                draws = store.draws(pid)
+                try:
+                    n = max(0, int(self._query().get("n", "100")))
+                except ValueError:
+                    n = 100
+                tail = draws[max(0, draws.shape[0] - n):]
+                self._send_json(200, {
+                    "problem_id": pid,
+                    "n_draws": int(draws.shape[0]),
+                    "chains": int(draws.shape[1]),
+                    "dim": int(draws.shape[2]),
+                    "returned": int(tail.shape[0]),
+                    "draws": tail.tolist(),
+                })
+            else:
+                self._send_json(404, {"error": f"unknown verb {verb!r}"})
+        except KeyError as e:
+            self._send_json(404, {"error": str(e)})
+
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        """``POST /posterior/<id>/predict`` — body
+        ``{"x": [[...]], "link": "identity"|"logistic"}`` (``x`` omitted
+        serves the tenant's registered — possibly packed — design);
+        response: ``{problem_id, link, draws_used, mean, quantile_probs,
+        quantiles, cache}`` from the batched evaluator."""
+        sd: "StatusServer" = self.server.statusd  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            route = self._posterior_route(path)
+            if route is None or route[1] != "predict":
+                self._send_json(404, {"error": "not found"})
+                return
+            store = sd.serving
+            if store is None:
+                self._send_json(
+                    503, {"error": "no posterior store attached "
+                          f"(set {SERVE_ROOT_ENV} or attach_serving)"}
+                )
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                self._send_json(400, {"error": "malformed JSON body"})
+                return
+            from .serving import PredictRequest
+
+            try:
+                import numpy as np
+
+                x = body.get("x")
+                req = PredictRequest(
+                    route[0],
+                    None if x is None else np.asarray(x, np.float32),
+                    link=body.get("link", "identity"),
+                )
+                out = store.predict([req])
+                self._send_json(200, out[0])
+            except KeyError as e:
+                self._send_json(404, {"error": str(e)})
+            except ValueError as e:
+                self._send_json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — a request must never kill the daemon
             try:
                 self._send(
                     500,
@@ -187,6 +330,17 @@ class StatusServer:
         self._requested = (host, int(port))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        #: the attached posterior read plane (serving.PosteriorStore);
+        #: None -> the /posterior/* endpoints answer 503
+        self.serving: Optional[Any] = None
+
+    def attach_serving(self, store: Any) -> "StatusServer":
+        """Attach a `serving.PosteriorStore`, enabling ``/posterior/*``.
+
+        The store is shared across handler threads (it locks
+        internally); re-attaching replaces the previous plane."""
+        self.serving = store
+        return self
 
     @property
     def port(self) -> Optional[int]:
@@ -296,7 +450,7 @@ def maybe_start_from_env(
     if port is None:
         return None
     try:
-        return start_status_server(port)
+        srv = start_status_server(port)
     except Exception as e:  # noqa: BLE001 — exporter startup is best-effort
         log.warning(
             "status server on port %s failed to start (%s: %s) — "
@@ -304,3 +458,17 @@ def maybe_start_from_env(
             port, type(e).__name__, e,
         )
         return None
+    serve_root = os.environ.get("STARK_SERVE_ROOT", "").strip()
+    if serve_root and srv.serving is None:
+        # posterior read plane over an existing fleet store root; a bad
+        # root degrades to 503s on /posterior/*, never a failed start
+        try:
+            from .serving import PosteriorStore
+
+            srv.attach_serving(PosteriorStore(serve_root))
+        except Exception as e:  # noqa: BLE001 — attach is best-effort
+            log.warning(
+                "posterior store at %s=%r failed to attach (%s: %s)",
+                SERVE_ROOT_ENV, serve_root, type(e).__name__, e,
+            )
+    return srv

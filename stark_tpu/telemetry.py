@@ -171,11 +171,22 @@ HEALTH_EVENT_TYPES = frozenset({"health_warning"})
 #: suppresses the family entirely (byte-identical traces).
 COMM_EVENT_TYPES = frozenset({"comm"})
 
+#: serving event types (stark_tpu.serving): ``serve_request`` — one
+#: posterior read-plane request (``endpoint`` in summary / predict /
+#: draws, ``problem_id``, ``dur_s`` host wall, ``cache`` hit/miss,
+#: ``ok``; predict requests add ``batch``/``groups`` — requests and
+#: compiled dispatches in the batched evaluation).  Emitted host-side
+#: by `serving.PosteriorStore`, entirely outside the samplers' op/key
+#: sequence; STARK_SERVE_TELEMETRY=0 suppresses the family (a fleet run
+#: queried by a live read plane then stays byte-identical — the
+#: ``serving_clean_identity`` drill).
+SERVING_EVENT_TYPES = frozenset({"serve_request"})
+
 #: the complete WRITER registry: every emit()/phase() call in stark_tpu/
 #: must use one of these names (tools/lint_trace_schema.py enforces it)
 ALL_EVENT_TYPES = (EVENT_TYPES | AUX_EVENT_TYPES | FLEET_EVENT_TYPES
                    | PROFILING_EVENT_TYPES | HEALTH_EVENT_TYPES
-                   | COMM_EVENT_TYPES)
+                   | COMM_EVENT_TYPES | SERVING_EVENT_TYPES)
 
 #: envelope keys every event must carry (validate_event)
 ENVELOPE_KEYS = ("schema", "event", "ts", "wall_s", "run")

@@ -70,6 +70,59 @@ def test_shape_validation(tmp_path):
             ds.append(np.zeros((7, 7, 7), np.float32))
 
 
+def _torn_copy(path, tmp_path, cut_bytes):
+    """Copy a store file and tear ``cut_bytes`` off its tail."""
+    import os
+    import shutil
+
+    torn = str(tmp_path / "torn.stkd")
+    shutil.copyfile(path, torn)
+    os.truncate(torn, os.path.getsize(torn) - cut_bytes)
+    return torn
+
+
+@pytest.mark.parametrize("mmap", [True, False])
+def test_read_tolerates_torn_tail(tmp_path, mmap):
+    # a crash mid-record leaves a partial final row: readers must
+    # truncate to the last complete row, not raise
+    path = str(tmp_path / "t.stkd")
+    block = np.arange(2 * 6 * 3, dtype=np.float32).reshape(2, 6, 3)
+    with DrawStore(path, chains=2, dim=3) as ds:
+        ds.append(block)
+    torn = _torn_copy(path, tmp_path, cut_bytes=5)  # tear into row 5
+    draws, chains, dim = read_draws(torn, mmap=mmap)
+    assert (chains, dim) == (2, 3)
+    assert draws.shape == (5, 2, 3)
+    np.testing.assert_array_equal(
+        draws, np.transpose(block, (1, 0, 2))[:5]
+    )
+
+
+@pytest.mark.parametrize("mmap", [True, False])
+def test_read_torn_inside_first_row(tmp_path, mmap):
+    # torn before one full record exists: zero draws, not an mmap error
+    path = str(tmp_path / "t0.stkd")
+    with DrawStore(path, chains=2, dim=3) as ds:
+        ds.append(np.ones((2, 1, 3), np.float32))
+    torn = _torn_copy(path, tmp_path, cut_bytes=4)
+    draws, chains, dim = read_draws(torn, mmap=mmap)
+    assert draws.shape == (0, 2, 3)
+    assert draws.dtype == np.float32
+
+
+def test_read_opens_read_only(tmp_path):
+    # the mmap handed to a serving process must not be writable: writing
+    # through it must raise rather than silently corrupt the live store
+    path = str(tmp_path / "ro.stkd")
+    with DrawStore(path, chains=2, dim=3) as ds:
+        ds.append(np.ones((2, 4, 3), np.float32))
+    draws, _, _ = read_draws(path, mmap=True)
+    assert isinstance(draws, np.memmap)
+    assert draws.mode == "r"
+    with pytest.raises((ValueError, OSError)):
+        draws[0, 0, 0] = 42.0
+
+
 @pytest.mark.slow  # >=8s on the 1-core host (pytest.ini policy, re-profiled 2026-08-03)
 def test_runner_writes_draw_store(tmp_path):
     import jax.numpy as jnp

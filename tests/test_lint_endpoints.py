@@ -1,0 +1,95 @@
+"""tools/lint_endpoints.py: every route in statusd's ROUTES tuple must
+appear in the README endpoint table AND as a literal in a tests/*.py
+contract test — the HTTP twin of lint_metrics_docs (metrics table) and
+lint_fused_knobs (env knobs).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import lint_endpoints  # noqa: E402
+
+
+def test_repo_is_clean():
+    violations = lint_endpoints.lint_repo(REPO)
+    assert violations == [], "\n".join(violations)
+
+
+def test_collector_reads_the_real_routes():
+    path = os.path.join(REPO, "stark_tpu", "statusd.py")
+    with open(path) as f:
+        routes = lint_endpoints.find_routes(f.read(), path)
+    # the retrofit floor: the three original endpoints plus the
+    # posterior read plane must all be declared
+    assert {
+        "/metrics",
+        "/healthz",
+        "/status",
+        "/posterior/<id>/summary",
+        "/posterior/<id>/predict",
+        "/posterior/<id>/draws",
+    } <= set(routes)
+
+
+def test_collector_ignores_non_literal_elements():
+    src = (
+        "X = '/dynamic'\n"
+        "ROUTES = ('/metrics', X, '/healthz')\n"
+    )
+    assert lint_endpoints.find_routes(src, "<mem>") == [
+        "/metrics", "/healthz",
+    ]
+
+
+def _write_repo(tmp_path, readme: str, test_body: str):
+    (tmp_path / "stark_tpu").mkdir(exist_ok=True)
+    (tmp_path / "tests").mkdir(exist_ok=True)
+    (tmp_path / "stark_tpu" / "statusd.py").write_text(
+        "ROUTES = ('/metrics', '/shiny')\n"
+    )
+    (tmp_path / "README.md").write_text(readme)
+    (tmp_path / "tests" / "test_x.py").write_text(test_body)
+
+
+def test_synthetic_violations_detected(tmp_path):
+    """An undocumented or untested route fails in each direction
+    independently; fixing both clears the lint."""
+    _write_repo(
+        tmp_path,
+        readme="| `/metrics` | scrape |\n",
+        test_body="ROUTE = '/metrics'\n",
+    )
+    violations = lint_endpoints.lint_repo(str(tmp_path))
+    assert len(violations) == 2
+    assert any("README endpoint table" in v for v in violations)
+    assert any("contract test" in v for v in violations)
+    _write_repo(
+        tmp_path,
+        readme="| `/metrics` | scrape |\n| `/shiny` | new |\n",
+        test_body="ROUTES = ['/metrics', '/shiny']\n",
+    )
+    assert lint_endpoints.lint_repo(str(tmp_path)) == []
+
+
+def test_missing_routes_tuple_reported(tmp_path):
+    (tmp_path / "stark_tpu").mkdir()
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "stark_tpu" / "statusd.py").write_text("x = 1\n")
+    (tmp_path / "README.md").write_text("")
+    violations = lint_endpoints.lint_repo(str(tmp_path))
+    assert violations and "contract declaration is missing" in violations[0]
+
+
+@pytest.mark.parametrize("rc_expect", [0])
+def test_cli_exit_code(rc_expect):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_endpoints.py")],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == rc_expect, proc.stderr

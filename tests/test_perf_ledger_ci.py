@@ -208,6 +208,55 @@ def test_quantized_fusedvg_rows_committed():
     )
 
 
+def test_serving_read_rows_committed():
+    """The posterior-serving read plane's ledger evidence (``bench.py
+    microbench serving``): committed ``read:summary:*``,
+    ``read:predict:*`` and ``read:reconverge:*`` rows exist, and each
+    newest row either holds its own acceptance gate — >=10x warm-LRU
+    summary QPS, >=5x batched predictive throughput at parity with a
+    quantized-X tenant named on the row, and an eight-schools
+    incremental resubmit that saved draws — or follows the honest-null
+    rule (a gate-losing leg records missing data in the value column,
+    never a measured zero)."""
+    rows = [json.loads(l) for l in open(_LEDGER) if l.strip()]
+
+    def newest(prefix):
+        series = [r for r in rows if r["config"].startswith(prefix)]
+        assert series, f"committed ledger must carry a {prefix}* row"
+        return series[-1]
+
+    summ = newest("read:summary:")
+    if summ["converged"] is True:
+        assert summ["warm_cold_speedup"] >= 10.0
+        assert summ["summary_qps_warm"] > summ["summary_qps_cold"]
+        assert summ["cache_hit_ratio"] > 0.0
+    else:
+        assert summ["ess_per_sec"] is None
+
+    pred = newest("read:predict:")
+    # the quantized tenant rides the row whether or not the gate held:
+    # the scale-fold identity is correctness evidence, not throughput
+    assert pred["quantized_tenant"]
+    assert pred["predict_parity_abs_err"] is not None
+    assert pred["predict_parity_abs_err"] <= 1e-5
+    if pred["converged"] is True:
+        assert pred["speedup_vs_loop"] >= 5.0
+        assert pred["batched_evals_per_sec"] > pred["loop_evals_per_sec"]
+    else:
+        assert pred["ess_per_sec"] is None
+
+    reconv = newest("read:reconverge:")
+    if reconv["converged"] is True:
+        assert reconv["reconverge_draws_saved"] > 0
+        assert reconv["warmstarted"] is True
+        assert (
+            reconv["warm_total_draws_per_chain"]
+            < reconv["cold_total_draws_per_chain"]
+        )
+    else:
+        assert reconv["ess_per_sec"] is None
+
+
 def test_fresh_config_passes(tmp_path):
     """A config with no history must not fail CI (fresh ledgers pass)."""
     path = tmp_path / "ledger.jsonl"

@@ -1,0 +1,423 @@
+"""Posterior read plane (stark_tpu/serving.py) contracts.
+
+Sidecar summaries (write-once at convergence, atomic, computed
+fallback), the multi-tenant LRU (hit/miss accounting, capacity
+eviction, `STARK_SERVE_CACHE=0` off-switch), the batched predictive
+evaluator (parity with the per-draw reference at both links, a
+quantized tenant served off the packed slab via the scale-fold
+identity, `STARK_SERVE_PREDICT_DRAWS` tail cap), telemetry knob-off
+silence (`STARK_SERVE_TELEMETRY=0`), the statusd ``/posterior/<id>/*``
+endpoint contracts (incl. `STARK_SERVE_ROOT` auto-attach and the
+schema-3 ``/status`` `serving` sub-object), DonorPool position
+ensembles + checkpoint ride, and `donor_pool_from_store` — the
+incremental-reconvergence seed.  `STARK_SERVE_SKETCH` caps the sidecar
+quantile subsample.
+
+Read-only discipline: nothing here mutates a store after sampling —
+the read plane must never write under its root.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from stark_tpu import serving, telemetry
+from stark_tpu.drawstore import DrawStore
+from stark_tpu.serving import PosteriorStore, PredictRequest
+from stark_tpu.statusd import StatusServer
+
+
+def _mk_store(root, pid, *, chains=2, draws=40, dim=3, seed=0,
+              sidecar=True):
+    """One tenant's .stkr store (+ optional sidecar) under root."""
+    path = os.path.join(str(root), f"p_{pid}.stkr")
+    rng = np.random.default_rng(seed)
+    with DrawStore(path, chains=chains, dim=dim) as ds:
+        ds.append(rng.standard_normal((chains, draws, dim))
+                  .astype(np.float32))
+    if sidecar:
+        serving.write_summary(
+            path, problem_id=pid, model_tag="T", status="converged",
+            min_ess=123.0, max_rhat=1.01,
+            adaptation={"step_size": 0.3,
+                        "inv_mass_diag": np.ones(dim)},
+        )
+    return path
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _post(port, path, obj):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# -------------------------------------------------------------------------
+# summary sidecar
+# -------------------------------------------------------------------------
+
+
+def test_sidecar_roundtrip_and_schema(tmp_path):
+    path = _mk_store(tmp_path, "t0", chains=2, draws=50, dim=3)
+    s = serving.read_summary(path)
+    assert s is not None and s["schema"] == serving.SUMMARY_SCHEMA
+    assert s["problem_id"] == "t0" and s["status"] == "converged"
+    assert (s["n_draws"], s["chains"], s["dim"]) == (50, 2, 3)
+    assert s["min_ess"] == 123.0 and s["max_rhat"] == 1.01
+    assert s["adaptation"]["step_size"] == 0.3
+    assert len(s["adaptation"]["inv_mass_diag"]) == 3
+    # moments match a float64 pass over the real draws
+    from stark_tpu.drawstore import read_draws
+
+    draws, _, _ = read_draws(path)
+    flat = draws.reshape(-1, 3)
+    np.testing.assert_allclose(
+        s["mean"], flat.mean(axis=0, dtype=np.float64), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        s["std"], flat.std(axis=0, dtype=np.float64), atol=1e-6
+    )
+    assert len(s["quantiles"]) == len(serving.QUANTILE_PROBS)
+    assert s["quantile_probs"] == list(serving.QUANTILE_PROBS)
+
+
+def test_summary_computed_fallback_without_sidecar(tmp_path):
+    _mk_store(tmp_path, "bare", sidecar=False)
+    store = PosteriorStore(str(tmp_path))
+    s = store.summary("bare")
+    assert s["problem_id"] == "bare" and s["status"] is None
+    assert s["n_draws"] == 40
+    # the fallback never persists: the root stays read-only
+    assert sorted(os.listdir(tmp_path)) == ["p_bare.stkr"]
+
+
+def test_sketch_cap_knob_bounds_the_subsample(tmp_path, monkeypatch):
+    """STARK_SERVE_SKETCH caps the quantile sketch rows: a cap at or
+    above the store is exact; a tiny cap coarsens quantiles only —
+    mean/std stay full-store float64 either way (floor 64)."""
+    path = _mk_store(tmp_path, "q", chains=2, draws=100, dim=2,
+                     sidecar=False)
+    from stark_tpu.drawstore import read_draws
+
+    draws, _, _ = read_draws(path)
+    flat = draws.reshape(-1, 2)
+    monkeypatch.setenv("STARK_SERVE_SKETCH", "100000")
+    exact = serving.compute_summary(draws)
+    np.testing.assert_allclose(
+        exact["quantiles"],
+        np.quantile(np.asarray(flat, np.float64),
+                    serving.QUANTILE_PROBS, axis=0),
+        atol=1e-7,
+    )
+    monkeypatch.setenv("STARK_SERVE_SKETCH", "64")
+    coarse = serving.compute_summary(draws)
+    np.testing.assert_allclose(coarse["mean"], exact["mean"], atol=1e-7)
+    np.testing.assert_allclose(coarse["std"], exact["std"], atol=1e-7)
+    q = np.asarray(coarse["quantiles"])
+    assert q.shape == (len(serving.QUANTILE_PROBS), 2)
+    assert np.all(np.isfinite(q)) and np.all(np.diff(q, axis=0) >= 0)
+
+
+# -------------------------------------------------------------------------
+# LRU
+# -------------------------------------------------------------------------
+
+
+def test_lru_hit_miss_eviction_and_cache_off(tmp_path, monkeypatch):
+    for pid in ("a", "b"):
+        _mk_store(tmp_path, pid, seed=ord(pid))
+    store = PosteriorStore(str(tmp_path), capacity=8)
+    assert store.ids() == ["a", "b"]
+    store.summary("a")            # cold open
+    store.summary("a")            # resident
+    store.draws("a")              # still resident (shared tenant entry)
+    st = store.cache_stats()
+    assert (st["misses"], st["hits"]) == (1, 2)
+    store.evict("a")              # the bench's cold knob
+    store.summary("a")
+    assert store.cache_stats()["misses"] == 2
+    # capacity-1 store: the second tenant evicts the first
+    small = PosteriorStore(str(tmp_path), capacity=1)
+    small.summary("a"); small.summary("b"); small.summary("a")
+    assert small.cache_stats() == {
+        "entries": 1, "capacity": 1, "hits": 0, "misses": 3,
+        "requests": 3,
+    }
+    # STARK_SERVE_CACHE=0 disables caching entirely (env-driven default)
+    monkeypatch.setenv("STARK_SERVE_CACHE", "0")
+    off = PosteriorStore(str(tmp_path))
+    assert off.capacity == 0
+    off.summary("a"); off.summary("a")
+    st = off.cache_stats()
+    assert st["entries"] == 0 and st["misses"] == 2 and st["hits"] == 0
+    assert PosteriorStore(str(tmp_path), capacity=3).capacity == 3
+    with pytest.raises(KeyError):
+        store.summary("nope")
+
+
+# -------------------------------------------------------------------------
+# batched predictive evaluator
+# -------------------------------------------------------------------------
+
+
+def test_predict_parity_batched_quantized_and_draw_cap(tmp_path,
+                                                       monkeypatch):
+    """The one-dispatch batched evaluator matches the per-draw reference
+    loop at <=1e-5 for every tenant in a mixed batch — including a
+    tenant served off its packed int8 design (scale folds into beta,
+    the bytes are never dequantized) — and STARK_SERVE_PREDICT_DRAWS
+    caps the draw tail entering the evaluator."""
+    chains, dim, m = 2, 3, 5
+    for i, pid in enumerate(("p0", "p1", "p2")):
+        _mk_store(tmp_path, pid, chains=chains, draws=30, dim=dim,
+                  seed=10 + i)
+    store = PosteriorStore(str(tmp_path))
+    rng = np.random.default_rng(99)
+    xq_design = rng.standard_normal((m, dim)).astype(np.float32)
+    store.register_design("p0", xq_design, dtype="int8")
+    reqs = [
+        PredictRequest("p0", None),                       # packed design
+        PredictRequest(
+            "p1", rng.standard_normal((m, dim)).astype(np.float32)
+        ),
+        PredictRequest(
+            "p2", rng.standard_normal((m, dim)).astype(np.float32),
+            link="logistic",
+        ),
+    ]
+    out = store.predict(reqs)
+    assert [o["problem_id"] for o in out] == ["p0", "p1", "p2"]
+    for req, o in zip(reqs, out):
+        beta, xq, scale, _cache = store._predict_operands(req)
+        x_eff = np.asarray(xq, np.float32) * scale[None, :]
+        ref_mean, ref_q = serving.predict_reference(
+            beta, x_eff, link=req.link
+        )
+        np.testing.assert_allclose(o["mean"], ref_mean, atol=1e-5)
+        np.testing.assert_allclose(o["quantiles"], ref_q, atol=1e-5)
+        assert o["quantile_probs"] == list(serving.QUANTILE_PROBS)
+    # the quantized tenant really serves off int8 bytes
+    xq0, scale0 = store._designs["p0"]
+    assert np.asarray(xq0).dtype == np.int8
+    assert not np.allclose(scale0, 1.0)
+    # draw-tail cap: ceil(cap/chains) tail rows -> cap draws
+    monkeypatch.setenv("STARK_SERVE_PREDICT_DRAWS", "16")
+    capped = store.predict([reqs[1]])[0]
+    assert capped["draws_used"] == 16
+    monkeypatch.delenv("STARK_SERVE_PREDICT_DRAWS")
+    assert store.predict([reqs[1]])[0]["draws_used"] == 30 * chains
+    # malformed query: no x and no registered design
+    with pytest.raises(KeyError):
+        store.predict([PredictRequest("p1", None)])
+    # dim-mismatched x is a ValueError, not a crash
+    with pytest.raises(ValueError):
+        store.predict([PredictRequest(
+            "p1", np.zeros((m, dim + 1), np.float32)
+        )])
+
+
+# -------------------------------------------------------------------------
+# telemetry knob
+# -------------------------------------------------------------------------
+
+
+def test_serve_telemetry_knob_off_silences_events(tmp_path, monkeypatch):
+    """Every read emits a `serve_request` event by default;
+    STARK_SERVE_TELEMETRY=0 silences the family — responses and cache
+    accounting identical (the read plane is host-side either way)."""
+    _mk_store(tmp_path, "t")
+    seen = []
+    telemetry.add_event_listener(seen.append)
+    try:
+        store = PosteriorStore(str(tmp_path))
+        store.summary("t")
+        store.draws("t")
+        store.predict([PredictRequest(
+            "t", np.zeros((2, 3), np.float32)
+        )])
+        events = [r for r in seen if r.get("event") == "serve_request"]
+        assert [e["endpoint"] for e in events] == \
+            ["summary", "draws", "predict"]
+        assert events[0]["cache"] == "miss" and events[1]["cache"] == "hit"
+        assert all(e["ok"] for e in events)
+        assert events[2]["batch"] == 1
+        # knob off: same reads, zero new events, same answers
+        monkeypatch.setenv("STARK_SERVE_TELEMETRY", "0")
+        before = len(seen)
+        quiet = PosteriorStore(str(tmp_path))
+        s_on, s_off = store.summary("t"), quiet.summary("t")
+        assert s_on == s_off
+        assert quiet.cache_stats()["requests"] == 1
+        assert len(seen) == before
+    finally:
+        telemetry.remove_event_listener(seen.append)
+
+
+# -------------------------------------------------------------------------
+# statusd endpoints
+# -------------------------------------------------------------------------
+
+
+def test_statusd_posterior_endpoint_contracts(tmp_path):
+    """The read-plane routes over a live daemon: 503 detached, then
+    summary / draws / predict against an attached store, 404 unknown
+    tenant, 400 malformed predict, and the schema-3 /status `serving`
+    sub-object fed by the request stream."""
+    _mk_store(tmp_path, "t8", chains=2, draws=25, dim=3)
+    srv = StatusServer(0, host="127.0.0.1").start()
+    try:
+        code, body = _get(srv.port, "/posterior/t8/summary")
+        assert code == 503 and "STARK_SERVE_ROOT" in json.loads(body)["error"]
+        srv.attach_serving(PosteriorStore(str(tmp_path)))
+        # /posterior/<id>/summary
+        code, body = _get(srv.port, "/posterior/t8/summary")
+        assert code == 200
+        s = json.loads(body)
+        assert s["problem_id"] == "t8" and s["status"] == "converged"
+        # /posterior/<id>/draws (?n= tail)
+        code, body = _get(srv.port, "/posterior/t8/draws?n=5")
+        assert code == 200
+        d = json.loads(body)
+        assert (d["n_draws"], d["chains"], d["dim"]) == (25, 2, 3)
+        assert d["returned"] == 5 and len(d["draws"]) == 5
+        # /posterior/<id>/predict (POST; explicit x)
+        code, body = _post(
+            srv.port, "/posterior/t8/predict",
+            {"x": [[0.0, 0.0, 0.0], [1.0, 1.0, 1.0]],
+             "link": "identity"},
+        )
+        assert code == 200
+        p = json.loads(body)
+        assert len(p["mean"]) == 2 and p["draws_used"] == 50
+        assert len(p["quantiles"]) == len(serving.QUANTILE_PROBS)
+        # error contracts
+        assert _get(srv.port, "/posterior/ghost/summary")[0] == 404
+        assert _get(srv.port, "/posterior/t8/frobnicate")[0] == 404
+        code, _ = _post(srv.port, "/posterior/t8/predict",
+                        {"x": [[1.0]]})        # k mismatch
+        assert code == 400
+        assert _post(srv.port, "/posterior/ghost/predict", {})[0] == 404
+        # /status grows the `serving` rollup at contract schema 3
+        code, body = _get(srv.port, "/status")
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["schema"] == 3
+        sv = snap["serving"]
+        assert sv["requests"] >= 4 and sv["misses"] >= 1
+        assert set(sv["by_endpoint"]) >= {"summary", "draws", "predict"}
+        assert sv["qps"] > 0
+        # metrics family materialized from the same stream
+        code, text = _get(srv.port, "/metrics")
+        assert code == 200
+        assert "stark_serve_requests_total" in text
+        assert "stark_serve_cache_misses_total" in text
+        assert "stark_serve_request_seconds_bucket" in text
+    finally:
+        srv.stop()
+
+
+def test_serve_root_env_auto_attach(tmp_path, monkeypatch):
+    """STARK_SERVE_ROOT=<fleet store root> attaches the read plane at
+    daemon start (maybe_start_from_env); a bad root degrades to 503s,
+    never a failed start."""
+    from stark_tpu import statusd
+
+    _mk_store(tmp_path, "auto")
+    monkeypatch.setenv("STARK_SERVE_ROOT", str(tmp_path))
+    srv = statusd.maybe_start_from_env(0)
+    try:
+        assert srv is not None and srv.serving is not None
+        code, body = _get(srv.port, "/posterior/auto/summary")
+        assert code == 200 and json.loads(body)["problem_id"] == "auto"
+    finally:
+        statusd.stop_status_server()
+    # unset -> detached daemon, /posterior/* answers 503
+    monkeypatch.delenv("STARK_SERVE_ROOT")
+    srv = statusd.maybe_start_from_env(0)
+    try:
+        assert srv is not None and srv.serving is None
+        assert _get(srv.port, "/posterior/auto/summary")[0] == 503
+    finally:
+        statusd.stop_status_server()
+
+
+# -------------------------------------------------------------------------
+# incremental reconvergence: position ensembles + the store-seeded pool
+# -------------------------------------------------------------------------
+
+
+def test_donor_pool_position_ensembles_and_checkpoint_ride():
+    """DonorPool's ensemble side mirrors the moment contract: finite-
+    validated on write AND read, latest-finite-wins, and it rides
+    state_dict/load_state (the fleet checkpoint representation)."""
+    from stark_tpu.fleet import DonorPool
+
+    pool = DonorPool()
+    assert pool.ensemble("m") is None
+    bad = np.ones((2, 3), np.float32); bad[1, 1] = np.nan
+    assert not pool.add_ensemble("m", bad)
+    assert not pool.add_ensemble("m", np.ones(3, np.float32))  # 1-D
+    assert pool.ensemble("m") is None
+    first = np.full((2, 3), 1.5, np.float32)
+    second = np.full((2, 3), 2.5, np.float32)
+    assert pool.add_ensemble("m", first)
+    assert pool.add_ensemble("m", second)           # latest finite wins
+    np.testing.assert_array_equal(pool.ensemble("m"), second)
+    assert not pool.add_ensemble("m", bad)          # rejected, kept
+    np.testing.assert_array_equal(pool.ensemble("m"), second)
+    # moments and ensemble ride the same checkpoint dict
+    assert pool.add("m", np.array([0.1, 0.2]), np.ones((2, 3)))
+    pool2 = DonorPool()
+    pool2.load_state(pool.state_dict())
+    np.testing.assert_array_equal(pool2.ensemble("m"), second)
+    step, _im, n = pool2.summary("m")
+    assert n == 1 and np.isfinite(step)
+    # a hand-NaN'd checkpoint cannot smuggle an ensemble past load
+    state = pool.state_dict()
+    state["m"]["ensemble"][0][0] = float("nan")
+    pool3 = DonorPool()
+    pool3.load_state(state)
+    assert pool3.ensemble("m") is None
+    assert pool3.summary("m") is not None           # moments unaffected
+
+
+def test_donor_pool_from_store_seeds_both_donors(tmp_path):
+    """`donor_pool_from_store` = sidecar adaptation -> moment donor,
+    last draw row -> position donor; a store without a sidecar still
+    yields the position ensemble."""
+    path = _mk_store(tmp_path, "y", chains=2, draws=30, dim=3)
+    pool = serving.donor_pool_from_store(path, "EightSchools")
+    step, im, n = pool.summary("EightSchools")
+    assert n == 1 and abs(step - 0.3) < 1e-9
+    np.testing.assert_allclose(im, np.ones(3))
+    ens = pool.ensemble("EightSchools")
+    from stark_tpu.drawstore import read_draws
+
+    draws, _, _ = read_draws(path)
+    np.testing.assert_array_equal(ens, draws[-1].astype(np.float32))
+    # sidecar-less store: moments absent, positions still donated
+    bare = _mk_store(tmp_path, "z", sidecar=False)
+    pool2 = serving.donor_pool_from_store(bare, "M")
+    assert pool2.summary("M") is None
+    assert pool2.ensemble("M") is not None

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Repo lint: every statusd route must be documented AND contract-tested.
+
+``stark_tpu/statusd.py`` declares its endpoint contract in the
+``ROUTES`` tuple — the exact paths the daemon serves (``/metrics``,
+``/healthz``, ``/status``, and the ``/posterior/<id>/*`` read plane).
+Operators curl these and dashboards scrape them, so an endpoint that
+exists only in handler code is the same documentation gap a registered-
+but-undocumented metric is (``lint_metrics_docs.py``) or an undocumented
+env knob (``lint_fused_knobs.py``).  This lint closes it for routes, in
+both directions a route can go stale:
+
+* **README** — every ``ROUTES`` entry must appear in a markdown TABLE
+  row of ``README.md`` (the endpoint table; prose or curl examples
+  don't count, same rule as the metric lint).
+* **tests/** — every ``ROUTES`` entry must appear as a literal in at
+  least one ``tests/*.py`` file, so each endpoint has a named contract
+  test and deleting or renaming a route breaks a test, not a dashboard.
+
+The ``ROUTES`` tuple is read by AST from the source file (no import of
+``stark_tpu.statusd``, so the lint runs without jax or a network
+stack).  Run directly (``python tools/lint_endpoints.py``) or via the
+test suite (``tests/test_lint_endpoints.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import sys
+from typing import List
+
+
+def find_routes(source: str, filename: str) -> List[str]:
+    """The string elements of the module-level ``ROUTES`` assignment."""
+    tree = ast.parse(source, filename=filename)
+    for node in tree.body:
+        targets = (
+            node.targets if isinstance(node, ast.Assign)
+            else [node.target] if isinstance(node, ast.AnnAssign) else []
+        )
+        if not any(
+            isinstance(t, ast.Name) and t.id == "ROUTES" for t in targets
+        ):
+            continue
+        value = node.value
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            return []
+        return [
+            el.value
+            for el in value.elts
+            if isinstance(el, ast.Constant) and isinstance(el.value, str)
+        ]
+    return []
+
+
+def lint_repo(repo: str) -> List[str]:
+    """Violation strings for the whole repo; empty = clean."""
+    statusd_path = os.path.join(repo, "stark_tpu", "statusd.py")
+    with open(statusd_path) as f:
+        routes = find_routes(f.read(), statusd_path)
+    if not routes:
+        return [
+            "no ROUTES tuple found in stark_tpu/statusd.py — the "
+            "endpoint contract declaration is missing"
+        ]
+    readme_path = os.path.join(repo, "README.md")
+    readme = open(readme_path).read() if os.path.exists(readme_path) else ""
+    # the contract is the endpoint TABLE, not any prose mention (the
+    # lint_metrics_docs rule): restrict the search to table rows
+    table_rows = "\n".join(
+        line for line in readme.splitlines() if line.lstrip().startswith("|")
+    )
+    tests_src = "".join(
+        open(p).read()
+        for p in sorted(glob.glob(os.path.join(repo, "tests", "*.py")))
+    )
+    violations = []
+    for route in routes:
+        if route not in table_rows:
+            violations.append(
+                f"{statusd_path}: route {route!r} is served but missing "
+                "from the README endpoint table — document it (a table "
+                "row; prose or curl examples don't count)"
+            )
+        if route not in tests_src:
+            violations.append(
+                f"{statusd_path}: route {route!r} has no contract test — "
+                "name it as a literal in a tests/*.py file so renaming "
+                "or deleting the endpoint breaks a test, not a dashboard"
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = lint_repo(repo)
+    for v in violations:
+        print(v, file=sys.stderr)
+    if violations:
+        print(
+            f"{len(violations)} endpoint contract gap(s) — see "
+            "tools/lint_endpoints.py docstring",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,12 +50,15 @@ _READ_FUNCS = frozenset({"get", "getenv", "pop", "fused_knob"})
 #: pair (STARK_SHARD_DEADLINE arms the mesh fleet's shard deadman —
 #: detection + degraded re-shard change the dispatch path;
 #: STARK_FEED_MAXDEPTH bounds FleetFeed admission, changing what
-#: `submit` does under load) — extend the alternation when a new
-#: execution-path knob family lands
+#: `submit` does under load), and the posterior-serving read-plane
+#: family (STARK_SERVE_* — serving.py's LRU capacity / telemetry switch
+#: / sketch + predict caps, plus statusd's STARK_SERVE_ROOT auto-attach:
+#: each changes what a read request serves or emits) — extend the
+#: alternation when a new execution-path knob family lands
 _KNOB_RE = re.compile(
     r"^STARK_(?:FUSED_[A-Z0-9_]+|RAGGED_NUTS|QUANT_[A-Z0-9_]+"
     r"|FLEET_SLOTS|FLEET_WARMSTART|FLEET_MESH|COMM_TELEMETRY"
-    r"|SHARD_DEADLINE|FEED_MAXDEPTH)$"
+    r"|SHARD_DEADLINE|FEED_MAXDEPTH|SERVE_[A-Z0-9_]+)$"
 )
 
 
