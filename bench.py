@@ -55,6 +55,7 @@ MXU dot passes), STARK_FUSED_X_DTYPE (f32|bf16 design-matrix stream),
 STARK_GROUPED_LANE_TILE (cap for large chain batches).
 """
 
+import atexit
 import json
 import math
 import os
@@ -337,6 +338,12 @@ def main():
     from stark_tpu.statusd import maybe_start_from_env
 
     maybe_start_from_env()
+    # autotuned execution profile (stark_tpu.profile): resolved AFTER the
+    # liveness probe (resolution fingerprints the hardware, which
+    # initializes jax) and applied for the rest of the process — bench
+    # legs read knobs at prepare time outside the sampler entry points.
+    # Explicit env wins per knob; STARK_PROFILE=0 disables entirely.
+    active_profile = apply_profile_for_process()
     import numpy as np
 
     import stark_tpu
@@ -1030,6 +1037,10 @@ def main():
                 "converged": converged and math.isfinite(ess_per_sec),
                 "max_rhat": round(rhat, 4) if math.isfinite(rhat) else None,
                 "platform": platform,
+                # active autotuned profile id — null (never "", never a
+                # default id) when the run used default/explicit-env
+                # knobs, so profile-less artifacts stay distinguishable
+                "profile": active_profile,
                 # distinguishes a dead-accelerator degraded run from a
                 # deliberate CPU run in the recorded artifact itself
                 "accelerator_fallback": fell_back,
@@ -1379,6 +1390,30 @@ def nutssched_config_key(row, platform):
     )
 
 
+#: the entered profile context, kept alive for the process: a GC'd
+#: generator-based context manager runs its ``finally`` (GeneratorExit at
+#: the yield), which would strip the applied knobs mid-run
+_PROFILE_CM = None
+
+
+def apply_profile_for_process():
+    """Resolve + apply the autotuned profile (stark_tpu.profile) for the
+    REST of the process (the env application dies with it) and return
+    the active profile id — null when no profile resolved, the value
+    every artifact/ledger row records per the null-not-0.0 rule.
+    Idempotent; nested sampler entry points see the reentrant no-op."""
+    global _PROFILE_CM
+    from stark_tpu import profile as stark_profile
+
+    if _PROFILE_CM is None:
+        _PROFILE_CM = stark_profile.applied()
+        _PROFILE_CM.__enter__()
+        # close deterministically at exit: a generator CM finalized by
+        # the shutdown GC runs its restore against a torn-down os module
+        atexit.register(_PROFILE_CM.__exit__, None, None, None)
+    return stark_profile.active_profile_id()
+
+
 def append_ledger(config, bench_dict, extra_keys=(), label="perf",
                   source="bench.py"):
     """Cross-run perf regression ledger (stark_tpu.ledger): append a
@@ -1485,6 +1520,9 @@ def run_fused_microbench(argv):
         )
         return 2
     legs = legs or [(f, None) for f in known]
+    # profile knobs steer the microbench prepare/trace paths too; each
+    # row records the id (null when none — the null-not-0.0 rule)
+    active_profile = apply_profile_for_process()
     platform = jax.devices()[0].platform
     failed = False
     for fam, xdt in legs:
@@ -1504,6 +1542,7 @@ def run_fused_microbench(argv):
             continue
         for r in results:
             row = res_row(r)
+            row["profile"] = active_profile
             if not row["converged"]:
                 # null, never 0.0: a failed leg gates as missing data
                 # (ADVICE r5 / the PR 4 convention)

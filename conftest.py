@@ -12,6 +12,11 @@
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Hermeticity: the suite must never pick up an operator's committed
+# autotuned profile (bench_artifacts/profiles/) — STARK_PROFILE unset
+# means "auto" by design (stark_tpu.profile), so default it off here.
+# Profile tests monkeypatch/subprocess their own value over this.
+os.environ.setdefault("STARK_PROFILE", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -135,6 +135,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as _PSPEC
 
 from . import diagnostics, faults, health as _health, telemetry
+from . import profile as _profile
 from .adaptation import DualAveragingState, build_warmup_schedule
 from .kernels.base import STREAM_DIAG_LAGS, HMCState, StreamDiagState
 from .model import Model, flatten_model, prepare_model_data
@@ -1522,12 +1523,16 @@ class _ProblemState:
         self.warmup_draws_saved = int(m.get("warmup_draws_saved", 0))
 
 
+@_profile.entrypoint
 def sample_fleet(spec: FleetSpec, data: Any = None, **kwargs) -> FleetResult:
     """Advance a fleet of independent posteriors — one vmapped dispatch
     per block — until every problem converges or exhausts its budget.
     See the module docstring for the contract; `_sample_fleet` for the
     parameter reference.  The thin wrapper pins the telemetry trace as
-    ambient for the whole run (same discipline as the single runner)."""
+    ambient for the whole run (same discipline as the single runner) and
+    applies the autotuned profile's knob defaults — including the
+    STARK_FLEET_* trio read below in `_sample_fleet` — before any knob
+    read (stark_tpu.profile; explicit env wins, STARK_PROFILE=0 off)."""
     if data is not None:
         raise TypeError(
             "sample_fleet takes per-problem data via FleetSpec, not a "
@@ -1827,6 +1832,9 @@ def _sample_fleet(
             # mesh-parallel fleet accounting rides ONLY mesh runs, so
             # knob-off trace files stay byte-identical to PR 13
             **({"fleet_shards": n_shards} if fleet_mesh is not None else {}),
+            # {"profile": id} when an autotuned profile steers this run;
+            # ABSENT otherwise (byte-identical traces)
+            **_profile.run_start_tags(),
             **telemetry.device_info(),
             **telemetry.provenance(),
         )

@@ -19,6 +19,16 @@ Row schema (``LEDGER_SCHEMA`` = 1)::
     note         str?   — freeform operator annotation
     git_sha / jax_version / jaxlib_version   — telemetry.provenance()
     platform / device_kind / device_count    — telemetry.device_info()
+    fingerprint  str?   — platform.hardware_fingerprint() (the autotuner's
+                          hardware comparability key; best-effort)
+    profile      str?   — the active autotuned profile id (stark_tpu.profile),
+                          or None when the run used default/explicit-env
+                          knobs.  Rows with DIFFERENT profiles are distinct
+                          gating series: an autotuned config must never be
+                          judged against the default-knob median (or vice
+                          versa), so `check_rows` filters history on
+                          (config, profile), with legacy pre-profile rows
+                          (no column) ≡ None.
     metrics: ess_per_sec, wall_s, max_rhat, converged, restarts,
              device_idle_frac, overshoot_draws, diag_bytes_to_host
              (absent → None; the gate skips missing values)
@@ -148,6 +158,25 @@ def make_row(
     for k in ("platform", "device_kind", "device_count"):
         if k in info:
             row[k] = info[k]
+    try:
+        from . import platform as _platform
+
+        row["fingerprint"] = _platform.hardware_fingerprint()
+    except Exception:  # noqa: BLE001 — provenance must never fault a run
+        pass
+    # profile provenance is ALWAYS written (null-not-absent for new rows:
+    # the column is part of the series key); a bench artifact that stamped
+    # its own "profile" wins over the ambient application state, because
+    # the artifact records what was active WHEN IT RAN
+    if bench is not None and "profile" in bench:
+        row["profile"] = bench["profile"]
+    else:
+        try:
+            from . import profile as _profile
+
+            row["profile"] = _profile.active_profile_id()
+        except Exception:  # noqa: BLE001 — provenance must never fault a run
+            row["profile"] = None
     metrics: Dict[str, Any] = {
         k: None
         for k in ("ess_per_sec", "wall_s", "max_rhat", "converged",
@@ -245,6 +274,11 @@ def check_rows(
     ``all_configs=True`` gates the newest row of every config present —
     use one of them whenever the ledger has concurrent writers.
 
+    History is additionally filtered to the newest row's ``profile``
+    (None for legacy/default-knob rows): switching an autotuned profile
+    on or off starts a fresh series rather than comparing apples to
+    oranges.
+
     Returns ``(ok, report_lines)``.  ``ok`` is False when a gated metric
     (all metrics under ``strict``) regressed past the tolerance band:
     higher-is-better metrics must reach ``median * (1 - tolerance)``,
@@ -276,16 +310,26 @@ def check_rows(
             return True, [f"no rows for config {config!r}: nothing to check"]
     newest = rows[-1]
     config = newest.get("config")
-    history = [r for r in rows[:-1] if r.get("config") == config]
+    # (config, profile) is the series key: a row produced under an
+    # autotuned profile is only comparable to rows under the SAME profile
+    # (legacy rows without the column ≡ None, the default-knob series)
+    profile = newest.get("profile")
+    history = [
+        r for r in rows[:-1]
+        if r.get("config") == config and r.get("profile") == profile
+    ]
+    series = f"config {config!r}" + (
+        f" profile {profile!r}" if profile else ""
+    )
     if len(history) < min_history:
         return True, [
-            f"insufficient history for config {config!r}: "
+            f"insufficient history for {series}: "
             f"{len(history)} prior row(s) < min_history={min_history}"
         ]
     history = history[-window:]
     ok = True
     report = [
-        f"config {config!r}: newest row "
+        f"{series}: newest row "
         f"(git {newest.get('git_sha') or 'unknown'}) vs trailing median "
         f"of {len(history)} row(s), tolerance {tolerance:.0%}"
     ]

@@ -9,8 +9,9 @@ the row ordering — so minibatching and independent sub-posterior splits
 are fail-fast invalid — but mesh DATA-AXIS SHARDING is supported (r5):
 `log_lik_sharded` runs the prefix scan per contiguous shard and
 stitches carries/tie blocks across the axis with three O(P)
-collectives, the framework's sequence-parallel path (the MCMC analogue
-of ring/context parallelism).  Chain parallelism always applies.
+`scan_shards` ordered scans (parallel/primitives.py — comm-accounted),
+the framework's sequence-parallel path (the MCMC analogue of
+ring/context parallelism).  Chain parallelism always applies.
 
 Capability-surface entry per SURVEY.md §3 "Model abstraction" (reference
 tree absent — built against the capability surface).
@@ -149,16 +150,23 @@ class CoxPH(Model):
 
         Rows are globally sorted by descending time (`prepare_data`) and
         mesh-sharded as contiguous blocks, so shard ``s`` holds global
-        rows [s·m, (s+1)·m).  Three O(P)-sized collectives stitch the
-        local prefix scans into the exact global quantities:
+        rows [s·m, (s+1)·m).  Three O(P)-sized `scan_shards` ordered
+        scans (parallel/primitives.py — comm-accounted, each one
+        allgather on the wire) stitch the local prefix scans into the
+        exact global quantities:
 
-          1. allgather of per-shard logsumexp totals → the exclusive
+          1. forward scan of per-shard logsumexp totals → the exclusive
              log-space carry added to every local prefix,
-          2. allgather of first local times → the cross-boundary
+          2. reverse scan of first local times → the cross-boundary
              tie-block-end flag for each shard's last row,
-          3. allgather of (first local block-end fill, has-any-end) →
-             the right-fill carry for rows whose tie block ends in a
+          3. reverse scan of (first local block-end fill, has-any-end)
+             → the right-fill carry for rows whose tie block ends in a
              later shard (a tie run may span any number of shards).
+
+        Each scan's ``combine`` keeps this method's exact masked
+        arithmetic, so the migration off the hand-rolled gathers is
+        bit-identical (tests/test_sharded.py pins it against the
+        hand-rolled reference).
 
         Returns this shard's PARTIAL of the globally-stitched log-lik —
         `flatten_model` psums value and gradient exactly as for ordinary
@@ -174,45 +182,57 @@ class CoxPH(Model):
         # unsharded log_lik compares native times, and under
         # jax_enable_x64 an f32 downcast (to pack the gather) would merge
         # near-tie blocks only on the sharded path (ADVICE r5)
-        from ..parallel.primitives import gather_axis, mapped_axis_size
+        from ..parallel.primitives import scan_shards
 
         t = data["t"]
-        s = jax.lax.axis_index(axis_name)
-        num_shards = mapped_axis_size(axis_name)  # static axis size
 
-        # two tiny O(P) gathers: the prefix totals in eta's dtype and the
-        # first local times in their own dtype (packing both into one
-        # stack would force the time downcast the tie fix exists to avoid)
+        # 1. forward ordered scan: the prefix totals in eta's dtype (the
+        # first times ride their OWN scan below — packing both into one
+        # stack would force the time downcast the tie fix exists to avoid).
+        # The combine is the exact masked logsumexp the hand-rolled path
+        # ran: `before` is the exclusive-scan mask over shard order.
         prefix_l = _cumulative_logsumexp(eta)
-        totals = gather_axis(prefix_l[-1], axis_name)  # (P,)
-        firsts = gather_axis(t[0], axis_name)  # (P,) native dtype
-
-        # exclusive cross-shard carry (log-space) onto the local prefix
-        carry = jax.scipy.special.logsumexp(
-            jnp.where(jnp.arange(num_shards) < s, totals, -jnp.inf)
+        carry = scan_shards(
+            prefix_l[-1], axis_name,
+            combine=lambda totals, before: jax.scipy.special.logsumexp(
+                jnp.where(before, totals, -jnp.inf)
+            ),
         )
         prefix_g = jnp.logaddexp(prefix_l, carry)
 
-        # tie-block ends, with the boundary flag taken from the NEXT
-        # shard's first time (the last global row is always an end)
-        nxt = firsts[jnp.minimum(s + 1, num_shards - 1)]
-        last_is_end = jnp.where(s + 1 < num_shards, t[-1] != nxt, True)
+        # 2. reverse ordered scan of first local times: the boundary flag
+        # for this shard's last row comes from the NEXT shard's first
+        # time (the last global row is always an end — no next shard)
+        def _next_first(firsts, after):
+            idx = jnp.where(
+                jnp.any(after), jnp.argmax(after), firsts.shape[0] - 1
+            )
+            return firsts[idx], jnp.any(after)
+
+        nxt, has_next = scan_shards(
+            t[0], axis_name, reverse=True, combine=_next_first
+        )
+        last_is_end = jnp.where(has_next, t[-1] != nxt, True)
         is_end = jnp.concatenate([t[1:] != t[:-1], last_is_end[None]])
 
-        # 3. fill-from-right of the global prefix at block ends; trailing
-        # rows of a block that closes in a LATER shard take that shard's
-        # first-end fill (nearest shard > s with any end — the global
-        # last row guarantees one exists).  One packed gather again.
+        # 3. reverse ordered scan of (first block-end fill, has-any-end):
+        # trailing rows of a block that closes in a LATER shard take that
+        # shard's first-end fill (nearest shard after this one with any
+        # end — the global last row guarantees one exists)
         fill, has_end = _fill_from_right_valid(prefix_g, is_end)
-        g2 = gather_axis(
-            jnp.stack([fill[0], has_end[0].astype(eta.dtype)]), axis_name
-        )  # (P, 2)
-        fs, hs = g2[:, 0], g2[:, 1] > 0.5
-        later = jnp.arange(num_shards) > s
-        rfill, _ = _fill_from_right_valid(
-            jnp.where(later, fs, 0.0), later & hs
+
+        def _later_fill(g2, after):
+            fs, hs = g2[:, 0], g2[:, 1] > 0.5
+            rfill, _ = _fill_from_right_valid(
+                jnp.where(after, fs, 0.0), after & hs
+            )
+            return rfill[0]
+
+        rfill0 = scan_shards(
+            jnp.stack([fill[0], has_end[0].astype(eta.dtype)]),
+            axis_name, reverse=True, combine=_later_fill,
         )
-        log_risk = jnp.where(has_end, fill, rfill[0])
+        log_risk = jnp.where(has_end, fill, rfill0)
 
         return jnp.sum(data["event"] * (eta - log_risk))
 

@@ -109,7 +109,7 @@ class StochasticVolatility(Model):
         must hold the contiguous time block ``local_row_range`` assigns
         it — there is no time index in ``data`` to validate against.
         """
-        from ..parallel.primitives import mapped_axis_size
+        from ..parallel.primitives import mapped_axis_size, scan_shards
 
         h = self.latent_h(p)
         m = data["y"].shape[0]  # this shard's (static) time-block length
@@ -125,8 +125,11 @@ class StochasticVolatility(Model):
                 f"shards x {m} rows); the model and data lengths must "
                 "match exactly"
             )
-        s = jax.lax.axis_index(axis_name)
-        h_loc = jax.lax.dynamic_slice_in_dim(h, s * m, m)
+        # the replicated half of the ordered-scan primitive: this shard's
+        # contiguous time-block slice of the replicated path (bit-
+        # identical to the hand-rolled dynamic_slice it replaced; zero
+        # collectives, so nothing is comm-accounted)
+        h_loc = scan_shards(h, axis_name, replicated=True)
         return jnp.sum(
             jstats.norm.logpdf(data["y"], 0.0, jnp.exp(h_loc / 2.0))
         )

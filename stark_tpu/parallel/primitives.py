@@ -25,6 +25,11 @@ implementation:
   * `gather_tree`  — materialize the global host view of a (possibly
     sharded) pytree; multi-process runs allgather so every host sees the
     same full value.
+  * `scan_shards`  — ordered cross-shard scan (the DrJAX ordered-
+    computation direction): gather per-shard scan totals IN SHARD ORDER
+    and hand the caller its exclusive-scan mask, or slice a replicated
+    sequence into this shard's ordered block — the two halves of the
+    sequence-parallel likelihood stitching (CoxPH / StochasticVolatility).
 
 Single-device, single-host behavior is bit-identical to the hand-rolled
 code it replaced: `map_shards(fn, mesh=None)` is literally ``jax.jit(fn)``
@@ -74,6 +79,7 @@ import time
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -441,6 +447,93 @@ def gather_axis(x: PyTree, axis: str, *, tiled: bool = False) -> PyTree:
         host_blocked_s=time.perf_counter() - t0,
     )
     return out
+
+
+def scan_shards(
+    values: PyTree,
+    axis: Optional[str],
+    *,
+    combine=None,
+    reverse: bool = False,
+    replicated: bool = False,
+):
+    """Ordered cross-shard scan — the DrJAX ordered-computation primitive
+    (PAPERS.md) the sequence-parallel likelihoods stitch on.
+
+    Shards of a named mesh axis are ORDERED (shard ``s`` holds the
+    contiguous global rows [s·m, (s+1)·m)), and a sequential likelihood
+    needs each shard's EXCLUSIVE carry over the shards before (after,
+    for a reverse scan) it in that order.  Two modes:
+
+    * **gather mode** (default): allgather every shard's per-shard
+      contribution ``values`` into ``totals`` (stacked along a new
+      leading axis, IN SHARD ORDER) and return ``combine(totals, mask)``
+      where ``mask`` is the (P,) bool exclusive-scan mask selecting the
+      shards strictly before this one (``reverse=True``: strictly
+      after).  ``combine`` keeps the caller's exact reduction arithmetic
+      (a masked logsumexp, a first-valid pick, a right-fill) so a
+      migration onto this primitive is bit-identical by construction —
+      the primitive owns the ORDER and the WIRE, the model owns the
+      algebra.  ``axis=None`` is the single-shard identity: ``totals``
+      is ``values[None]`` and the mask is all-False (no predecessors).
+    * **replicated mode** (``replicated=True``): ``values`` is the FULL
+      replicated sequence (every shard computed it identically from
+      replicated params) and the primitive returns this shard's ordered
+      contiguous slice along axis 0 — the zero-collective half of
+      sequence parallelism (StochasticVolatility).  The sequence length
+      must divide evenly by the shard count: ``dynamic_slice`` CLAMPS
+      out-of-range starts, which would silently alias tail slices.
+
+    Comm-accounted at trace time like its siblings: gather mode emits
+    one ``scan_shards`` event per trace (wire bytes = contribution
+    payload x axis size — the allgather's wire).  Replicated mode moves
+    NOTHING (the input is already replicated) and, like
+    `mapped_axis_size`, emits nothing — there is no communication to
+    account."""
+    from jax import lax
+
+    if replicated:
+        if combine is not None:
+            raise ValueError(
+                "scan_shards(replicated=True) slices a replicated "
+                "sequence; combine= applies only to gather mode"
+            )
+        if axis is None:
+            return values
+        num = mapped_axis_size(axis)
+        n = int(values.shape[0])
+        if n % num:
+            raise ValueError(
+                f"scan_shards(replicated=True): sequence length {n} does "
+                f"not divide over {num} shards (dynamic_slice would clamp "
+                "and silently alias tail slices)"
+            )
+        m = n // num
+        s = lax.axis_index(axis)
+        return lax.dynamic_slice_in_dim(values, s * m, m)
+    if combine is None:
+        raise ValueError("scan_shards gather mode needs a combine= callable")
+    if axis is None:
+        totals = jax.tree.map(lambda v: jnp.asarray(v)[None], values)
+        return combine(totals, jnp.zeros((1,), bool))
+    s = lax.axis_index(axis)
+    num = mapped_axis_size(axis)
+    idx = jnp.arange(num)
+    mask = (idx > s) if reverse else (idx < s)
+    if not comm_telemetry_enabled():
+        totals = jax.tree.map(lambda v: lax.all_gather(v, axis), values)
+        return combine(totals, mask)
+    t0 = time.perf_counter()
+    totals = jax.tree.map(lambda v: lax.all_gather(v, axis), values)
+    payload = predict_tree_bytes(values)
+    _record_comm(
+        "scan_shards", site=_caller_site(), axis=axis,
+        participants=_static_axis_count(axis),
+        payload_bytes=payload,
+        wire_bytes=payload * _static_axis_count(axis),
+        host_blocked_s=time.perf_counter() - t0,
+    )
+    return combine(totals, mask)
 
 
 def _static_axis_count(axis: str) -> int:

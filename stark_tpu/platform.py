@@ -130,6 +130,66 @@ def device_memory_stats() -> "list[dict]":
     return out
 
 
+#: process-cached fingerprint (`hardware_fingerprint`): the probe touches
+#: every X-stream dtype once, and the whole point of the key is that it
+#: never changes within a process
+_FINGERPRINT: Optional[str] = None
+
+
+def _dtype_support() -> "list[str]":
+    """The X-stream dtype names (ops.precision.X_DTYPE_NAMES) this
+    backend can materialize AND round-trip through f32 — the capability
+    half of the hardware fingerprint (two platforms with the same device
+    kind but different fp8 support must not share autotuned profiles).
+    Best-effort per dtype: an unsupported dtype is simply absent."""
+    import jax.numpy as jnp
+
+    from .ops.precision import X_DTYPE_NAMES, _X_DTYPES
+
+    ok = []
+    for name in X_DTYPE_NAMES:
+        try:
+            x = jnp.asarray([1.0, -0.5], dtype=_X_DTYPES[name])
+            jnp.asarray(x, jnp.float32).block_until_ready()
+            ok.append(name)
+        except Exception:  # noqa: BLE001 — unsupported dtype on this backend
+            continue
+    return ok
+
+
+def hardware_fingerprint() -> str:
+    """Stable hardware identity key: ``<platform>-<device_kind>-<count>d-
+    <dtype-support-hash>`` — the comparability key the autotuner
+    (tools/autotune.py) files profiles under and `stark_tpu.ledger`
+    stamps into rows, so mined history and emitted profiles only ever
+    match runs on equivalent hardware.  Deterministic across processes
+    on the same machine/config (tests/test_autotune.py pins it): every
+    component is a static backend property, and the dtype-support hash
+    is a sha1 over the sorted supported X-stream dtype names.  Cached
+    per process; ``unknown-...`` when the backend is unreachable (a
+    fingerprint probe must never fault the caller)."""
+    global _FINGERPRINT
+    if _FINGERPRINT is not None:
+        return _FINGERPRINT
+    import hashlib
+    import re
+
+    try:
+        from . import telemetry
+
+        info = telemetry.device_info()
+        plat = str(info.get("platform", "unknown"))
+        kind = str(info.get("device_kind", "unknown"))
+        count = int(info.get("device_count", 0))
+        support = _dtype_support()
+    except Exception:  # noqa: BLE001 — dead backend: a stable "unknown" key
+        plat, kind, count, support = "unknown", "unknown", 0, []
+    kind = re.sub(r"[^A-Za-z0-9_.]+", "_", kind)
+    h = hashlib.sha1(",".join(sorted(support)).encode()).hexdigest()[:8]
+    _FINGERPRINT = f"{plat}-{kind}-{count}d-{h}"
+    return _FINGERPRINT
+
+
 def probe_accelerator(timeout: int = None) -> bool:
     """True iff accelerator client init completes (subprocess probe).
 

@@ -1,0 +1,384 @@
+"""Autotuned execution profiles — the self-driving config plane.
+
+The repo grew ~15 interacting performance knobs (the ``STARK_FUSED_*``
+family, the quantized X-stream dtype, the ragged-NUTS scheduler, the
+fleet slot/warm-start/mesh trio) and all the evidence needed to choose
+them — committed ledger series per (op, dtype, scheduler), the
+precision-parity grid, the microbench legs — but until this module
+nobody reconciled them: every run shipped on defaults.
+``tools/autotune.py`` mines that evidence into a **profile**: a
+versioned JSON file of knob values keyed by
+`platform.hardware_fingerprint`, parity-gated (only configurations
+whose parity cells all pass are eligible) and filed under
+``bench_artifacts/profiles/<fingerprint>.json``.  This module is the
+LOAD side: the runner/sampler/fleet entry points resolve the profile at
+startup and apply it as **environment defaults**.
+
+Precedence (the contract every test pins): **explicit env > profile >
+built-in default**.  A profile value is applied ONLY for knobs absent
+from ``os.environ`` — an operator's explicit ``STARK_FUSED_X_DTYPE=f32``
+always beats the profile's ``int8``.  The ``STARK_PROFILE`` escape
+hatch: a path loads that file, ``auto`` (or unset — profiles are on by
+default) resolves ``<profiles-dir>/<fingerprint>.json``, ``0`` (or
+empty) disables resolution entirely and restores byte-identical
+pre-profile traces.  ``STARK_PROFILE_DIR`` points ``auto`` at a
+different profiles directory (tests use a tmpdir; the default is the
+repo's ``bench_artifacts/profiles``).
+
+Loudness contract: a profile that fails validation — wrong schema,
+unknown knob, out-of-candidate value, wrong hardware fingerprint, or a
+recorded parity verdict that is not a pass — is REFUSED: the run
+proceeds on defaults, a ``profile_load`` trace event + ``log.warning``
+say so (telemetry.PROFILE_EVENT_TYPES).  A successfully applied profile
+emits no event of its own; its ``id`` is stamped into ``run_start``
+(`run_start_tags`) and into every ledger row (`stark_tpu.ledger`
+``profile`` column) so regressions in the *choice* gate like
+regressions in the *number*.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import hashlib
+import json
+import logging
+import os
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+#: module logger (repo lint: no bare print() in library code)
+log = logging.getLogger("stark_tpu.profile")
+
+__all__ = [
+    "CANDIDATE_SPACE",
+    "PROFILE_DIR_ENV",
+    "PROFILE_ENV",
+    "PROFILE_SCHEMA",
+    "ProfileError",
+    "active_profile",
+    "active_profile_id",
+    "applied",
+    "default_profile_path",
+    "entrypoint",
+    "load_profile",
+    "profile_id",
+    "profiles_dir",
+    "resolve_profile",
+    "run_start_tags",
+    "validate_profile",
+    "write_profile",
+]
+
+PROFILE_SCHEMA = 1
+
+#: env escape hatch: a path | "auto" (the default when unset) | "0"/""
+PROFILE_ENV = "STARK_PROFILE"
+
+#: where ``auto`` looks for ``<fingerprint>.json`` (default:
+#: ``<repo>/bench_artifacts/profiles``)
+PROFILE_DIR_ENV = "STARK_PROFILE_DIR"
+
+#: the autotuner's candidate space: every knob the autotuner can set,
+#: with its closed set of candidate values.  This table is the registry
+#: ``tools/lint_fused_knobs.py`` checks for completeness — a new tunable
+#: execution-path knob (fused families, X-stream dtype, scheduler, fleet
+#: trio) must be added HERE (and handled in tools/autotune.py) or the
+#: lint fails, so a knob can't silently escape tuning.  `load_profile`
+#: refuses any profile whose knobs stray outside this table.
+CANDIDATE_SPACE: Dict[str, Tuple[str, ...]] = {
+    "STARK_FUSED_PRECISION": ("default", "high", "highest"),
+    "STARK_FUSED_X_DTYPE": ("f32", "bf16", "int8", "fp8e4m3", "fp8e5m2"),
+    "STARK_FUSED_GLM": ("0", "1"),
+    "STARK_FUSED_LMM": ("0", "1"),
+    "STARK_FUSED_IRT": ("0", "1"),
+    "STARK_FUSED_ORDINAL": ("0", "1"),
+    "STARK_FUSED_ROBUST": ("0", "1"),
+    "STARK_RAGGED_NUTS": ("0", "1"),
+    "STARK_QUANT_PCT": ("99", "99.9", "100"),
+    "STARK_FLEET_SLOTS": ("0", "1"),
+    "STARK_FLEET_WARMSTART": ("0", "1"),
+    "STARK_FLEET_MESH": ("0", "1"),
+}
+
+
+class ProfileError(ValueError):
+    """A profile failed schema/candidate validation at load time."""
+
+
+def profiles_dir() -> str:
+    """The ``auto``-mode profiles directory (STARK_PROFILE_DIR override;
+    default ``<repo>/bench_artifacts/profiles``)."""
+    override = os.environ.get("STARK_PROFILE_DIR")
+    if override:
+        return override
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(repo, "bench_artifacts", "profiles")
+
+
+def default_profile_path(fingerprint: Optional[str] = None) -> str:
+    """Where ``auto`` resolution looks for this hardware's profile."""
+    if fingerprint is None:
+        from . import platform as _platform
+
+        fingerprint = _platform.hardware_fingerprint()
+    return os.path.join(profiles_dir(), f"{fingerprint}.json")
+
+
+def profile_id(knobs: Dict[str, str], fingerprint: str) -> str:
+    """Stable content id: ``<fingerprint>#<sha1(sorted knobs)[:8]>`` —
+    two profiles with the same choices share an id, so ledger series
+    keyed on it stay comparable across re-emissions."""
+    blob = ",".join(f"{k}={knobs[k]}" for k in sorted(knobs))
+    return f"{fingerprint}#{hashlib.sha1(blob.encode()).hexdigest()[:8]}"
+
+
+def validate_profile(profile: Any) -> Dict[str, Any]:
+    """Schema + candidate-space validation; raises `ProfileError` with
+    the reason (the message is what the loud refusal event carries)."""
+    if not isinstance(profile, dict):
+        raise ProfileError("profile is not a JSON object")
+    schema = profile.get("schema")
+    if schema != PROFILE_SCHEMA:
+        raise ProfileError(
+            f"profile schema {schema!r} != writer schema {PROFILE_SCHEMA} "
+            "(stale profile — regenerate with tools/autotune.py)"
+        )
+    knobs = profile.get("knobs")
+    if not isinstance(knobs, dict) or not knobs:
+        raise ProfileError("profile carries no knobs")
+    for k, v in knobs.items():
+        space = CANDIDATE_SPACE.get(k)
+        if space is None:
+            raise ProfileError(
+                f"unknown knob {k!r} (not in profile.CANDIDATE_SPACE)"
+            )
+        if str(v) not in space:
+            raise ProfileError(
+                f"{k}={v!r} outside candidate space {space}"
+            )
+    for key in ("id", "fingerprint"):
+        if not isinstance(profile.get(key), str) or not profile[key]:
+            raise ProfileError(f"profile missing {key!r}")
+    parity = profile.get("parity")
+    if not isinstance(parity, dict):
+        raise ProfileError("profile carries no parity verdict")
+    return profile
+
+
+def write_profile(profile: Dict[str, Any], path: Optional[str] = None) -> str:
+    """Atomic write (tmp + rename in the destination directory, so a
+    concurrent reader never sees a torn file); returns the path."""
+    validate_profile(profile)
+    if path is None:
+        path = default_profile_path(profile["fingerprint"])
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(profile, f, indent=1, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def load_profile(path: str) -> Dict[str, Any]:
+    """Parse + validate one profile file; `ProfileError` on any refusal
+    reason (unreadable, torn JSON, schema/knob/candidate violation, or a
+    recorded parity verdict that is not a pass — a profile whose chosen
+    config failed ANY parity cell must never silently steer a run)."""
+    try:
+        with open(path) as f:
+            profile = json.load(f)
+    except OSError as e:
+        raise ProfileError(f"unreadable profile: {e}") from e
+    except json.JSONDecodeError as e:
+        raise ProfileError(f"torn/invalid profile JSON: {e}") from e
+    validate_profile(profile)
+    if profile["parity"].get("ok") is not True:
+        failed = profile["parity"].get("failed") or []
+        raise ProfileError(
+            "profile's chosen config did not pass the parity sweep "
+            f"(failed cells: {failed or 'unrecorded'}) — refusing to "
+            "apply it; regenerate with tools/autotune.py"
+        )
+    return profile
+
+
+def _emit_refusal(action: str, path: str, reason: str,
+                  pid: Optional[str] = None) -> None:
+    """The loud half: log.warning always; a ``profile_load`` event when
+    a trace is installed (telemetry.PROFILE_EVENT_TYPES)."""
+    log.warning("profile %s (%s): %s", action, path, reason)
+    from . import telemetry
+
+    tr = telemetry.get_trace()
+    if tr is not None and tr.enabled:
+        tr.emit(
+            "profile_load", action=action, path=str(path), reason=reason,
+            **({"profile": pid} if pid else {}),
+        )
+
+
+def resolve_profile() -> Optional[Dict[str, Any]]:
+    """The startup resolution every entry point runs (via `applied`).
+
+    ``STARK_PROFILE`` = "0"/"" → None (byte-identical traces, nothing
+    emitted); a path → that file; "auto"/unset → the fingerprint-keyed
+    file under `profiles_dir` (missing file → silent None: hardware
+    without a profile runs defaults, that is not an error — but an
+    EXPLICIT path that is missing is loud).  Any validation failure —
+    including a fingerprint recorded for different hardware — refuses
+    the profile loudly and returns None; the run proceeds on defaults.
+    """
+    raw = os.environ.get("STARK_PROFILE")
+    explicit_path = None
+    if raw is not None:
+        raw = raw.strip()
+        if raw in ("", "0"):
+            return None
+        if raw != "auto":
+            explicit_path = raw
+    path = explicit_path or default_profile_path()
+    if not os.path.exists(path):
+        if explicit_path:
+            _emit_refusal("missing", path, "explicit STARK_PROFILE path "
+                          "does not exist; running on defaults")
+        return None
+    try:
+        profile = load_profile(path)
+    except ProfileError as e:
+        _emit_refusal("refused", path, str(e))
+        return None
+    from . import platform as _platform
+
+    fp = _platform.hardware_fingerprint()
+    if profile["fingerprint"] != fp:
+        _emit_refusal(
+            "refused", path,
+            f"profile fingerprint {profile['fingerprint']!r} does not "
+            f"match this hardware ({fp!r}) — mined evidence from other "
+            "hardware must not steer this run",
+            pid=profile.get("id"),
+        )
+        return None
+    return profile
+
+
+#: the one active profile application per process (entry points nest —
+#: bench drives the runner, the fleet falls back to the runner — and the
+#: OUTERMOST application wins; no lock: entries apply from the driving
+#: thread before worker threads start)
+_ACTIVE: Optional[Dict[str, Any]] = None
+
+
+def active_profile() -> Optional[Dict[str, Any]]:
+    """The profile applied by the innermost `applied` context (None =
+    this process runs default/explicit-env knobs)."""
+    return _ACTIVE["profile"] if _ACTIVE is not None else None
+
+
+def active_profile_id() -> Optional[str]:
+    """The active profile's id, or None — the null-not-0.0 provenance
+    value ledger rows and bench artifacts record."""
+    prof = active_profile()
+    return prof["id"] if prof is not None else None
+
+
+def run_start_tags() -> Dict[str, Any]:
+    """``run_start`` provenance: ``{"profile": id}`` when a profile is
+    active, ``{}`` otherwise — the field is ABSENT (not null) on
+    profile-less runs so their traces stay byte-identical to the
+    pre-profile era."""
+    pid = active_profile_id()
+    return {"profile": pid} if pid else {}
+
+
+@contextlib.contextmanager
+def applied():
+    """Resolve + apply the profile as env DEFAULTS for the context.
+
+    Only knobs absent from ``os.environ`` are set (explicit env always
+    wins); applied keys are removed again on exit, so nothing leaks past
+    the run.  Reentrant: a nested application under an active one is a
+    no-op (the outermost entry's resolution governs the whole run).
+    Yields the active profile (or None).
+    """
+    global _ACTIVE
+    if _ACTIVE is not None:
+        yield _ACTIVE["profile"]
+        return
+    profile = resolve_profile()
+    if profile is None:
+        yield None
+        return
+    # bind the mapping itself: if this generator is only finalized at
+    # interpreter shutdown, the ``os`` module global may already be gone
+    environ = os.environ
+    applied_keys: List[str] = []
+    overridden: List[str] = []
+    for k, v in profile["knobs"].items():
+        if k in environ:
+            overridden.append(k)
+            continue
+        environ[k] = str(v)
+        applied_keys.append(k)
+    if overridden:
+        log.info(
+            "profile %s: %d knob(s) overridden by explicit env: %s",
+            profile["id"], len(overridden), ",".join(sorted(overridden)),
+        )
+    _ACTIVE = {"profile": profile, "keys": applied_keys}
+    try:
+        yield profile
+    finally:
+        for k in applied_keys:
+            environ.pop(k, None)
+        _ACTIVE = None
+
+
+def entrypoint(fn):
+    """Decorator the sampling entry points (`sampler.sample`,
+    `runner.sample_until_converged`, `fleet.sample_fleet`, bench legs)
+    wear: the wrapped call runs under `applied`, so profile defaults are
+    in place before ANY knob read (fused-tag resolution, precision
+    statics, fleet scheduler) and gone after."""
+
+    @functools.wraps(fn)
+    def _with_profile(*args, **kwargs):
+        with applied():
+            return fn(*args, **kwargs)
+
+    return _with_profile
+
+
+def new_profile(
+    *,
+    fingerprint: str,
+    knobs: Dict[str, str],
+    model: str,
+    parity: Dict[str, Any],
+    evidence: Optional[Dict[str, Any]] = None,
+    source: str = "tools/autotune.py",
+) -> Dict[str, Any]:
+    """Assemble a schema'd profile dict (the write-side constructor the
+    autotuner uses; `validate_profile` runs at write time)."""
+    knobs = {k: str(v) for k, v in knobs.items()}
+    return {
+        "schema": PROFILE_SCHEMA,
+        "id": profile_id(knobs, fingerprint),
+        "fingerprint": fingerprint,
+        "model": model,
+        "created_ts": time.time(),
+        "source": source,
+        "knobs": knobs,
+        "parity": parity,
+        "evidence": evidence or {},
+    }

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import diagnostics, faults, health as _health, telemetry
+from . import profile as _profile
 from .kernels.base import HMCState
 from .ops import quantize as _quantize
 from .model import Model
@@ -142,12 +143,15 @@ def load_adapt_state(path, *, kernel, model_name, ndim, data_fp=None):
         return None, repr(e)
 
 
+@_profile.entrypoint
 def sample_until_converged(model: Model, data: Any = None, **kwargs):
     """Run chains until converged — see `_sample_until_converged` for the
     full parameter reference (this thin wrapper only pins the telemetry
     trace as ambient for the WHOLE run, so in-loop ``progress_every``
     heartbeats and backend-driver phase events reach a parameter-passed
-    trace, not just an ambiently installed one)."""
+    trace, not just an ambiently installed one, and applies the
+    autotuned profile's knob defaults for the run — stark_tpu.profile;
+    explicit env always wins, STARK_PROFILE=0 disables)."""
     trace = telemetry.resolve_trace(kwargs.pop("trace", None))
     with telemetry.use_trace(trace):
         return _sample_until_converged(model, data, trace=trace, **kwargs)
@@ -378,6 +382,9 @@ def _sample_until_converged(
             rhat_target=rhat_target,
             ess_target=ess_target,
             resuming=bool(resume_from),
+            # {"profile": id} when an autotuned profile steers this run;
+            # ABSENT otherwise (byte-identical pre-profile traces)
+            **_profile.run_start_tags(),
             **telemetry.device_info(),
             **telemetry.provenance(),
         )

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import diagnostics, telemetry
+from . import profile as _profile
 from .adaptation import (
     build_warmup_schedule,
     da_init,
@@ -742,6 +743,7 @@ def _constrain_draws(fm: FlatModel, zs) -> Dict[str, np.ndarray]:
     return {k: np.asarray(v) for k, v in constrained.items()}
 
 
+@_profile.entrypoint
 def sample(
     model: Model,
     data: Any = None,
@@ -801,6 +803,9 @@ def sample(
                 num_samples=cfg.num_samples,
                 seed=seed,
                 backend=type(backend).__name__,
+                # {"profile": id} when an autotuned profile steers this
+                # run; ABSENT otherwise (byte-identical traces)
+                **_profile.run_start_tags(),
                 **telemetry.device_info(),
                 **telemetry.provenance(),
             )

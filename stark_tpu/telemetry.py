@@ -156,7 +156,9 @@ HEALTH_EVENT_TYPES = frozenset({"health_warning"})
 #: communication-observatory event types (stark_tpu.parallel.primitives):
 #: ``comm`` — one collective dispatch through the MapReduce primitives
 #: layer, with ``primitive`` (map_shards / reduce_tree / gather_axis /
-#: broadcast / shard_put / gather_tree), the named mesh ``axis`` (when
+#: broadcast / shard_put / gather_tree / scan_shards — the ordered
+#: cross-shard scan's allgather; its replicated-slice mode moves nothing
+#: and emits nothing), the named mesh ``axis`` (when
 #: one is in scope), ``participants`` (collective fan-in/fan-out),
 #: ``payload_bytes`` (one participant's pytree-leaf bytes, the
 #: `quantize.predict_x_bytes` idiom), ``wire_bytes`` (payload x fan),
@@ -182,11 +184,25 @@ COMM_EVENT_TYPES = frozenset({"comm"})
 #: ``serving_clean_identity`` drill).
 SERVING_EVENT_TYPES = frozenset({"serve_request"})
 
+#: config-plane event types (stark_tpu.profile): ``profile_load`` — one
+#: autotuned-profile resolution FAILURE at an entry point (``action`` in
+#: refused / missing, with ``path``, ``reason``, and the ``profile`` id
+#: when the file parsed far enough to carry one).  The loud half of the
+#: profile contract: a parity-failing / schema-mismatched / wrong-
+#: fingerprint profile is REFUSED (the run proceeds on defaults) and
+#: this event + a log warning say so.  The quiet half emits nothing: a
+#: successfully applied profile is stamped into ``run_start``
+#: (``profile`` field) instead, and no-profile / STARK_PROFILE=0 runs
+#: emit neither — trace files stay byte-identical to the pre-profile
+#: era by construction.
+PROFILE_EVENT_TYPES = frozenset({"profile_load"})
+
 #: the complete WRITER registry: every emit()/phase() call in stark_tpu/
 #: must use one of these names (tools/lint_trace_schema.py enforces it)
 ALL_EVENT_TYPES = (EVENT_TYPES | AUX_EVENT_TYPES | FLEET_EVENT_TYPES
                    | PROFILING_EVENT_TYPES | HEALTH_EVENT_TYPES
-                   | COMM_EVENT_TYPES | SERVING_EVENT_TYPES)
+                   | COMM_EVENT_TYPES | SERVING_EVENT_TYPES
+                   | PROFILE_EVENT_TYPES)
 
 #: envelope keys every event must carry (validate_event)
 ENVELOPE_KEYS = ("schema", "event", "ts", "wall_s", "run")

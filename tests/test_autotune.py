@@ -1,0 +1,511 @@
+"""Self-driving config plane: `tools/autotune.py` + `stark_tpu/profile.py`.
+
+The contracts under test, in load-bearing order:
+
+* the hardware fingerprint is deterministic (in-process AND across a
+  subprocess — the autotune ``--check`` summary must report the same
+  key this process computes);
+* ledger mining is honest about what it skipped: torn lines, stale
+  schemas and fingerprint mismatches are COUNTED, never silently
+  dropped, and mismatched history degrades to fresh measurement
+  (`missing_fresh_legs`) rather than steering this hardware with
+  another's evidence;
+* selection is parity-gated: a fast dtype with a failing parity cell is
+  ineligible, the precision is the cheapest passing one, ragged NUTS
+  needs bit identity, the fleet trio follows its committed gates;
+* the load side refuses loudly (``profile_load`` event + warning) on
+  schema/candidate/fingerprint/parity violations and NEVER applies a
+  parity-failing profile;
+* precedence is strictly explicit env > profile > built-in default
+  (``STARK_PROFILE_DIR`` points ``auto`` at the store under test;
+  ``STARK_PROFILE=0`` restores the pre-profile world: no resolution,
+  no ``profile`` field in ``run_start``).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stark_tpu import ledger, profile, telemetry
+from stark_tpu import platform as platform_mod
+from stark_tpu.model import Model, ParamSpec
+from stark_tpu.telemetry import RunTrace, read_trace, use_trace
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import autotune  # noqa: E402  (tools/ is not a package)
+
+
+def _fp():
+    return platform_mod.hardware_fingerprint()
+
+
+def _mk_profile(fingerprint=None, knobs=None, parity_ok=True):
+    knobs = knobs or {"STARK_FUSED_LMM": "1", "STARK_FUSED_X_DTYPE": "f32"}
+    return profile.new_profile(
+        fingerprint=fingerprint or _fp(),
+        knobs=knobs,
+        model="test",
+        parity={
+            "ok": parity_ok,
+            "x_dtype": "f32",
+            "precision": "default",
+            "cells": 1,
+            "failed": [] if parity_ok else ["lmm:f32:default"],
+        },
+    )
+
+
+def _parity_rows(spec):
+    """[(x_dtype, precision, ok), ...] -> parity-row dicts."""
+    return [
+        {"op": "logistic", "x_dtype": d, "precision": p, "ok": ok}
+        for d, p, ok in spec
+    ]
+
+
+def _empty_evidence():
+    return {"fusedvg": {}, "nutssched": None, "fleet": {}, "fleet_mesh": None}
+
+
+# ---------------------------------------------------------------------------
+# hardware fingerprint
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_stable_and_shaped():
+    """Deterministic within a process and shaped
+    ``<platform>-<kind>-<count>d-<8 hex>`` (the suite pins 8 CPU
+    devices, so the count leg is visible here)."""
+    a, b = _fp(), _fp()
+    assert a == b
+    assert re.fullmatch(r"cpu-cpu-8d-[0-9a-f]{8}", a), a
+
+
+def test_profile_event_type_registered():
+    assert "profile_load" in telemetry.PROFILE_EVENT_TYPES
+    assert "profile_load" in telemetry.ALL_EVENT_TYPES
+
+
+# ---------------------------------------------------------------------------
+# ledger mining (pure)
+# ---------------------------------------------------------------------------
+
+
+def test_mine_ledger_missing_and_empty(tmp_path):
+    info = {"platform": "cpu", "device_kind": "cpu", "device_count": 8}
+    rows, counts = autotune.mine_ledger(
+        str(tmp_path / "absent.jsonl"), "fp", info
+    )
+    assert rows == [] and counts["lines"] == 0 and counts["matched"] == 0
+    p = tmp_path / "empty.jsonl"
+    p.write_text("\n\n")
+    rows, counts = autotune.mine_ledger(str(p), "fp", info)
+    assert rows == [] and counts["lines"] == 0
+
+
+def test_mine_ledger_counts_every_skip(tmp_path):
+    """Torn lines, stale schemas and fingerprint mismatches are counted
+    — never silently dropped — and legacy pre-fingerprint rows match on
+    the platform/device_kind/device_count triple."""
+    info = {"platform": "cpu", "device_kind": "cpu", "device_count": 8}
+    fp = "cpu-cpu-8d-deadbeef"
+    lines = [
+        "{torn",                                                   # torn
+        json.dumps({"schema": ledger.LEDGER_SCHEMA + 1,
+                    "fingerprint": fp, "config": "a"}),            # stale
+        json.dumps({"schema": ledger.LEDGER_SCHEMA,
+                    "fingerprint": "tpu-v5e-8d-00000000",
+                    "config": "b"}),                               # mismatch
+        json.dumps({"schema": ledger.LEDGER_SCHEMA,
+                    "fingerprint": fp, "config": "c"}),            # match
+        json.dumps({"schema": ledger.LEDGER_SCHEMA, "platform": "cpu",
+                    "device_kind": "cpu", "device_count": 8,
+                    "config": "legacy-match"}),                    # legacy
+        json.dumps({"schema": ledger.LEDGER_SCHEMA, "platform": "tpu",
+                    "device_kind": "v5e", "device_count": 4,
+                    "config": "legacy-other"}),                    # mismatch
+    ]
+    p = tmp_path / "l.jsonl"
+    p.write_text("\n".join(lines) + "\n")
+    rows, counts = autotune.mine_ledger(str(p), fp, info)
+    assert counts == {
+        "matched": 2, "stale_schema": 1, "fingerprint_mismatch": 2,
+        "torn": 1, "lines": 6,
+    }
+    assert [r["config"] for r in rows] == ["c", "legacy-match"]
+
+
+def test_fingerprint_mismatch_falls_back_to_fresh_legs():
+    """Mismatched history == no history: after mining drops every row
+    (other hardware), the full run must measure every fresh leg."""
+    ev = autotune.structure_evidence([])
+    legs = autotune.missing_fresh_legs(ev, ["f32", "bf16", "int8"])
+    assert ("nutssched",) in legs
+    assert ("fleet_stream",) in legs
+    for fam in autotune.FAMILY_KNOBS:
+        assert ("fusedvg", fam, None) in legs
+    assert ("fusedvg", autotune.DTYPE_FAMILY, "bf16") in legs
+    assert ("fusedvg", autotune.DTYPE_FAMILY, "int8") in legs
+    # answered evidence needs no fresh leg
+    ev["fusedvg"][("lmm", "f32")] = {"speedup_vs_autodiff": 2.0}
+    ev["nutssched"] = {"bit_identical": True}
+    legs2 = autotune.missing_fresh_legs(ev, ["f32"])
+    assert ("fusedvg", "lmm", None) not in legs2
+    assert ("nutssched",) not in legs2
+
+
+def test_structure_evidence_latest_wins():
+    mk = lambda cfg, v: {"config": cfg, "speedup_vs_autodiff": v}
+    rows = [
+        mk("fusedvg:lmm:n=1:d=1:platform=cpu", 1.0),
+        mk("fusedvg:lmm:n=1:d=1:platform=cpu", 3.0),  # newer row wins
+        mk("fusedvg:lmm:n=1:d=1:platform=cpu:x=int8", 2.0),
+        {"config": "nutssched:mixed_depth:x", "bit_identical": True},
+        {"config": "fleet:stream:es:B=4:sched=slots:platform=cpu",
+         "ess_per_sec": 5.0},
+        {"config": "fleet:mesh:es:B=4:shards=4",
+         "speedup_vs_single_device": 2.5},
+    ]
+    ev = autotune.structure_evidence(rows)
+    assert ev["fusedvg"][("lmm", "f32")]["speedup_vs_autodiff"] == 3.0
+    assert ("lmm", "int8") in ev["fusedvg"]
+    assert ev["nutssched"]["bit_identical"] is True
+    assert ev["fleet"]["slots"]["ess_per_sec"] == 5.0
+    assert ev["fleet_mesh"]["speedup_vs_single_device"] == 2.5
+
+
+# ---------------------------------------------------------------------------
+# selection (pure)
+# ---------------------------------------------------------------------------
+
+
+def test_select_family_toggles_need_measured_speedup():
+    ev = _empty_evidence()
+    ev["fusedvg"][("lmm", "f32")] = {"speedup_vs_autodiff": 2.0}
+    ev["fusedvg"][("irt", "f32")] = {"speedup_vs_autodiff": 0.8}
+    rows = _parity_rows([("f32", "default", True)])
+    knobs, parity, _ = autotune.select_config(ev, rows, ["f32"])
+    assert knobs["STARK_FUSED_LMM"] == "1"
+    assert knobs["STARK_FUSED_IRT"] == "0"       # measured slower
+    assert knobs["STARK_FUSED_ORDINAL"] == "0"   # no evidence -> default
+    assert knobs["STARK_FUSED_GLM"] == "1"       # built-in default is on
+    assert parity["ok"] is True
+
+
+def test_select_dtype_parity_gate_and_wash():
+    ev = _empty_evidence()
+    ev["fusedvg"][("lmm", "f32")] = {"ess_per_sec": 100.0}
+    ev["fusedvg"][("lmm", "int8")] = {"ess_per_sec": 250.0}
+    ev["fusedvg"][("lmm", "bf16")] = {"ess_per_sec": 400.0}
+    # bf16 is fastest but fails parity -> int8 (eligible, >5% win) wins
+    rows = _parity_rows([
+        ("f32", "default", True),
+        ("int8", "default", True),
+        ("bf16", "default", False),
+    ])
+    knobs, parity, rationale = autotune.select_config(ev, rows, [
+        "f32", "bf16", "int8",
+    ])
+    assert knobs["STARK_FUSED_X_DTYPE"] == "int8"
+    assert parity["x_dtype"] == "int8"
+    assert rationale["STARK_FUSED_X_DTYPE"]["ratios_vs_f32"]["int8"] == 2.5
+    # a <5% wash must not buy precision risk
+    ev["fusedvg"][("lmm", "int8")] = {"ess_per_sec": 103.0}
+    knobs, _, _ = autotune.select_config(
+        ev, _parity_rows([("f32", "default", True),
+                          ("int8", "default", True)]),
+        ["f32", "int8"],
+    )
+    assert knobs["STARK_FUSED_X_DTYPE"] == "f32"
+
+
+def test_select_precision_cheapest_passing_and_failure():
+    ev = _empty_evidence()
+    # default fails, high passes -> high is the cheapest passing
+    rows = _parity_rows([("f32", "default", False), ("f32", "high", True)])
+    knobs, parity, _ = autotune.select_config(ev, rows, ["f32"])
+    assert knobs["STARK_FUSED_PRECISION"] == "high"
+    assert parity["ok"] is True
+    # nothing passes -> parity verdict False (caller writes NO profile)
+    rows = _parity_rows([("f32", "default", False), ("f32", "high", False)])
+    _, parity, _ = autotune.select_config(ev, rows, ["f32"])
+    assert parity["ok"] is False
+    assert parity["failed"]
+
+
+def test_select_ragged_and_fleet_gates():
+    ev = _empty_evidence()
+    ev["nutssched"] = {"bit_identical": True, "speedup_vs_legacy": 1.4}
+    ev["fleet"] = {
+        "slots": {"converged": True, "ess_per_sec": 10.0},
+        "compact": {"ess_per_sec": 8.0},
+        "slots_warmstart": {"warmstart_speedup": 1.3},
+    }
+    ev["fleet_mesh"] = {"converged": True, "speedup_vs_single_device": 2.5}
+    rows = _parity_rows([("f32", "default", True)])
+    knobs, _, _ = autotune.select_config(ev, rows, ["f32"])
+    assert knobs["STARK_RAGGED_NUTS"] == "1"
+    assert knobs["STARK_FLEET_SLOTS"] == "1"
+    assert knobs["STARK_FLEET_WARMSTART"] == "1"
+    assert knobs["STARK_FLEET_MESH"] == "1"
+    # bit identity is the admission ticket, speedup alone is not enough
+    ev["nutssched"] = {"bit_identical": False, "speedup_vs_legacy": 3.0}
+    # slots slower than compact -> off, and warm-start rides on slots
+    ev["fleet"]["slots"]["ess_per_sec"] = 5.0
+    ev["fleet_mesh"]["speedup_vs_single_device"] = 1.5  # below 2x bar
+    knobs, _, _ = autotune.select_config(ev, rows, ["f32"])
+    assert knobs["STARK_RAGGED_NUTS"] == "0"
+    assert knobs["STARK_FLEET_SLOTS"] == "0"
+    assert knobs["STARK_FLEET_WARMSTART"] == "0"
+    assert knobs["STARK_FLEET_MESH"] == "0"
+
+
+# ---------------------------------------------------------------------------
+# profile schema / write / load
+# ---------------------------------------------------------------------------
+
+
+def test_profile_id_content_stable():
+    a = profile.profile_id({"K1": "1", "K2": "x"}, "fp")
+    b = profile.profile_id({"K2": "x", "K1": "1"}, "fp")  # order-free
+    assert a == b and a.startswith("fp#") and len(a.split("#")[1]) == 8
+    assert profile.profile_id({"K1": "0"}, "fp") != a
+
+
+def test_write_load_round_trip(tmp_path):
+    prof = _mk_profile()
+    path = profile.write_profile(prof, str(tmp_path / "p.json"))
+    loaded = profile.load_profile(path)
+    assert loaded == prof
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+def test_validate_refusals(tmp_path):
+    good = _mk_profile()
+    bad_schema = dict(good, schema=99)
+    with pytest.raises(profile.ProfileError, match="schema"):
+        profile.validate_profile(bad_schema)
+    bad_knob = dict(good, knobs={"STARK_NOT_A_KNOB": "1"})
+    with pytest.raises(profile.ProfileError, match="unknown knob"):
+        profile.validate_profile(bad_knob)
+    bad_value = dict(good, knobs={"STARK_FUSED_X_DTYPE": "f64"})
+    with pytest.raises(profile.ProfileError, match="candidate space"):
+        profile.validate_profile(bad_value)
+    no_parity = {k: v for k, v in good.items() if k != "parity"}
+    with pytest.raises(profile.ProfileError, match="parity"):
+        profile.validate_profile(no_parity)
+    # a torn file is a refusal, not a crash
+    p = tmp_path / "torn.json"
+    p.write_text('{"schema": 1, "knobs"')
+    with pytest.raises(profile.ProfileError, match="torn"):
+        profile.load_profile(str(p))
+
+
+def test_load_refuses_parity_failing_profile(tmp_path):
+    """A profile whose recorded parity verdict is not a pass must never
+    silently steer a run — `load_profile` raises, naming the cells."""
+    prof = _mk_profile(parity_ok=False)
+    path = profile.write_profile(prof, str(tmp_path / "p.json"))
+    with pytest.raises(profile.ProfileError, match="parity"):
+        profile.load_profile(path)
+
+
+# ---------------------------------------------------------------------------
+# resolution + loud refusal
+# ---------------------------------------------------------------------------
+
+
+def _resolve_with_trace(tmp_path, monkeypatch, value):
+    monkeypatch.setenv("STARK_PROFILE", value)
+    trace_path = str(tmp_path / "t.jsonl")
+    with RunTrace(trace_path) as tr, use_trace(tr):
+        got = profile.resolve_profile()
+    evs = [e for e in read_trace(trace_path)
+           if e.get("event") == "profile_load"]
+    return got, evs
+
+
+def test_resolve_off_and_auto_missing_are_silent(tmp_path, monkeypatch):
+    got, evs = _resolve_with_trace(tmp_path, monkeypatch, "0")
+    assert got is None and evs == []
+    assert profile.run_start_tags() == {}
+    # auto with no profile for this hardware: defaults, silently
+    monkeypatch.setenv("STARK_PROFILE_DIR", str(tmp_path / "nowhere"))
+    got, evs = _resolve_with_trace(tmp_path, monkeypatch, "auto")
+    assert got is None and evs == []
+
+
+def test_resolve_explicit_missing_path_is_loud(tmp_path, monkeypatch):
+    got, evs = _resolve_with_trace(
+        tmp_path, monkeypatch, str(tmp_path / "absent.json")
+    )
+    assert got is None
+    assert len(evs) == 1 and evs[0]["action"] == "missing"
+
+
+def test_resolve_refuses_parity_failing_loudly(tmp_path, monkeypatch):
+    path = profile.write_profile(
+        _mk_profile(parity_ok=False), str(tmp_path / "p.json")
+    )
+    got, evs = _resolve_with_trace(tmp_path, monkeypatch, path)
+    assert got is None
+    assert len(evs) == 1 and evs[0]["action"] == "refused"
+    assert "parity" in evs[0]["reason"]
+
+
+def test_resolve_refuses_foreign_fingerprint_loudly(tmp_path, monkeypatch):
+    path = profile.write_profile(
+        _mk_profile(fingerprint="tpu-v5e-8d-00000000"),
+        str(tmp_path / "p.json"),
+    )
+    got, evs = _resolve_with_trace(tmp_path, monkeypatch, path)
+    assert got is None
+    assert len(evs) == 1 and evs[0]["action"] == "refused"
+    assert "fingerprint" in evs[0]["reason"]
+
+
+def test_resolve_auto_uses_profile_dir(tmp_path, monkeypatch):
+    """STARK_PROFILE_DIR points ``auto`` at a different store; the
+    fingerprint-keyed file there resolves."""
+    store = tmp_path / "store"
+    prof = _mk_profile()
+    profile.write_profile(prof, str(store / f"{_fp()}.json"))
+    monkeypatch.setenv("STARK_PROFILE_DIR", str(store))
+    got, evs = _resolve_with_trace(tmp_path, monkeypatch, "auto")
+    assert got is not None and got["id"] == prof["id"]
+    assert evs == []  # applied profiles are silent (stamped, not evented)
+
+
+# ---------------------------------------------------------------------------
+# application: precedence, restore, reentrancy, provenance
+# ---------------------------------------------------------------------------
+
+
+def test_applied_env_precedence_and_restore(tmp_path, monkeypatch):
+    prof = _mk_profile(knobs={
+        "STARK_FUSED_LMM": "1", "STARK_FUSED_X_DTYPE": "int8",
+    })
+    path = profile.write_profile(prof, str(tmp_path / "p.json"))
+    monkeypatch.setenv("STARK_PROFILE", path)
+    monkeypatch.setenv("STARK_FUSED_X_DTYPE", "f32")  # explicit env
+    monkeypatch.delenv("STARK_FUSED_LMM", raising=False)
+    with profile.applied() as got:
+        assert got["id"] == prof["id"]
+        assert os.environ["STARK_FUSED_X_DTYPE"] == "f32"  # env wins
+        assert os.environ["STARK_FUSED_LMM"] == "1"        # profile fills
+        assert profile.active_profile_id() == prof["id"]
+        assert profile.run_start_tags() == {"profile": prof["id"]}
+    assert "STARK_FUSED_LMM" not in os.environ  # applied keys removed
+    assert os.environ["STARK_FUSED_X_DTYPE"] == "f32"  # explicit survives
+    assert profile.active_profile_id() is None
+
+
+def test_applied_reentrant_outermost_wins(tmp_path, monkeypatch):
+    path = profile.write_profile(
+        _mk_profile(knobs={"STARK_FUSED_LMM": "1"}),
+        str(tmp_path / "p.json"),
+    )
+    monkeypatch.setenv("STARK_PROFILE", path)
+    monkeypatch.delenv("STARK_FUSED_LMM", raising=False)
+    with profile.applied() as outer:
+        with profile.applied() as inner:  # nested: no-op, same profile
+            assert inner is outer
+        # exiting the inner context must NOT strip the outer application
+        assert os.environ["STARK_FUSED_LMM"] == "1"
+        assert profile.active_profile() is outer
+    assert "STARK_FUSED_LMM" not in os.environ
+
+
+def test_ledger_row_stamped_under_applied(tmp_path, monkeypatch):
+    prof = _mk_profile()
+    path = profile.write_profile(prof, str(tmp_path / "p.json"))
+    monkeypatch.setenv("STARK_PROFILE", path)
+    with profile.applied():
+        row = ledger.make_row(source="t", config="c",
+                              bench={"value": 1.0, "wall_s": 1.0})
+    assert row["profile"] == prof["id"]
+    assert row["fingerprint"] == _fp()
+    # and with no profile active the column is honest-null, not absent
+    row = ledger.make_row(source="t", config="c",
+                          bench={"value": 1.0, "wall_s": 1.0})
+    assert row["profile"] is None
+
+
+class _Mean(Model):
+    def param_spec(self):
+        return {"x": ParamSpec((1,))}
+
+    def log_prior(self, p):
+        return -0.5 * jnp.sum(p["x"] ** 2)
+
+    def log_lik(self, p, data):
+        return -0.5 * jnp.sum((data["y"] - p["x"]) ** 2)
+
+
+def test_run_start_stamped_and_absent(tmp_path, monkeypatch):
+    """The entry points load the profile by default: a sampler run under
+    ``auto`` stamps the profile id into ``run_start``; with
+    ``STARK_PROFILE=0`` the field is ABSENT (not null) — those traces
+    stay byte-identical to the pre-profile era."""
+    import stark_tpu
+
+    store = tmp_path / "store"
+    prof = _mk_profile(knobs={"STARK_FUSED_LMM": "1"})
+    profile.write_profile(prof, str(store / f"{_fp()}.json"))
+    monkeypatch.setenv("STARK_PROFILE_DIR", str(store))
+    data = {"y": np.zeros(4, np.float32)}
+
+    def _run(tag):
+        trace_path = str(tmp_path / f"{tag}.jsonl")
+        with RunTrace(trace_path) as tr, use_trace(tr):
+            stark_tpu.sample(
+                _Mean(), data, chains=1, num_warmup=5, num_samples=5,
+                kernel="hmc", num_leapfrog=2, seed=0,
+            )
+        (ev,) = [e for e in read_trace(trace_path)
+                 if e.get("event") == "run_start"]
+        return ev
+
+    monkeypatch.setenv("STARK_PROFILE", "auto")
+    assert _run("on")["profile"] == prof["id"]
+    monkeypatch.setenv("STARK_PROFILE", "0")
+    assert "profile" not in _run("off")
+
+
+# ---------------------------------------------------------------------------
+# the --check contract (subprocess; also the cross-process fingerprint pin)
+# ---------------------------------------------------------------------------
+
+
+def test_autotune_check_contract(tmp_path):
+    """``tools/autotune.py --check`` is the tier-1 smoke for the whole
+    mine -> select -> emit -> load pipeline: exit 0, a parity-passing
+    summary, a written profile that round-trips through `load_profile`,
+    and a fingerprint identical to this process's (cross-process
+    stability of the profile key)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = tmp_path / "prof.json"
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "autotune.py"),
+         "--check", "--out", str(out)],
+        capture_output=True, text=True, env=env, cwd=_REPO, timeout=300,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    summary = json.loads(res.stdout)
+    assert summary["parity_ok"] is True
+    assert summary["fingerprint"] == _fp()  # cross-process identical
+    assert "matching row(s)" in res.stderr  # mining counts are reported
+    loaded = profile.load_profile(str(out))
+    assert loaded["id"] == summary["profile"]
+    assert loaded["fingerprint"] == summary["fingerprint"]
+    for k, v in loaded["knobs"].items():
+        assert str(v) in profile.CANDIDATE_SPACE[k]

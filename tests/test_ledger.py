@@ -330,3 +330,71 @@ def test_row_shape_is_uniform_across_sources():
     assert metric_keys <= set(from_trace) and metric_keys <= set(from_bench)
     assert from_trace["restarts"] == 2
     assert from_bench["restarts"] is None
+
+
+# ---------------------------------------------------------------------------
+# (config, profile) series — autotuned-profile provenance (PR 19)
+# ---------------------------------------------------------------------------
+
+
+def test_row_carries_profile_provenance():
+    """Every row carries the hardware fingerprint and a ``profile``
+    column — honest-null when no profile steers the process, and the
+    bench dict's explicit value (the autotuner's own row) wins over the
+    ambient active profile."""
+    row = ledger.make_row(source="t", config="c", bench=_bench(1.0))
+    assert row["profile"] is None
+    assert isinstance(row["fingerprint"], str) and row["fingerprint"]
+    row = ledger.make_row(
+        source="t", config="c", bench={**_bench(1.0), "profile": "hw#beef"}
+    )
+    assert row["profile"] == "hw#beef"
+
+
+def test_check_isolates_profile_series(tmp_path):
+    """Switching the autotuned profile starts a FRESH series: a knob
+    flip must not masquerade as (or mask) a perf regression.  Same
+    config + same profile still gates."""
+    p = tmp_path / "ledger.jsonl"
+    for eps in (100.0,) * 5:
+        ledger.append_row(
+            ledger.make_row(source="t", config="c",
+                            bench={**_bench(eps), "profile": "hw#aaaa"}),
+            str(p),
+        )
+    # different profile, half the rate: a new series, not a regression
+    ledger.append_row(
+        ledger.make_row(source="t", config="c",
+                        bench={**_bench(50.0), "profile": "hw#bbbb"}),
+        str(p),
+    )
+    ok, report = ledger.check_rows(ledger.read_rows(str(p)))
+    assert ok, report
+    assert any("hw#bbbb" in line for line in report)
+    # same profile, half the rate: the gate still fires
+    ledger.append_row(
+        ledger.make_row(source="t", config="c",
+                        bench={**_bench(50.0), "profile": "hw#aaaa"}),
+        str(p),
+    )
+    ok, report = ledger.check_rows(ledger.read_rows(str(p)))
+    assert not ok, report
+    assert any("hw#aaaa" in line for line in report)
+
+
+def test_check_legacy_rows_are_the_null_profile_series(tmp_path):
+    """Rows predating the ``profile`` column group with profile=None
+    rows (legacy ≡ default-knob series), so history written before this
+    schema addition keeps gating."""
+    p = tmp_path / "ledger.jsonl"
+    for eps in (100.0,) * 5:
+        row = ledger.make_row(source="t", config="c", bench=_bench(eps))
+        row.pop("profile", None)
+        row.pop("fingerprint", None)  # pre-PR-19 row shape
+        ledger.append_row(row, str(p))
+    ledger.append_row(
+        ledger.make_row(source="t", config="c", bench=_bench(50.0)),
+        str(p),
+    )
+    ok, report = ledger.check_rows(ledger.read_rows(str(p)))
+    assert not ok, report

@@ -58,6 +58,10 @@ def test_collector_finds_all_knob_families():
         # the quant-calibration knob family IS covered
         ('import os\nos.environ.get("STARK_QUANT_CALIB_NEW")\n',
          ["STARK_QUANT_CALIB_NEW"]),
+        # the config-plane meta-knobs ARE covered (profile resolution)
+        ('import os\nos.environ.get("STARK_PROFILE")\n', ["STARK_PROFILE"]),
+        ('import os\nos.environ.get("STARK_PROFILE_DIR")\n',
+         ["STARK_PROFILE_DIR"]),
     ],
 )
 def test_find_knob_reads(source, expect):
@@ -87,3 +91,66 @@ def test_cli_exit_zero():
         capture_output=True, text=True,
     )
     assert out.returncode == 0, out.stderr
+
+
+def test_candidate_space_completeness_both_directions(tmp_path):
+    """The autotuner registry check: a tunable knob read outside
+    profile.CANDIDATE_SPACE fails (it would silently escape tuning), and
+    a registry key nobody reads fails (dead/typo'd entry).  Repos
+    without a profile module (the synthetic case above) skip the check
+    entirely."""
+    repo = tmp_path
+    pkg = repo / "stark_tpu"
+    pkg.mkdir()
+    (repo / "tests").mkdir()
+    # documented + tested, so only the registry violations remain
+    (repo / "README.md").write_text(
+        "STARK_FUSED_NEWFAM STARK_FLEET_SLOTS STARK_FUSED_PRECISION\n"
+    )
+    (repo / "tests" / "test_x.py").write_text(
+        '"""names STARK_FUSED_NEWFAM STARK_FLEET_SLOTS '
+        'STARK_FUSED_PRECISION"""\n'
+    )
+    (pkg / "newop.py").write_text(
+        'import os\n'
+        'A = os.environ.get("STARK_FUSED_NEWFAM", "0")\n'  # not in registry
+        'B = os.environ.get("STARK_FUSED_PRECISION", "high")\n'
+    )
+    (pkg / "profile.py").write_text(
+        'CANDIDATE_SPACE = {\n'
+        '    "STARK_FUSED_PRECISION": ("default", "high"),\n'
+        '    "STARK_FLEET_SLOTS": ("0", "1"),\n'  # read by nobody here
+        '}\n'
+    )
+    violations = lint_fused_knobs.lint_repo(str(repo))
+    missing = [v for v in violations if "missing from profile" in v]
+    dead = [v for v in violations if "dead" in v]
+    assert len(missing) == 1 and "STARK_FUSED_NEWFAM" in missing[0]
+    assert len(dead) == 1 and "STARK_FLEET_SLOTS" in dead[0]
+    # observability switches are NOT tunable: no registry demand
+    (pkg / "obs.py").write_text(
+        'import os\nC = os.environ.get("STARK_COMM_TELEMETRY", "1")\n'
+    )
+    (repo / "README.md").write_text(
+        "STARK_FUSED_PRECISION STARK_FLEET_SLOTS STARK_COMM_TELEMETRY\n"
+    )
+    (repo / "tests" / "test_x.py").write_text(
+        '"""STARK_FUSED_PRECISION STARK_FLEET_SLOTS '
+        'STARK_COMM_TELEMETRY"""\n'
+    )
+    (pkg / "newop.py").write_text(
+        'import os\n'
+        'B = os.environ.get("STARK_FUSED_PRECISION", "high")\n'
+        'D = os.environ.get("STARK_FLEET_SLOTS", "0")\n'
+    )
+    assert lint_fused_knobs.lint_repo(str(repo)) == []
+
+
+def test_candidate_space_keys_parses_real_registry():
+    """The AST parse of the real profile module sees the full registry
+    (kept in lockstep with profile.CANDIDATE_SPACE itself)."""
+    keys = lint_fused_knobs.candidate_space_keys(REPO)
+    sys.path.insert(0, REPO)
+    from stark_tpu import profile
+
+    assert keys == set(profile.CANDIDATE_SPACE)

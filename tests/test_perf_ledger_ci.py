@@ -264,3 +264,36 @@ def test_fresh_config_passes(tmp_path):
     res = _check("--ledger", str(path), "check")
     assert res.returncode == 0, res.stdout + res.stderr
     assert "insufficient history" in res.stdout
+
+
+def test_autotune_row_committed():
+    """The autotuner's committed ``autotune:*`` row is honest-null
+    provenance: it measures nothing gateable (ess_per_sec null, never
+    0.0), ``converged`` carries the parity verdict, the chosen profile
+    id is stamped, and the mining counts are recorded (skipped evidence
+    is counted, not silent)."""
+    rows = [json.loads(l) for l in open(_LEDGER) if l.strip()]
+    auto = [r for r in rows
+            if str(r.get("config", "")).startswith("autotune:")]
+    assert auto, "committed ledger must carry an autotune:* row"
+    newest = auto[-1]
+    assert newest["ess_per_sec"] is None       # null-not-0.0
+    assert newest["converged"] is True         # the parity verdict
+    assert isinstance(newest["profile"], str) and "#" in newest["profile"]
+    assert isinstance(newest.get("fingerprint"), str)
+    assert newest["profile"].startswith(newest["fingerprint"])
+    assert newest["parity_cells"] > 0
+    for key in ("mined_rows", "stale_rows_skipped",
+                "fingerprint_mismatch_rows"):
+        assert isinstance(newest[key], int)
+    # the committed profile the row points at exists and loads
+    prof_path = os.path.join(
+        _REPO, "bench_artifacts", "profiles",
+        f"{newest['fingerprint']}.json",
+    )
+    assert os.path.exists(prof_path), prof_path
+    sys.path.insert(0, _REPO)
+    from stark_tpu import profile
+
+    loaded = profile.load_profile(prof_path)
+    assert loaded["id"] == newest["profile"]

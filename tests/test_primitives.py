@@ -153,3 +153,145 @@ def test_run_over_chains_parity():
     bad = make_mesh({"data": 2}, devices=jax.devices()[:2])
     with pytest.raises(ValueError, match="chains"):
         run_over_chains(bad, v, keys, z)
+
+
+# ---------------------------------------------------------------------------
+# scan_shards — the ordered cross-shard scan (PR 19)
+# ---------------------------------------------------------------------------
+
+
+def _exclusive_sums(shard_sums, reverse=False):
+    out = []
+    for i in range(len(shard_sums)):
+        peers = shard_sums[i + 1:] if reverse else shard_sums[:i]
+        out.append(float(sum(peers)))
+    return out
+
+
+def test_scan_shards_gather_forward_and_reverse():
+    """Gather mode hands ``combine`` the shard-ordered totals and the
+    strictly-before mask (strictly-after under ``reverse``) — the
+    masked-sum combine reproduces the exclusive prefix per shard."""
+    from stark_tpu.compat import shard_map
+    from stark_tpu.parallel.primitives import scan_shards
+
+    mesh = _mesh(4)
+    x = jnp.arange(8.0)  # shard sums: [1, 5, 9, 13]
+
+    def run(reverse):
+        def f(xs):
+            c = scan_shards(
+                jnp.sum(xs), "data", reverse=reverse,
+                combine=lambda t, m: jnp.sum(jnp.where(m, t, 0.0)),
+            )
+            return c[None]
+
+        fn = shard_map(f, mesh=mesh, in_specs=(P("data"),),
+                       out_specs=P("data"), check_vma=False)
+        return np.asarray(jax.jit(fn)(x))
+
+    np.testing.assert_array_equal(
+        run(False), _exclusive_sums([1.0, 5.0, 9.0, 13.0])
+    )
+    np.testing.assert_array_equal(
+        run(True), _exclusive_sums([1.0, 5.0, 9.0, 13.0], reverse=True)
+    )
+
+
+def test_scan_shards_axis_none_identity():
+    """axis=None is the single-shard case: one stacked total, an
+    all-False mask (no predecessors in either direction)."""
+    from stark_tpu.parallel.primitives import scan_shards
+
+    def combine(totals, mask):
+        assert totals.shape == (1,)
+        return jnp.sum(jnp.where(mask, totals, 0.0))
+
+    assert float(scan_shards(jnp.float32(7.0), None, combine=combine)) == 0.0
+    v = jnp.arange(6.0)
+    np.testing.assert_array_equal(
+        np.asarray(scan_shards(v, None, replicated=True)), np.asarray(v)
+    )
+
+
+def test_scan_shards_replicated_ordered_slices():
+    """Replicated mode returns shard s's contiguous slice of the full
+    replicated sequence — gathering the per-shard slices along the shard
+    axis reassembles the sequence exactly."""
+    from stark_tpu.compat import shard_map
+    from stark_tpu.parallel.primitives import scan_shards
+
+    mesh = _mesh(4)
+    full = jnp.arange(8.0) * 1.5
+
+    def f(_):
+        return scan_shards(full, "data", replicated=True)
+
+    fn = shard_map(f, mesh=mesh, in_specs=(P("data"),),
+                   out_specs=P("data"), check_vma=False)
+    out = jax.jit(fn)(jnp.zeros(4))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(full))
+
+
+def test_scan_shards_mode_and_divisibility_errors():
+    from stark_tpu.compat import shard_map
+    from stark_tpu.parallel.primitives import scan_shards
+
+    with pytest.raises(ValueError, match="combine"):
+        scan_shards(jnp.zeros(2), None)  # gather mode needs combine=
+    with pytest.raises(ValueError, match="gather mode"):
+        scan_shards(jnp.zeros(2), None, replicated=True,
+                    combine=lambda t, m: t)
+    mesh = _mesh(4)
+    fn = shard_map(
+        lambda _: scan_shards(jnp.zeros(7), "data", replicated=True),
+        mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+        check_vma=False,
+    )
+    with pytest.raises(ValueError, match="does not divide"):
+        jax.jit(fn)(jnp.zeros(4))  # 7 rows over 4 shards would alias
+
+
+def test_scan_shards_comm_accounted_and_silenceable(tmp_path, monkeypatch):
+    """Gather mode emits one comm event per traced scan (wire = payload
+    x axis size — the allgather); replicated mode moves nothing and
+    emits nothing; STARK_COMM_TELEMETRY=0 silences the accounting with
+    bit-identical results."""
+    from stark_tpu.compat import shard_map
+    from stark_tpu.parallel.primitives import scan_shards
+    from stark_tpu.telemetry import RunTrace, read_trace, use_trace
+
+    mesh = _mesh(4)
+    x = jnp.arange(8.0)
+
+    def compute():
+        def f(xs):
+            c = scan_shards(
+                jnp.sum(xs), "data",
+                combine=lambda t, m: jnp.sum(jnp.where(m, t, 0.0)),
+            )
+            h = scan_shards(jnp.arange(8.0), "data", replicated=True)
+            return c + h
+
+        fn = shard_map(f, mesh=mesh, in_specs=(P("data"),),
+                       out_specs=P("data"), check_vma=False)
+        return np.asarray(jax.jit(fn)(x))
+
+    trace_on = str(tmp_path / "on.jsonl")
+    with RunTrace(trace_on) as tr, use_trace(tr):
+        y_on = compute()
+    comm = [e for e in read_trace(trace_on) if e.get("event") == "comm"]
+    scans = [e for e in comm if e["primitive"] == "scan_shards"]
+    assert len(scans) == 1, comm  # replicated half emits nothing
+    (ev,) = scans
+    assert ev["axis"] == "data" and ev["participants"] == 4
+    assert ev["payload_bytes"] == 4          # one f32 scalar per shard
+    assert ev["wire_bytes"] == 16            # allgather: payload x shards
+
+    monkeypatch.setenv("STARK_COMM_TELEMETRY", "0")
+    trace_off = str(tmp_path / "off.jsonl")
+    with RunTrace(trace_off) as tr, use_trace(tr):
+        y_off = compute()
+    assert not [e for e in read_trace(trace_off)
+                if e.get("event") == "comm"]
+    np.testing.assert_array_equal(y_on, y_off)

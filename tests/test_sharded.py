@@ -333,3 +333,124 @@ def test_sv_sharded_length_mismatch_fails_fast():
             kernel="nuts", max_tree_depth=4, num_warmup=4, num_samples=4,
             seed=0,
         )
+
+
+# ---------------------------------------------------------------------------
+# scan_shards migration bit-identity (PR 19): the sequence-parallel
+# stitching moved off hand-rolled gathers onto the ordered-scan
+# primitive; each combine keeps the models' exact masked arithmetic, so
+# the migration must be DRAW-bit-identical, pinned here against the
+# pre-migration implementations copied verbatim below.
+# ---------------------------------------------------------------------------
+
+
+def _legacy_coxph_log_lik_sharded(model, p, data, axis_name):
+    """The pre-scan_shards CoxPH stitching (hand-rolled gather_axis +
+    shard-index masks), kept as the bit-identity reference."""
+    from stark_tpu.models.survival import (
+        _cumulative_logsumexp,
+        _fill_from_right_valid,
+    )
+    from stark_tpu.parallel.primitives import gather_axis, mapped_axis_size
+
+    eta = data["x"] @ p["beta"]
+    t = data["t"]
+    s = jax.lax.axis_index(axis_name)
+    num_shards = mapped_axis_size(axis_name)
+    prefix_l = _cumulative_logsumexp(eta)
+    totals = gather_axis(prefix_l[-1], axis_name)
+    firsts = gather_axis(t[0], axis_name)
+    carry = jax.scipy.special.logsumexp(
+        jnp.where(jnp.arange(num_shards) < s, totals, -jnp.inf)
+    )
+    prefix_g = jnp.logaddexp(prefix_l, carry)
+    nxt = firsts[jnp.minimum(s + 1, num_shards - 1)]
+    last_is_end = jnp.where(s + 1 < num_shards, t[-1] != nxt, True)
+    is_end = jnp.concatenate([t[1:] != t[:-1], last_is_end[None]])
+    fill, has_end = _fill_from_right_valid(prefix_g, is_end)
+    g2 = gather_axis(
+        jnp.stack([fill[0], has_end[0].astype(eta.dtype)]), axis_name
+    )
+    fs, hs = g2[:, 0], g2[:, 1] > 0.5
+    later = jnp.arange(num_shards) > s
+    rfill, _ = _fill_from_right_valid(
+        jnp.where(later, fs, 0.0), later & hs
+    )
+    log_risk = jnp.where(has_end, fill, rfill[0])
+    return jnp.sum(data["event"] * (eta - log_risk))
+
+
+def _legacy_sv_log_lik_sharded(model, p, data, axis_name):
+    """The pre-scan_shards SV slice (hand-rolled dynamic_slice by shard
+    index), kept as the bit-identity reference."""
+    from stark_tpu.parallel.primitives import mapped_axis_size
+
+    h = model.latent_h(p)
+    m = data["y"].shape[0]
+    num_shards = mapped_axis_size(axis_name)
+    assert m * num_shards == model.num_steps
+    s = jax.lax.axis_index(axis_name)
+    h_loc = jax.lax.dynamic_slice_in_dim(h, s * m, m)
+    import jax.scipy.stats as jstats
+
+    return jnp.sum(
+        jstats.norm.logpdf(data["y"], 0.0, jnp.exp(h_loc / 2.0))
+    )
+
+
+def _bitwise_vs_legacy(model, data, legacy_log_lik, shards=4):
+    """Potential AND gradient of the migrated sharded path, bitwise
+    against the hand-rolled reference on the same mesh."""
+    from stark_tpu.parallel.mesh import row_partition_specs
+
+    mesh = make_mesh(
+        {"data": shards, "chains": 1}, devices=jax.devices()[:shards]
+    )
+    fm = flatten_model(model, axis_name="data")
+    z = 0.3 * jax.random.normal(jax.random.PRNGKey(1), (fm.ndim,))
+    row_axes = model.data_shard_row_axes(data)
+    specs = row_partition_specs(data, "data", row_axes)
+    sharded = shard_data(data, mesh, row_axes=row_axes)
+
+    def run(fmodel):
+        fn = shard_map(
+            lambda zz, dd: fmodel.potential_and_grad(zz, dd),
+            mesh=mesh, in_specs=(P(), specs), out_specs=(P(), P()),
+            check_vma=False,
+        )
+        v, g = jax.jit(fn)(z, sharded)
+        return np.asarray(v), np.asarray(g)
+
+    class _Legacy(type(model)):
+        def log_lik_sharded(self, p, d, axis_name):
+            return legacy_log_lik(self, p, d, axis_name)
+
+    legacy = _Legacy.__new__(_Legacy)
+    legacy.__dict__.update(model.__dict__)
+    fm_legacy = flatten_model(legacy, axis_name="data")
+
+    v_new, g_new = run(fm)
+    v_old, g_old = run(fm_legacy)
+    np.testing.assert_array_equal(v_new, v_old)
+    np.testing.assert_array_equal(g_new, g_old)
+
+
+def test_coxph_scan_shards_migration_bit_identical():
+    """CoxPH's three-scan stitching on `scan_shards` reproduces the
+    hand-rolled gathers to the BYTE (value and gradient), including tie
+    blocks spanning shard boundaries."""
+    model, data = _coxph_tied_setup(n=1024, d=3)
+    _bitwise_vs_legacy(
+        model, data, _legacy_coxph_log_lik_sharded, shards=4
+    )
+
+
+def test_sv_scan_shards_migration_bit_identical():
+    """SV's replicated-path slice via scan_shards(replicated=True) is
+    byte-identical to the hand-rolled dynamic_slice."""
+    from stark_tpu.models import StochasticVolatility
+    from stark_tpu.models.timeseries import synth_sv_data
+
+    model = StochasticVolatility(num_steps=512)
+    data, _ = synth_sv_data(jax.random.PRNGKey(2), 512)
+    _bitwise_vs_legacy(model, data, _legacy_sv_log_lik_sharded, shards=4)

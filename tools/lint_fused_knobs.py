@@ -21,6 +21,12 @@ statically:
    the "Ragged NUTS scheduling" section for the scheduler knob), or
 3. appears nowhere under ``tests/`` (every knob needs a test exercising
    its fallback / knob-off bit-identity behavior by name).
+4. Registry completeness for the autotuner (``STARK_PROFILE*`` family,
+   stark_tpu/profile.py): every TUNABLE knob (fused family + dtype +
+   precision, ragged scheduler, quant percentile, fleet trio) must
+   appear in ``profile.CANDIDATE_SPACE`` — a knob outside the candidate
+   table silently escapes tuning — and every registry key must be a
+   knob some env-read actually reads (no dead/typo'd entries).
 
 AST-based (strings in comments can't trip it); imports nothing from the
 package, so it runs anywhere.  Run directly or via
@@ -58,7 +64,21 @@ _READ_FUNCS = frozenset({"get", "getenv", "pop", "fused_knob"})
 _KNOB_RE = re.compile(
     r"^STARK_(?:FUSED_[A-Z0-9_]+|RAGGED_NUTS|QUANT_[A-Z0-9_]+"
     r"|FLEET_SLOTS|FLEET_WARMSTART|FLEET_MESH|COMM_TELEMETRY"
-    r"|SHARD_DEADLINE|FEED_MAXDEPTH|SERVE_[A-Z0-9_]+)$"
+    r"|SHARD_DEADLINE|FEED_MAXDEPTH|SERVE_[A-Z0-9_]+"
+    r"|PROFILE(?:_[A-Z0-9_]+)?)$"
+)
+
+#: knobs the autotuner is responsible for: per-run execution-path
+#: selectors a profile may set.  Every collected knob matching this
+#: must appear in profile.CANDIDATE_SPACE (the autotuner's candidate
+#: table) — a tunable knob outside the registry silently escapes
+#: tuning.  Deliberately EXCLUDES the observability/serving switches
+#: (telemetry, serving caps, fault deadlines: they don't change which
+#: executable a run picks) and the STARK_PROFILE* family itself (the
+#: meta-knobs that resolve the profile can't live inside one).
+_TUNABLE_RE = re.compile(
+    r"^STARK_(?:FUSED_[A-Z0-9_]+|RAGGED_NUTS|QUANT_PCT"
+    r"|FLEET_SLOTS|FLEET_WARMSTART|FLEET_MESH)$"
 )
 
 
@@ -121,6 +141,34 @@ def _grep_tree(tree_dir: str, needles: Set[str]) -> Set[str]:
     return found
 
 
+def candidate_space_keys(repo: str) -> Set[str]:
+    """The ``CANDIDATE_SPACE`` dict-literal keys AST-parsed out of
+    ``stark_tpu/profile.py`` (no import — the lint must run anywhere).
+    Empty set when the module or the literal is absent."""
+    path = os.path.join(repo, "stark_tpu", "profile.py")
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Name)
+                and t.id == "CANDIDATE_SPACE"
+                and isinstance(node.value, ast.Dict)
+            ):
+                return {
+                    k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                }
+    return set()
+
+
 def lint_repo(repo: str) -> List[str]:
     """Violation strings for the whole repo; empty = clean."""
     knobs = collect_knobs(os.path.join(repo, "stark_tpu"))
@@ -146,6 +194,28 @@ def lint_repo(repo: str) -> List[str]:
                 "tests/ — add a fallback / knob-off bit-identity test "
                 "that names the knob"
             )
+    # autotuner-registry completeness (both directions), when the
+    # profile module exists in this tree (synthetic lint-test repos may
+    # omit it): every tunable execution-path knob must appear in
+    # profile.CANDIDATE_SPACE, and every registry key must be a knob
+    # somebody actually reads
+    space = candidate_space_keys(repo)
+    if space:
+        for knob in sorted(knobs):
+            if _TUNABLE_RE.match(knob) and knob not in space:
+                violations.append(
+                    f"{knobs[knob][0]}: tunable knob {knob} is missing "
+                    "from profile.CANDIDATE_SPACE — the autotuner "
+                    "(tools/autotune.py) cannot set a knob outside its "
+                    "candidate table, so it silently escapes tuning"
+                )
+        for key in sorted(space):
+            if key not in knobs:
+                violations.append(
+                    f"stark_tpu/profile.py: CANDIDATE_SPACE key {key} is "
+                    "read by no env-read call under stark_tpu/ — a dead "
+                    "registry entry (typo'd knob name?)"
+                )
     return violations
 
 

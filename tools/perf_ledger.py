@@ -23,7 +23,11 @@ auto-appends after every full run (STARK_PERF_LEDGER=0 opts out).
 Row schema, tolerance semantics, and the trailing-median rule live in
 `stark_tpu.ledger` (shared with the bench auto-append); the trace read
 path reuses `telemetry.summarize_trace` — the same dict
-``tools/trace_report.py --json`` emits.
+``tools/trace_report.py --json`` emits.  Rows carry ``profile``
+provenance (the active autotuned profile id, None otherwise); ``check``
+treats differing profiles as distinct series — an autotuned run never
+gates against the default-knob median.
+
 """
 
 from __future__ import annotations
@@ -122,7 +126,7 @@ def cmd_show(args) -> int:
     if not rows:
         print("(empty ledger)")
         return 0
-    cols = ("ts", "config", "git_sha", "ess_per_sec", "wall_s",
+    cols = ("ts", "config", "profile", "git_sha", "ess_per_sec", "wall_s",
             "device_idle_frac", "overshoot_draws", "converged")
     for r in rows:
         print(json.dumps({k: r.get(k) for k in cols}))
