@@ -134,7 +134,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as _PSPEC
 
-from . import diagnostics, faults, health as _health, telemetry
+from . import diagnostics, faults, health as _health, lineage, telemetry
 from . import profile as _profile
 from .adaptation import DualAveragingState, build_warmup_schedule
 from .kernels.base import STREAM_DIAG_LAGS, HMCState, StreamDiagState
@@ -560,6 +560,12 @@ class FleetFeed:
                         "feed_reject", depth=depth,
                         maxdepth=self.maxdepth, retry_after_s=retry,
                         rejects=self._rejects,
+                        # lineage: a retrying tenant's rejects correlate
+                        # to its job once the pid is known; field rides
+                        # only with lineage on (byte-identity contract)
+                        **({"problem_id": str(problem_id)}
+                           if problem_id is not None and lineage.enabled()
+                           else {}),
                     )
                 raise FeedRejected(
                     depth=depth, maxdepth=self.maxdepth,
@@ -570,6 +576,25 @@ class FleetFeed:
             self._seq += 1
             pid = str(problem_id)
             self._items.append((pid, data, budget))
+            if lineage.enabled():
+                # mint the tenant's job_id at the FRONT DOOR: the same
+                # arrival-ordinal discipline as the key seeding, so a
+                # resubmit-after-crash re-mints the same id.  The
+                # feed_submit event is the lineage anchor every report
+                # starts from.
+                jid = lineage.job_for(pid)
+                if jid is None:
+                    jid = lineage.mint_job_id(pid, self._seq - 1)
+                    lineage.register(pid, jid)
+                tr = self._trace
+                if tr is None:
+                    tr = telemetry.get_trace()
+                if tr is not None and getattr(tr, "enabled", False):
+                    tr.emit(
+                        "feed_submit", problem_id=pid,
+                        depth=len(self._items),
+                        budgeted=budget is not None,
+                    )
             self._cond.notify_all()
         return pid
 
@@ -1411,7 +1436,7 @@ class _ProblemState:
         "budget_exhausted", "history", "min_ess", "max_rhat",
         "ess_target", "deadline_s", "max_restarts", "lane_restarts",
         "failed", "failed_reason", "submitted", "warmstarted",
-        "warmup_draws_saved",
+        "warmup_draws_saved", "job_id",
     )
 
     def __init__(self, idx: int, pid: str, key, chains: int, ndim: int, *,
@@ -1433,6 +1458,9 @@ class _ProblemState:
         self.submitted = submitted
         self.warmstarted = False
         self.warmup_draws_saved = 0
+        # lineage correlation id (stark_tpu.lineage); None with
+        # STARK_LINEAGE=0 so knob-off checkpoints stay byte-identical
+        self.job_id: Optional[str] = None
         self._reset(chains, ndim)
 
     def _reset(self, chains: int, ndim: int) -> None:
@@ -1485,6 +1513,10 @@ class _ProblemState:
         if self.warmstarted:
             extra["warmstarted"] = True
             extra["warmup_draws_saved"] = self.warmup_draws_saved
+        if self.job_id is not None:
+            # lineage rides only when minted: a STARK_LINEAGE=0 run's
+            # checkpoint stays byte-identical to pre-lineage files
+            extra["job_id"] = self.job_id
         return {
             **extra,
             "blocks_done": self.blocks_done,
@@ -1521,6 +1553,12 @@ class _ProblemState:
         self.submitted = bool(m.get("submitted", self.submitted))
         self.warmstarted = bool(m.get("warmstarted", False))
         self.warmup_draws_saved = int(m.get("warmup_draws_saved", 0))
+        jid = m.get("job_id")
+        if jid is not None:
+            # a resumed tenant keeps its minted id (and re-arms the
+            # annotator's registry in the resuming process)
+            self.job_id = jid
+            lineage.register(self.pid, jid)
 
 
 @_profile.entrypoint
@@ -1783,6 +1821,15 @@ def _sample_fleet(
         if fleet_mesh is not None and comm_on and health_on
         else None
     )
+    # SLO burn-rate trail (lineage observatory): block-cadence slo_burn
+    # events per budgeted tenant + the once-per-(tenant, budget)
+    # ``budget_burn`` health warning.  Rides ONLY lineage-on runs —
+    # STARK_LINEAGE=0 traces stay byte-identical to the pre-lineage repo.
+    lineage_on = lineage.enabled()
+    burn_trail = (
+        _health.BudgetBurnTrail(trace=trace)
+        if lineage_on and health_on else None
+    )
     # elastic fault domains (PR 17): STARK_SHARD_DEADLINE arms the
     # per-shard deadman on mesh runs — None (the default) disables the
     # whole subsystem and keeps traces byte-identical
@@ -1906,6 +1953,15 @@ def _sample_fleet(
         )
         for i in range(B)
     ]
+    if lineage.enabled():
+        # direct-entry parity: spec problems (no FleetFeed front door)
+        # mint at registration, same (pid, global ordinal) discipline —
+        # a feed-submitted pid resuming through the spec keeps its id
+        for p in probs:
+            p.job_id = lineage.job_for(p.pid) or lineage.mint_job_id(
+                p.pid, p.idx
+            )
+            lineage.register(p.pid, p.job_id)
 
     # dynamic problem registry: streamed submissions (FleetFeed) extend
     # the spec's problem list at block boundaries.  ``all_ids[i]`` is
@@ -2091,6 +2147,12 @@ def _sample_fleet(
             i, pid, _cold_key(i), chains, fm.ndim, submitted=True,
             **_budget_for(i),
         ))
+        if lineage.enabled():
+            p = probs[i]
+            # the feed minted at submit time (registry hit); a direct
+            # _add_problem (resume replay) mints at the arrival ordinal
+            p.job_id = lineage.job_for(pid) or lineage.mint_job_id(pid, i)
+            lineage.register(pid, p.job_id)
         return i
 
     def _drain_feed() -> int:
@@ -2478,6 +2540,13 @@ def _sample_fleet(
                     max_rhat=p.max_rhat,
                     health=verdict,
                     adaptation=adapt,
+                    # lineage: the sidecar carries job_id across the
+                    # process boundary to the read plane, so a serving
+                    # daemon's serve_request events correlate back to
+                    # this run; rides only when minted (STARK_LINEAGE=0
+                    # sidecars stay byte-identical)
+                    **({"extra": {"job_id": p.job_id}}
+                       if p.job_id is not None else {}),
                 )
             except Exception as e:  # noqa: BLE001 — serving is best-effort
                 log.warning(
@@ -3511,6 +3580,49 @@ def _sample_fleet(
                     recorder.note_anomaly(
                         f"deadline:{p.pid}", rec_done
                     )
+            # --- SLO burn-rate accounting (lineage observatory) -----------
+            # block-cadence fraction of each active tenant's ProblemBudget
+            # grants consumed: deadline wall, restart count, and ESS
+            # progress toward the gate target.  Absent budgets ride as
+            # null, never 0.0 (the null-not-0.0 rule); the whole family
+            # rides ONLY lineage-on runs (STARK_LINEAGE=0 byte-identity).
+            if lineage_on and trace.enabled:
+                for p in probs:
+                    if not p.active:
+                        continue
+                    deadline_burn = (
+                        round(now_wall / p.deadline_s, 4)
+                        if p.deadline_s else None
+                    )
+                    restart_burn = (
+                        round(p.lane_restarts / p.max_restarts, 4)
+                        if p.max_restarts else None
+                    )
+                    ess_burn = (
+                        round(p.min_ess / p.ess_target, 4)
+                        if p.min_ess is not None and p.ess_target
+                        else None
+                    )
+                    if (deadline_burn is None and restart_burn is None
+                            and ess_burn is None):
+                        continue
+                    trace.emit(
+                        "slo_burn",
+                        problem_id=p.pid,
+                        block=blocks_dispatched,
+                        **{k: v for k, v in (
+                            ("deadline_burn", deadline_burn),
+                            ("restart_burn", restart_burn),
+                            ("ess_burn", ess_burn),
+                        ) if v is not None},
+                    )
+                    if burn_trail is not None:
+                        burn_trail.observe(
+                            p.pid,
+                            {"deadline": deadline_burn,
+                             "restart": restart_burn},
+                            block=blocks_dispatched,
+                        )
             n_active = sum(probs[i].active for i in order)
             occupancy = n_active / max(len(order), 1)
             occupancy_trail.append(occupancy)
@@ -3691,6 +3803,11 @@ def _sample_fleet(
             flush_metrics()  # one write+fsync per fleet block (see emit)
             if checkpoint_path:
                 save_fleet_checkpoint(checkpoint_path)
+                if lineage_on:
+                    # the /jobs index sidecar snapshots on the same
+                    # durability cadence as the checkpoint (atomic
+                    # tmp+rename; best-effort — never faults the run)
+                    lineage.save_index(trace.path)
             if pending:
                 # crash-with-queued-work drill point: the checkpoint just
                 # persisted the queue (spec indices and streamed
@@ -3811,6 +3928,10 @@ def _sample_fleet(
             **({"lost_shards": lost_shard_ids} if lost_shard_ids else {}),
             **stream_end,
         )
+    if lineage_on:
+        # final index snapshot: every terminal state (and the run_end
+        # fold) is durable next to the trace for /jobs + the report tool
+        lineage.save_index(trace.path)
     return FleetResult(
         results,
         wall_s=wall,

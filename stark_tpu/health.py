@@ -92,6 +92,7 @@ from . import telemetry
 
 __all__ = [
     "HealthMonitor",
+    "BudgetBurnTrail",
     "ShardBalanceTrail",
     "WARNINGS",
     "health_enabled",
@@ -163,6 +164,15 @@ WARNINGS: Dict[str, Dict[str, str]] = {
                  "and STARK_SHARD_DEADLINE arms the deadman that declares "
                  "a blown-out shard lost and re-packs the fleet around it"),
     },
+    "budget_burn": {
+        "severity": "warn",
+        "knob": "STARK_HEALTH_BUDGET_BURN",
+        "hint": ("a tenant consumed most of a ProblemBudget grant "
+                 "(deadline wall / restart count) before converging: "
+                 "raise its budget, warm-start it from a donor, or "
+                 "expect a budget_exhausted exit — the slo_burn trail "
+                 "shows which budget is burning and how fast"),
+    },
 }
 
 
@@ -215,6 +225,9 @@ def thresholds() -> Dict[str, float]:
         "snapshots": _env_int(os.environ.get("STARK_HEALTH_SNAPSHOTS"), 4),
         "snapshot_dim": _env_int(
             os.environ.get("STARK_HEALTH_SNAPSHOT_DIM"), 16
+        ),
+        "budget_burn": _env_float(
+            os.environ.get("STARK_HEALTH_BUDGET_BURN"), 0.9
         ),
     }
 
@@ -711,3 +724,66 @@ class ShardBalanceTrail:
         except Exception:  # noqa: BLE001 — observability must not fault the run
             pass
         self.active["mesh_imbalance"] = rec
+
+
+class BudgetBurnTrail:
+    """SLO budget-burn warning engine over the fleet's block-cadence
+    ``slo_burn`` accounting (the lineage observatory's health leg).
+
+    The fleet hands every active problem's burn fractions (deadline wall
+    consumed / restart budget consumed) to ``observe``; the first time a
+    tenant's worst CONSUMABLE budget crosses ``STARK_HEALTH_BUDGET_BURN``
+    the trail emits ONE ``budget_burn`` health warning naming the tenant
+    and the burning budget — once per (tenant, budget), so a tenant
+    grinding at 95%% burn for fifty blocks pages an operator once, not
+    fifty times.  ESS progress is deliberately NOT a trigger: attaining
+    the gate target is success, not burn.  Shares the warning
+    taxonomy/emit shape with :class:`HealthMonitor`; never raises into
+    the run.
+    """
+
+    def __init__(self, *, trace: Any = None,
+                 threshold: Optional[float] = None):
+        self._trace = trace
+        self.threshold = (
+            float(threshold) if threshold is not None
+            else thresholds()["budget_burn"]
+        )
+        self._warned: set = set()
+        #: warning state, mirroring HealthMonitor.active
+        self.active: Dict[str, Dict[str, Any]] = {}
+
+    def observe(self, problem_id: str, burns: Dict[str, Optional[float]],
+                *, block: Optional[int] = None) -> None:
+        """Judge one problem's burn fractions (``deadline`` / ``restart``
+        keys; None = no such budget granted) against the threshold."""
+        for budget in ("deadline", "restart"):
+            frac = burns.get(budget)
+            if frac is None or (problem_id, budget) in self._warned:
+                continue
+            if frac < self.threshold:
+                continue
+            self._warned.add((problem_id, budget))
+            spec = WARNINGS["budget_burn"]
+            rec = {
+                "warning": "budget_burn",
+                "severity": spec["severity"],
+                "hint": spec["hint"],
+                "knob": spec["knob"],
+                "value": round(float(frac), 4),
+                "threshold": float(self.threshold),
+                "budget": budget,
+                "problem_id": problem_id,
+            }
+            if block is not None:
+                rec["block"] = int(block)
+            trace = (
+                self._trace if self._trace is not None
+                else telemetry.get_trace()
+            )
+            try:
+                if trace is not None and trace.enabled:
+                    trace.emit("health_warning", **rec)
+            except Exception:  # noqa: BLE001 — observability must not
+                pass  # fault the run
+            self.active["budget_burn"] = rec

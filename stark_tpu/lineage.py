@@ -1,0 +1,464 @@
+"""Tenant lineage: end-to-end job correlation across admission → sampling → serving.
+
+Every tenant that enters the system — through `FleetFeed.submit`, as a
+spec problem handed to `sample_fleet`, or as a single `runner` run —
+gets ONE stable ``job_id`` minted at entry, and every tenant-scoped
+trace event it touches from then on carries that id: admission
+(``feed_submit`` / ``feed_reject`` / ``problem_admitted``), slot
+placement and donor warm-start, per-block sampling and health warnings,
+shard-loss re-homing and quarantine, checkpoint/restart, the terminal
+``problem_converged``, the summary sidecar (``SUMMARY_SCHEMA``), and —
+in a DIFFERENT process, possibly days later — every
+``/posterior/<id>/*`` serving hit (the sidecar carries the id across
+the process boundary).  One identifier links a tenant's first submit to
+its last read; ``tools/lineage_report.py`` reconstructs the story and
+``statusd`` answers ``/jobs`` + ``/jobs/<job_id>`` from the live
+`LineageIndex`.
+
+Mechanics — three small pieces, all host-side and stdlib-only (this
+module must import without jax, like `telemetry`, so the repo lints and
+`tools/lineage_report.py` can run anywhere):
+
+* **Minting + registry.**  `mint_job_id(problem_id, ordinal)` is a
+  deterministic hash of the tenant id and its global arrival ordinal —
+  the same ``seed + i`` discipline the fleet uses for keys — so a
+  supervised crash-resume re-mints the SAME id without coordination
+  (and the checkpoint persists it anyway, belt and braces).  The
+  process-local registry maps ``problem_id -> job_id`` for the
+  annotator.
+
+* **Annotation.**  `telemetry.RunTrace.emit` runs the registered record
+  annotator on every record after field merge: a record whose event
+  type is in `JOB_EVENT_TYPES` and whose ``problem_id`` is registered
+  gains ``job_id``; a ``shard_lost`` record's ``problem_ids`` list
+  gains the parallel ``job_ids``; single-run events with no problem_id
+  inherit the ambient job installed by `use_job` at the runner /
+  supervisor entry.  Event types in `EXEMPT_EVENT_TYPES` (process- or
+  fleet-global: ``run_start``, ``fleet_block``, ``comm``, …) are never
+  stamped — the partition is enforced by ``tools/lint_trace_schema.py``.
+
+* **Index.**  `LineageIndex` folds any mix of trace records into
+  per-job rollups (state machine, durations, restarts, shard losses,
+  serve counts) and persists atomically (tmp+rename) as a sidecar next
+  to the trace (``<trace>.lineage.json``), so ``statusd`` and the
+  report tool never rescan a multi-GB trace.  The process-global
+  `GLOBAL_INDEX` is fed by the annotator itself — every process that
+  emits correlated events (fleet, runner, serving daemon) has a live
+  index for free.
+
+Observability-only contract: ``STARK_LINEAGE=0`` disables the whole
+layer — no ``job_id`` fields, no ``feed_submit``/``slo_burn`` events,
+traces byte-identical to the pre-lineage repo — and draws are
+bit-identical either way (nothing here touches the op/key sequence).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Any, Dict, Iterable, List, Optional
+
+from . import telemetry
+
+__all__ = [
+    "JOB_EVENT_TYPES",
+    "EXEMPT_EVENT_TYPES",
+    "LineageIndex",
+    "GLOBAL_INDEX",
+    "enabled",
+    "mint_job_id",
+    "register",
+    "job_for",
+    "current_job",
+    "use_job",
+    "index_path",
+    "save_index",
+    "reset",
+]
+
+
+def enabled() -> bool:
+    """The family switch: ``STARK_LINEAGE=0`` disables minting,
+    annotation, and the new event families entirely (byte-identical
+    traces — the same opt-out contract as ``STARK_COMM_TELEMETRY`` /
+    ``STARK_SERVE_TELEMETRY`` / ``STARK_HEALTH``)."""
+    return os.environ.get("STARK_LINEAGE", "").strip() != "0"
+
+
+# --------------------------------------------------------------------------
+# event classification: every event type in telemetry.ALL_EVENT_TYPES is
+# either job_id-BEARING (tenant-correlated — the annotator may stamp it)
+# or explicitly EXEMPT (process-/fleet-global — never stamped).
+# tools/lint_trace_schema.py fails on any event left unclassified, so a
+# new event family cannot land without deciding its lineage story.
+# --------------------------------------------------------------------------
+
+#: tenant-correlated event types: these may carry ``job_id`` (directly
+#: via a registered ``problem_id``, via the ``problem_ids`` list on
+#: ``shard_lost``, or via the ambient single-run job context)
+JOB_EVENT_TYPES = frozenset({
+    # single-run / per-lane sampling lifecycle (ambient job in runner runs)
+    "warmup_block", "sample_block", "chain_health", "checkpoint",
+    "progress", "adapt", "budget", "collect", "fault",
+    # fleet per-tenant lifecycle
+    "feed_submit", "feed_reject", "problem_admitted", "slot_recycled",
+    "problem_reseeded", "problem_quarantined", "problem_converged",
+    "shard_lost", "slo_burn",
+    # health + serving are per-tenant whenever a problem_id rides them
+    "health_warning", "serve_request",
+})
+
+#: process-/fleet-global event types: one record covers many (or no)
+#: tenants, so stamping a single job_id would be a lie — the annotator
+#: never touches these
+EXEMPT_EVENT_TYPES = frozenset({
+    "run_start", "run_end", "compile", "fleet_block", "fleet_compact",
+    "span", "comm", "profile_load", "trace_rotated",
+})
+
+
+def mint_job_id(problem_id: str, ordinal: int) -> str:
+    """Deterministic job id for (tenant, global arrival ordinal).
+
+    Stable across supervised restarts by construction (same pid, same
+    ordinal → same id), collision-safe across tenants reusing a pid in
+    different slots, and short enough to read in a trace line."""
+    digest = hashlib.sha1(
+        f"{problem_id}#{int(ordinal)}".encode()
+    ).hexdigest()
+    return "j-" + digest[:12]
+
+
+# --------------------------------------------------------------------------
+# registry + ambient job context
+# --------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_REGISTRY: Dict[str, str] = {}
+
+#: ambient job for single-run entry points (runner / supervisor) whose
+#: events carry no problem_id; ContextVar keeps nested runs isolated and
+#: a module-level mirror reaches jax.debug.callback host threads (the
+#: same split telemetry uses for the ambient trace)
+_JOB: ContextVar[Optional[str]] = ContextVar("stark_tpu_job", default=None)
+_CALLBACK_JOB: Optional[str] = None
+
+
+def register(problem_id: str, job_id: str) -> None:
+    """Bind a tenant id to its job for this process's annotator."""
+    with _LOCK:
+        _REGISTRY[str(problem_id)] = job_id
+
+
+def job_for(problem_id: str) -> Optional[str]:
+    """The registered job for a tenant id, or None."""
+    with _LOCK:
+        return _REGISTRY.get(str(problem_id))
+
+
+def current_job() -> Optional[str]:
+    """The ambient single-run job id (ContextVar, callback mirror)."""
+    jid = _JOB.get()
+    return jid if jid is not None else _CALLBACK_JOB
+
+
+@contextmanager
+def use_job(job_id: str):
+    """Install ``job_id`` as the ambient job for the enclosed run — the
+    runner / supervisor entry hook for single-run parity (fleet tenants
+    ride the registry instead)."""
+    global _CALLBACK_JOB
+    token = _JOB.set(job_id)
+    prev = _CALLBACK_JOB
+    _CALLBACK_JOB = job_id
+    try:
+        yield job_id
+    finally:
+        _JOB.reset(token)
+        _CALLBACK_JOB = prev
+
+
+def reset() -> None:
+    """Drop the registry and the global index (test isolation)."""
+    with _LOCK:
+        _REGISTRY.clear()
+    GLOBAL_INDEX.clear()
+
+
+# --------------------------------------------------------------------------
+# the annotator: telemetry.emit runs this on every record
+# --------------------------------------------------------------------------
+
+
+def _annotate(rec: Dict[str, Any]) -> None:
+    """Stamp ``job_id`` / ``job_ids`` onto one emitted record, in place.
+
+    Called from `telemetry.RunTrace.emit` after field merge (registered
+    via `telemetry.add_record_annotator` at import).  No-op with
+    ``STARK_LINEAGE=0`` (byte-identity) and for `EXEMPT_EVENT_TYPES`.
+    Every correlated record also feeds the process-global
+    `GLOBAL_INDEX`, so /jobs answers without a trace rescan."""
+    if not enabled():
+        return
+    event = rec.get("event")
+    if event not in JOB_EVENT_TYPES:
+        return
+    if "job_id" not in rec and "job_ids" not in rec:
+        pid = rec.get("problem_id")
+        if pid is None and event == "slot_recycled":
+            # a recycle names its INCOMING tenant as ``to_problem`` —
+            # that is the job the slot now belongs to
+            pid = rec.get("to_problem")
+        jid = job_for(pid) if isinstance(pid, str) else None
+        if jid is None and isinstance(rec.get("problem_ids"), (list, tuple)):
+            jids = [job_for(p) for p in rec["problem_ids"]]
+            if any(j is not None for j in jids):
+                rec["job_ids"] = jids
+        elif jid is None:
+            jid = current_job()
+        if jid is not None:
+            rec["job_id"] = jid
+    GLOBAL_INDEX.update(rec)
+
+
+telemetry.add_record_annotator(_annotate)
+
+
+# --------------------------------------------------------------------------
+# the queryable index
+# --------------------------------------------------------------------------
+
+#: serve endpoints counted per job (anything else lands in "other")
+_SERVE_ENDPOINTS = ("summary", "predict", "draws")
+
+INDEX_SCHEMA = 1
+
+
+def _new_record(job_id: str) -> Dict[str, Any]:
+    return {
+        "job_id": job_id,
+        "problem_id": None,
+        "state": "observed",
+        "events": 0,
+        "first_ts": None,
+        "last_ts": None,
+        "duration_s": None,
+        "blocks": 0,
+        "restarts": 0,
+        "shard_losses": 0,
+        "checkpoints": 0,
+        "health_warnings": 0,
+        "submitted_ts": None,
+        "converged_ts": None,
+        "status": None,
+        "slo": None,
+        "serves": {"summary": 0, "predict": 0, "draws": 0, "other": 0},
+        "first_serve_ts": None,
+    }
+
+
+class LineageIndex:
+    """Per-job rollups folded from trace records — the /jobs backing store.
+
+    `update` is tolerant of anything: records from mixed schema
+    versions, foreign dicts, torn lines already skipped by the reader —
+    a record without a job reference is simply not lineage evidence.
+    Thread-safe (the annotator feeds it from emit sites on any thread).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._jobs)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._jobs.clear()
+
+    # -- folding -----------------------------------------------------------
+
+    def update(self, rec: Dict[str, Any]) -> None:
+        """Fold one trace record into the per-job rollups (no-op unless
+        the record names a job via ``job_id`` / ``job_ids``)."""
+        if not isinstance(rec, dict):
+            return
+        jids = []
+        jid = rec.get("job_id")
+        if isinstance(jid, str):
+            jids.append(jid)
+        more = rec.get("job_ids")
+        if isinstance(more, (list, tuple)):
+            jids.extend(j for j in more if isinstance(j, str))
+        if not jids:
+            return
+        with self._lock:
+            for j in jids:
+                self._fold_one(self._jobs.setdefault(j, _new_record(j)), rec)
+
+    def _fold_one(self, job: Dict[str, Any], rec: Dict[str, Any]) -> None:
+        event = rec.get("event")
+        ts = rec.get("ts")
+        job["events"] += 1
+        if isinstance(ts, (int, float)):
+            if job["first_ts"] is None or ts < job["first_ts"]:
+                job["first_ts"] = ts
+            if job["last_ts"] is None or ts > job["last_ts"]:
+                job["last_ts"] = ts
+            if job["first_ts"] is not None:
+                job["duration_s"] = round(job["last_ts"] - job["first_ts"], 4)
+        pid = rec.get("problem_id")
+        if job["problem_id"] is None and isinstance(pid, str):
+            job["problem_id"] = pid
+        if event == "feed_submit":
+            job["state"] = "submitted"
+            if job["submitted_ts"] is None:
+                job["submitted_ts"] = ts
+        elif event == "problem_admitted":
+            if job["state"] in ("observed", "submitted"):
+                job["state"] = "admitted"
+        elif event in ("warmup_block", "sample_block", "slo_burn"):
+            if job["state"] in ("observed", "submitted", "admitted"):
+                job["state"] = "sampling"
+            if event == "slo_burn":
+                job["slo"] = {
+                    k: rec[k]
+                    for k in ("deadline_burn", "restart_burn", "ess_burn")
+                    if rec.get(k) is not None
+                }
+        elif event == "problem_reseeded":
+            job["restarts"] += 1
+        elif event == "shard_lost":
+            job["shard_losses"] += 1
+        elif event == "checkpoint":
+            job["checkpoints"] += 1
+        elif event == "health_warning":
+            job["health_warnings"] += 1
+        elif event == "problem_quarantined":
+            job["state"] = "quarantined"
+        elif event == "problem_converged":
+            status = rec.get("status")
+            job["status"] = status
+            job["state"] = (
+                "converged" if status == "converged" else (status or "done")
+            )
+            job["converged_ts"] = ts
+            if isinstance(rec.get("blocks"), int):
+                job["blocks"] = rec["blocks"]
+        elif event == "serve_request":
+            ep = rec.get("endpoint")
+            key = ep if ep in _SERVE_ENDPOINTS else "other"
+            job["serves"][key] = job["serves"].get(key, 0) + 1
+            if job["first_serve_ts"] is None:
+                job["first_serve_ts"] = ts
+        if event in ("fleet_block",):
+            pass  # exempt events never reach here (no job_id), but be safe
+
+    def fold_events(self, events: Iterable[Dict[str, Any]]) -> "LineageIndex":
+        for rec in events:
+            self.update(rec)
+        return self
+
+    def fold_trace(self, path: str) -> "LineageIndex":
+        """Fold one trace file (tolerant reader — torn lines skipped),
+        including any rotated predecessors next to it."""
+        for part in telemetry.rotated_paths(path):
+            try:
+                for rec in telemetry.iter_trace(part, strict=False):
+                    self.update(rec)
+            except OSError:
+                continue
+        return self
+
+    def adopt(self, rec: Dict[str, Any]) -> None:
+        """Install one already-folded rollup verbatim (sidecar merge in
+        `tools/lineage_report.py` — NOT event folding, no counting)."""
+        if isinstance(rec, dict) and isinstance(rec.get("job_id"), str):
+            base = _new_record(rec["job_id"])
+            base.update(rec)
+            with self._lock:
+                self._jobs[rec["job_id"]] = base
+
+    # -- queries -----------------------------------------------------------
+
+    def job(self, job_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            rec = self._jobs.get(job_id)
+            return dict(rec) if rec is not None else None
+
+    def jobs(self) -> List[Dict[str, Any]]:
+        """All rollups, oldest first (stable for the /jobs listing)."""
+        with self._lock:
+            out = [dict(v) for v in self._jobs.values()]
+        out.sort(key=lambda r: (r["first_ts"] or 0.0, r["job_id"]))
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """Tiny rollup-of-rollups for the /status payload."""
+        by_state: Dict[str, int] = {}
+        with self._lock:
+            for rec in self._jobs.values():
+                by_state[rec["state"]] = by_state.get(rec["state"], 0) + 1
+            n = len(self._jobs)
+        return {"count": n, "by_state": by_state}
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: str) -> str:
+        """Atomically persist the index sidecar (tmp+rename — a
+        concurrent /jobs reader or report run never sees a torn file)."""
+        payload = {"schema": INDEX_SCHEMA, "jobs": self.jobs()}
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> Optional["LineageIndex"]:
+        """The persisted sidecar as a live index, or None (absent/torn)."""
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        if not isinstance(payload, dict) or "jobs" not in payload:
+            return None
+        idx = cls()
+        for rec in payload["jobs"]:
+            if isinstance(rec, dict) and isinstance(rec.get("job_id"), str):
+                base = _new_record(rec["job_id"])
+                base.update(rec)
+                idx._jobs[rec["job_id"]] = base
+        return idx
+
+
+#: the process-global index the annotator feeds — what statusd's /jobs
+#: serves and the fleet snapshots into the on-disk sidecar
+GLOBAL_INDEX = LineageIndex()
+
+
+def index_path(trace_path: str) -> str:
+    """The index sidecar lives NEXT TO the trace (same convention as the
+    serving summary sidecar): ``<trace>.lineage.json``."""
+    return trace_path + ".lineage.json"
+
+
+def save_index(trace_path: Optional[str]) -> Optional[str]:
+    """Snapshot `GLOBAL_INDEX` next to ``trace_path`` (no-op without a
+    file-backed trace or with lineage disabled); never raises — the
+    sidecar is best-effort observability, not run state."""
+    if trace_path is None or not enabled():
+        return None
+    try:
+        return GLOBAL_INDEX.save(index_path(trace_path))
+    except OSError:
+        return None

@@ -39,7 +39,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-from . import telemetry
+from . import lineage, telemetry
 
 __all__ = [
     "Counter",
@@ -59,8 +59,13 @@ METRIC_PREFIX = "stark"
 #: (schema/uptime_s/last_postmortem + per-problem SLO gauges); 3 = the
 #: posterior read plane (``serving`` sub-object: cumulative request /
 #: cache-hit-miss counts, per-endpoint totals, the latest endpoint, and
-#: the scrape-window QPS — ``{}`` until the first ``serve_request``).
-STATUS_SCHEMA = 3
+#: the scrape-window QPS — ``{}`` until the first ``serve_request``);
+#: 4 = the lineage observatory (``jobs`` sub-object: tracked-job count +
+#: by-state rollup from the process-global LineageIndex, null with
+#: STARK_LINEAGE=0; ``serving`` gains per-problem request counts with
+#: each tenant's ``job_id`` when the sidecar carries one; the
+#: ``/jobs`` + ``/jobs/<job_id>`` statusd endpoints ship alongside).
+STATUS_SCHEMA = 4
 
 #: default histogram buckets (seconds) — block/checkpoint walls span
 #: ~10 ms (tiny CPU drills) to minutes (compile-inclusive first blocks)
@@ -651,6 +656,13 @@ class TraceCollector:
             "fraction of the per-problem restart budget consumed "
             "(1.0 = the next lane fault quarantines the tenant)",
         )
+        self.g_job_slo_burn = r.gauge(
+            f"{p}_job_slo_burn",
+            "live SLO burn per tenant from block-cadence slo_burn "
+            "events (labels problem + budget in deadline/restart/ess): "
+            "fraction of that budget consumed; absent budgets emit no "
+            "series (STARK_LINEAGE=0 emits none at all)",
+        )
         self.g_healthy = r.gauge(
             f"{p}_healthy", "1 when /healthz reports 200, else 0"
         )
@@ -743,6 +755,8 @@ class TraceCollector:
             self.g_problem_ess_rate.clear()
             self.g_problem_headroom.clear()
             self.g_problem_restart_burn.clear()
+            # run B's scrape must not serve run A's live SLO burn series
+            self.g_job_slo_burn.clear()
             # the mesh layout is per-run state: run B single-device (or
             # on a narrower mesh) must not keep serving run A's shard
             # count or shard labels
@@ -1233,6 +1247,39 @@ class TraceCollector:
             by_ep = sv.setdefault("by_endpoint", {})
             by_ep[endpoint] = int(by_ep.get(endpoint, 0)) + 1
             sv["last_endpoint"] = endpoint
+            # serving<->sampling correlation (lineage observatory): the
+            # per-problem rollup carries each tenant's job_id when the
+            # event (via the summary sidecar) knows it — how a
+            # cross-process /status consumer joins read traffic back to
+            # the run that produced the posterior
+            pid = rec.get("problem_id")
+            if isinstance(pid, str) and pid:
+                by_prob = sv.setdefault("by_problem", {})
+                ent = by_prob.setdefault(pid, {"requests": 0})
+                ent["requests"] = int(ent.get("requests", 0)) + 1
+                jid = rec.get("job_id")
+                if isinstance(jid, str):
+                    ent["job_id"] = jid
+                sv["last_problem"] = pid
+
+    def _on_slo_burn(self, rec: Dict[str, Any]) -> None:
+        """Block-cadence SLO burn accounting (stark_tpu.lineage): one
+        labeled series per (tenant, budget) — fraction consumed.  An
+        absent budget emitted no field, so it sets no series (the
+        null-not-0.0 rule, carried through to the gauge)."""
+        pid = rec.get("problem_id")
+        if not isinstance(pid, str):
+            return
+        for budget, field in (
+            ("deadline", "deadline_burn"),
+            ("restart", "restart_burn"),
+            ("ess", "ess_burn"),
+        ):
+            v = rec.get(field)
+            if isinstance(v, (int, float)):
+                self.g_job_slo_burn.set(
+                    float(v), problem=pid, budget=budget
+                )
 
     def _serve_qps(self) -> float:
         """Trailing-60 s request rate (scrape-time gauge hook)."""
@@ -1284,6 +1331,11 @@ class TraceCollector:
                 serving_snap["by_endpoint"] = dict(
                     serving_snap["by_endpoint"]
                 )
+            if "by_problem" in serving_snap:
+                serving_snap["by_problem"] = {
+                    k: dict(v)
+                    for k, v in serving_snap["by_problem"].items()
+                }
             if serving_snap:
                 serving_snap["qps"] = round(self._serve_qps(), 4)
             snap = {
@@ -1318,5 +1370,12 @@ class TraceCollector:
             # flight recorder's {path, trigger, ts}; null when none) —
             # the operator's jump-link from "it restarted" to forensics
             last_postmortem=telemetry.last_postmortem(),
+            # lineage rollup-of-rollups (schema 4): tracked jobs + their
+            # state histogram from the process-global index; null (not
+            # {}) with the observatory off — absent evidence is absent
+            jobs=(
+                lineage.GLOBAL_INDEX.summary()
+                if lineage.enabled() else None
+            ),
         )
         return snap

@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import diagnostics, faults, health as _health, telemetry
+from . import diagnostics, faults, health as _health, lineage, telemetry
 from . import profile as _profile
 from .kernels.base import HMCState
 from .ops import quantize as _quantize
@@ -154,6 +154,20 @@ def sample_until_converged(model: Model, data: Any = None, **kwargs):
     explicit env always wins, STARK_PROFILE=0 disables)."""
     trace = telemetry.resolve_trace(kwargs.pop("trace", None))
     with telemetry.use_trace(trace):
+        if lineage.enabled():
+            # single-run lineage parity: one ambient job for the whole
+            # run (the supervisor's outer job wins, so every restart
+            # attempt correlates to ONE id; otherwise mint
+            # deterministically from the model/seed — a resumed run
+            # re-mints the same id)
+            jid = lineage.current_job() or lineage.mint_job_id(
+                getattr(model, "tag", type(model).__name__),
+                int(kwargs.get("seed", 0)),
+            )
+            with lineage.use_job(jid):
+                return _sample_until_converged(
+                    model, data, trace=trace, **kwargs
+                )
         return _sample_until_converged(model, data, trace=trace, **kwargs)
 
 

@@ -56,7 +56,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .drawstore import read_draws
-from . import telemetry
+from . import lineage, telemetry
 
 __all__ = [
     "SERVE_CACHE_ENV",
@@ -96,8 +96,12 @@ _DEFAULT_SKETCH = 4096
 SERVE_PREDICT_DRAWS_ENV = "STARK_SERVE_PREDICT_DRAWS"
 _DEFAULT_PREDICT_DRAWS = 512
 
-#: sidecar contract version (bump on shape changes; readers key on it)
-SUMMARY_SCHEMA = 1
+#: sidecar contract version (bump on shape changes; readers key on it).
+#: v2: optional ``job_id`` lineage key (stark_tpu.lineage) — the fleet
+#: persists the tenant's correlation id so a serving daemon in another
+#: process can stamp it onto serve_request events; absent on
+#: STARK_LINEAGE=0 runs (v1 sidecars read fine — the key is optional)
+SUMMARY_SCHEMA = 2
 
 #: the fixed quantile grid every summary and predictive response carries
 QUANTILE_PROBS = (0.01, 0.05, 0.25, 0.5, 0.75, 0.95, 0.99)
@@ -396,6 +400,17 @@ class PosteriorStore:
             **fields,
         )
 
+    def _job_fields(self, t: Optional["_Tenant"]) -> Dict[str, Any]:
+        """Lineage correlation for a serve_request: the tenant's job_id
+        read back from the summary sidecar the fleet wrote (the id's
+        ride across the process boundary).  Empty with STARK_LINEAGE=0
+        or a pre-lineage sidecar — the field is present only when known
+        (byte-identity + null-not-0.0)."""
+        if not lineage.enabled() or t is None or t.summary is None:
+            return {}
+        jid = t.summary.get("job_id")
+        return {"job_id": jid} if isinstance(jid, str) else {}
+
     # -- registry ----------------------------------------------------------
 
     def path(self, problem_id: str) -> str:
@@ -467,7 +482,8 @@ class PosteriorStore:
         except Exception:
             self._emit("draws", problem_id, t0, "miss", ok=False)
             raise
-        self._emit("draws", problem_id, t0, cache, n=int(t.draws.shape[0]))
+        self._emit("draws", problem_id, t0, cache, n=int(t.draws.shape[0]),
+                   **self._job_fields(t))
         return t.draws
 
     def summary(self, problem_id: str) -> Dict[str, Any]:
@@ -481,7 +497,7 @@ class PosteriorStore:
         except Exception:
             self._emit("summary", problem_id, t0, "miss", ok=False)
             raise
-        self._emit("summary", problem_id, t0, cache)
+        self._emit("summary", problem_id, t0, cache, **self._job_fields(t))
         return t.summary
 
     # -- predictive --------------------------------------------------------
@@ -587,6 +603,22 @@ class PosteriorStore:
                     "cache": resolved[i][4],
                 }
         hit_all = all(r[4] == "hit" for r in resolved) if resolved else False
+        job_fields: Dict[str, Any] = {}
+        if lineage.enabled() and resolved:
+            # batched requests: the parallel job_ids list mirrors the
+            # (capped) problem_id join; present only when at least one
+            # tenant's sidecar carries a lineage id
+            with self._lock:
+                jids = []
+                for r in resolved[:8]:
+                    t = self._lru.get(r[0].problem_id)
+                    jid = (
+                        t.summary.get("job_id")
+                        if t is not None and t.summary else None
+                    )
+                    jids.append(jid if isinstance(jid, str) else None)
+            if any(j is not None for j in jids):
+                job_fields["job_ids"] = jids
         self._emit(
             "predict",
             ",".join(r[0].problem_id for r in resolved[:8]),
@@ -594,6 +626,7 @@ class PosteriorStore:
             "hit" if hit_all else "miss",
             batch=len(resolved),
             groups=len(groups),
+            **job_fields,
         )
         return [r for r in out if r is not None]
 

@@ -70,6 +70,18 @@ Endpoints:
     ``/status``'s ``serving`` sub-object; see the README "Posterior
     serving" section for the full JSON contracts.
 
+  * ``GET /jobs`` / ``GET /jobs/<job_id>`` — the tenant lineage
+    observatory (`stark_tpu.lineage`): per-job rollups folded LIVE by
+    the record annotator as events are emitted (no trace rescan).
+    ``/jobs`` lists every job this process has observed, oldest first
+    (``{"schema": INDEX_SCHEMA, "enabled": ..., "jobs": [...]}``);
+    ``/jobs/<job_id>`` returns one record — lifecycle state, event
+    counts, block/restart/shard-loss/checkpoint tallies, latest SLO
+    burn fractions, convergence status, and serving hit counts — or
+    404 for an unknown id.  With ``STARK_LINEAGE=0`` the index is
+    never fed, so ``/jobs`` answers with an empty list and
+    ``enabled: false``.
+
 Probe contract: ``python -m stark_tpu status --json`` prints ONE
 machine-parseable line ``{"endpoint", "code", "body"}`` for any of the
 three endpoints (body parsed when the response was JSON).
@@ -130,6 +142,8 @@ ROUTES = (
     "/posterior/<id>/summary",
     "/posterior/<id>/predict",
     "/posterior/<id>/draws",
+    "/jobs",
+    "/jobs/<job_id>",
 )
 
 #: bind address: loopback by default — the endpoints expose run metadata
@@ -202,6 +216,28 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, body, "application/json")
             elif self._posterior_route(path) is not None:
                 self._serve_posterior_get(sd, *self._posterior_route(path))
+            elif path == "/jobs":
+                # tenant lineage observatory (stark_tpu.lineage): the
+                # live per-job rollups this process's annotator folded —
+                # no trace rescan, oldest job first
+                from . import lineage
+
+                self._send_json(200, {
+                    "schema": lineage.INDEX_SCHEMA,
+                    "enabled": lineage.enabled(),
+                    "jobs": lineage.GLOBAL_INDEX.jobs(),
+                })
+            elif path.startswith("/jobs/"):
+                from . import lineage
+
+                jid = path[len("/jobs/"):]
+                rec = lineage.GLOBAL_INDEX.job(jid)
+                if rec is None:
+                    self._send_json(
+                        404, {"error": f"unknown job {jid!r}"}
+                    )
+                else:
+                    self._send_json(200, rec)
             else:
                 self._send(404, b"not found\n", "text/plain; charset=utf-8")
         except Exception as e:  # noqa: BLE001 — a scrape must never kill the daemon

@@ -58,7 +58,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import telemetry
+from . import lineage, telemetry
 from .checkpoint import load_checkpoint
 from .faults import fail_point
 from .model import Model
@@ -421,6 +421,21 @@ def supervised_sample(
     recorder = telemetry.flight_recorder(workdir)
     recorder.install()
 
+    # lineage: ONE ambient job for the whole supervision (every restart
+    # attempt, every supervisor-side quarantine/restart event correlates
+    # to the same id — minted deterministically from model/seed, so the
+    # runner's own minting agrees and a process-crash resume re-mints
+    # the same id).  Entered manually so the existing try/finally
+    # structure stays put; no-op with STARK_LINEAGE=0.
+    _job_cm = None
+    if lineage.enabled():
+        _job_cm = lineage.use_job(
+            lineage.current_job() or lineage.mint_job_id(
+                getattr(model, "tag", type(model).__name__), int(seed)
+            )
+        )
+        _job_cm.__enter__()
+
     attempt = 0
 
     def on_failure(e: BaseException, fault: str, resumed: bool) -> None:
@@ -560,3 +575,5 @@ def supervised_sample(
                 on_failure(e, classify_fault(e), resume is not None)
     finally:
         recorder.uninstall()
+        if _job_cm is not None:
+            _job_cm.__exit__(None, None, None)

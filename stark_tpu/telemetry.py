@@ -197,12 +197,28 @@ SERVING_EVENT_TYPES = frozenset({"serve_request"})
 #: era by construction.
 PROFILE_EVENT_TYPES = frozenset({"profile_load"})
 
+#: lineage event types (stark_tpu.lineage): ``feed_submit`` — one
+#: accepted `FleetFeed.submit`, the moment a tenant's ``job_id`` is
+#: minted (``problem_id``, ``job_id``, queue ``depth``); ``slo_burn`` —
+#: block-cadence SLO burn-rate accounting over a tenant's
+#: `ProblemBudget` grants (``deadline_burn`` / ``restart_burn`` /
+#: ``ess_burn`` fractions consumed; absent budgets ride as null, never
+#: 0.0); ``trace_rotated`` — the trace file crossed
+#: ``STARK_TRACE_MAX_MB`` and was atomically rotated (``rotated_to``,
+#: ``size_bytes``; first line of the fresh file).  ``feed_submit`` and
+#: ``slo_burn`` are emitted only with lineage enabled
+#: (STARK_LINEAGE=0 → byte-identical traces); ``trace_rotated`` only
+#: when the rotation knob is set (unset → unbounded file, the
+#: pre-rotation contract).
+LINEAGE_EVENT_TYPES = frozenset({"feed_submit", "slo_burn",
+                                 "trace_rotated"})
+
 #: the complete WRITER registry: every emit()/phase() call in stark_tpu/
 #: must use one of these names (tools/lint_trace_schema.py enforces it)
 ALL_EVENT_TYPES = (EVENT_TYPES | AUX_EVENT_TYPES | FLEET_EVENT_TYPES
                    | PROFILING_EVENT_TYPES | HEALTH_EVENT_TYPES
                    | COMM_EVENT_TYPES | SERVING_EVENT_TYPES
-                   | PROFILE_EVENT_TYPES)
+                   | PROFILE_EVENT_TYPES | LINEAGE_EVENT_TYPES)
 
 #: envelope keys every event must carry (validate_event)
 ENVELOPE_KEYS = ("schema", "event", "ts", "wall_s", "run")
@@ -215,6 +231,49 @@ ENVELOPE_KEYS = ("schema", "event", "ts", "wall_s", "run")
 #: tiled by fleet_block + warmup_block + checkpoint, not sample_block
 PHASE_EVENTS = ("compile", "warmup_block", "sample_block", "fleet_block",
                 "checkpoint", "collect")
+
+
+def _trace_max_bytes() -> Optional[int]:
+    """Resolved ``STARK_TRACE_MAX_MB`` rotation threshold in bytes, or
+    None (unset / unparseable / non-positive → unbounded, the historical
+    contract).  Read once per trace open: a long-lived serving loop's
+    always-on recorder must not grow one file without bound, but a knob
+    flip mid-run only takes effect on the next trace."""
+    raw = os.environ.get("STARK_TRACE_MAX_MB", "").strip()
+    if not raw:
+        return None
+    try:
+        mb = float(raw)
+    except ValueError:
+        return None
+    if mb <= 0:
+        return None
+    return int(mb * 1024 * 1024)
+
+
+def rotated_paths(path: str) -> List[str]:
+    """The on-disk rotation sequence for a trace, OLDEST FIRST, live
+    file last: ``path.1``, ``path.2``, …, ``path``.  Readers
+    (`summarize_trace` callers, lineage folding, the report tool) chain
+    these to see the whole history; flight-recorder bundles are exempt
+    from rotation and unaffected."""
+    parts = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        parts.append(f"{path}.{n}")
+        n += 1
+    parts.append(path)
+    return parts
+
+
+def iter_traces(paths, strict: bool = False):
+    """Chain `iter_trace` over many files (a rotated sequence, a fleet's
+    mixed trace set); a missing file is skipped, not fatal."""
+    for path in paths:
+        try:
+            yield from iter_trace(path, strict=strict)
+        except OSError:
+            continue
 
 
 def _last_run_ordinal(path: str) -> int:
@@ -254,10 +313,12 @@ class _TraceState:
     global to the file.
     """
 
-    __slots__ = ("f", "t0", "run", "lock", "path", "last_progress_ts")
+    __slots__ = ("f", "t0", "run", "lock", "path", "last_progress_ts",
+                 "max_bytes")
 
     def __init__(self, path: Optional[str]):
         self.path = path
+        self.max_bytes = _trace_max_bytes() if path is not None else None
         if path is None:
             # in-memory bus: no file — events exist only for the
             # registered listeners (the status daemon's untraced mode)
@@ -276,6 +337,44 @@ class _TraceState:
         # serializes line writes so events never interleave mid-line
         self.lock = threading.Lock()
         self.last_progress_ts = 0.0
+
+
+def _rotate_locked(st: "_TraceState") -> Optional[Dict[str, Any]]:
+    """Rotate the live trace file (st.lock HELD): close, shift the full
+    file to the next free ``path.N`` slot via os.replace (atomic — a
+    concurrent reader sees the old complete file or the new one, never
+    a truncation), reopen fresh, and write one ``trace_rotated`` record
+    as the new file's first line.  The run ordinal continues across the
+    rotation.  Returns the rotated record for listener fan-out, or None
+    when rotation failed (the trace keeps appending to the original
+    file — retention is best-effort, the run is not)."""
+    path = st.path
+    size = st.f.tell()
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        n += 1
+    try:
+        st.f.close()
+        os.replace(path, f"{path}.{n}")
+        st.f = open(path, "a")
+    except OSError:
+        try:  # rotation failed: best effort to keep tracing at all
+            st.f = open(path, "a")
+        except OSError:
+            st.f = None
+        return None
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "event": "trace_rotated",
+        "ts": time.time(),
+        "wall_s": round(time.perf_counter() - st.t0, 4),
+        "run": st.run,
+        "rotated_to": f"{path}.{n}",
+        "size_bytes": size,
+    }
+    st.f.write(json.dumps(rec) + "\n")
+    st.f.flush()
+    return rec
 
 
 class _Phase:
@@ -355,6 +454,16 @@ class RunTrace:
         }
         rec.update(self._tags)
         rec.update(fields)
+        if _RECORD_ANNOTATORS:
+            # lineage (stark_tpu.lineage) stamps job_id here — one hook
+            # covers every emit site; annotators must be cheap and a
+            # failing one must never fault the run
+            for fn in list(_RECORD_ANNOTATORS):
+                try:
+                    fn(rec)
+                except Exception:  # noqa: BLE001
+                    pass
+        rotated_rec = None
         try:
             with st.lock:
                 if event == "run_start":
@@ -363,12 +472,17 @@ class RunTrace:
                 if st.f is not None:
                     st.f.write(json.dumps(rec) + "\n")
                     st.f.flush()
+                    if (st.max_bytes is not None
+                            and st.f.tell() >= st.max_bytes):
+                        rotated_rec = _rotate_locked(st)
         except (OSError, ValueError):  # closed/full disk: drop tracing,
             st.f = None  # never the run
             if not listening:
                 return None
         if listening:
             notify_event(rec)
+            if rotated_rec is not None:
+                notify_event(rotated_rec)
         return rec
 
     def phase(self, event: str, **fields) -> _Phase:
@@ -473,6 +587,27 @@ _PROGRESS_LISTENERS: List[Any] = []
 # truth test per emit); listeners must be cheap and never raise (the
 # exporter must not fault the run it observes).
 _EVENT_LISTENERS: List[Any] = []
+
+# record annotators: in-place enrichment of every record BEFORE it is
+# serialized — the lineage layer (stark_tpu.lineage) registers one to
+# stamp job_id at the single point all ~50 emit sites funnel through.
+# Zero-cost when empty; an annotator must be cheap, must only ADD
+# fields, and must never raise (exceptions are swallowed in emit).
+_RECORD_ANNOTATORS: List[Any] = []
+
+
+def add_record_annotator(fn) -> None:
+    """Register ``fn(record)`` to mutate every record in place before it
+    is written/fanned out (see `_RECORD_ANNOTATORS`)."""
+    if fn not in _RECORD_ANNOTATORS:
+        _RECORD_ANNOTATORS.append(fn)
+
+
+def remove_record_annotator(fn) -> None:
+    try:
+        _RECORD_ANNOTATORS.remove(fn)
+    except ValueError:
+        pass
 
 
 def add_event_listener(fn) -> None:

@@ -1,0 +1,651 @@
+"""Tenant lineage observatory (stark_tpu/lineage.py) contracts.
+
+The contracts under test:
+
+* **Minting + registry** — `mint_job_id` is deterministic in
+  (problem_id, arrival ordinal) so supervised crash-resume re-mints the
+  same id; the process registry and the ambient `use_job` context feed
+  the record annotator.
+* **Annotation** — every emitted record whose event type is in
+  `lineage.JOB_EVENT_TYPES` gains ``job_id`` (registry / ``job_ids``
+  list / ambient); `EXEMPT_EVENT_TYPES` records are never stamped; a
+  pre-set ``job_id`` (the serving daemon's sidecar-sourced one) wins.
+* **Opt-out byte-identity** — ``STARK_LINEAGE=0``: no ``job_id``
+  fields, no ``feed_submit``/``slo_burn`` events, the event stream
+  identical to the lineage-on run minus its artifacts, and draws
+  bit-identical either way (the pinned PR-19-shape contract).
+* **Index** — `LineageIndex` folds heterogeneous records into per-job
+  rollups, persists atomically, round-trips through the sidecar, and
+  backs ``statusd``'s ``/jobs`` + ``/jobs/<job_id>`` endpoints
+  (STATUS_SCHEMA 4) without rescanning a trace.
+* **Rotation** — ``STARK_TRACE_MAX_MB`` atomically rotates the live
+  trace (``trace_rotated`` first line of each fresh file), readers
+  chain the whole sequence, flight-recorder bundles are exempt.
+* **SLO burn** — block-cadence ``slo_burn`` events over `ProblemBudget`
+  grants feed the ``stark_job_slo_burn`` gauge and the ``budget_burn``
+  health warning (``STARK_HEALTH_BUDGET_BURN`` threshold knob).
+* **The drill** (slow tier) — a FleetFeed mesh run with an injected
+  shard loss, post-convergence serving hits, and
+  ``tools/lineage_report.py`` reconstructing one tenant's full story
+  with >=95% job_id coverage.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from stark_tpu import faults, lineage, serving, telemetry
+from stark_tpu.fleet import FleetFeed, FleetSpec, ProblemBudget, sample_fleet
+from stark_tpu.parallel.mesh import make_mesh
+from stark_tpu.health import BudgetBurnTrail, thresholds
+from stark_tpu.models.eight_schools import SIGMA, Y, EightSchools
+from stark_tpu.runner import sample_until_converged
+from stark_tpu.statusd import ROUTES, StatusServer
+from stark_tpu.telemetry import RunTrace, read_trace
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURE = os.path.join(_REPO, "tests", "fixtures", "lineage_trace.jsonl")
+
+
+@pytest.fixture(autouse=True)
+def _clean_lineage():
+    lineage.reset()
+    yield
+    lineage.reset()
+
+
+# ---------------------------------------------------------------------------
+# minting + registry + ambient context
+# ---------------------------------------------------------------------------
+
+
+def test_mint_job_id_deterministic():
+    a = lineage.mint_job_id("p0000", 0)
+    assert a == lineage.mint_job_id("p0000", 0)
+    assert a.startswith("j-") and len(a) == 14
+    assert a != lineage.mint_job_id("p0000", 1)
+    assert a != lineage.mint_job_id("p0001", 0)
+
+
+def test_registry_round_trip():
+    assert lineage.job_for("p0") is None
+    lineage.register("p0", "j-abc")
+    assert lineage.job_for("p0") == "j-abc"
+    lineage.reset()
+    assert lineage.job_for("p0") is None
+
+
+def test_use_job_ambient_nesting():
+    assert lineage.current_job() is None
+    with lineage.use_job("j-outer"):
+        assert lineage.current_job() == "j-outer"
+        with lineage.use_job("j-inner"):
+            assert lineage.current_job() == "j-inner"
+        assert lineage.current_job() == "j-outer"
+    assert lineage.current_job() is None
+
+
+# ---------------------------------------------------------------------------
+# the record annotator
+# ---------------------------------------------------------------------------
+
+
+def test_annotator_stamps_job_events_and_feeds_index(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    lineage.register("p0", "j-p0")
+    lineage.register("p1", "j-p1")
+    with RunTrace(path) as tr:
+        tr.emit("problem_admitted", problem_id="p0", slot=0)
+        tr.emit("fleet_block", block=0, occupancy=1.0)  # exempt
+        tr.emit("shard_lost", problem_ids=["p0", "p1"], lost_shards=[1])
+        with lineage.use_job("j-amb"):
+            tr.emit("sample_block", block=1, dur_s=0.1)  # no problem_id
+        tr.emit("sample_block", block=2, dur_s=0.1)  # no job in scope
+    evs = {
+        (e["event"], e.get("block")): e for e in read_trace(path)
+    }
+    assert evs[("problem_admitted", None)]["job_id"] == "j-p0"
+    assert "job_id" not in evs[("fleet_block", 0)]
+    assert evs[("shard_lost", None)]["job_ids"] == ["j-p0", "j-p1"]
+    assert evs[("sample_block", 1)]["job_id"] == "j-amb"
+    assert "job_id" not in evs[("sample_block", 2)]
+    # the same annotation fed the live index — no trace rescan
+    assert lineage.GLOBAL_INDEX.job("j-p0")["problem_id"] == "p0"
+    assert lineage.GLOBAL_INDEX.job("j-p1")["shard_losses"] == 1
+    assert lineage.GLOBAL_INDEX.job("j-amb")["state"] == "sampling"
+
+
+def test_annotator_never_overwrites_existing_job_id(tmp_path):
+    """A serving daemon stamps the sidecar-sourced job_id itself; the
+    annotator must not clobber it with a stale registry entry."""
+    path = str(tmp_path / "t.jsonl")
+    lineage.register("p0", "j-registry")
+    with RunTrace(path) as tr:
+        tr.emit("serve_request", endpoint="summary", problem_id="p0",
+                job_id="j-sidecar", dur_s=0.001, cache="hit", ok=True)
+    (ev,) = read_trace(path)
+    assert ev["job_id"] == "j-sidecar"
+    assert lineage.GLOBAL_INDEX.job("j-sidecar") is not None
+    assert lineage.GLOBAL_INDEX.job("j-registry") is None
+
+
+def test_lineage_off_no_stamping(tmp_path, monkeypatch):
+    monkeypatch.setenv("STARK_LINEAGE", "0")
+    path = str(tmp_path / "t.jsonl")
+    lineage.register("p0", "j-p0")
+    with lineage.use_job("j-amb"):
+        with RunTrace(path) as tr:
+            tr.emit("problem_admitted", problem_id="p0", slot=0)
+            tr.emit("sample_block", block=1, dur_s=0.1)
+    for ev in read_trace(path):
+        assert "job_id" not in ev and "job_ids" not in ev
+    assert len(lineage.GLOBAL_INDEX) == 0
+
+
+# ---------------------------------------------------------------------------
+# LineageIndex: folding, persistence, atomicity
+# ---------------------------------------------------------------------------
+
+
+def _lifecycle_events(jid="j-x", pid="p0"):
+    base = {"schema": 1, "wall_s": 0.0, "run": 0, "job_id": jid,
+            "problem_id": pid}
+    return [
+        {**base, "event": "feed_submit", "ts": 1.0, "depth": 1},
+        {**base, "event": "problem_admitted", "ts": 2.0, "slot": 0},
+        {**base, "event": "sample_block", "ts": 3.0, "block": 0},
+        {**base, "event": "slo_burn", "ts": 3.5, "deadline_burn": 0.4},
+        {**base, "event": "checkpoint", "ts": 4.0},
+        {**base, "event": "problem_reseeded", "ts": 5.0},
+        {**base, "event": "health_warning", "ts": 5.5,
+         "warning": "budget_burn"},
+        {**base, "event": "problem_converged", "ts": 6.0,
+         "status": "converged", "blocks": 7},
+        {**base, "event": "serve_request", "ts": 9.0, "endpoint": "summary"},
+        {**base, "event": "serve_request", "ts": 9.5, "endpoint": "predict"},
+    ]
+
+
+def test_index_folds_full_lifecycle():
+    idx = lineage.LineageIndex().fold_events(_lifecycle_events())
+    rec = idx.job("j-x")
+    assert rec["state"] == "converged" and rec["status"] == "converged"
+    assert rec["problem_id"] == "p0"
+    assert rec["submitted_ts"] == 1.0 and rec["converged_ts"] == 6.0
+    assert rec["blocks"] == 7 and rec["restarts"] == 1
+    assert rec["checkpoints"] == 1 and rec["health_warnings"] == 1
+    assert rec["slo"] == {"deadline_burn": 0.4}
+    assert rec["serves"] == {"summary": 1, "predict": 1, "draws": 0,
+                             "other": 0}
+    assert rec["first_serve_ts"] == 9.0
+    assert rec["duration_s"] == 8.5
+    # garbage records are not lineage evidence, never an error
+    idx.update({"event": "sample_block"})
+    idx.update("not a dict")
+    idx.update({"job_id": 42, "event": "x"})
+    assert len(idx) == 1
+
+
+def test_index_save_load_round_trip_atomic(tmp_path):
+    idx = lineage.LineageIndex().fold_events(_lifecycle_events())
+    path = str(tmp_path / "t.jsonl.lineage.json")
+    idx.save(path)
+    assert not os.path.exists(path + ".tmp"), "tmp must be renamed away"
+    loaded = lineage.LineageIndex.load(path)
+    assert loaded.job("j-x") == idx.job("j-x")
+    assert lineage.LineageIndex.load(str(tmp_path / "absent.json")) is None
+    torn = str(tmp_path / "torn.json")
+    with open(torn, "w") as f:
+        f.write('{"schema": 1, "jobs": [{"job_')
+    assert lineage.LineageIndex.load(torn) is None
+
+
+def test_index_summary_and_order():
+    idx = lineage.LineageIndex()
+    idx.fold_events(_lifecycle_events("j-b", "p1"))
+    idx.update({"event": "feed_submit", "ts": 0.5, "job_id": "j-a",
+                "problem_id": "p9"})
+    jobs = idx.jobs()
+    assert [r["job_id"] for r in jobs] == ["j-a", "j-b"]  # oldest first
+    assert idx.summary() == {
+        "count": 2, "by_state": {"submitted": 1, "converged": 1},
+    }
+
+
+# ---------------------------------------------------------------------------
+# trace rotation: STARK_TRACE_MAX_MB
+# ---------------------------------------------------------------------------
+
+
+def test_trace_rotation_and_chained_readers(tmp_path, monkeypatch):
+    """Crossing STARK_TRACE_MAX_MB rotates atomically: numbered
+    predecessors, a trace_rotated record leading each fresh file, and
+    the chained readers seeing every event exactly once."""
+    monkeypatch.setenv("STARK_TRACE_MAX_MB", "0.001")  # ~1 KiB
+    path = str(tmp_path / "t.jsonl")
+    n = 40
+    with RunTrace(path) as tr:
+        for i in range(n):
+            tr.emit("progress", block=i, note="x" * 64)
+    parts = telemetry.rotated_paths(path)
+    assert len(parts) > 1 and parts[-1] == path
+    assert parts[0] == path + ".1"
+    evs = list(telemetry.iter_traces(parts))
+    rotated = [e for e in evs if e["event"] == "trace_rotated"]
+    progress = [e for e in evs if e["event"] == "progress"]
+    assert [e["block"] for e in progress] == list(range(n))
+    assert len(rotated) == len(parts) - 1
+    for r in rotated:
+        assert r["rotated_to"].startswith(path + ".")
+        assert r["size_bytes"] > 0
+    # each fresh file opens with its trace_rotated marker
+    for p in parts[1:]:
+        first = next(telemetry.iter_trace(p, strict=False))
+        assert first["event"] == "trace_rotated"
+
+
+def test_rotation_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("STARK_TRACE_MAX_MB", raising=False)
+    path = str(tmp_path / "t.jsonl")
+    with RunTrace(path) as tr:
+        for i in range(50):
+            tr.emit("progress", block=i, note="x" * 64)
+    assert telemetry.rotated_paths(path) == [path]
+    assert all(e["event"] == "progress" for e in read_trace(path))
+
+
+def test_flight_recorder_bundles_exempt_from_rotation(tmp_path,
+                                                      monkeypatch):
+    """Postmortem bundles are forensic snapshots, not growing logs —
+    a tiny STARK_TRACE_MAX_MB must leave events.jsonl whole."""
+    monkeypatch.setenv("STARK_TRACE_MAX_MB", "0.0001")
+    recorder = telemetry.flight_recorder(str(tmp_path))
+    recorder.install()
+    try:
+        tr = RunTrace(None)
+        for i in range(80):
+            tr.emit("progress", block=i, note="x" * 64)
+        bundle_dir = recorder.dump_postmortem("lineage_test")
+    finally:
+        recorder.uninstall()
+        recorder.set_workdir(None)
+    events_file = os.path.join(bundle_dir, "events.jsonl")
+    assert os.path.exists(events_file)
+    assert not os.path.exists(events_file + ".1")
+    assert sum(1 for _ in telemetry.iter_trace(events_file,
+                                               strict=False)) >= 80
+
+
+# ---------------------------------------------------------------------------
+# SLO burn: the budget_burn warning + threshold knob
+# ---------------------------------------------------------------------------
+
+
+def test_budget_burn_trail_warns_once_per_budget(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    with RunTrace(path) as tr:
+        trail = BudgetBurnTrail(trace=tr, threshold=0.9)
+        trail.observe("p0", {"deadline": 0.5, "restart": None}, block=1)
+        trail.observe("p0", {"deadline": 0.95, "restart": 0.2}, block=2)
+        trail.observe("p0", {"deadline": 0.99, "restart": 1.0}, block=3)
+    warns = [e for e in read_trace(path) if e["event"] == "health_warning"]
+    assert [(w["budget"], w["block"]) for w in warns] == [
+        ("deadline", 2), ("restart", 3),
+    ]
+    w = warns[0]
+    assert w["warning"] == "budget_burn" and w["severity"] == "warn"
+    assert w["value"] == 0.95 and w["threshold"] == 0.9
+    assert w["knob"] == "STARK_HEALTH_BUDGET_BURN"
+    assert w["problem_id"] == "p0" and "budget" in w["hint"].lower()
+
+
+def test_budget_burn_threshold_knob(monkeypatch):
+    assert thresholds()["budget_burn"] == 0.9
+    monkeypatch.setenv("STARK_HEALTH_BUDGET_BURN", "0.5")
+    assert thresholds()["budget_burn"] == 0.5
+    trail = BudgetBurnTrail(trace=RunTrace(None))
+    assert trail.threshold == 0.5
+
+
+# ---------------------------------------------------------------------------
+# statusd: /jobs + /jobs/<job_id> (STATUS_SCHEMA 4)
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_jobs_endpoints_contract():
+    assert "/jobs" in ROUTES and "/jobs/<job_id>" in ROUTES
+    srv = StatusServer(0, host="127.0.0.1").start()
+    try:
+        tr = RunTrace(None)
+        tr.emit("run_start", entry="sample_fleet", problems=1, chains=2)
+        lineage.register("p0", "j-p0")
+        tr.emit("problem_admitted", problem_id="p0", slot=0)
+        tr.emit("slo_burn", problem_id="p0", block=3, deadline_burn=0.25)
+        tr.emit("problem_converged", problem_id="p0", status="converged",
+                blocks=4)
+        code, body = _get(srv.port, "/jobs")
+        assert code == 200
+        listing = json.loads(body)
+        assert listing["schema"] == lineage.INDEX_SCHEMA
+        assert listing["enabled"] is True
+        assert [j["job_id"] for j in listing["jobs"]] == ["j-p0"]
+        code, body = _get(srv.port, "/jobs/j-p0")
+        assert code == 200
+        rec = json.loads(body)
+        assert rec["problem_id"] == "p0" and rec["state"] == "converged"
+        assert rec["blocks"] == 4 and rec["slo"] == {"deadline_burn": 0.25}
+        assert _get(srv.port, "/jobs/j-nope")[0] == 404
+        # /status: schema bump + the jobs rollup + per-problem serving
+        code, body = _get(srv.port, "/status")
+        snap = json.loads(body)
+        assert snap["schema"] == 4
+        assert snap["jobs"] == {"count": 1,
+                                "by_state": {"converged": 1}}
+    finally:
+        srv.stop()
+
+
+def test_status_serving_by_problem_and_slo_gauge():
+    from test_metrics import parse_exposition
+
+    srv = StatusServer(0, host="127.0.0.1").start()
+    try:
+        tr = RunTrace(None)
+        tr.emit("run_start", entry="sample_fleet", problems=1, chains=2)
+        tr.emit("slo_burn", problem_id="p0", block=1, deadline_burn=0.4,
+                ess_burn=0.7)
+        tr.emit("serve_request", endpoint="summary", problem_id="p0",
+                job_id="j-p0", dur_s=0.001, cache="hit", ok=True)
+        tr.emit("serve_request", endpoint="predict", problem_id="p0",
+                job_id="j-p0", dur_s=0.002, cache="hit", ok=True)
+        code, body = _get(srv.port, "/status")
+        sv = json.loads(body)["serving"]
+        assert sv["requests"] == 2 and sv["last_problem"] == "p0"
+        assert sv["by_problem"]["p0"] == {"requests": 2, "job_id": "j-p0"}
+        code, text = _get(srv.port, "/metrics")
+        samples, types = parse_exposition(text)
+        key = 'stark_job_slo_burn{budget="deadline",problem="p0"}'
+        assert samples[key] == 0.4
+        assert samples[
+            'stark_job_slo_burn{budget="ess",problem="p0"}'
+        ] == 0.7
+        assert types["stark_job_slo_burn"] == "gauge"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# single-run ambient parity + the pinned opt-out identity
+# ---------------------------------------------------------------------------
+
+_RUN_KW = dict(chains=2, block_size=30, max_blocks=2, min_blocks=2,
+               rhat_target=0.0, ess_target=1e9, num_warmup=100,
+               num_samples=1, seed=0)
+
+
+def _schools_run(tmp_path, tag):
+    path = str(tmp_path / f"{tag}.jsonl")
+    tr = RunTrace(path)
+    with telemetry.use_trace(tr):
+        res = sample_until_converged(
+            EightSchools(),
+            {"y": np.asarray(Y), "sigma": np.asarray(SIGMA)}, **_RUN_KW,
+        )
+    tr.close()
+    return res, read_trace(path)
+
+
+def test_single_run_ambient_job_parity(tmp_path):
+    """A direct runner call gets the same lineage story as a fleet
+    tenant: one job id minted at entry, every job-bearing event
+    stamped with it."""
+    _res, evs = _schools_run(tmp_path, "single")
+    jids = {
+        e["job_id"] for e in evs if e["event"] in lineage.JOB_EVENT_TYPES
+    }
+    assert len(jids) == 1
+    (jid,) = jids
+    assert jid.startswith("j-")
+    for e in evs:
+        if e["event"] in lineage.JOB_EVENT_TYPES:
+            assert e["job_id"] == jid
+        else:
+            assert "job_id" not in e
+
+
+def test_lineage_off_identical_stream_and_draws(tmp_path, monkeypatch):
+    """The pinned opt-out contract: STARK_LINEAGE=0 produces the
+    pre-lineage trace shape — no job_id/job_ids keys, no lineage-only
+    events, the remaining stream field-for-field identical — and draws
+    bit-identical either way (lineage is host-side by construction)."""
+    monkeypatch.delenv("STARK_LINEAGE", raising=False)
+    res_on, ev_on = _schools_run(tmp_path, "on")
+    lineage.reset()
+    monkeypatch.setenv("STARK_LINEAGE", "0")
+    res_off, ev_off = _schools_run(tmp_path, "off")
+    np.testing.assert_array_equal(res_on.draws_flat, res_off.draws_flat)
+    for e in ev_off:
+        assert "job_id" not in e and "job_ids" not in e
+        assert e["event"] not in ("feed_submit", "slo_burn")
+    stripped = [
+        {k: v for k, v in e.items() if k not in ("job_id", "job_ids")}
+        for e in ev_on
+        if e["event"] not in ("feed_submit", "slo_burn")
+    ]
+    assert [e["event"] for e in stripped] == [e["event"] for e in ev_off]
+    assert [sorted(e) for e in stripped] == [sorted(e) for e in ev_off]
+
+
+# ---------------------------------------------------------------------------
+# the report tool on the committed fixture (tier-1 end-to-end)
+# ---------------------------------------------------------------------------
+
+
+def _run_report(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "lineage_report.py"),
+         *args],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+
+
+def test_lineage_report_fixture_fleet_rollup():
+    p = _run_report(_FIXTURE)
+    assert p.returncode == 0, p.stderr
+    assert "tenant lineage: 2 job(s)" in p.stdout
+    assert "j-f14ae09698b1" in p.stdout  # mint_job_id("p0000", 0)
+    assert "job_id coverage" in p.stdout and "100.0%" in p.stdout
+
+
+def test_lineage_report_fixture_single_tenant_timeline():
+    p = _run_report(_FIXTURE, "--problem", "p0000")
+    assert p.returncode == 0, p.stderr
+    out = p.stdout
+    for milestone in ("submitted to feed", "admitted / placed in slot",
+                      "sampling", "slo burn", "SHARD LOST",
+                      "converged", "served"):
+        assert milestone in out, f"missing milestone: {milestone}"
+    # machine form: coverage + timeline + the per-job rollup
+    p = _run_report(_FIXTURE, "--problem", "p0000", "--json")
+    payload = json.loads(p.stdout)
+    assert payload["coverage"]["fraction"] == 1.0
+    assert payload["job"]["state"] == "converged"
+    assert payload["timeline"][0]["what"] == "submitted to feed"
+    assert payload["timeline"][-1]["what"] == "served"
+
+
+def test_lineage_report_unknown_tenant_fails_loud():
+    p = _run_report(_FIXTURE, "--job", "j-nope")
+    assert p.returncode == 1
+    assert "no lineage record matches" in p.stderr
+
+
+# ---------------------------------------------------------------------------
+# the full observatory drill (slow tier): FleetFeed tenants, one injected
+# shard loss, post-convergence serving, the report tool, and the opt-out
+# ---------------------------------------------------------------------------
+
+
+_DRILL_KW = dict(chains=2, block_size=25, max_blocks=10, min_blocks=2,
+                 num_warmup=100, ess_target=40.0, rhat_target=1.3, seed=0,
+                 kernel="hmc", num_leapfrog=12, health_check=True)
+
+
+def _drill_ds(seed):
+    rng = np.random.default_rng(seed)
+    y, sig = np.asarray(Y), np.asarray(SIGMA)
+    return {"y": (y + rng.normal(0, 2.0, y.shape)).astype(np.float32),
+            "sigma": sig}
+
+
+def _run_drill(tmp_path, tag):
+    """One lineage drill: spec(1) + three FleetFeed tenants on a
+    4-shard mesh; shard 0 (feed tenant s0000's lane after the refill
+    wave) is killed at block 8, mid-flight for that tenant."""
+    root = tmp_path / tag
+    root.mkdir()
+    trace_path = str(root / "drill.jsonl")
+    tr = RunTrace(trace_path)
+    spec = FleetSpec.from_problems(EightSchools(), [_drill_ds(0)])
+    feed = FleetFeed()
+    # pre-run submissions: the ambient trace is what carries the
+    # feed_submit record (the fleet only binds the feed's trace at run
+    # start)
+    with telemetry.use_trace(tr):
+        for i in (1, 2, 3):
+            feed.submit(_drill_ds(i), budget=ProblemBudget(
+                ess_target=40.0, deadline_s=300.0, max_restarts=2))
+    feed.close()
+    mesh = make_mesh({"problems": 4}, devices=jax.devices()[:4])
+    faults.configure("fleet.shard_dead=kill(0)*1@7")
+    try:
+        res = sample_fleet(
+            spec, mesh=mesh, feed=feed, max_batch=4,
+            problem_max_restarts=1, trace=tr,
+            checkpoint_path=str(root / "ckpt.npz"),
+            draw_store_path=str(root / "stores"), **_DRILL_KW,
+        )
+    finally:
+        faults.reset()
+    return res, root, trace_path, tr
+
+
+@pytest.mark.slow
+def test_lineage_e2e_drill(tmp_path, monkeypatch):
+    """ISSUE acceptance drill, end to end: a FleetFeed run with three
+    tenants and an injected shard loss; after convergence the victim's
+    posterior is served (summary + predict); `tools/lineage_report.py`
+    then reconstructs the single-tenant story — submit, burn, SHARD
+    LOST, reseed, converged, served — with >=95% of its tenant-
+    referencing events carrying the job id; `/jobs/<job_id>` answers
+    with the matching record; and STARK_LINEAGE=0 reruns the identical
+    schedule with bit-identical draws and a job_id-free stream."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices (conftest forces 8)")
+    monkeypatch.setenv("STARK_SHARD_DEADLINE", "4")
+    monkeypatch.delenv("STARK_LINEAGE", raising=False)
+
+    res, root, trace_path, tr = _run_drill(tmp_path, "on")
+    assert res.degraded is True and res.lost_shards == [0]
+    by_pid = {p.problem_id: p for p in res.problems}
+    assert by_pid["s0000"].status == "converged"
+
+    evs = read_trace(trace_path)
+    lost = [e for e in evs if e["event"] == "shard_lost"]
+    assert len(lost) == 1 and lost[0]["problem_ids"] == ["s0000"]
+    jid = lineage.job_for("s0000")
+    assert jid is not None and lost[0]["job_ids"] == [jid]
+    assert [e["problem_id"] for e in evs if e["event"] == "feed_submit"] \
+        == ["s0000", "s0001", "s0002"]
+
+    # ---- serving leg: the converged victim answers reads, and every
+    # serve_request carries its job id (recovered from the summary
+    # sidecar — no in-run registry needed)
+    with telemetry.use_trace(tr):
+        store = serving.PosteriorStore(str(root / "stores"))
+        summary = store.summary("s0000")
+        assert summary["job_id"] == jid
+        dim = np.asarray(store.draws("s0000")).shape[-1]
+        out = store.predict([serving.PredictRequest(
+            "s0000", x=np.zeros((2, dim), np.float32))])
+        assert len(out) == 1
+    tr.close()
+    serves = [e for e in read_trace(trace_path)
+              if e["event"] == "serve_request"]
+    assert {e["endpoint"] for e in serves} >= {"summary", "predict"}
+    for e in serves:
+        if e["problem_id"] == "s0000" or e.get("problem_ids") == ["s0000"]:
+            assert e.get("job_id") == jid or e.get("job_ids") == [jid]
+
+    # ---- /jobs/<job_id>: the live index answers with the same story
+    srv = StatusServer(0, host="127.0.0.1").start()
+    try:
+        code, body = _get(srv.port, f"/jobs/{jid}")
+        assert code == 200
+        rec = json.loads(body)
+        assert rec["problem_id"] == "s0000"
+        assert rec["state"] == "converged" and rec["status"] == "converged"
+        assert rec["shard_losses"] == 1 and rec["restarts"] == 1
+        assert rec["serves"]["summary"] >= 1
+        assert rec["serves"]["predict"] >= 1
+        assert rec["first_serve_ts"] is not None
+    finally:
+        srv.stop()
+
+    # ---- the report tool reconstructs the tenant's story
+    p = _run_report(trace_path, "--problem", "s0000",
+                    "--postmortem", str(root / "postmortem"))
+    assert p.returncode == 0, p.stderr
+    for milestone in ("submitted to feed", "slo burn",
+                      "SHARD LOST (re-homed)", "RESEED (restart)",
+                      "converged", "served"):
+        assert milestone in p.stdout, f"missing milestone: {milestone}"
+    p = _run_report(trace_path, "--problem", "s0000", "--json")
+    payload = json.loads(p.stdout)
+    assert payload["coverage"]["fraction"] >= 0.95
+    assert payload["job"]["job_id"] == jid
+    assert payload["job"]["shard_losses"] == 1
+    whats = [t["what"] for t in payload["timeline"]]
+    assert whats[0] == "submitted to feed" and whats[-1] == "served"
+    assert "SHARD LOST (re-homed)" in whats and "RESEED (restart)" in whats
+
+    # ---- opt-out rerun: same schedule, bit-identical draws, no lineage
+    lineage.reset()
+    monkeypatch.setenv("STARK_LINEAGE", "0")
+    res_off, root_off, trace_off, tr_off = _run_drill(tmp_path, "off")
+    tr_off.close()
+    assert res_off.lost_shards == [0]
+    store_on = serving.PosteriorStore(str(root / "stores"))
+    store_off = serving.PosteriorStore(str(root_off / "stores"))
+    for pid in ("p0000", "s0000", "s0001", "s0002"):
+        np.testing.assert_array_equal(
+            np.asarray(store_on.draws(pid)),
+            np.asarray(store_off.draws(pid)),
+            err_msg=f"draws differ for {pid} with lineage off",
+        )
+    ev_off = read_trace(trace_off)
+    for e in ev_off:
+        assert "job_id" not in e and "job_ids" not in e
+        assert e["event"] not in ("feed_submit", "slo_burn")
+    names_on = [e["event"] for e in read_trace(trace_path)
+                if e["event"] not in ("feed_submit", "slo_burn",
+                                      "serve_request")]
+    assert [e["event"] for e in ev_off] == names_on

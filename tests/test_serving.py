@@ -318,11 +318,12 @@ def test_statusd_posterior_endpoint_contracts(tmp_path):
                         {"x": [[1.0]]})        # k mismatch
         assert code == 400
         assert _post(srv.port, "/posterior/ghost/predict", {})[0] == 404
-        # /status grows the `serving` rollup at contract schema 3
+        # /status grows the `serving` rollup (contract schema 4 carries
+        # the lineage jobs rollup too)
         code, body = _get(srv.port, "/status")
         assert code == 200
         snap = json.loads(body)
-        assert snap["schema"] == 3
+        assert snap["schema"] == 4
         sv = snap["serving"]
         assert sv["requests"] >= 4 and sv["misses"] >= 1
         assert set(sv["by_endpoint"]) >= {"summary", "draws", "predict"}

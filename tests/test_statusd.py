@@ -129,7 +129,7 @@ def test_status_contract_schema_and_uptime(server):
     code, body = _get(server.port, "/status")
     assert code == 200
     snap = json.loads(body)
-    assert snap["schema"] == STATUS_SCHEMA == 3
+    assert snap["schema"] == STATUS_SCHEMA == 4
     assert isinstance(snap["uptime_s"], (int, float))
     assert snap["uptime_s"] >= 0
     assert "last_postmortem" in snap
@@ -150,7 +150,7 @@ def test_status_cli_json_envelope(server, capsys):
     assert out.count("\n") == 1
     env = json.loads(out)
     assert env["endpoint"] == "status" and env["code"] == 200
-    assert env["body"]["schema"] == 3
+    assert env["body"]["schema"] == 4
 
     assert cli_main(["status", "--port", port, "--healthz", "--json"]) == 0
     env = json.loads(capsys.readouterr().out)
@@ -176,7 +176,7 @@ def test_status_cli_json_envelope(server, capsys):
 
     # without --json the raw body contract is unchanged
     assert cli_main(["status", "--port", port]) == 0
-    assert json.loads(capsys.readouterr().out)["schema"] == 3
+    assert json.loads(capsys.readouterr().out)["schema"] == 4
 
 
 def test_status_cli_json_envelope_when_nothing_listens(capsys):

@@ -695,3 +695,105 @@ def test_summarize_num_divergent_ignores_unrelated_earlier_runs():
     s = summarize_trace(events)
     assert s["health"]["num_divergent"] == 1
     assert summarize_trace(events, run=1)["health"]["num_divergent"] == 9
+
+
+# ---------------------------------------------------------------------------
+# summarize_trace over heterogeneous inputs: rotated sequences, mixed
+# schema versions, torn final lines (PR 20 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_trace_over_rotated_sequence(tmp_path, monkeypatch):
+    """A rotated trace read back through `rotated_paths` + `iter_traces`
+    summarizes as ONE story: every block lands in the phase totals, the
+    `trace_rotated` markers count as ordinary auxiliary events, and the
+    run_end wall survives in whichever part it rotated into."""
+    monkeypatch.setenv("STARK_TRACE_MAX_MB", "0.001")
+    p = str(tmp_path / "t.jsonl")
+    with RunTrace(p) as tr:
+        tr.emit("run_start")
+        for b in range(40):
+            tr.emit("sample_block", block=b, dur_s=0.01, note="x" * 64)
+        tr.emit("run_end", dur_s=1.5)
+    parts = telemetry.rotated_paths(p)
+    assert len(parts) > 1, "rotation never triggered"
+    events = list(telemetry.iter_traces(parts))
+    s = summarize_trace(events)
+    assert s["phases"]["sample_block"]["count"] == 40
+    assert s["wall_s"] == 1.5
+    assert s["events"] == len(events)
+    # each fresh part opens with its rotation marker; the summary treats
+    # them as known auxiliaries (not "other"/unknown)
+    rotated = [e for e in events if e["event"] == "trace_rotated"]
+    assert len(rotated) == len(parts) - 1
+    assert s["other"] == {}
+
+
+def test_summarize_trace_mixed_schema_versions():
+    """One file holding records from different writer generations — a
+    PR-1-era record with no envelope at all, a current-schema record,
+    and a future-schema record with unknown fields — summarizes without
+    raising; unknown event families degrade into ``other``, never
+    silently vanish."""
+    events = [
+        # current writer
+        {"schema": SCHEMA_VERSION, "ts": 1.0, "wall_s": 0.0, "run": 0,
+         "event": "run_start", "entry": "sample"},
+        {"schema": SCHEMA_VERSION, "ts": 2.0, "wall_s": 0.1, "run": 0,
+         "event": "sample_block", "block": 0, "dur_s": 0.1},
+        # pre-schema (PR-1-era): no schema/run/ts envelope
+        {"event": "sample_block", "block": 1, "dur_s": 0.2},
+        # future writer: higher schema, unknown event + fields
+        {"schema": SCHEMA_VERSION + 1, "ts": 3.0, "wall_s": 0.2, "run": 0,
+         "event": "quantum_block", "qubits": 8},
+        {"schema": SCHEMA_VERSION, "ts": 4.0, "wall_s": 0.3, "run": 0,
+         "event": "run_end", "dur_s": 0.9},
+    ]
+    s = summarize_trace(events)
+    assert s["phases"]["sample_block"]["count"] == 2
+    assert s["phases"]["sample_block"]["total_s"] == pytest.approx(0.3)
+    assert s["wall_s"] == 0.9
+    assert s["other"] == {"quantum_block": 1}
+
+
+def test_summarize_trace_torn_final_line(tmp_path):
+    """A crash mid-append leaves a torn last line; the tolerant reader
+    (strict=False) skips it and the summary still covers everything
+    before the tear — the strict reader refuses, loudly."""
+    p = str(tmp_path / "t.jsonl")
+    with RunTrace(p) as tr:
+        tr.emit("run_start")
+        tr.emit("sample_block", block=0, dur_s=0.4)
+    with open(p, "a") as f:
+        f.write('{"schema": 1, "event": "run_end", "dur_s"')  # torn
+    with pytest.raises(TraceError):
+        read_trace(p)
+    events = read_trace(p, strict=False)
+    s = summarize_trace(events)
+    assert s["phases"]["sample_block"]["count"] == 1
+    # the run_end never landed: the summary falls back to the event span
+    assert s["wall_s"] == pytest.approx(
+        events[-1]["wall_s"] - events[0]["wall_s"])
+    assert s["events"] == 2
+
+
+def test_summarize_trace_torn_line_inside_rotated_part(tmp_path,
+                                                       monkeypatch):
+    """The tear can sit in a ROTATED part (the file that was live at
+    crash time is not always the live file now): `iter_traces` with
+    strict=False chains past it and later parts still contribute."""
+    monkeypatch.setenv("STARK_TRACE_MAX_MB", "0.001")
+    p = str(tmp_path / "t.jsonl")
+    with RunTrace(p) as tr:
+        tr.emit("run_start")
+        for b in range(40):
+            tr.emit("sample_block", block=b, dur_s=0.01, note="x" * 64)
+        tr.emit("run_end", dur_s=1.5)
+    parts = telemetry.rotated_paths(p)
+    assert len(parts) > 2
+    with open(parts[1], "a") as f:
+        f.write('{"event": "sample_bl')  # tear the middle part
+    events = list(telemetry.iter_traces(parts, strict=False))
+    s = summarize_trace(events)
+    assert s["phases"]["sample_block"]["count"] == 40
+    assert s["wall_s"] == 1.5

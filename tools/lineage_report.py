@@ -1,0 +1,421 @@
+#!/usr/bin/env python
+"""Reconstruct a tenant's end-to-end story from the lineage trail.
+
+    python tools/lineage_report.py /tmp/t.jsonl                  # fleet rollup
+    python tools/lineage_report.py /tmp/t.jsonl --job j-ab12...  # one tenant
+    python tools/lineage_report.py /tmp/t.jsonl --problem glm-3  # by tenant id
+    python tools/lineage_report.py /tmp/t.jsonl --fleet          # force rollup
+    python tools/lineage_report.py /tmp/t.jsonl --postmortem wd  # + pm bundles
+    python tools/lineage_report.py /tmp/t.jsonl --json           # machine form
+
+The tenant lineage observatory (``stark_tpu/lineage.py``) stamps one
+stable ``job_id`` onto every tenant-scoped event from ``feed_submit``
+through sampling, incidents (shard loss, reseed, quarantine,
+health warnings), ``problem_converged``, and — via the summary
+sidecar, across a process boundary — every ``/posterior/<id>/*``
+``serve_request``.  This tool replays that trail as a human timeline:
+
+    submit -> admitted/placed -> warm-start -> blocks (with SLO burn)
+           -> incidents -> converged -> first/Nth serve
+
+Inputs are whatever the run left behind, folded together: one or more
+trace files (rotated ``<trace>.N`` predecessors are discovered
+automatically), the atomic ``<trace>.lineage.json`` index sidecar
+(``--index``; used for the rollup when present so multi-GB traces are
+not rescanned — the timeline still reads the raw events), and
+flight-recorder postmortem bundles (``--postmortem <workdir>`` scans
+``postmortem/pm*/events.jsonl``).  Every record set also yields a
+``coverage`` fraction — the share of job-bearing event types that
+actually carry a ``job_id`` — the number the lineage E2E drill asserts
+is >= 0.95.
+
+n/a-safe by contract: a pre-lineage trace (or one written under
+``STARK_LINEAGE=0``) has no job ids and renders "no lineage evidence",
+never an error.  Stdlib + the telemetry reader only (no jax), so it
+runs anywhere the trace lands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# repo-root invocation without installation; tools/ for the shared
+# table/format helpers (one renderer idiom across the report tools)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from stark_tpu import lineage, telemetry  # noqa: E402
+from trace_report import _table  # noqa: E402
+
+#: human labels for the timeline, in the order a lifecycle unfolds
+_TIMELINE_LABELS = {
+    "feed_submit": "submitted to feed",
+    "feed_reject": "REJECTED at admission",
+    "problem_admitted": "admitted / placed in slot",
+    "slot_recycled": "slot recycled",
+    "problem_reseeded": "RESEED (restart)",
+    "problem_quarantined": "QUARANTINED",
+    "problem_converged": "converged",
+    "shard_lost": "SHARD LOST (re-homed)",
+    "checkpoint": "checkpoint",
+    "health_warning": "health warning",
+    "serve_request": "served",
+    "slo_burn": "slo burn",
+    "fault": "fault",
+}
+
+#: block-cadence event types collapsed into one "sampled N blocks" line
+#: per contiguous stretch (a 10k-block run should not print 10k rows)
+_BLOCK_EVENTS = ("warmup_block", "sample_block")
+
+
+# --------------------------------------------------------------------------
+# gathering evidence
+# --------------------------------------------------------------------------
+
+
+def gather_events(
+    traces: List[str], postmortem: Optional[str]
+) -> List[Dict[str, Any]]:
+    """All parseable records from the trace files (rotated predecessors
+    included, oldest first) plus any flight-recorder bundles."""
+    events: List[Dict[str, Any]] = []
+    for path in traces:
+        for part in telemetry.rotated_paths(path):
+            try:
+                events.extend(telemetry.iter_trace(part, strict=False))
+            except OSError:
+                continue
+    if postmortem:
+        pat = os.path.join(postmortem, "postmortem", "pm*", "events.jsonl")
+        for bundle in sorted(glob.glob(pat)):
+            try:
+                events.extend(telemetry.iter_trace(bundle, strict=False))
+            except OSError:
+                continue
+    return events
+
+
+def load_index(
+    traces: List[str], explicit: Optional[str],
+    events: List[Dict[str, Any]],
+) -> Tuple[lineage.LineageIndex, str]:
+    """The per-job rollups.
+
+    Folded fresh from the gathered events (the raw trail is the source
+    of truth, and the timeline needs a full read anyway); the
+    ``<trace>.lineage.json`` sidecar then contributes any job it knows
+    that the events no longer show — a tenant whose records were
+    rotated into a file that got pruned.  Folding the sidecar's OWN
+    counts on top of the events would double-count, so overlap always
+    resolves to the fresh fold."""
+    idx = lineage.LineageIndex().fold_events(events)
+    src = "(folded from events)"
+    candidates = (
+        [explicit] if explicit
+        else [lineage.index_path(p) for p in traces]
+    )
+    for path in candidates:
+        if path and os.path.exists(path):
+            side = lineage.LineageIndex.load(path)
+            if side is None:
+                continue
+            src = f"events + {path}"
+            for rec in side.jobs():
+                if idx.job(rec["job_id"]) is None:
+                    idx.adopt(rec)
+            break
+    return idx, src
+
+
+def coverage(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The drill's acceptance number: of all job-bearing-TYPE events
+    that reference a tenant at all, what fraction carry a job id.
+
+    An event type being in `lineage.JOB_EVENT_TYPES` says the family is
+    tenant-correlat*able* — individual instances may still be
+    fleet-global (a batch-level ``warmup_block`` phase, a
+    ``stage="fleet"`` checkpoint) and name no tenant.  Those carry
+    nothing to correlate, so they sit outside both numerator and
+    denominator; counting them would make the coverage number report
+    the fleet's emission style, not lineage's stamping fidelity."""
+    bearing = carrying = 0
+    missing: Dict[str, int] = {}
+    for e in events:
+        ev = e.get("event")
+        if ev not in lineage.JOB_EVENT_TYPES:
+            continue
+        if not any(
+            k in e
+            for k in ("problem_id", "problem_ids", "to_problem",
+                      "job_id", "job_ids")
+        ):
+            continue
+        bearing += 1
+        if e.get("job_id") is not None or e.get("job_ids") is not None:
+            carrying += 1
+        else:
+            missing[ev] = missing.get(ev, 0) + 1
+    return {
+        "job_bearing_events": bearing,
+        "carrying_job_id": carrying,
+        "fraction": round(carrying / bearing, 4) if bearing else None,
+        "missing_by_event": missing,
+    }
+
+
+# --------------------------------------------------------------------------
+# one tenant's timeline
+# --------------------------------------------------------------------------
+
+
+def _matches(e: Dict[str, Any], job_id: str) -> bool:
+    if e.get("job_id") == job_id:
+        return True
+    jids = e.get("job_ids")
+    return isinstance(jids, (list, tuple)) and job_id in jids
+
+
+def job_timeline(
+    events: List[Dict[str, Any]], job_id: str
+) -> List[Dict[str, Any]]:
+    """The tenant's story as ordered entries; contiguous block-cadence
+    stretches collapse into one summary entry each."""
+    mine = [e for e in events if _matches(e, job_id)]
+    mine.sort(key=lambda e: (e.get("ts") or 0.0))
+    out: List[Dict[str, Any]] = []
+    run: List[Dict[str, Any]] = []  # current block-event stretch
+
+    def flush():
+        if not run:
+            return
+        first, last = run[0], run[-1]
+        out.append({
+            "ts": first.get("ts"),
+            "what": "sampling",
+            "detail": (
+                f"{len(run)} block events "
+                f"(block {first.get('block')}..{last.get('block')})"
+            ),
+        })
+        run.clear()
+
+    for e in mine:
+        ev = e.get("event")
+        if ev in _BLOCK_EVENTS:
+            run.append(e)
+            continue
+        flush()
+        entry: Dict[str, Any] = {
+            "ts": e.get("ts"),
+            "what": _TIMELINE_LABELS.get(ev, ev),
+        }
+        detail = []
+        if ev == "feed_submit":
+            detail.append(f"depth={e.get('depth')}")
+            if e.get("budgeted"):
+                detail.append("budgeted")
+        elif ev == "problem_admitted":
+            if e.get("slot") is not None:
+                detail.append(f"slot={e.get('slot')}")
+            if e.get("donor") is not None:
+                detail.append(f"warm-start from donor {e.get('donor')}")
+        elif ev == "slo_burn":
+            detail.extend(
+                f"{k.replace('_burn', '')}={e[k]:.0%}"
+                for k in ("deadline_burn", "restart_burn", "ess_burn")
+                if isinstance(e.get(k), (int, float))
+            )
+        elif ev == "health_warning":
+            detail.append(str(e.get("warning")))
+            if e.get("value") is not None:
+                detail.append(f"value={e.get('value')}")
+        elif ev == "shard_lost":
+            detail.append(f"shards={e.get('lost_shards', e.get('shard'))}")
+        elif ev == "problem_converged":
+            detail.append(f"status={e.get('status')}")
+            if e.get("blocks") is not None:
+                detail.append(f"blocks={e.get('blocks')}")
+        elif ev == "serve_request":
+            detail.append(f"endpoint={e.get('endpoint')}")
+            if e.get("cache") is not None:
+                detail.append(f"cache={e.get('cache')}")
+        elif ev == "checkpoint":
+            if e.get("block") is not None:
+                detail.append(f"block={e.get('block')}")
+        if e.get("problem_id") is not None and ev in (
+            "feed_submit", "problem_admitted",
+        ):
+            detail.insert(0, f"problem={e.get('problem_id')}")
+        entry["detail"] = ", ".join(str(d) for d in detail)
+        out.append(entry)
+    flush()
+    return out
+
+
+def resolve_job(
+    idx: lineage.LineageIndex, job: Optional[str], problem: Optional[str]
+) -> Optional[str]:
+    """--job wins; --problem maps a tenant id to its job via the index."""
+    if job:
+        return job
+    if problem:
+        for rec in idx.jobs():
+            if rec.get("problem_id") == problem:
+                return rec["job_id"]
+    return None
+
+
+# --------------------------------------------------------------------------
+# rendering
+# --------------------------------------------------------------------------
+
+
+def render_fleet(idx: lineage.LineageIndex, cov: Dict[str, Any]) -> str:
+    jobs = idx.jobs()
+    out = [f"tenant lineage: {len(jobs)} job(s)"]
+    if not jobs:
+        out.append("(no lineage evidence — pre-lineage trace or "
+                   "STARK_LINEAGE=0)")
+        return "\n".join(out)
+    rows = []
+    for r in jobs:
+        serves = r.get("serves") or {}
+        rows.append((
+            r["job_id"],
+            r.get("problem_id"),
+            r.get("state"),
+            r.get("blocks"),
+            r.get("restarts"),
+            r.get("shard_losses"),
+            r.get("health_warnings"),
+            sum(v for v in serves.values() if isinstance(v, int)),
+            (f"{r['duration_s']:.1f}s"
+             if isinstance(r.get("duration_s"), (int, float)) else None),
+        ))
+    out.append("")
+    out.append(_table(
+        rows,
+        ("job", "problem", "state", "blocks", "restarts", "shard_loss",
+         "warnings", "serves", "span"),
+    ))
+    if cov["fraction"] is not None:
+        out.append("")
+        out.append(
+            f"job_id coverage: {cov['carrying_job_id']}/"
+            f"{cov['job_bearing_events']} job-bearing events "
+            f"({cov['fraction']:.1%})"
+        )
+    return "\n".join(out)
+
+
+def render_job(
+    job_id: str, rec: Optional[Dict[str, Any]],
+    timeline: List[Dict[str, Any]],
+) -> str:
+    out = [f"job {job_id}"]
+    if rec:
+        head = [
+            ("problem", rec.get("problem_id")),
+            ("state", rec.get("state")),
+            ("status", rec.get("status")),
+            ("blocks", rec.get("blocks")),
+            ("restarts", rec.get("restarts")),
+            ("shard losses", rec.get("shard_losses")),
+            ("checkpoints", rec.get("checkpoints")),
+            ("health warnings", rec.get("health_warnings")),
+            ("serves", rec.get("serves")),
+            ("span", rec.get("duration_s")),
+        ]
+        out.append("")
+        out.append(_table(
+            [(k, v) for k, v in head if v is not None], ("field", "value")
+        ))
+    if not timeline:
+        out.append("")
+        out.append("(no events carry this job id)")
+        return "\n".join(out)
+    t0 = next(
+        (e["ts"] for e in timeline if isinstance(e.get("ts"), (int, float))),
+        None,
+    )
+    rows = []
+    for e in timeline:
+        ts = e.get("ts")
+        rel = (
+            f"+{ts - t0:.2f}s"
+            if isinstance(ts, (int, float)) and t0 is not None else ""
+        )
+        rows.append((rel, e["what"], e.get("detail") or ""))
+    out.append("")
+    out.append(_table(rows, ("t", "milestone", "detail")))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("traces", nargs="+",
+                    help="JSONL trace file(s); rotated <trace>.N "
+                         "predecessors are folded in automatically")
+    ap.add_argument("--job", default=None,
+                    help="report one tenant by job id")
+    ap.add_argument("--problem", default=None,
+                    help="report one tenant by problem id")
+    ap.add_argument("--fleet", action="store_true",
+                    help="force the fleet rollup table (the default "
+                         "when no tenant is selected)")
+    ap.add_argument("--index", default=None,
+                    help="lineage index sidecar (default: "
+                         "<trace>.lineage.json when present)")
+    ap.add_argument("--postmortem", default=None,
+                    help="workdir whose postmortem/pm*/events.jsonl "
+                         "bundles should be folded in")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable report")
+    args = ap.parse_args(argv)
+
+    events = gather_events(args.traces, args.postmortem)
+    if not events:
+        print(f"{args.traces[0]}: no parseable events", file=sys.stderr)
+        return 1
+    idx, idx_src = load_index(args.traces, args.index, events)
+    cov = coverage(events)
+
+    job_id = resolve_job(idx, args.job, args.problem)
+    if (args.job or args.problem) and (
+        job_id is None or idx.job(job_id) is None
+    ):
+        sel = args.job or args.problem
+        print(f"no lineage record matches {sel!r}", file=sys.stderr)
+        return 1
+
+    if args.json:
+        payload: Dict[str, Any] = {
+            "schema": lineage.INDEX_SCHEMA,
+            "index_source": idx_src,
+            "coverage": cov,
+            "jobs": idx.jobs(),
+        }
+        if job_id is not None and not args.fleet:
+            payload["job"] = idx.job(job_id)
+            payload["timeline"] = job_timeline(events, job_id)
+        print(json.dumps(payload, indent=1, default=str))
+        return 0
+
+    if job_id is not None and not args.fleet:
+        print(render_job(job_id, idx.job(job_id),
+                         job_timeline(events, job_id)))
+        if cov["fraction"] is not None:
+            print(f"\njob_id coverage (whole trace): {cov['fraction']:.1%}")
+    else:
+        print(render_fleet(idx, cov))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,13 +59,17 @@ _READ_FUNCS = frozenset({"get", "getenv", "pop", "fused_knob"})
 #: `submit` does under load), and the posterior-serving read-plane
 #: family (STARK_SERVE_* — serving.py's LRU capacity / telemetry switch
 #: / sketch + predict caps, plus statusd's STARK_SERVE_ROOT auto-attach:
-#: each changes what a read request serves or emits) — extend the
-#: alternation when a new execution-path knob family lands
+#: each changes what a read request serves or emits), and the tenant
+#: lineage pair (STARK_LINEAGE=0 silences job_id stamping + the
+#: feed_submit/slo_burn families for byte-identical traces;
+#: STARK_TRACE_MAX_MB arms trace-file rotation, changing what lands in
+#: which file) — extend the alternation when a new execution-path knob
+#: family lands
 _KNOB_RE = re.compile(
     r"^STARK_(?:FUSED_[A-Z0-9_]+|RAGGED_NUTS|QUANT_[A-Z0-9_]+"
     r"|FLEET_SLOTS|FLEET_WARMSTART|FLEET_MESH|COMM_TELEMETRY"
-    r"|SHARD_DEADLINE|FEED_MAXDEPTH|SERVE_[A-Z0-9_]+"
-    r"|PROFILE(?:_[A-Z0-9_]+)?)$"
+    r"|SHARD_DEADLINE|FEED_MAXDEPTH|SERVE_[A-Z0-9_]+|LINEAGE"
+    r"|TRACE_MAX_MB|PROFILE(?:_[A-Z0-9_]+)?)$"
 )
 
 #: knobs the autotuner is responsible for: per-run execution-path
@@ -73,7 +77,8 @@ _KNOB_RE = re.compile(
 #: must appear in profile.CANDIDATE_SPACE (the autotuner's candidate
 #: table) — a tunable knob outside the registry silently escapes
 #: tuning.  Deliberately EXCLUDES the observability/serving switches
-#: (telemetry, serving caps, fault deadlines: they don't change which
+#: (telemetry, serving caps, fault deadlines, and the lineage pair
+#: STARK_LINEAGE / STARK_TRACE_MAX_MB: they don't change which
 #: executable a run picks) and the STARK_PROFILE* family itself (the
 #: meta-knobs that resolve the profile can't live inside one).
 _TUNABLE_RE = re.compile(

@@ -21,10 +21,19 @@ than a direct ``emit()`` call, so their event names — including the new
 ``health_warning`` family — are collected and checked too; before this,
 a typo'd anomaly event name would have slipped past the lint.
 
-AST-based (strings/comments can't trip it); `stark_tpu.telemetry` imports
-no jax at module load, so the lint runs anywhere.  Run directly
-(``python tools/lint_trace_schema.py``) or via the test suite
-(``tests/test_lint_trace_schema.py``).
+PR 20 added a second axis: the tenant lineage observatory
+(``stark_tpu/lineage.py``) partitions the registry into job_id-BEARING
+event types (`lineage.JOB_EVENT_TYPES` — tenant-correlated, the record
+annotator may stamp them) and EXEMPT ones (`lineage.EXEMPT_EVENT_TYPES`
+— process-/fleet-global, never stamped).  The lint now also fails when
+the two sets overlap, when a name in `ALL_EVENT_TYPES` sits in neither
+(a new event family cannot land without deciding its lineage story),
+or when either set classifies a name the registry doesn't know.
+
+AST-based (strings/comments can't trip it); `stark_tpu.telemetry` and
+`stark_tpu.lineage` import no jax at module load, so the lint runs
+anywhere.  Run directly (``python tools/lint_trace_schema.py``) or via
+the test suite (``tests/test_lint_trace_schema.py``).
 """
 
 from __future__ import annotations
@@ -93,23 +102,54 @@ def lint_package(pkg_dir: str) -> List[str]:
     return violations
 
 
+def lint_lineage_partition() -> List[str]:
+    """Violation strings for the lineage classification: every name in
+    `ALL_EVENT_TYPES` must be in exactly one of
+    `lineage.JOB_EVENT_TYPES` / `lineage.EXEMPT_EVENT_TYPES`, and
+    neither set may classify a name the registry doesn't know."""
+    from stark_tpu.lineage import EXEMPT_EVENT_TYPES, JOB_EVENT_TYPES
+
+    violations = []
+    for name in sorted(JOB_EVENT_TYPES & EXEMPT_EVENT_TYPES):
+        violations.append(
+            f"lineage: {name!r} is both job_id-bearing AND exempt — "
+            "pick one"
+        )
+    for name in sorted(
+        ALL_EVENT_TYPES - JOB_EVENT_TYPES - EXEMPT_EVENT_TYPES
+    ):
+        violations.append(
+            f"lineage: {name!r} is unclassified — add it to "
+            "lineage.JOB_EVENT_TYPES (tenant-correlated, annotator may "
+            "stamp job_id) or lineage.EXEMPT_EVENT_TYPES "
+            "(process-/fleet-global, never stamped)"
+        )
+    for name in sorted(
+        (JOB_EVENT_TYPES | EXEMPT_EVENT_TYPES) - ALL_EVENT_TYPES
+    ):
+        violations.append(
+            f"lineage: {name!r} is classified but missing from "
+            "telemetry.ALL_EVENT_TYPES — stale classification?"
+        )
+    return violations
+
+
 def main(argv=None) -> int:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     pkg = os.path.join(repo, "stark_tpu")
     violations = lint_package(pkg)
-    for v in violations:
-        print(v, file=sys.stderr)
     if violations:
         known = ", ".join(sorted(ALL_EVENT_TYPES))
-        print(
+        violations.append(
             f"{len(violations)} emit/phase call(s) with event names missing "
             f"from telemetry's schema registry (known: {known}) — add the "
             "event to EVENT_TYPES/AUX_EVENT_TYPES (and document it) or fix "
-            "the name (see tools/lint_trace_schema.py docstring)",
-            file=sys.stderr,
+            "the name (see tools/lint_trace_schema.py docstring)"
         )
-        return 1
-    return 0
+    violations.extend(lint_lineage_partition())
+    for v in violations:
+        print(v, file=sys.stderr)
+    return 1 if violations else 0
 
 
 if __name__ == "__main__":
